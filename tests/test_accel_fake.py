@@ -1,0 +1,69 @@
+import asyncio
+
+import pytest
+
+from tpumon.collectors.accel_fake import FAKE_TOPOLOGIES, FakeTpuCollector
+
+
+def test_topologies_shapes():
+    for topo, (kind, hosts, per_host) in FAKE_TOPOLOGIES.items():
+        c = FakeTpuCollector(topology=topo, clock=lambda: 1000.0)
+        chips = c.chips()
+        assert len(chips) == hosts * per_host, topo
+        assert all(ch.kind == kind for ch in chips)
+        assert len({ch.chip_id for ch in chips}) == len(chips)  # unique ids
+
+
+def test_v5e8_values_in_range():
+    c = FakeTpuCollector(topology="v5e-8", clock=lambda: 1234.5)
+    for ch in c.chips():
+        assert 0 <= ch.mxu_duty_pct <= 100
+        assert 0 < ch.hbm_used <= ch.hbm_total
+        assert ch.hbm_total == 16 * 1024**3
+        assert 30 < ch.temp_c < 90
+        assert ch.ici_tx_bytes > 0 and ch.ici_link_up
+
+
+def test_deterministic_given_time():
+    a = FakeTpuCollector(topology="v5e-8", clock=lambda: 500.0).chips()
+    b = FakeTpuCollector(topology="v5e-8", clock=lambda: 500.0).chips()
+    assert [c.mxu_duty_pct for c in a] == [c.mxu_duty_pct for c in b]
+
+
+def test_ici_counters_monotonic():
+    t = [100.0]
+    c = FakeTpuCollector(topology="v5e-1", clock=lambda: t[0])
+    first = c.chips()[0].ici_tx_bytes
+    t[0] = 110.0
+    second = c.chips()[0].ici_tx_bytes
+    assert second > first
+
+
+def test_kill_host_fault_injection():
+    c = FakeTpuCollector(topology="v5p-64")
+    assert len(c.chips()) == 64
+    c.kill_host("tpu-host-3")
+    chips = c.chips()
+    assert len(chips) == 60
+    assert not any(ch.host == "tpu-host-3" for ch in chips)
+    c.revive_host("tpu-host-3")
+    assert len(c.chips()) == 64
+
+
+def test_override_injection():
+    c = FakeTpuCollector(topology="v5e-8")
+    cid = "tpu-host-0/chip-2"
+    c.set_override(cid, mxu_duty_pct=0.5, ici_link_up=False)
+    chips = {ch.chip_id: ch for ch in c.chips()}
+    assert chips[cid].mxu_duty_pct == 0.5
+    assert chips[cid].ici_link_up is False
+
+
+def test_unknown_topology_rejected():
+    with pytest.raises(ValueError):
+        FakeTpuCollector(topology="v99-1")
+
+
+def test_collect_sample_envelope():
+    s = asyncio.run(FakeTpuCollector(topology="v5e-4").collect())
+    assert s.ok and s.source == "accel" and len(s.data) == 4

@@ -1,0 +1,161 @@
+"""Alert-engine table tests (SURVEY §4.2): every threshold boundary of
+§2.2 plus the stateful pod transitions across successive evaluations."""
+
+from tpumon.alerts import AlertEngine
+from tpumon.config import Thresholds, TriLevel
+from tpumon.topology import ChipSample, slice_views
+
+
+def chip(i=0, **kw):
+    defaults = dict(
+        chip_id=f"h0/chip-{i}",
+        host="h0",
+        slice_id="s0",
+        index=i,
+        kind="v5e",
+        mxu_duty_pct=60.0,
+        hbm_used=8 * 2**30,
+        hbm_total=16 * 2**30,
+        temp_c=50.0,
+        ici_link_up=True,
+    )
+    defaults.update(kw)
+    return ChipSample(**defaults)
+
+
+def host(cpu=10.0, mem=10.0, disk=10.0):
+    return {
+        "cpu": {"percent": cpu},
+        "memory": {"percent": mem},
+        "disk": {"percent": disk},
+    }
+
+
+def keys(result):
+    return {a["key"] for sev in result.values() for a in sev if isinstance(sev, list)}
+
+
+def test_host_threshold_table():
+    e = AlertEngine()
+    # Reference thresholds 70/85/95 (monitor_server.js:163-175).
+    cases = [
+        (69.9, None),
+        (70.1, "minor"),
+        (85.1, "serious"),
+        (95.1, "critical"),
+    ]
+    for value, sev in cases:
+        r = e.evaluate(host=host(cpu=value))
+        found = [s for s in ("minor", "serious", "critical") if r[s]]
+        assert found == ([sev] if sev else []), (value, r)
+        if sev:
+            a = r[sev][0]
+            assert a["title"] and a["desc"] and a["fix"]  # remediation text
+
+
+def test_all_host_signals_alert_independently():
+    r = AlertEngine().evaluate(host=host(cpu=96, mem=86, disk=71))
+    assert "host.cpu.critical" in keys(r)
+    assert "host.memory.serious" in keys(r)
+    assert "host.disk.minor" in keys(r)
+
+
+def test_per_chip_hbm_not_just_device0():
+    """The reference only inspected gpuMetrics[0] (monitor_server.js:178);
+    tpumon must alert on any chip."""
+    chips = [chip(0, hbm_used=1 * 2**30), chip(5, hbm_used=int(15.5 * 2**30))]
+    r = AlertEngine().evaluate(chips=chips)
+    assert "chip.h0/chip-5.hbm.critical" in keys(r)
+    assert not any("chip-0" in k for k in keys(r))
+
+
+def test_chip_temp_thresholds():
+    r = AlertEngine().evaluate(chips=[chip(temp_c=76)])
+    assert "chip.h0/chip-0.temp.serious" in keys(r)
+    r = AlertEngine().evaluate(chips=[chip(temp_c=86)])
+    assert "chip.h0/chip-0.temp.critical" in keys(r)
+
+
+def test_stalled_chip_rule():
+    # HBM committed + MXU idle => stalled (serious)
+    r = AlertEngine().evaluate(chips=[chip(mxu_duty_pct=1.0, hbm_used=10 * 2**30)])
+    assert "chip.h0/chip-0.stalled" in keys(r)
+    # idle MXU with low HBM is fine (idle chip, not stalled job)
+    r = AlertEngine().evaluate(chips=[chip(mxu_duty_pct=1.0, hbm_used=1 * 2**30)])
+    assert "chip.h0/chip-0.stalled" not in keys(r)
+
+
+def test_ici_link_down_critical():
+    r = AlertEngine().evaluate(chips=[chip(ici_link_up=False)])
+    assert "chip.h0/chip-0.ici_down" in keys(r)
+    assert r["critical"]
+
+
+def test_slice_missing_chips_critical():
+    chips = [chip(i) for i in range(6)]
+    views = slice_views(chips, {"s0": 8})
+    r = AlertEngine().evaluate(slices=views)
+    assert "slice.s0.missing" in keys(r)
+    a = r["critical"][0]
+    assert "6/8" in a["desc"]
+
+
+def test_pod_rules_and_transitions():
+    e = AlertEngine()
+    pods_t0 = [
+        {"namespace": "d", "name": "a", "status": "Pending", "restarts": 0},
+        {"namespace": "d", "name": "b", "status": "Running", "restarts": 1},
+        {"namespace": "d", "name": "c", "status": "Failed", "restarts": 0},
+    ]
+    r = e.evaluate(pods=pods_t0)
+    ks = keys(r)
+    assert "pod.d/a.pending" in ks  # serious (monitor_server.js:229-231)
+    assert "pod.d/c.failed" in ks  # critical (monitor_server.js:227-228)
+    assert "pod.d/a.recovered" not in ks  # no previous state yet
+
+    pods_t1 = [
+        {"namespace": "d", "name": "a", "status": "Running", "restarts": 0},
+        {"namespace": "d", "name": "b", "status": "Running", "restarts": 3},
+        {"namespace": "d", "name": "c", "status": "Failed", "restarts": 0},
+    ]
+    r = e.evaluate(pods=pods_t1)
+    ks = keys(r)
+    assert "pod.d/a.recovered" in ks  # non-Running -> Running (:201-207)
+    assert "pod.d/b.restarted" in ks  # restart count up (:210-215)
+    # Transition alerts fire once, persistent ones keep firing.
+    r = e.evaluate(pods=pods_t1)
+    ks = keys(r)
+    assert "pod.d/a.recovered" not in ks
+    assert "pod.d/b.restarted" not in ks
+    assert "pod.d/c.failed" in ks
+
+
+def test_crashloop_detected_from_reason():
+    r = AlertEngine().evaluate(
+        pods=[
+            {
+                "namespace": "d",
+                "name": "x",
+                "status": "Running",
+                "reason": "CrashLoopBackOff",
+                "restarts": 7,
+            }
+        ]
+    )
+    assert "pod.d/x.crashloop" in keys(r)
+
+
+def test_serving_target_down():
+    r = AlertEngine().evaluate(serving=[{"target": "t1", "ok": False, "error": "boom"}])
+    assert "serving.t1.down" in keys(r)
+
+
+def test_custom_thresholds_respected():
+    e = AlertEngine(Thresholds(cpu_pct=TriLevel(10, 20, 30)))
+    r = e.evaluate(host=host(cpu=25))
+    assert "host.cpu.serious" in keys(r)
+
+
+def test_empty_inputs_no_alerts():
+    r = AlertEngine().evaluate()
+    assert all(not v for k, v in r.items())

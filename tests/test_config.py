@@ -1,0 +1,92 @@
+import json
+
+import pytest
+
+from tpumon.config import Config, Thresholds, TriLevel, load_config, parse_duration
+
+
+def test_defaults_match_reference_constants():
+    cfg = Config()
+    # Reference parity: port 8888 (monitor_server.js:10), 30m/30s history
+    # (monitor_server.js:38), 70/85/95 thresholds (monitor_server.js:163-184).
+    assert cfg.port == 8888
+    assert cfg.history_window_s == 1800
+    assert cfg.history_step_s == 30
+    assert cfg.thresholds.cpu_pct == TriLevel(70, 85, 95)
+    assert cfg.thresholds.temp_c == TriLevel(None, 75, 85)
+
+
+def test_parse_duration():
+    assert parse_duration("30m") == 1800
+    assert parse_duration("45s") == 45
+    assert parse_duration("2h") == 7200
+    assert parse_duration("1d") == 86400
+    assert parse_duration(90) == 90
+    assert parse_duration("bogus", default=1800) == 1800
+    with pytest.raises(ValueError):
+        parse_duration("bogus")
+
+
+def test_trilevel_severity_boundaries():
+    t = TriLevel(70, 85, 95)
+    # Strict > comparisons like the reference (monitor_server.js:163-175).
+    assert t.severity(70) is None
+    assert t.severity(70.1) == "minor"
+    assert t.severity(85) == "minor"
+    assert t.severity(85.1) == "serious"
+    assert t.severity(95) == "serious"
+    assert t.severity(95.1) == "critical"
+    t2 = TriLevel(None, 75, 85)
+    assert t2.severity(74) is None
+    assert t2.severity(76) == "serious"
+    assert t2.severity(86) == "critical"
+
+
+def test_load_config_file_env_overrides(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(
+        json.dumps(
+            {
+                "port": 9000,
+                "history_window": "1h",
+                "collectors": ["host", "accel"],
+                "thresholds": {"cpu_pct": [60, 80, 90], "temp_c": [70, 80]},
+                "expected_slice_chips": {"slice-0": 8},
+            }
+        )
+    )
+    cfg = load_config(
+        path=str(p),
+        env={"TPUMON_ACCEL_BACKEND": "fake:v5e-8", "TPUMON_PORT": "9100"},
+    )
+    assert cfg.port == 9100  # env beats file
+    assert cfg.history_window_s == 3600
+    assert cfg.collectors == ("host", "accel")
+    assert cfg.accel_backend == "fake:v5e-8"
+    assert cfg.thresholds.cpu_pct == TriLevel(60, 80, 90)
+    assert cfg.thresholds.temp_c == TriLevel(None, 70, 80)
+    assert cfg.expected_slice_chips == {"slice-0": 8}
+
+
+def test_load_config_env_lists_and_unknown_key():
+    cfg = load_config(env={"TPUMON_SERVING_TARGETS": "http://a:9000, http://b:9000"})
+    assert cfg.serving_targets == ("http://a:9000", "http://b:9000")
+    with pytest.raises(ValueError):
+        load_config(env={"TPUMON_NO_SUCH_KEY": "1"})
+
+
+def test_effective_cpu_count_autodetect():
+    assert Config(cpu_count=4).effective_cpu_count() == 4
+    assert Config().effective_cpu_count() >= 1
+
+
+def test_scalar_for_trilevel_threshold_rejected():
+    """A bare number for a TriLevel threshold must fail at load time, not
+    crash the alert engine later (code-review finding)."""
+    with pytest.raises(ValueError):
+        load_config(env={"TPUMON_THRESHOLDS": json.dumps({"cpu_pct": 90})})
+    with pytest.raises(ValueError):
+        load_config(env={"TPUMON_THRESHOLDS": json.dumps({"mxu_idle_pct": [1, 2, 3]})})
+    # scalar for scalar field is fine
+    cfg = load_config(env={"TPUMON_THRESHOLDS": json.dumps({"mxu_idle_pct": 2.5})})
+    assert cfg.thresholds.mxu_idle_pct == 2.5

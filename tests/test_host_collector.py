@@ -1,0 +1,80 @@
+"""Golden-input tests for the host collector (SURVEY §4.1: each collector
+is a thin parser over an external format; shapes from monitor_server.js:68-79)."""
+
+import asyncio
+
+import pytest
+
+from tpumon.collectors.host import HostCollector, parse_meminfo, _read_proc_stat_cpu
+
+MEMINFO = """\
+MemTotal:       16384000 kB
+MemFree:         2048000 kB
+MemAvailable:    8192000 kB
+Buffers:          512000 kB
+Cached:          4096000 kB
+"""
+
+LOADAVG = "2.45 1.80 1.20 3/1234 56789\n"
+
+STAT_T0 = "cpu  1000 50 500 8000 200 0 50 0 0 0\ncpu0 500 25 250 4000 100 0 25 0 0 0\n"
+# +300 busy (user+system), +700 total
+STAT_T1 = "cpu  1250 50 550 8400 200 0 50 0 0 0\ncpu0 625 25 275 4200 100 0 25 0 0 0\n"
+
+
+def make_proc(tmp_path, stat=STAT_T0):
+    (tmp_path / "meminfo").write_text(MEMINFO)
+    (tmp_path / "loadavg").write_text(LOADAVG)
+    (tmp_path / "stat").write_text(stat)
+    return str(tmp_path)
+
+
+def test_parse_meminfo_units():
+    mi = parse_meminfo(MEMINFO)
+    assert mi["MemTotal"] == 16384000 * 1024
+    assert mi["MemAvailable"] == 8192000 * 1024
+
+
+def test_proc_stat_cpu_line():
+    busy, total = _read_proc_stat_cpu(STAT_T0)
+    assert total == 1000 + 50 + 500 + 8000 + 200 + 0 + 50 + 0
+    assert busy == total - 8000 - 200
+
+
+def test_host_collect_golden(tmp_path):
+    c = HostCollector(cpu_count=8, proc_root=make_proc(tmp_path))
+    s = asyncio.run(c.collect())
+    assert s.ok
+    # First sample: load-based estimate (reference formula with real cores,
+    # monitor_server.js:76).
+    assert s.data["cpu"]["load_1min"] == 2.45
+    assert s.data["cpu"]["percent"] == pytest.approx(100 * 2.45 / 8, abs=0.1)
+    mem = s.data["memory"]
+    assert mem["total"] == 16384000 * 1024
+    assert mem["percent"] == pytest.approx(50.0, abs=0.1)
+    disk = s.data["disk"]
+    assert disk["total"] > 0 and 0 <= disk["percent"] <= 100
+
+
+def test_host_cpu_percent_from_stat_delta(tmp_path):
+    proc = make_proc(tmp_path)
+    c = HostCollector(cpu_count=8, proc_root=proc)
+    asyncio.run(c.collect())
+    (tmp_path / "stat").write_text(STAT_T1)
+    s = asyncio.run(c.collect())
+    # busy delta = 300, total delta = 700
+    assert s.data["cpu"]["percent"] == pytest.approx(100 * 300 / 700, abs=0.1)
+
+
+def test_host_degrades_per_subsource(tmp_path):
+    """Reference contract: errors degrade to empty objects, not a crash
+    (monitor_server.js:80) — but tpumon records the error."""
+    (tmp_path / "loadavg").write_text(LOADAVG)
+    (tmp_path / "stat").write_text(STAT_T0)
+    # no meminfo file
+    c = HostCollector(cpu_count=8, proc_root=str(tmp_path))
+    s = asyncio.run(c.collect())
+    assert not s.ok
+    assert s.data["memory"] == {}
+    assert s.data["cpu"]["load_1min"] == 2.45  # other sub-sources still work
+    assert "memory" in s.error

@@ -1,0 +1,57 @@
+from tpumon.topology import ChipSample, normalize_chip_kind, slice_views
+
+
+def chip(i, host="h0", slice_id="s0", **kw):
+    defaults = dict(
+        chip_id=f"{host}/chip-{i}",
+        host=host,
+        slice_id=slice_id,
+        index=i,
+        kind="v5e",
+        mxu_duty_pct=50.0,
+        hbm_used=8 * 2**30,
+        hbm_total=16 * 2**30,
+    )
+    defaults.update(kw)
+    return ChipSample(**defaults)
+
+
+def test_normalize_chip_kind():
+    assert normalize_chip_kind("TPU v5 lite") == "v5e"
+    assert normalize_chip_kind("TPU v5p") == "v5p"
+    assert normalize_chip_kind("TPU v4") == "v4"
+    assert normalize_chip_kind("TPU v6e") == "v6e"
+
+
+def test_hbm_pct():
+    assert chip(0).hbm_pct == 50.0
+    assert chip(0, hbm_used=None).hbm_pct is None
+    assert chip(0, hbm_total=None).hbm_pct is None
+
+
+def test_slice_views_rollup():
+    chips = [chip(i, host=f"h{i // 2}") for i in range(4)]
+    views = slice_views(chips, expected={"s0": 8})
+    assert len(views) == 1
+    v = views[0]
+    assert v.reporting_chips == 4
+    assert v.expected_chips == 8
+    assert v.missing_chips == 4
+    assert sorted(v.hosts) == ["h0", "h1"]
+    assert v.mean("mxu_duty_pct") == 50.0
+
+
+def test_slice_views_absent_expected_slice():
+    views = slice_views([], expected={"ghost": 16})
+    assert len(views) == 1
+    assert views[0].slice_id == "ghost"
+    assert views[0].missing_chips == 16
+
+
+def test_slice_json_shape():
+    v = slice_views([chip(0)], expected={})[0]
+    j = v.to_json()
+    assert j["slice"] == "s0"
+    assert j["reporting_chips"] == 1
+    assert j["missing_chips"] == 0
+    assert j["mean_hbm_pct"] == 50.0

@@ -1,0 +1,33 @@
+"""tpumon — a TPU-native cluster monitoring framework.
+
+Re-implements, TPU-first, the capabilities of the reference dashboard
+``fuqiangfeng96-web/k8s-llm-monitor`` (a Node.js + browser K8s LLM monitor,
+see /root/reference/monitor_server.js and monitor.html):
+
+- live host metric cards           (reference: monitor_server.js:66-81)
+- live accelerator metric cards    (reference: monitor_server.js:83-95, nvidia-smi)
+- Kubernetes pod table             (reference: monitor_server.js:97-114, kubectl)
+- 30-min history charts            (reference: monitor_server.js:117-154, PromQL)
+- three-tier alert engine          (reference: monitor_server.js:156-238)
+- single self-contained dashboard  (reference: monitor.html)
+
+The NVIDIA data path (nvidia-smi shell-out, DCGM exporter, DCGM_FI_DEV_*
+series) is replaced by a TPU-native one: per-chip MXU duty cycle, HBM
+usage and ICI link traffic read in-process, exported as tpu_* Prometheus
+series by an in-tree exporter, with chip->host->slice topology as a
+first-class data model and JetStream/MaxText serving-metrics ingest.
+
+Architectural divergences from the reference (deliberate, per SURVEY.md):
+- async collectors + a single background sampler own all state; HTTP
+  handlers only read snapshots (fixes the reference's event-loop blocking
+  execSync calls and its lastPodStates data race, monitor_server.js:157,235).
+- per-chip alerting (the reference only inspects device 0,
+  monitor_server.js:178).
+- in-process ring-buffer history as a degraded mode so the dashboard works
+  without Prometheus.
+- explicit per-source health instead of indistinguishable empty payloads.
+"""
+
+__version__ = "0.1.0"
+
+from tpumon.config import Config, load_config  # noqa: F401

@@ -1,0 +1,3 @@
+from tpumon.app import main
+
+raise SystemExit(main())

@@ -1,0 +1,342 @@
+"""Three-tier alert engine, re-keyed for TPU.
+
+Reference parity (monitor_server.js:156-238 ``checkAlerts``): severity
+buckets ``{minor, serious, critical}`` of ``{title, desc, fix}`` alerts
+(``fix`` is human remediation advice), with threshold rules (SURVEY §2.2)
+and stateful pod-transition detection (recovered / restarted).
+
+Deliberate fixes over the reference:
+- **Per-chip** accelerator rules — the reference inspected only device 0
+  (monitor_server.js:178); a v5e-8 has 8 chips.
+- **Server-side sampling** — the reference updated its transition cache
+  inside the request handler (monitor_server.js:235), so detection
+  depended on client polling and concurrent clients raced on shared
+  state (SURVEY §5.2). Here the engine is owned by the background
+  sampler; requests only read the last evaluation.
+- TPU-only rules: stalled-chip (HBM committed but MXU idle), ICI link
+  down, and slice-failure (expected chips missing) per SURVEY §2.2's
+  north-star re-keying.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from tpumon.config import Thresholds
+from tpumon.topology import ChipSample, SliceView
+
+SEVERITIES = ("minor", "serious", "critical")
+
+
+@dataclass(frozen=True)
+class Alert:
+    severity: str
+    title: str
+    desc: str
+    fix: str
+    key: str  # stable identity for dedup/testing
+
+    def to_json(self) -> dict:
+        return {
+            "severity": self.severity,
+            "title": self.title,
+            "desc": self.desc,
+            "fix": self.fix,
+            "key": self.key,
+        }
+
+
+def _bucketize(alerts: Iterable[Alert]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {s: [] for s in SEVERITIES}
+    for a in alerts:
+        out[a.severity].append(a.to_json())
+    return out
+
+
+_SEV_LABEL = {"minor": "notice", "serious": "high", "critical": "critical"}
+
+
+class AlertEngine:
+    def __init__(self, thresholds: Thresholds | None = None):
+        self.t = thresholds or Thresholds()
+        # Pod transition state (reference: module-global lastPodStates,
+        # monitor_server.js:157 — here private to the engine, which is
+        # only driven by the sampler).
+        self._last_pods: dict[str, dict] | None = None
+        self._last_eval: dict[str, list[dict]] = _bucketize([])
+        self._last_eval_ts: float | None = None
+
+    # ---------------- host rules (monitor_server.js:162-175) -------------
+
+    def _host_alerts(self, host: dict | None) -> list[Alert]:
+        alerts: list[Alert] = []
+        if not host:
+            return alerts
+        checks = (
+            (
+                "cpu",
+                (host.get("cpu") or {}).get("percent"),
+                self.t.cpu_pct,
+                "CPU usage",
+                "Identify hot processes (top/pidstat); rebalance or scale out "
+                "CPU-bound preprocessing and data-loading work.",
+            ),
+            (
+                "memory",
+                (host.get("memory") or {}).get("percent"),
+                self.t.memory_pct,
+                "Memory usage",
+                "Find the largest consumers (ps --sort=-rss); lower host-side "
+                "cache sizes or move work off this host before the OOM killer "
+                "does it for you.",
+            ),
+            (
+                "disk",
+                (host.get("disk") or {}).get("percent"),
+                self.t.disk_pct,
+                "Disk usage",
+                "Clear old checkpoints/logs or expand the volume; full disks "
+                "break checkpoint writes and pod scheduling.",
+            ),
+        )
+        for key, value, tri, label, fix in checks:
+            if value is None:
+                continue
+            sev = tri.severity(float(value))
+            if sev:
+                alerts.append(
+                    Alert(
+                        severity=sev,
+                        title=f"{label} {_SEV_LABEL[sev]}",
+                        desc=f"{label} at {float(value):.1f}% "
+                        f"(threshold {getattr(tri, sev)}%)",
+                        fix=fix,
+                        key=f"host.{key}.{sev}",
+                    )
+                )
+        return alerts
+
+    # ------------- per-chip rules (re-keyed monitor_server.js:178-184) ----
+
+    def _chip_alerts(self, chips: list[ChipSample]) -> list[Alert]:
+        alerts: list[Alert] = []
+        for c in chips:
+            hbm = c.hbm_pct
+            if hbm is not None:
+                sev = self.t.hbm_pct.severity(hbm)
+                if sev:
+                    alerts.append(
+                        Alert(
+                            severity=sev,
+                            title=f"HBM pressure on {c.chip_id}",
+                            desc=f"HBM at {hbm:.1f}% "
+                            f"({(c.hbm_used or 0) / 2**30:.1f} / "
+                            f"{(c.hbm_total or 0) / 2**30:.1f} GiB)",
+                            fix="Reduce batch size or sequence length, shard the "
+                            "model over more chips, or enable rematerialization "
+                            "(jax.checkpoint) to trade FLOPs for HBM.",
+                            key=f"chip.{c.chip_id}.hbm.{sev}",
+                        )
+                    )
+            if c.temp_c is not None:
+                sev = self.t.temp_c.severity(c.temp_c)
+                if sev:
+                    alerts.append(
+                        Alert(
+                            severity=sev,
+                            title=f"Temperature {_SEV_LABEL[sev]} on {c.chip_id}",
+                            desc=f"Chip at {c.temp_c:.0f}°C "
+                            f"(threshold {getattr(self.t.temp_c, sev)}°C)",
+                            fix="Check node cooling/airflow and ambient temp; "
+                            "sustained thermal throttling degrades step time "
+                            "before it damages hardware.",
+                            key=f"chip.{c.chip_id}.temp.{sev}",
+                        )
+                    )
+            # Stalled-chip rule: HBM heavily committed but MXU ~idle ⇒ the
+            # job holds memory without computing (wedged collective, host
+            # input stall, deadlock).
+            if (
+                c.mxu_duty_pct is not None
+                and hbm is not None
+                and hbm > self.t.mxu_idle_hbm_gate_pct
+                and c.mxu_duty_pct < self.t.mxu_idle_pct
+            ):
+                alerts.append(
+                    Alert(
+                        severity="serious",
+                        title=f"Chip {c.chip_id} stalled",
+                        desc=f"HBM {hbm:.0f}% committed but MXU duty cycle only "
+                        f"{c.mxu_duty_pct:.1f}%",
+                        fix="The job holds memory but isn't computing: look for "
+                        "a host-side input bottleneck, a hung collective "
+                        "(one host of the slice down?), or a deadlocked step.",
+                        key=f"chip.{c.chip_id}.stalled",
+                    )
+                )
+            if c.ici_link_up is False:
+                alerts.append(
+                    Alert(
+                        severity="critical",
+                        title=f"ICI link down on {c.chip_id}",
+                        desc="Inter-chip interconnect link reports down; "
+                        "collectives crossing it will hang or fail.",
+                        fix="Drain the slice and file a hardware case; a single "
+                        "bad ICI link poisons every collective in the slice.",
+                        key=f"chip.{c.chip_id}.ici_down",
+                    )
+                )
+        return alerts
+
+    # ------------- slice rules (SURVEY §2.2 TPU re-keying) ----------------
+
+    def _slice_alerts(self, slices: list[SliceView]) -> list[Alert]:
+        alerts: list[Alert] = []
+        for s in slices:
+            if s.expected_chips and s.missing_chips > 0:
+                alerts.append(
+                    Alert(
+                        severity="critical",
+                        title=f"Slice {s.slice_id} unhealthy",
+                        desc=f"{s.reporting_chips}/{s.expected_chips} chips "
+                        f"reporting ({s.missing_chips} missing) across hosts "
+                        f"{', '.join(s.hosts) or 'none'}",
+                        fix="A multi-host slice is all-or-nothing: check the "
+                        "non-reporting hosts' pods/VMs and restart the slice "
+                        "job from the last checkpoint once all hosts are back.",
+                        key=f"slice.{s.slice_id}.missing",
+                    )
+                )
+        return alerts
+
+    # ------------- pod rules (monitor_server.js:188-232) ------------------
+
+    def _pod_alerts(self, pods: list[dict] | None) -> list[Alert]:
+        alerts: list[Alert] = []
+        if pods is None:
+            return alerts
+        current: dict[str, dict] = {
+            f"{p.get('namespace')}/{p.get('name')}": p for p in pods
+        }
+        prev = self._last_pods
+        for full_name, p in current.items():
+            status = p.get("status")
+            reason = p.get("reason")
+            if status in ("Failed", "Error") or reason in ("Error", "OOMKilled"):
+                alerts.append(
+                    Alert(
+                        severity="critical",
+                        title=f"Pod {full_name} failed",
+                        desc=f"Pod in {status}"
+                        + (f" ({reason})" if reason else ""),
+                        fix="kubectl describe / logs the pod; fix the image, "
+                        "config or OOM cause, then delete the pod so its "
+                        "controller recreates it.",
+                        key=f"pod.{full_name}.failed",
+                    )
+                )
+            elif reason == "CrashLoopBackOff":
+                alerts.append(
+                    Alert(
+                        severity="critical",
+                        title=f"Pod {full_name} crash-looping",
+                        desc="Container repeatedly crashing (CrashLoopBackOff)",
+                        fix="kubectl logs --previous to see the crash; fix the "
+                        "startup error before restart backoff masks it.",
+                        key=f"pod.{full_name}.crashloop",
+                    )
+                )
+            elif status == "Pending":
+                alerts.append(
+                    Alert(
+                        severity="serious",
+                        title=f"Pod {full_name} pending",
+                        desc="Pod unscheduled or pulling images"
+                        + (f" ({reason})" if reason else ""),
+                        fix="kubectl describe pod for scheduling events — for "
+                        "TPU pods, usually no free chips of the requested "
+                        "topology or a missing node selector/toleration.",
+                        key=f"pod.{full_name}.pending",
+                    )
+                )
+            if prev is not None:
+                was = prev.get(full_name)
+                if was is not None:
+                    if was.get("status") != "Running" and status == "Running":
+                        alerts.append(
+                            Alert(
+                                severity="serious",
+                                title=f"Pod {full_name} recovered",
+                                desc=f"Transitioned {was.get('status')} → Running",
+                                fix="Confirm the workload resumed cleanly (for "
+                                "training jobs: restored from the latest "
+                                "checkpoint, step counter advancing).",
+                                key=f"pod.{full_name}.recovered",
+                            )
+                        )
+                    if (p.get("restarts") or 0) > (was.get("restarts") or 0):
+                        alerts.append(
+                            Alert(
+                                severity="serious",
+                                title=f"Pod {full_name} restarted",
+                                desc=f"Restart count {was.get('restarts')} → "
+                                f"{p.get('restarts')}",
+                                fix="kubectl logs --previous for the terminated "
+                                "container; repeated restarts on TPU pods "
+                                "often mean device OOM or preemption.",
+                                key=f"pod.{full_name}.restarted",
+                            )
+                        )
+        self._last_pods = current
+        return alerts
+
+    # ------------- serving rules (BASELINE config 4) ----------------------
+
+    def _serving_alerts(self, serving: list[dict] | None) -> list[Alert]:
+        alerts: list[Alert] = []
+        for s in serving or []:
+            if not s.get("ok"):
+                alerts.append(
+                    Alert(
+                        severity="serious",
+                        title=f"Serving target {s.get('target')} unreachable",
+                        desc=str(s.get("error", "scrape failed")),
+                        fix="Check the JetStream/MaxText server process and its "
+                        "metrics port; an unreachable target usually means "
+                        "the server crashed or the port mapping changed.",
+                        key=f"serving.{s.get('target')}.down",
+                    )
+                )
+        return alerts
+
+    # ----------------------------------------------------------------------
+
+    def evaluate(
+        self,
+        host: dict | None = None,
+        chips: list[ChipSample] | None = None,
+        slices: list[SliceView] | None = None,
+        pods: list[dict] | None = None,
+        serving: list[dict] | None = None,
+        update_pod_state: bool = True,
+    ) -> dict[str, list[dict]]:
+        alerts: list[Alert] = []
+        alerts += self._host_alerts(host)
+        alerts += self._chip_alerts(chips or [])
+        alerts += self._slice_alerts(slices or [])
+        if update_pod_state:
+            alerts += self._pod_alerts(pods)
+        alerts += self._serving_alerts(serving)
+        self._last_eval = _bucketize(alerts)
+        self._last_eval_ts = time.time()
+        return self._last_eval
+
+    @property
+    def last(self) -> dict[str, list[dict]]:
+        return self._last_eval
+
+    @property
+    def last_ts(self) -> float | None:
+        return self._last_eval_ts

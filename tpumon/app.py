@@ -1,0 +1,128 @@
+"""Application wiring and entrypoint (``python -m tpumon``).
+
+Reference startup (SURVEY §3.1): read HTML, create server, listen — no
+config, no health check, no graceful shutdown (monitor_server.js:241-298).
+tpumon adds all three: config via file/env (tpumon.config), /api/health,
+and SIGINT/SIGTERM-driven orderly shutdown of the sampler and server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import signal
+import sys
+
+from tpumon.alerts import AlertEngine
+from tpumon.collectors.accel import make_accel_collector
+from tpumon.collectors.host import HostCollector
+from tpumon.collectors.k8s import K8sCollector
+from tpumon.collectors.serving import ServingCollector
+from tpumon.config import Config, load_config
+from tpumon.history import HistoryService, RingHistory
+from tpumon.sampler import Sampler
+from tpumon.server import MonitorServer
+
+
+def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
+    """Construct the collector/sampler/server graph for a config."""
+    enabled = set(cfg.collectors)
+    host = (
+        HostCollector(cpu_count=cfg.cpu_count, disk_mounts=cfg.disk_mounts)
+        if "host" in enabled
+        else None
+    )
+    accel = make_accel_collector(cfg) if "accel" in enabled else None
+    k8s = (
+        K8sCollector(mode=cfg.k8s_mode, api_url=cfg.k8s_api_url)
+        if "k8s" in enabled and cfg.k8s_mode != "none"
+        else None
+    )
+    serving = (
+        ServingCollector(targets=cfg.serving_targets)
+        if "serving" in enabled and cfg.serving_targets
+        else None
+    )
+    ring = RingHistory(window_s=cfg.history_window_s)
+    sampler = Sampler(
+        cfg,
+        host=host,
+        accel=accel,
+        k8s=k8s,
+        serving=serving,
+        history=ring,
+        engine=AlertEngine(cfg.thresholds),
+    )
+    history = HistoryService(
+        ring,
+        prometheus_url=cfg.prometheus_url,
+        window_s=cfg.history_window_s,
+        step_s=cfg.history_step_s,
+    )
+    server = MonitorServer(cfg, sampler, history)
+    return sampler, server
+
+
+async def run(cfg: Config) -> None:
+    sampler, server = build(cfg)
+    await sampler.start()
+    await server.start()
+    print(
+        f"tpumon listening on http://{cfg.host}:{server.port} "
+        f"(collectors: {', '.join(cfg.collectors)}; "
+        f"accel backend: {cfg.accel_backend}; "
+        f"prometheus: {cfg.prometheus_url or 'ring-buffer only'})",
+        flush=True,
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("tpumon shutting down...", flush=True)
+    await server.stop()
+    await sampler.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    path = None
+    overrides = {}
+    it = iter(argv)
+
+    def take(flag: str) -> str:
+        v = next(it, None)
+        if v is None:
+            print(f"{flag} requires a value", file=sys.stderr)
+            raise SystemExit(2)
+        return v
+
+    for arg in it:
+        if arg in ("-c", "--config"):
+            path = take(arg)
+        elif arg == "--port":
+            v = take(arg)
+            if not v.isdigit():
+                print(f"--port wants an integer, got {v!r}", file=sys.stderr)
+                return 2
+            overrides["port"] = v
+        elif arg == "--accel-backend":
+            overrides["accel_backend"] = take(arg)
+        elif arg in ("-h", "--help"):
+            print(
+                "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
+                "[--accel-backend auto|jax|fake:v5e-8|none]\n"
+                "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
+            )
+            return 0
+        else:
+            print(f"unknown argument {arg!r}", file=sys.stderr)
+            return 2
+    cfg = load_config(path=path, overrides=overrides)
+    asyncio.run(run(cfg))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

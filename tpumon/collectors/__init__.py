@@ -1,0 +1,61 @@
+"""Collector layer (the reference's L1, SURVEY.md §1).
+
+The reference collects synchronously per HTTP request — three blocking
+``execSync`` shell-outs on the Node event loop (monitor_server.js:72,85,99).
+tpumon collectors are instead invoked by a background sampler
+(tpumon.sampler) on fixed cadences; each returns a Sample envelope that
+carries explicit health (ok / error / latency) so degraded sources are
+distinguishable from genuinely-empty data (SURVEY §7 "honest degraded
+modes").
+
+Collectors expose an async ``collect()``; anything that must block (file
+IO is cheap enough inline; subprocess fallbacks use asyncio subprocesses)
+must not stall the event loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Protocol, runtime_checkable
+
+
+@dataclass
+class Sample:
+    """Result envelope for one collection attempt."""
+
+    source: str
+    ok: bool
+    data: Any
+    error: str | None = None
+    ts: float = field(default_factory=time.time)
+    latency_ms: float = 0.0
+
+    def health_json(self) -> dict:
+        return {
+            "source": self.source,
+            "ok": self.ok,
+            "error": self.error,
+            "ts": self.ts,
+            "latency_ms": round(self.latency_ms, 3),
+        }
+
+
+@runtime_checkable
+class Collector(Protocol):
+    name: str
+
+    async def collect(self) -> Sample: ...
+
+
+async def run_collector(c: Collector) -> Sample:
+    """Invoke a collector, timing it and converting exceptions to a
+    degraded Sample (the reference's silent-degradation contract,
+    monitor_server.js:80,94,113 — but with the error recorded)."""
+    t0 = time.monotonic()
+    try:
+        s = await c.collect()
+    except Exception as e:  # degrade, never crash the sampler
+        s = Sample(source=c.name, ok=False, data=None, error=f"{type(e).__name__}: {e}")
+    s.latency_ms = (time.monotonic() - t0) * 1e3
+    return s

@@ -1,0 +1,40 @@
+"""Accelerator collector factory.
+
+Backend selection (Config.accel_backend):
+- "auto": JaxTpuCollector if JAX reports TPU devices, else a disabled
+  placeholder that reports no chips (the host-only config — the
+  reference's "nvidia-smi absent => []" mode, monitor_server.js:94, but
+  with the reason recorded).
+- "jax": force the real collector.
+- "fake:<topology>": synthetic chips (v5e-1 / v5e-8 / v5p-64 ...).
+- "none": disabled.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpumon.collectors import Collector, Sample
+from tpumon.collectors.accel_fake import FakeTpuCollector
+from tpumon.collectors.accel_jax import JaxTpuCollector
+from tpumon.config import Config
+
+
+@dataclass
+class NullAccelCollector:
+    name: str = "accel"
+    reason: str = "accel collector disabled"
+
+    async def collect(self) -> Sample:
+        return Sample(source=self.name, ok=True, data=[], error=self.reason)
+
+
+def make_accel_collector(cfg: Config) -> Collector:
+    backend = cfg.accel_backend
+    if backend == "none":
+        return NullAccelCollector(reason="accel backend 'none' configured")
+    if backend.startswith("fake:"):
+        return FakeTpuCollector(topology=backend.split(":", 1)[1])
+    if backend in ("auto", "jax"):
+        return JaxTpuCollector()
+    raise ValueError(f"unknown accel backend {backend!r}")

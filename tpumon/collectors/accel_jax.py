@@ -1,0 +1,147 @@
+"""Real TPU accelerator collector: JAX device enumeration + libtpu metrics.
+
+Replaces the reference's GPU collector (``nvidia-smi`` shell-out +
+CSV parse, monitor_server.js:83-95) with two in-process sources merged
+per chip:
+
+1. **Identity & topology** — ``jax.local_devices()``: chip kind, index,
+   coords, process/slice membership. Always available when JAX can see
+   the chip.
+2. **Counters** — in preference order:
+   a. libtpu runtime-metrics gRPC (tpumon.collectors.libtpu_grpc): HBM
+      used/total + TensorCore duty cycle — the tpu-info data path.
+   b. ``device.memory_stats()`` (PJRT): HBM bytes_in_use / bytes_limit.
+   c. nothing — fields stay None and the sample is marked degraded.
+
+JAX import and device enumeration happen lazily on first collect (in a
+thread, since backend init can take seconds) and are cached; per-sample
+work is the gRPC round-trip / memory_stats call only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+from dataclasses import dataclass, field
+
+from tpumon.collectors import Sample
+from tpumon.collectors.libtpu_grpc import LibtpuMetricsClient
+from tpumon.topology import HBM_BYTES_BY_KIND, ChipSample, normalize_chip_kind
+
+
+@dataclass
+class JaxTpuCollector:
+    name: str = "accel"
+    slice_id: str | None = None  # default: derived from env / "slice-0"
+    hostname: str | None = None
+    libtpu_addr: str = "localhost:8431"
+
+    _devices: list | None = field(default=None, repr=False)
+    _client: LibtpuMetricsClient | None = field(default=None, repr=False)
+    _libtpu_ok: bool | None = field(default=None, repr=False)
+    _init_error: str | None = field(default=None, repr=False)
+    _collects: int = field(default=0, repr=False)
+
+    # Re-probe a missing libtpu metrics service every N collects: the
+    # service only exists once a workload initializes libtpu, which may
+    # happen long after the monitor starts.
+    LIBTPU_REPROBE_EVERY: int = 30
+
+    def __post_init__(self) -> None:
+        self.hostname = self.hostname or socket.gethostname()
+        if self.slice_id is None:
+            # GKE TPU podslice pods carry these; fall back to a stable default.
+            self.slice_id = (
+                os.environ.get("TPU_SLICE_NAME")
+                or os.environ.get("MEGASCALE_SLICE_ID")
+                or "slice-0"
+            )
+        self._client = LibtpuMetricsClient(addr=self.libtpu_addr)
+
+    def _init_devices(self) -> list:
+        """Blocking JAX init; run in a thread."""
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "tpu"]
+
+    async def _devices_cached(self) -> list:
+        if self._devices is None and self._init_error is None:
+            try:
+                self._devices = await asyncio.to_thread(self._init_devices)
+            except Exception as e:
+                self._init_error = f"{type(e).__name__}: {e}"
+                self._devices = []
+        return self._devices or []
+
+    async def collect(self) -> Sample:
+        devices = await self._devices_cached()
+        if not devices:
+            return Sample(
+                source=self.name,
+                ok=False,
+                data=[],
+                error=self._init_error or "no local TPU devices visible to JAX",
+            )
+
+        # Counter source (a): libtpu gRPC. On a miss, skip for a while but
+        # keep re-probing — the service appears when a workload starts.
+        self._collects += 1
+        libtpu_snap = None
+        if (
+            self._libtpu_ok is not False
+            or self._collects % self.LIBTPU_REPROBE_EVERY == 0
+        ):
+            libtpu_snap = await self._client.snapshot()
+            self._libtpu_ok = libtpu_snap is not None
+
+        chips: list[ChipSample] = []
+        degraded: list[str] = []
+        for d in devices:
+            kind = normalize_chip_kind(d.device_kind)
+            local_idx = getattr(d, "local_hardware_id", None)
+            if local_idx is None:
+                local_idx = d.id
+            hbm_used = hbm_total = None
+            duty = None
+            if libtpu_snap is not None:
+                hbm_used = libtpu_snap["hbm_used"].get(local_idx)
+                hbm_total = libtpu_snap["hbm_total"].get(local_idx)
+                duty = libtpu_snap["duty_pct"].get(local_idx)
+            if hbm_used is None:
+                # Counter source (b): PJRT memory stats (process-local view).
+                try:
+                    ms = d.memory_stats()
+                except Exception:
+                    ms = None
+                if ms:
+                    hbm_used = ms.get("bytes_in_use")
+                    hbm_total = ms.get("bytes_limit") or hbm_total
+            if hbm_total is None:
+                hbm_total = HBM_BYTES_BY_KIND.get(kind)
+            if hbm_used is None and duty is None:
+                degraded.append(f"chip {local_idx}: no counter source")
+            chips.append(
+                ChipSample(
+                    chip_id=f"{self.hostname}/chip-{local_idx}",
+                    host=self.hostname,
+                    slice_id=self.slice_id,
+                    index=int(local_idx),
+                    kind=kind,
+                    coords=tuple(getattr(d, "coords", ()) or ()),
+                    mxu_duty_pct=duty,
+                    hbm_used=int(hbm_used) if hbm_used is not None else None,
+                    hbm_total=int(hbm_total) if hbm_total is not None else None,
+                    temp_c=None,  # not exposed by libtpu metrics today
+                )
+            )
+        return Sample(
+            source=self.name,
+            ok=not degraded,
+            data=chips,
+            error=("; ".join(degraded) or None),
+        )
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()

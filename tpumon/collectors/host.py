@@ -1,0 +1,133 @@
+"""Host metrics collector: CPU, memory, disk.
+
+Reference parity (monitor_server.js:66-81 ``getHostMetrics``):
+- CPU: the reference divides 1-min loadavg by a hardcoded 8 cores
+  (monitor_server.js:76). tpumon reports both a real utilization percent
+  computed from /proc/stat jiffy deltas between samples *and* the loadavg,
+  with the core count auto-detected (SURVEY §5.6).
+- Memory: /proc/meminfo MemTotal/MemAvailable (monitor_server.js:69-71).
+- Disk: the reference shells out ``df -B1 /`` (monitor_server.js:72);
+  tpumon uses os.statvfs directly — no subprocess.
+
+Shape of the returned data matches the reference contract (SURVEY §2.3
+/api/host/metrics) with numbers, not stringified floats: the reference
+returns percent fields as toFixed(1) strings (monitor_server.js:76-78), a
+quirk SURVEY §2.1 says to fix.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tpumon.collectors import Sample
+
+
+def _read_proc_stat_cpu(text: str) -> tuple[int, int]:
+    """Return (busy_jiffies, total_jiffies) from the aggregate 'cpu ' line."""
+    for line in text.splitlines():
+        if line.startswith("cpu "):
+            parts = [int(x) for x in line.split()[1:]]
+            # user nice system idle iowait irq softirq steal [guest guest_nice]
+            idle = parts[3] + (parts[4] if len(parts) > 4 else 0)
+            total = sum(parts[:8])
+            return total - idle, total
+    raise ValueError("no aggregate 'cpu' line in /proc/stat")
+
+
+def parse_meminfo(text: str) -> dict[str, int]:
+    """Parse /proc/meminfo into {key: bytes}."""
+    out: dict[str, int] = {}
+    for line in text.splitlines():
+        if ":" not in line:
+            continue
+        key, _, rest = line.partition(":")
+        fields = rest.split()
+        if not fields:
+            continue
+        val = int(fields[0])
+        if len(fields) > 1 and fields[1] == "kB":
+            val *= 1024
+        out[key.strip()] = val
+    return out
+
+
+@dataclass
+class HostCollector:
+    name: str = "host"
+    cpu_count: int = 0
+    disk_mounts: tuple[str, ...] = ("/",)
+    proc_root: str = "/proc"  # overridable for golden-input tests
+
+    _last_cpu: tuple[int, int] | None = None
+
+    def __post_init__(self) -> None:
+        self.cpu_count = self.cpu_count or os.cpu_count() or 1
+
+    # -- sub-collectors; each degrades independently (monitor_server.js:80) --
+
+    def _cpu(self) -> dict:
+        with open(os.path.join(self.proc_root, "loadavg")) as f:
+            load1 = float(f.read().split()[0])
+        with open(os.path.join(self.proc_root, "stat")) as f:
+            busy, total = _read_proc_stat_cpu(f.read())
+        pct = None
+        if self._last_cpu is not None:
+            dbusy = busy - self._last_cpu[0]
+            dtotal = total - self._last_cpu[1]
+            if dtotal > 0:
+                pct = 100.0 * dbusy / dtotal
+        self._last_cpu = (busy, total)
+        if pct is None:
+            # First sample: fall back to the reference's load-based estimate,
+            # but with the detected core count (monitor_server.js:76).
+            pct = min(100.0, 100.0 * load1 / self.cpu_count)
+        return {
+            "load_1min": load1,
+            "cores": self.cpu_count,
+            "percent": round(pct, 1),
+        }
+
+    def _memory(self) -> dict:
+        with open(os.path.join(self.proc_root, "meminfo")) as f:
+            mi = parse_meminfo(f.read())
+        total = mi["MemTotal"]
+        avail = mi.get("MemAvailable", mi.get("MemFree", 0))
+        used = total - avail
+        return {
+            "total": total,
+            "used": used,
+            "available": avail,
+            "percent": round(100.0 * used / total, 1) if total else None,
+        }
+
+    def _disk(self) -> dict:
+        mounts = {}
+        for mount in self.disk_mounts:
+            st = os.statvfs(mount)
+            total = st.f_blocks * st.f_frsize
+            avail = st.f_bavail * st.f_frsize
+            used = total - st.f_bfree * st.f_frsize
+            mounts[mount] = {
+                "total": total,
+                "used": used,
+                "percent": round(100.0 * used / total, 1) if total else None,
+            }
+        primary = mounts[self.disk_mounts[0]]
+        return {**primary, "mounts": mounts}
+
+    async def collect(self) -> Sample:
+        data: dict = {}
+        errors: list[str] = []
+        for key, fn in (("cpu", self._cpu), ("memory", self._memory), ("disk", self._disk)):
+            try:
+                data[key] = fn()
+            except Exception as e:
+                data[key] = {}
+                errors.append(f"{key}: {type(e).__name__}: {e}")
+        return Sample(
+            source=self.name,
+            ok=not errors,
+            data=data,
+            error="; ".join(errors) or None,
+        )

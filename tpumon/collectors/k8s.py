@@ -1,0 +1,212 @@
+"""Kubernetes pod collector.
+
+Reference parity (monitor_server.js:97-114 ``getK8sPods``): per pod
+namespace, name, phase, restart count summed over containerStatuses
+(:104), humanized age from status.startTime (:106-110). The reference
+shells out ``execSync('kubectl get pods -A -o json')`` on the event loop
+(:99) — SURVEY §2.1 flags a hung kubectl freezing the whole server.
+
+tpumon talks to the Kubernetes API directly (in-cluster service-account
+auth, or any configured API URL), with an *async subprocess* kubectl
+fallback for dev boxes. Parsing is a pure function over the PodList JSON
+so golden-input tests (SURVEY §4.1) cover containerStatuses edge cases.
+
+TPU additions: each pod record carries slice/topology metadata when
+present (GKE TPU nodeSelectors, JobSet labels) so the alert engine can
+map pods -> slices (SURVEY §2.5 "pod-slice topology awareness").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import json
+import os
+import ssl
+import urllib.request
+from dataclasses import dataclass
+
+from tpumon.collectors import Sample
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# GKE TPU-related pod/node metadata keys (labels & nodeSelector).
+TPU_TOPOLOGY_KEY = "cloud.google.com/gke-tpu-topology"
+TPU_ACCEL_KEY = "cloud.google.com/gke-tpu-accelerator"
+JOBSET_NAME_KEY = "jobset.sigs.k8s.io/jobset-name"
+JOB_INDEX_KEY = "batch.kubernetes.io/job-completion-index"
+
+
+def humanize_age(seconds: float) -> str:
+    """Humanize like the reference (monitor_server.js:106-110): days if
+    >=1d, hours if >=1h, else minutes."""
+    if seconds >= 86400:
+        return f"{int(seconds // 86400)}d"
+    if seconds >= 3600:
+        return f"{int(seconds // 3600)}h"
+    return f"{max(0, int(seconds // 60))}m"
+
+
+def _parse_k8s_time(text: str) -> float | None:
+    try:
+        return dt.datetime.fromisoformat(text.replace("Z", "+00:00")).timestamp()
+    except (ValueError, AttributeError):
+        return None
+
+
+def parse_pod_list(obj: dict, now: float | None = None) -> list[dict]:
+    """Pure parser over a K8s PodList JSON document."""
+    now = dt.datetime.now(dt.timezone.utc).timestamp() if now is None else now
+    pods: list[dict] = []
+    for item in obj.get("items", []):
+        meta = item.get("metadata", {}) or {}
+        status = item.get("status", {}) or {}
+        spec = item.get("spec", {}) or {}
+        # Restarts summed over containerStatuses (monitor_server.js:104);
+        # containerStatuses may be absent for Pending pods.
+        restarts = sum(
+            cs.get("restartCount", 0) for cs in status.get("containerStatuses") or []
+        )
+        start = _parse_k8s_time(status.get("startTime"))
+        age_s = max(0.0, now - start) if start is not None else None
+        labels = meta.get("labels") or {}
+        node_selector = spec.get("nodeSelector") or {}
+        phase = status.get("phase", "Unknown")
+        # Surface container-level waiting/terminated reasons (CrashLoopBackOff,
+        # OOMKilled, ...) the reference can't see — it only looks at phase.
+        reason = status.get("reason")
+        for cs in status.get("containerStatuses") or []:
+            state = cs.get("state") or {}
+            last_state = cs.get("lastState") or {}
+            waiting = state.get("waiting") or {}
+            terminated = state.get("terminated") or last_state.get("terminated") or {}
+            if waiting.get("reason"):
+                reason = waiting["reason"]
+                break
+            term_reason = terminated.get("reason")
+            if term_reason and term_reason != "Completed":
+                reason = term_reason
+                break
+        pods.append(
+            {
+                "namespace": meta.get("namespace", ""),
+                "name": meta.get("name", ""),
+                "status": phase,
+                "reason": reason,
+                "restarts": restarts,
+                "age": humanize_age(age_s) if age_s is not None else "",
+                "age_s": age_s,
+                "node": spec.get("nodeName"),
+                "tpu_topology": node_selector.get(TPU_TOPOLOGY_KEY),
+                "tpu_accelerator": node_selector.get(TPU_ACCEL_KEY),
+                "jobset": labels.get(JOBSET_NAME_KEY),
+                "job_index": labels.get(JOB_INDEX_KEY),
+            }
+        )
+    return pods
+
+
+# --------------------------------------------------------------------------
+# Pod sources
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ApiPodSource:
+    """Reads /api/v1/pods from a Kubernetes API server.
+
+    In-cluster: uses the mounted service-account token + CA. Out of
+    cluster: any api_url (e.g. a `kubectl proxy` or a test fake) works
+    unauthenticated over http.
+    """
+
+    api_url: str | None = None
+    timeout_s: float = 5.0
+
+    def _resolve(self) -> tuple[str, dict[str, str], ssl.SSLContext | None]:
+        if self.api_url:
+            return self.api_url, {}, None
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not in-cluster (KUBERNETES_SERVICE_HOST unset)")
+        headers = {}
+        token_path = os.path.join(SA_DIR, "token")
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                headers["Authorization"] = f"Bearer {f.read().strip()}"
+        ctx = None
+        ca_path = os.path.join(SA_DIR, "ca.crt")
+        if os.path.exists(ca_path):
+            ctx = ssl.create_default_context(cafile=ca_path)
+        return f"https://{host}:{port}", headers, ctx
+
+    def _fetch(self) -> dict:
+        base, headers, ctx = self._resolve()
+        req = urllib.request.Request(f"{base}/api/v1/pods", headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout_s, context=ctx) as r:
+            return json.load(r)
+
+    async def fetch_pod_list(self) -> dict:
+        return await asyncio.to_thread(self._fetch)
+
+
+@dataclass
+class KubectlPodSource:
+    """Async-subprocess kubectl fallback (never blocks the event loop,
+    unlike the reference's execSync at monitor_server.js:99)."""
+
+    timeout_s: float = 10.0
+
+    async def fetch_pod_list(self) -> dict:
+        proc = await asyncio.create_subprocess_exec(
+            "kubectl",
+            "get",
+            "pods",
+            "-A",
+            "-o",
+            "json",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        try:
+            stdout, stderr = await asyncio.wait_for(
+                proc.communicate(), timeout=self.timeout_s
+            )
+        except asyncio.TimeoutError:
+            proc.kill()
+            raise RuntimeError(f"kubectl timed out after {self.timeout_s}s")
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl failed: {stderr.decode(errors='replace')[:200]}")
+        return json.loads(stdout)
+
+
+@dataclass
+class K8sCollector:
+    name: str = "k8s"
+    mode: str = "auto"  # "auto" | "api" | "kubectl" | "none"
+    api_url: str | None = None
+
+    def _sources(self):
+        if self.mode == "api":
+            return [ApiPodSource(api_url=self.api_url)]
+        if self.mode == "kubectl":
+            return [KubectlPodSource()]
+        if self.mode == "none":
+            return []
+        return [ApiPodSource(api_url=self.api_url), KubectlPodSource()]
+
+    async def collect(self) -> Sample:
+        errors: list[str] = []
+        for source in self._sources():
+            try:
+                pod_list = await source.fetch_pod_list()
+                return Sample(source=self.name, ok=True, data=parse_pod_list(pod_list))
+            except Exception as e:
+                errors.append(f"{type(source).__name__}: {type(e).__name__}: {e}")
+        return Sample(
+            source=self.name,
+            ok=False,
+            data=[],
+            error="; ".join(errors) or "k8s collection disabled",
+        )

@@ -1,0 +1,138 @@
+"""libtpu runtime-metrics gRPC client.
+
+This is the TPU-native replacement for the reference's accelerator data
+path — ``execSync('nvidia-smi --query-gpu=...')`` + CSV parsing
+(monitor_server.js:83-95) and the out-of-tree DCGM exporter
+(README.md:135). On Cloud TPU VMs, libtpu serves runtime metrics over
+gRPC on localhost (default port 8431, the same service the ``tpu-info``
+CLI reads): per-device HBM usage/capacity and TensorCore duty cycle.
+
+We speak the wire protocol directly via tpumon.protowire — the request is
+a single-string message and responses are decoded structurally — so no
+generated proto stubs are needed and minor proto evolution doesn't break
+us. The client degrades to ``available=False`` when the service is absent
+(e.g. non-TPU hosts, or tunneled single-chip dev environments), in which
+case the accel collector still reports chip identity from JAX with
+metric fields None (SURVEY §7: honest degraded modes).
+
+Metric names as exposed by libtpu (verified against tpu-info's public
+metric list; re-verify on hardware per SURVEY §5.8):
+  tpu.runtime.hbm.memory.usage.bytes
+  tpu.runtime.hbm.memory.total.bytes
+  tpu.runtime.tensorcore.dutycycle.percent
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from tpumon import protowire as pw
+
+METRIC_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+METRIC_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+METRIC_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+METRIC_UPTIME = "tpu.runtime.uptime"
+
+GRPC_METHOD = "/tpu.monitoring.runtime.MetricService/GetRuntimeMetric"
+DEFAULT_ADDR = "localhost:8431"
+
+
+def encode_metric_request(metric_name: str) -> bytes:
+    """MetricRequest { string metric_name = 1; }"""
+    return pw.encode_string(1, metric_name)
+
+
+def extract_gauges(response: bytes) -> dict[int, float]:
+    """Structurally extract {device_index: value} from a MetricResponse.
+
+    The response nests TPUMetric -> repeated Metric { Attribute, Gauge }.
+    Rather than depending on exact field numbers below the top level, we
+    walk the decoded tree: a per-device entry is a Message that contains
+    (a) an attribute submessage holding an int (the device index) and
+    (b) a gauge submessage holding an int or double (the value).
+    """
+    msg = pw.decode_message(response)
+    out: dict[int, float] = {}
+    for f in msg.walk():
+        if not isinstance(f.value, pw.Message):
+            continue
+        entry = f.value
+        device_idx: int | None = None
+        gauge_val: float | None = None
+        for sub in entry.fields:
+            if not isinstance(sub.value, pw.Message):
+                continue
+            ints = [
+                g.value
+                for g in sub.value.walk()
+                if isinstance(g.value, int) and g.wire_type == pw.WT_VARINT
+            ]
+            doubles = [
+                g.value for g in sub.value.walk() if isinstance(g.value, float)
+            ]
+            # Attribute submessage: holds the (small) device index.
+            # Gauge submessage: holds the measured value (int64 or double).
+            if doubles and gauge_val is None:
+                gauge_val = doubles[0]
+            elif ints:
+                if device_idx is None and 0 <= ints[0] < 4096:
+                    device_idx = ints[0]
+                elif gauge_val is None:
+                    gauge_val = float(ints[0])
+        if device_idx is not None and gauge_val is not None:
+            out[device_idx] = gauge_val
+    return out
+
+
+@dataclass
+class LibtpuMetricsClient:
+    addr: str = DEFAULT_ADDR
+    timeout_s: float = 2.0
+    _channel: object = field(default=None, repr=False)
+
+    def _get_channel(self):
+        if self._channel is None:
+            import grpc
+
+            self._channel = grpc.aio.insecure_channel(self.addr)
+        return self._channel
+
+    async def get_metric(self, metric_name: str) -> dict[int, float] | None:
+        """Fetch one metric for all local devices; None if unavailable."""
+        try:
+            import grpc
+
+            channel = self._get_channel()
+            call = channel.unary_unary(
+                GRPC_METHOD,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            resp: bytes = await asyncio.wait_for(
+                call(encode_metric_request(metric_name)), timeout=self.timeout_s
+            )
+            return extract_gauges(resp)
+        except Exception:
+            return None
+
+    async def snapshot(self) -> dict[str, dict[int, float]] | None:
+        """Fetch HBM usage/total and duty cycle; None if service absent."""
+        results = await asyncio.gather(
+            self.get_metric(METRIC_HBM_USAGE),
+            self.get_metric(METRIC_HBM_TOTAL),
+            self.get_metric(METRIC_DUTY_CYCLE),
+        )
+        usage, total, duty = results
+        if usage is None and total is None and duty is None:
+            return None
+        return {
+            "hbm_used": usage or {},
+            "hbm_total": total or {},
+            "duty_pct": duty or {},
+        }
+
+    async def close(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+            self._channel = None

@@ -1,0 +1,100 @@
+"""Async Prometheus query client.
+
+Reference parity: httpGet + queryPrometheus + queryRangePrometheus
+(monitor_server.js:14-52) — instant and range queries, resolving to null /
+[] on any failure. Differences (deliberate, SURVEY §3.3):
+
+- Queries are issued **in parallel** by callers via asyncio.gather; the
+  reference awaited its six history queries sequentially
+  (monitor_server.js:119-134).
+- Range results keep **all** series (the reference kept only the first,
+  monitor_server.js:138) — per-chip tpu_* series need them all.
+- Failures still degrade to None/[] but the error is recorded on the
+  client for source-health reporting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Series:
+    labels: dict[str, str]
+    times: list[float]  # unix seconds
+    values: list[float]
+
+
+@dataclass
+class PrometheusClient:
+    base_url: str
+    timeout_s: float = 5.0
+    last_error: str | None = field(default=None, repr=False)
+
+    def _get(self, path: str, params: dict) -> dict | None:
+        url = f"{self.base_url}{path}?{urllib.parse.urlencode(params)}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                body = json.load(r)
+            if body.get("status") != "success":
+                raise ValueError(f"prometheus status={body.get('status')}")
+            self.last_error = None
+            return body
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+            return None
+
+    async def query(self, promql: str, ts: float | None = None) -> float | None:
+        """Instant query; first sample's value or None (monitor_server.js:27-36)."""
+        params = {"query": promql}
+        if ts is not None:
+            params["time"] = ts
+        body = await asyncio.to_thread(self._get, "/api/v1/query", params)
+        if not body:
+            return None
+        result = body.get("data", {}).get("result", [])
+        if not result:
+            return None
+        try:
+            return float(result[0]["value"][1])
+        except (KeyError, IndexError, ValueError):
+            return None
+
+    async def query_range(
+        self,
+        promql: str,
+        window_s: float = 1800,
+        step_s: float = 30,
+        end: float | None = None,
+    ) -> list[Series]:
+        """Range query over the trailing window (monitor_server.js:38-52)."""
+        end = time.time() if end is None else end
+        body = await asyncio.to_thread(
+            self._get,
+            "/api/v1/query_range",
+            {
+                "query": promql,
+                "start": end - window_s,
+                "end": end,
+                "step": step_s,
+            },
+        )
+        if not body:
+            return []
+        out: list[Series] = []
+        for series in body.get("data", {}).get("result", []):
+            times: list[float] = []
+            values: list[float] = []
+            for t, v in series.get("values", []):
+                try:
+                    values.append(float(v))
+                except ValueError:
+                    continue
+                times.append(float(t))
+            out.append(Series(labels=series.get("metric", {}), times=times, values=values))
+        return out

@@ -1,0 +1,169 @@
+"""LLM serving-metrics ingest (JetStream / MaxText).
+
+The reference's tech-stack README names vLLM metric collection
+(README.md:73) but ships no code for it (SURVEY §5.7); serving is only
+visible as pods + GPU counters. tpumon makes serving ingest real and
+TPU-native: scrape the Prometheus ``/metrics`` endpoints of JetStream /
+MaxText JAX-serving processes and distill the panels the dashboard needs
+— TTFT, token throughput, queue depth, request rate (BASELINE config 4).
+
+Metric-name mapping is table-driven because serving stacks drift; each
+target is matched against known families with sensible fallbacks, and
+unknown deployments degrade to "target reachable, no recognized metrics"
+rather than erroring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from tpumon.collectors import Sample
+from tpumon.metrics_text import (
+    histogram_quantile,
+    parse_metrics_text,
+    samples_by_name,
+)
+
+# Known metric families, in preference order per signal.
+# JetStream server: https://github.com/AI-Hypercomputer/JetStream (public
+# metric names); vLLM names kept as a compatibility fallback.
+TTFT_HISTOGRAMS = (
+    "jetstream_time_to_first_token",
+    "jetstream_time_to_first_token_seconds",
+    "vllm:time_to_first_token_seconds",
+)
+TPOT_HISTOGRAMS = (
+    "jetstream_time_per_output_token",
+    "vllm:time_per_output_token_seconds",
+)
+TOKEN_COUNTERS = (
+    "jetstream_total_tokens_in_current_batch",
+    "jetstream_generate_tokens",
+    "jetstream_total_output_tokens",
+    "vllm:generation_tokens",
+)
+QUEUE_GAUGES = (
+    "jetstream_queue_size",
+    "jetstream_transfer_backlog",
+    "jetstream_prefill_backlog_size",
+    "vllm:num_requests_waiting",
+)
+REQUEST_COUNTERS = (
+    "jetstream_request_count",
+    "jetstream_num_requests",
+    "vllm:request_success",
+)
+SLOTS_GAUGES = (
+    "jetstream_slots_used_percentage",
+    "jetstream_slots_available",
+)
+
+
+def _sum_samples(by_name: dict, names: tuple[str, ...]) -> tuple[str, float] | None:
+    for name in names:
+        if name in by_name:
+            return name, sum(s.value for s in by_name[name])
+    return None
+
+
+def _histogram_p(by_name: dict, names: tuple[str, ...], q: float):
+    for name in names:
+        bucket = by_name.get(name + "_bucket")
+        if bucket:
+            val = histogram_quantile(bucket, q)
+            if val is not None:
+                return name, val
+    return None
+
+
+def distill_serving_metrics(
+    text: str, prev: dict | None = None, now: float | None = None
+) -> dict:
+    """Distill one target's exposition text into dashboard-ready fields.
+
+    ``prev`` is the previous distilled dict (for counter-rate computation
+    between scrapes).
+    """
+    now = time.time() if now is None else now
+    by_name = samples_by_name(parse_metrics_text(text))
+    out: dict = {"ts": now, "raw_families": len(by_name)}
+
+    ttft = _histogram_p(by_name, TTFT_HISTOGRAMS, 0.5)
+    if ttft:
+        name, val = ttft
+        # JetStream buckets are seconds; report ms.
+        out["ttft_p50_ms"] = val * 1e3
+        p99 = _histogram_p(by_name, TTFT_HISTOGRAMS, 0.99)
+        if p99:
+            out["ttft_p99_ms"] = p99[1] * 1e3
+    tpot = _histogram_p(by_name, TPOT_HISTOGRAMS, 0.5)
+    if tpot:
+        out["tpot_p50_ms"] = tpot[1] * 1e3
+
+    tokens = _sum_samples(by_name, TOKEN_COUNTERS)
+    if tokens:
+        out["tokens_total"] = tokens[1]
+        if prev and "tokens_total" in prev and prev["ts"] < now:
+            delta = tokens[1] - prev["tokens_total"]
+            if delta >= 0:
+                out["tokens_per_sec"] = delta / (now - prev["ts"])
+
+    requests = _sum_samples(by_name, REQUEST_COUNTERS)
+    if requests:
+        out["requests_total"] = requests[1]
+        if prev and "requests_total" in prev and prev["ts"] < now:
+            delta = requests[1] - prev["requests_total"]
+            if delta >= 0:
+                out["requests_per_sec"] = delta / (now - prev["ts"])
+
+    queue = _sum_samples(by_name, QUEUE_GAUGES)
+    if queue:
+        out["queue_depth"] = queue[1]
+    slots = _sum_samples(by_name, SLOTS_GAUGES)
+    if slots:
+        out["slots"] = slots[1]
+    return out
+
+
+@dataclass
+class ServingCollector:
+    targets: tuple[str, ...] = ()
+    name: str = "serving"
+    timeout_s: float = 3.0
+    _prev: dict[str, dict] = field(default_factory=dict)
+
+    def _fetch(self, url: str) -> str:
+        if not url.startswith(("http://", "https://")):
+            url = f"http://{url}"
+        if not url.rstrip("/").endswith("/metrics"):
+            url = url.rstrip("/") + "/metrics"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            return r.read().decode("utf-8", errors="replace")
+
+    async def _collect_one(self, target: str) -> dict:
+        try:
+            text = await asyncio.to_thread(self._fetch, target)
+            distilled = distill_serving_metrics(text, prev=self._prev.get(target))
+            self._prev[target] = distilled
+            return {"target": target, "ok": True, **distilled}
+        except Exception as e:
+            return {
+                "target": target,
+                "ok": False,
+                "error": f"{type(e).__name__}: {e}",
+            }
+
+    async def collect(self) -> Sample:
+        if not self.targets:
+            return Sample(
+                source=self.name, ok=True, data=[], error="no serving targets configured"
+            )
+        results = await asyncio.gather(*(self._collect_one(t) for t in self.targets))
+        ok = all(r.get("ok") for r in results)
+        errors = "; ".join(
+            f"{r['target']}: {r['error']}" for r in results if not r.get("ok")
+        )
+        return Sample(source=self.name, ok=ok, data=list(results), error=errors or None)

@@ -1,0 +1,234 @@
+"""Typed configuration for tpumon.
+
+The reference has zero configurability: two hardcoded constants
+(``PORT = 8888``, ``PROMETHEUS_URL``, monitor_server.js:10-11), a hardcoded
+8-core CPU divisor (monitor_server.js:76) and magic-number alert thresholds
+(monitor_server.js:163-184). tpumon replaces that with a small typed config
+loaded from defaults <- optional JSON/TOML file <- TPUMON_* environment
+variables, covering everything SURVEY.md §5.6 calls for: port, Prometheus
+URL, core count (auto-detected), thresholds, enabled collectors and
+topology expectations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+_DURATION_RE = re.compile(r"^(\d+)([smhd])$")
+_DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+
+
+def parse_duration(text: str | int | float, default: float | None = None) -> float:
+    """Parse ``"30m"``-style durations into seconds.
+
+    Same grammar as the reference's parseDuration (monitor_server.js:54-63:
+    regex ``(\\d+)([smhd])``), but a bad input raises (or returns an explicit
+    caller-provided default) instead of silently becoming 1800.
+    """
+    if isinstance(text, (int, float)):
+        return float(text)
+    m = _DURATION_RE.match(text.strip())
+    if not m:
+        if default is not None:
+            return default
+        raise ValueError(f"invalid duration {text!r} (want e.g. '30s', '30m', '1h')")
+    return float(int(m.group(1)) * _DURATION_UNITS[m.group(2)])
+
+
+@dataclass(frozen=True)
+class TriLevel:
+    """A minor/serious/critical threshold triple.
+
+    Mirrors the reference's three severity buckets (monitor_server.js:159-238,
+    README.md:58-64). ``minor`` may be None for signals that only have
+    serious/critical levels (e.g. temperature, monitor_server.js:183-184).
+    """
+
+    minor: float | None
+    serious: float
+    critical: float
+
+    def severity(self, value: float) -> str | None:
+        """Classify a value; returns 'minor' | 'serious' | 'critical' | None."""
+        if value > self.critical:
+            return "critical"
+        if value > self.serious:
+            return "serious"
+        if self.minor is not None and value > self.minor:
+            return "minor"
+        return None
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    """Alert thresholds, re-keyed for TPU per SURVEY.md §2.2.
+
+    cpu/memory/disk keep the reference's 70/85/95 (monitor_server.js:163-175).
+    GPU-mem% becomes per-chip HBM% (reference checked device 0 only,
+    monitor_server.js:178-182); GPU temp becomes chip temp 75/85
+    (monitor_server.js:183-184). MXU duty-cycle gets *idle* rules instead of
+    high-usage rules (a busy MXU is healthy; a claimed-busy job on an idle
+    MXU is not) plus the TPU-only ICI/slice rules.
+    """
+
+    cpu_pct: TriLevel = TriLevel(70, 85, 95)
+    memory_pct: TriLevel = TriLevel(70, 85, 95)
+    disk_pct: TriLevel = TriLevel(70, 85, 95)
+    hbm_pct: TriLevel = TriLevel(70, 85, 95)
+    temp_c: TriLevel = TriLevel(None, 75, 85)
+    # A chip whose HBM is heavily committed but whose MXU duty-cycle sits
+    # below this for the whole observation window is likely a wedged/stalled
+    # job (serious).
+    mxu_idle_pct: float = 5.0
+    mxu_idle_hbm_gate_pct: float = 50.0
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- serving ---
+    port: int = 8888  # same default as the reference (monitor_server.js:10)
+    host: str = "0.0.0.0"
+
+    # --- history (reference: 30m window / 30s step, monitor_server.js:38) ---
+    prometheus_url: str | None = None  # None => ring-buffer-only degraded mode
+    history_window_s: float = 30 * 60
+    history_step_s: float = 30
+
+    # --- sampling (replaces per-request execSync collection, SURVEY §3.2) ---
+    sample_interval_s: float = 1.0
+    pods_interval_s: float = 5.0
+    serving_interval_s: float = 5.0
+
+    # --- collectors ---
+    collectors: tuple[str, ...] = ("host", "accel", "k8s", "serving")
+    # accel backend: "auto" | "jax" | "fake:<topology>" | "none"
+    accel_backend: str = "auto"
+    # host cpu count: 0 => auto-detect (reference hardcoded 8, monitor_server.js:76)
+    cpu_count: int = 0
+    disk_mounts: tuple[str, ...] = ("/",)
+    # k8s: "auto" tries in-cluster API then kubectl; "api" | "kubectl" | "none"
+    k8s_mode: str = "auto"
+    k8s_api_url: str | None = None
+    # JetStream / MaxText /metrics scrape targets (SURVEY §5.7)
+    serving_targets: tuple[str, ...] = ()
+
+    # --- topology expectations (for slice-failure alerting, SURVEY §2.2) ---
+    # e.g. {"slice-0": 8} => alert critical if fewer chips report
+    expected_slice_chips: Mapping[str, int] = field(default_factory=dict)
+
+    thresholds: Thresholds = field(default_factory=Thresholds)
+
+    def effective_cpu_count(self) -> int:
+        return self.cpu_count or os.cpu_count() or 1
+
+
+# Keys accepted from file / env and how to coerce them.
+_SCALAR_FIELDS: dict[str, type] = {
+    "port": int,
+    "host": str,
+    "prometheus_url": str,
+    "sample_interval_s": float,
+    "pods_interval_s": float,
+    "serving_interval_s": float,
+    "accel_backend": str,
+    "cpu_count": int,
+    "k8s_mode": str,
+    "k8s_api_url": str,
+}
+_DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}
+_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets"}
+
+
+def _coerce_thresholds(raw: Mapping[str, Any], base: Thresholds) -> Thresholds:
+    kw: dict[str, Any] = {}
+    for f in dataclasses.fields(Thresholds):
+        if f.name not in raw:
+            continue
+        v = raw[f.name]
+        is_trilevel = f.type in ("TriLevel", TriLevel)
+        if isinstance(v, (list, tuple)):
+            if not is_trilevel:
+                raise ValueError(f"threshold {f.name}: want a single number, got {v!r}")
+            if len(v) == 3:
+                kw[f.name] = TriLevel(v[0], v[1], v[2])
+            elif len(v) == 2:
+                kw[f.name] = TriLevel(None, v[0], v[1])
+            else:
+                raise ValueError(f"threshold {f.name}: want 2 or 3 values, got {v!r}")
+        elif is_trilevel:
+            raise ValueError(
+                f"threshold {f.name}: want [minor, serious, critical] or "
+                f"[serious, critical], got {v!r}"
+            )
+        else:
+            kw[f.name] = float(v)
+    return dataclasses.replace(base, **kw) if kw else base
+
+
+def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
+    for key, value in raw.items():
+        if key in _SCALAR_FIELDS:
+            cfg_kw[key] = None if value is None else _SCALAR_FIELDS[key](value)
+        elif key in ("history_window", "history_step"):
+            cfg_kw[key + "_s"] = parse_duration(value)
+        elif key in _LIST_FIELDS:
+            if isinstance(value, str):
+                value = [v.strip() for v in value.split(",") if v.strip()]
+            cfg_kw[key] = tuple(value)
+        elif key == "expected_slice_chips":
+            cfg_kw[key] = {str(k): int(v) for k, v in value.items()}
+        elif key == "thresholds":
+            cfg_kw["_thresholds_raw"] = value
+        else:
+            raise ValueError(f"unknown config key {key!r}")
+
+
+def load_config(
+    path: str | None = None,
+    env: Mapping[str, str] | None = None,
+    overrides: Mapping[str, Any] | None = None,
+) -> Config:
+    """Build a Config from defaults <- file <- env <- explicit overrides."""
+    env = os.environ if env is None else env
+    kw: dict[str, Any] = {}
+
+    path = path or env.get("TPUMON_CONFIG")
+    if path:
+        with open(path, "rb") as f:
+            if path.endswith(".toml"):
+                import tomllib
+
+                raw = tomllib.load(f)
+            else:
+                raw = json.load(f)
+        _apply_mapping(kw, raw)
+
+    env_raw: dict[str, Any] = {}
+    for env_key, value in env.items():
+        if not env_key.startswith("TPUMON_") or env_key == "TPUMON_CONFIG":
+            continue
+        key = env_key[len("TPUMON_") :].lower()
+        env_raw[key] = value
+    if env_raw:
+        # Env values arrive as strings; expected_slice_chips as JSON.
+        if "expected_slice_chips" in env_raw:
+            env_raw["expected_slice_chips"] = json.loads(env_raw["expected_slice_chips"])
+        if "thresholds" in env_raw:
+            env_raw["thresholds"] = json.loads(env_raw["thresholds"])
+        _apply_mapping(kw, env_raw)
+
+    if overrides:
+        _apply_mapping(kw, overrides)
+
+    thresholds_raw = kw.pop("_thresholds_raw", None)
+    cfg = Config(**kw)
+    if thresholds_raw:
+        cfg = dataclasses.replace(
+            cfg, thresholds=_coerce_thresholds(thresholds_raw, cfg.thresholds)
+        )
+    return cfg

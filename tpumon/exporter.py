@@ -1,0 +1,143 @@
+"""In-tree Prometheus exporter.
+
+Replaces the reference's out-of-tree exporter fleet — node-exporter :9100,
+DCGM exporter :9400 and its DCGM_FI_DEV_* series (README.md:130-136,
+monitor_server.js:128-134) — with one in-process ``/metrics`` endpoint
+publishing:
+
+- ``tpu_*``       per-chip gauges/counters (labels: chip, host, slice, kind)
+- ``tpumon_host_*``  host gauges (so history PromQL needs no node-exporter)
+- ``tpumon_*``       self-metrics (sample counts/latency — SURVEY §5.1)
+- ``tpumon_serving_*`` distilled serving signals per target
+
+These are exactly the series tpumon.history.PROM_QUERIES re-keys onto
+(SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpumon.metrics_text import MetricsWriter
+from tpumon.sampler import Sampler
+
+
+def render_exporter(sampler: Sampler) -> str:
+    w = MetricsWriter()
+
+    # ---- host (tpumon_host_*) ----
+    host = sampler.host_data()
+    if host:
+        cpu = host.get("cpu") or {}
+        mem = host.get("memory") or {}
+        disk = host.get("disk") or {}
+        g = w.gauge("tpumon_host_cpu_pct", "Host CPU utilization percent")
+        if cpu.get("percent") is not None:
+            g.add({}, cpu["percent"])
+        g = w.gauge("tpumon_host_load1", "Host 1-minute load average")
+        if cpu.get("load_1min") is not None:
+            g.add({}, cpu["load_1min"])
+        g = w.gauge("tpumon_host_memory_pct", "Host memory used percent")
+        if mem.get("percent") is not None:
+            g.add({}, mem["percent"])
+        g = w.gauge("tpumon_host_memory_used_bytes", "Host memory used bytes")
+        if mem.get("used") is not None:
+            g.add({}, mem["used"])
+        g = w.gauge("tpumon_host_disk_pct", "Disk used percent per mount")
+        for mount, d in (disk.get("mounts") or {}).items():
+            if d.get("percent") is not None:
+                g.add({"mount": mount}, d["percent"])
+
+    # ---- chips (tpu_*) ----
+    chips = sampler.chips()
+    if chips:
+        duty = w.gauge("tpu_mxu_duty_cycle_pct", "TensorCore/MXU duty cycle percent")
+        used = w.gauge("tpu_hbm_used_bytes", "HBM bytes in use")
+        total = w.gauge("tpu_hbm_total_bytes", "HBM capacity bytes")
+        used_pct = w.gauge("tpu_hbm_used_pct", "HBM used percent")
+        temp = w.gauge("tpu_temp_celsius", "Chip temperature")
+        tx = w.counter("tpu_ici_tx_bytes_total", "Cumulative ICI bytes transmitted")
+        rx = w.counter("tpu_ici_rx_bytes_total", "Cumulative ICI bytes received")
+        link = w.gauge("tpu_ici_link_up", "ICI link state (1=up)")
+        for c in chips:
+            labels = {
+                "chip": c.chip_id,
+                "host": c.host,
+                "slice": c.slice_id,
+                "kind": c.kind,
+            }
+            if c.mxu_duty_pct is not None:
+                duty.add(labels, c.mxu_duty_pct)
+            if c.hbm_used is not None:
+                used.add(labels, c.hbm_used)
+            if c.hbm_total is not None:
+                total.add(labels, c.hbm_total)
+            if c.hbm_pct is not None:
+                used_pct.add(labels, c.hbm_pct)
+            if c.temp_c is not None:
+                temp.add(labels, c.temp_c)
+            if c.ici_tx_bytes is not None:
+                tx.add(labels, c.ici_tx_bytes)
+            if c.ici_rx_bytes is not None:
+                rx.add(labels, c.ici_rx_bytes)
+            if c.ici_link_up is not None:
+                link.add(labels, 1.0 if c.ici_link_up else 0.0)
+
+    # ---- slices ----
+    slices = sampler.slices()
+    if slices:
+        reporting = w.gauge("tpu_slice_reporting_chips", "Chips currently reporting")
+        expected = w.gauge("tpu_slice_expected_chips", "Chips expected in slice")
+        for s in slices:
+            labels = {"slice": s.slice_id}
+            reporting.add(labels, s.reporting_chips)
+            if s.expected_chips is not None:
+                expected.add(labels, s.expected_chips)
+
+    # ---- pods ----
+    pods = sampler.pods()
+    if pods:
+        phase_counts: dict[str, int] = {}
+        for p in pods:
+            phase_counts[p.get("status", "Unknown")] = (
+                phase_counts.get(p.get("status", "Unknown"), 0) + 1
+            )
+        g = w.gauge("tpumon_pods_by_phase", "Pod count per phase")
+        for phase, n in sorted(phase_counts.items()):
+            g.add({"phase": phase}, n)
+
+    # ---- serving ----
+    serving = sampler.serving_data()
+    if serving:
+        tps = w.gauge("tpumon_serving_tokens_per_sec", "Generated tokens/sec")
+        ttft = w.gauge("tpumon_serving_ttft_p50_ms", "TTFT p50 in ms")
+        queue = w.gauge("tpumon_serving_queue_depth", "Request queue depth")
+        up = w.gauge("tpumon_serving_up", "Serving target scrape success")
+        for s in serving:
+            labels = {"target": s.get("target", "")}
+            up.add(labels, 1.0 if s.get("ok") else 0.0)
+            if s.get("tokens_per_sec") is not None:
+                tps.add(labels, s["tokens_per_sec"])
+            if s.get("ttft_p50_ms") is not None:
+                ttft.add(labels, s["ttft_p50_ms"])
+            if s.get("queue_depth") is not None:
+                queue.add(labels, s["queue_depth"])
+
+    # ---- self metrics ----
+    samples = w.counter("tpumon_samples_total", "Collection attempts per source")
+    failures = w.counter("tpumon_sample_failures_total", "Failed collections")
+    lat = w.gauge("tpumon_sample_latency_p50_ms", "Collection latency p50 (ms)")
+    ok = w.gauge("tpumon_source_up", "Source healthy (1=ok)")
+    for name, st in sorted(sampler.stats.items()):
+        labels = {"source": name}
+        samples.add(labels, st.samples)
+        failures.add(labels, st.failures)
+        p50 = st.p50_ms()
+        if p50 is not None:
+            lat.add(labels, round(p50, 3))
+        latest = sampler.latest.get(name)
+        if latest is not None:
+            ok.add(labels, 1.0 if latest.ok else 0.0)
+    g = w.gauge("tpumon_uptime_seconds", "Monitor uptime")
+    g.add({}, round(time.time() - sampler.started_at, 1))
+    return w.render()

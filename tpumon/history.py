@@ -1,0 +1,170 @@
+"""History: in-process ring buffers + optional Prometheus-backed range data.
+
+Reference parity (monitor_server.js:117-154 ``getHistoryMetrics``): 30-min
+window / 30-s step curves for cpu, memory, disk and accelerator series,
+rendered as ``{labels: [HH:mm], data: [...]}`` per series (SURVEY §2.3).
+
+Differences (deliberate, SURVEY §3.3 + §5.8):
+- The six PromQL range queries the reference awaited **sequentially** are
+  issued in **parallel**, and they are re-keyed from ``DCGM_FI_DEV_*`` to
+  the ``tpu_*`` / ``tpumon_*`` series our own exporter publishes.
+- A Prometheus outage (or no Prometheus configured at all) degrades to an
+  **in-process ring buffer** the sampler feeds every tick, so the
+  dashboard always has history (the reference returns empty series,
+  monitor_server.js:139).
+- ``gpuTemp`` was collected but never rendered by the reference
+  (monitor_server.js:134 vs monitor.html:523-526); here temperature is a
+  first-class rendered series.
+- Values are numbers, not toFixed(1) strings (SURVEY §2.1 quirk, fixed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpumon.collectors.prometheus import PrometheusClient
+
+# PromQL re-keying (SURVEY §5.8): all queries ride tpumon's own exporter.
+PROM_QUERIES: dict[str, str] = {
+    "cpu": "avg(tpumon_host_cpu_pct)",
+    "memory": "avg(tpumon_host_memory_pct)",
+    "disk": "avg(tpumon_host_disk_pct)",
+    "mxu": "avg(tpu_mxu_duty_cycle_pct)",
+    "hbm": "avg(tpu_hbm_used_pct)",
+    "temp": "avg(tpu_temp_celsius)",
+    "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
+    "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
+    "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
+}
+
+
+def format_hhmm(ts: float) -> str:
+    return time.strftime("%H:%M", time.localtime(ts))
+
+
+@dataclass
+class RingSeries:
+    """One bounded time series of (ts, value)."""
+
+    window_s: float
+    points: deque = field(default_factory=deque)  # (ts, value)
+
+    def add(self, ts: float, value: float) -> None:
+        self.points.append((ts, value))
+        cutoff = ts - self.window_s
+        while self.points and self.points[0][0] < cutoff:
+            self.points.popleft()
+
+    def resample(self, step_s: float, end: float | None = None) -> tuple[list[float], list[float]]:
+        """Downsample to a fixed step grid (last-value-wins per bucket)."""
+        if not self.points:
+            return [], []
+        pts = list(self.points)
+        end = end if end is not None else pts[-1][0]
+        start = max(pts[0][0], end - self.window_s)
+        times = [t for t, _ in pts]
+        grid: list[float] = []
+        vals: list[float] = []
+        t = start
+        while t <= end + 1e-9:
+            i = bisect.bisect_right(times, t) - 1
+            if i >= 0:
+                grid.append(t)
+                vals.append(pts[i][1])
+            t += step_s
+        return grid, vals
+
+
+class RingHistory:
+    """Named ring-buffer series, fed by the sampler each tick."""
+
+    def __init__(self, window_s: float = 1800):
+        self.window_s = window_s
+        self.series: dict[str, RingSeries] = {}
+
+    def record(self, name: str, value: float | None, ts: float | None = None) -> None:
+        if value is None:
+            return
+        ts = time.time() if ts is None else ts
+        s = self.series.get(name)
+        if s is None:
+            s = self.series[name] = RingSeries(window_s=self.window_s)
+        s.add(ts, float(value))
+
+    def snapshot_series(self, name: str, step_s: float) -> dict:
+        s = self.series.get(name)
+        if s is None:
+            return {"labels": [], "data": []}
+        grid, vals = s.resample(step_s)
+        return {
+            "labels": [format_hhmm(t) for t in grid],
+            "data": [round(v, 2) for v in vals],
+        }
+
+
+class HistoryService:
+    """Serves the /api/history contract from Prometheus when available,
+    falling back per-series to the ring buffer."""
+
+    def __init__(
+        self,
+        ring: RingHistory,
+        prometheus_url: str | None = None,
+        window_s: float = 1800,
+        step_s: float = 30,
+    ):
+        self.ring = ring
+        self.window_s = window_s
+        self.step_s = step_s
+        self.prom = PrometheusClient(prometheus_url) if prometheus_url else None
+        self.last_prom_ok: bool | None = None
+
+    async def _prom_series(self) -> dict[str, dict] | None:
+        if self.prom is None:
+            return None
+        names = list(PROM_QUERIES)
+        results = await asyncio.gather(
+            *(
+                self.prom.query_range(PROM_QUERIES[n], self.window_s, self.step_s)
+                for n in names
+            )
+        )
+        out: dict[str, dict] = {}
+        any_ok = False
+        for name, series_list in zip(names, results):
+            if not series_list:
+                continue
+            any_ok = True
+            s = series_list[0]
+            out[name] = {
+                "labels": [format_hhmm(t) for t in s.times],
+                "data": [round(v, 2) for v in s.values],
+            }
+        self.last_prom_ok = any_ok
+        return out if any_ok else None
+
+    async def snapshot(self) -> dict:
+        prom = await self._prom_series()
+        out: dict = {"source": "prometheus" if prom else "ring"}
+        # Per-series fallback: Prometheus result wins, ring fills gaps.
+        for name in PROM_QUERIES:
+            if prom and name in prom:
+                out[name] = prom[name]
+            else:
+                out[name] = self.ring.snapshot_series(name, self.step_s)
+        # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
+        # drill-down charts; Prometheus equivalents are labelled series the
+        # client can also get via its own PromQL if deployed.
+        per_chip: dict[str, dict] = {}
+        for name in self.ring.series:
+            if name.startswith("chip."):
+                per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
+                    name, self.step_s
+                )
+        if per_chip:
+            out["per_chip"] = per_chip
+        return out

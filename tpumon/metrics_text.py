@@ -1,0 +1,181 @@
+"""Prometheus text-exposition format: writer and parser.
+
+Writer: backs the in-tree exporter (``/metrics``) that replaces the
+reference's out-of-tree DCGM exporter dependency (README.md:135) — the
+``tpu_*`` series that /api/history PromQL re-keys onto (SURVEY §5.8).
+
+Parser: backs the serving-metrics ingest (JetStream / MaxText expose a
+Prometheus ``/metrics`` endpoint) — the TPU-native replacement for the
+reference's aspirational vLLM scrape (README.md:73; no vLLM code exists
+in the reference snapshot, SURVEY §5.7).
+
+Both sides are dependency-free and handle the subset of the format that
+Prometheus clients actually emit: HELP/TYPE comments, labels with escaped
+values, counters/gauges, histogram/summary series (exposed as plain
+sample lines with _bucket/_sum/_count suffixes).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+
+# --------------------------------------------------------------------------
+# Writer
+# --------------------------------------------------------------------------
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def format_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+@dataclass
+class MetricFamily:
+    name: str
+    mtype: str  # "gauge" | "counter" | "untyped"
+    help: str = ""
+    samples: list[tuple[dict[str, str], float]] = field(default_factory=list)
+
+    def add(self, labels: dict[str, str] | None = None, value: float = 0.0) -> None:
+        self.samples.append((labels or {}, value))
+
+
+class MetricsWriter:
+    def __init__(self) -> None:
+        self.families: list[MetricFamily] = []
+
+    def family(self, name: str, mtype: str, help: str = "") -> MetricFamily:
+        fam = MetricFamily(name=name, mtype=mtype, help=help)
+        self.families.append(fam)
+        return fam
+
+    def gauge(self, name: str, help: str = "") -> MetricFamily:
+        return self.family(name, "gauge", help)
+
+    def counter(self, name: str, help: str = "") -> MetricFamily:
+        return self.family(name, "counter", help)
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for fam in self.families:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.mtype}")
+            for labels, value in fam.samples:
+                if labels:
+                    inner = ",".join(
+                        f'{k}="{_escape_label_value(str(v))}"' for k, v in labels.items()
+                    )
+                    lines.append(f"{fam.name}{{{inner}}} {format_value(value)}")
+                else:
+                    lines.append(f"{fam.name} {format_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# Parser
+# --------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?\s*$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return v.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)
+
+
+@dataclass
+class ParsedSample:
+    name: str
+    labels: dict[str, str]
+    value: float
+
+
+def parse_metrics_text(text: str) -> list[ParsedSample]:
+    """Parse Prometheus exposition text into a flat sample list."""
+    out: list[ParsedSample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        labels: dict[str, str] = {}
+        if m.group("labels"):
+            for lm in _LABEL_RE.finditer(m.group("labels")):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+        try:
+            value = _parse_value(m.group("value"))
+        except ValueError:
+            continue
+        out.append(ParsedSample(name=m.group("name"), labels=labels, value=value))
+    return out
+
+
+def samples_by_name(samples: list[ParsedSample]) -> dict[str, list[ParsedSample]]:
+    by: dict[str, list[ParsedSample]] = {}
+    for s in samples:
+        by.setdefault(s.name, []).append(s)
+    return by
+
+
+def histogram_quantile(
+    samples: list[ParsedSample], q: float
+) -> float | None:
+    """Estimate a quantile from _bucket samples (cumulative, le-labelled),
+    linearly interpolating within the bucket — same approach as PromQL's
+    histogram_quantile."""
+    buckets: list[tuple[float, float]] = []
+    for s in samples:
+        le = s.labels.get("le")
+        if le is None:
+            continue
+        buckets.append((_parse_value(le), s.value))
+    if not buckets:
+        return None
+    buckets.sort(key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if math.isinf(le):
+                return prev_le if prev_le > 0 else None
+            if count == prev_count:
+                return le
+            frac = (rank - prev_count) / (count - prev_count)
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_count = le, count
+    return buckets[-1][0] if not math.isinf(buckets[-1][0]) else prev_le

@@ -1,0 +1,182 @@
+"""Minimal protobuf wire-format codec (no generated stubs, no deps).
+
+Used by the libtpu runtime-metrics client (tpumon.collectors.libtpu_grpc):
+libtpu's gRPC MetricService speaks protobuf, but shipping generated stubs
+for a small, version-drifting proto is brittle — instead we encode the
+one-field request by hand and decode responses generically into nested
+Python structures, then extract (device_id, value) pairs structurally.
+
+This replaces the reference's accelerator data path of shelling out to
+``nvidia-smi`` and CSV-parsing its stdout (monitor_server.js:83-95) with an
+in-process RPC — no subprocess, no text scraping.
+
+Wire format (https://protobuf.dev/programming-guides/encoding/):
+  tag = (field_number << 3) | wire_type
+  wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+WT_VARINT = 0
+WT_FIXED64 = 1
+WT_LEN = 2
+WT_FIXED32 = 5
+
+
+def encode_varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64  # two's-complement for negative int64
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decode_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift >= 70:
+            raise ValueError("varint too long")
+
+
+def encode_tag(field: int, wire_type: int) -> bytes:
+    return encode_varint((field << 3) | wire_type)
+
+
+def encode_string(field: int, value: str) -> bytes:
+    raw = value.encode("utf-8")
+    return encode_tag(field, WT_LEN) + encode_varint(len(raw)) + raw
+
+
+def encode_message(field: int, payload: bytes) -> bytes:
+    return encode_tag(field, WT_LEN) + encode_varint(len(payload)) + payload
+
+
+def encode_int(field: int, value: int) -> bytes:
+    return encode_tag(field, WT_VARINT) + encode_varint(value)
+
+
+def encode_double(field: int, value: float) -> bytes:
+    return encode_tag(field, WT_FIXED64) + struct.pack("<d", value)
+
+
+class Field:
+    """One decoded field occurrence."""
+
+    __slots__ = ("number", "wire_type", "value")
+
+    def __init__(self, number: int, wire_type: int, value: Any):
+        self.number = number
+        self.wire_type = wire_type
+        self.value = value
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Field({self.number}, wt={self.wire_type}, {self.value!r})"
+
+
+class Message:
+    """A decoded message: ordered list of Fields, with helpers."""
+
+    __slots__ = ("fields",)
+
+    def __init__(self, fields: list[Field]):
+        self.fields = fields
+
+    def all(self, number: int) -> list[Any]:
+        return [f.value for f in self.fields if f.number == number]
+
+    def first(self, number: int, default: Any = None) -> Any:
+        for f in self.fields:
+            if f.number == number:
+                return f.value
+        return default
+
+    def walk(self):
+        """Yield every Field in the tree, depth-first."""
+        for f in self.fields:
+            yield f
+            if isinstance(f.value, Message):
+                yield from f.value.walk()
+
+
+def _try_decode_submessage(raw: bytes) -> Message | None:
+    if not raw:
+        return None
+    try:
+        return decode_message(raw)
+    except ValueError:
+        return None
+
+
+def decode_message(buf: bytes, max_depth: int = 16) -> Message:
+    """Decode protobuf bytes into a Message tree.
+
+    Length-delimited fields are speculatively decoded as sub-messages; if
+    that fails they are kept as utf-8 text (when decodable) or raw bytes.
+    This is lossy w.r.t. schema (a string that happens to be valid proto
+    decodes as a Message) which is fine for structural extraction — callers
+    must match on shape, not on type alone.
+    """
+    if max_depth < 0:
+        raise ValueError("max depth exceeded")
+    fields: list[Field] = []
+    pos = 0
+    while pos < len(buf):
+        tag, pos = decode_varint(buf, pos)
+        number, wt = tag >> 3, tag & 7
+        if number == 0:
+            raise ValueError("field number 0")
+        if wt == WT_VARINT:
+            val, pos = decode_varint(buf, pos)
+            fields.append(Field(number, wt, val))
+        elif wt == WT_FIXED64:
+            if pos + 8 > len(buf):
+                raise ValueError("truncated fixed64")
+            (val,) = struct.unpack_from("<d", buf, pos)
+            fields.append(Field(number, wt, val))
+            pos += 8
+        elif wt == WT_FIXED32:
+            if pos + 4 > len(buf):
+                raise ValueError("truncated fixed32")
+            (val,) = struct.unpack_from("<f", buf, pos)
+            fields.append(Field(number, wt, val))
+            pos += 4
+        elif wt == WT_LEN:
+            ln, pos = decode_varint(buf, pos)
+            if pos + ln > len(buf):
+                raise ValueError("truncated length-delimited field")
+            raw = buf[pos : pos + ln]
+            pos += ln
+            sub = None
+            if max_depth > 0:
+                try:
+                    sub = decode_message(raw, max_depth - 1) if raw else None
+                except ValueError:
+                    sub = None
+            if sub is not None:
+                fields.append(Field(number, wt, sub))
+            else:
+                try:
+                    fields.append(Field(number, wt, raw.decode("utf-8")))
+                except UnicodeDecodeError:
+                    fields.append(Field(number, wt, raw))
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+    return Message(fields)

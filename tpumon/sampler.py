@@ -1,0 +1,260 @@
+"""Background sampler: the single owner of all monitoring state.
+
+The reference collects synchronously inside each HTTP request handler —
+three blocking shell-outs per /api/alerts hit (monitor_server.js:283-286)
+— and keeps pod-transition state in a module global mutated per request
+(monitor_server.js:157,235), which SURVEY §5.2 identifies as a data race
+between concurrent pollers. tpumon inverts this: one asyncio sampler
+collects on fixed cadences, owns the alert engine and ring history, and
+publishes immutable-ish snapshots; HTTP handlers only read. Transition
+detection becomes independent of client polling (SURVEY §2.2 note).
+
+The sampler also keeps self-metrics (per-source sample counts, latencies,
+consecutive failures) — the §5.1 "measure our own pipeline" requirement
+behind the driver's scrape→render p50 metric.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpumon.alerts import AlertEngine
+from tpumon.collectors import Collector, Sample, run_collector
+from tpumon.config import Config
+from tpumon.history import RingHistory
+from tpumon.topology import ChipSample, slice_views
+
+
+@dataclass
+class SourceStats:
+    samples: int = 0
+    failures: int = 0
+    consecutive_failures: int = 0
+    latencies_ms: deque = field(default_factory=lambda: deque(maxlen=512))
+
+    def record(self, s: Sample) -> None:
+        self.samples += 1
+        self.latencies_ms.append(s.latency_ms)
+        if s.ok:
+            self.consecutive_failures = 0
+        else:
+            self.failures += 1
+            self.consecutive_failures += 1
+
+    def p50_ms(self) -> float | None:
+        return statistics.median(self.latencies_ms) if self.latencies_ms else None
+
+    def to_json(self) -> dict:
+        return {
+            "samples": self.samples,
+            "failures": self.failures,
+            "consecutive_failures": self.consecutive_failures,
+            "latency_p50_ms": round(self.p50_ms() or 0.0, 3),
+        }
+
+
+class Sampler:
+    def __init__(
+        self,
+        cfg: Config,
+        host: Collector | None = None,
+        accel: Collector | None = None,
+        k8s: Collector | None = None,
+        serving: Collector | None = None,
+        history: RingHistory | None = None,
+        engine: AlertEngine | None = None,
+    ):
+        self.cfg = cfg
+        self.host = host
+        self.accel = accel
+        self.k8s = k8s
+        self.serving = serving
+        self.history = history if history is not None else RingHistory(cfg.history_window_s)
+        self.engine = engine or AlertEngine(cfg.thresholds)
+
+        self.latest: dict[str, Sample] = {}
+        self.stats: dict[str, SourceStats] = {}
+        self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
+        self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
+        self._tasks: list[asyncio.Task] = []
+        self.started_at = time.time()
+
+    # ------------------------- snapshot accessors -------------------------
+
+    def sample_of(self, source: str) -> Sample | None:
+        return self.latest.get(source)
+
+    def chips(self) -> list[ChipSample]:
+        s = self.latest.get("accel")
+        return list(s.data) if s and s.data else []
+
+    def slices(self):
+        return slice_views(self.chips(), self.cfg.expected_slice_chips)
+
+    def pods(self) -> list[dict]:
+        s = self.latest.get("k8s")
+        return list(s.data) if s and s.data else []
+
+    def host_data(self) -> dict:
+        s = self.latest.get("host")
+        return dict(s.data) if s and s.data else {}
+
+    def serving_data(self) -> list[dict]:
+        s = self.latest.get("serving")
+        return list(s.data) if s and s.data else []
+
+    def health_json(self) -> dict:
+        return {
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "sources": {
+                name: {
+                    **(self.latest[name].health_json() if name in self.latest else {}),
+                    **(self.stats[name].to_json() if name in self.stats else {}),
+                }
+                for name in ("host", "accel", "k8s", "serving")
+                if name in self.latest or name in self.stats
+            },
+        }
+
+    # ----------------------------- sampling -------------------------------
+
+    async def _run(self, c: Collector | None) -> Sample | None:
+        if c is None:
+            return None
+        s = await run_collector(c)
+        self.latest[s.source] = s
+        self.stats.setdefault(s.source, SourceStats()).record(s)
+        return s
+
+    def _update_ici_rates(self, chips: list[ChipSample], ts: float) -> None:
+        # Prune chips that stopped reporting (dead host) so aggregate ICI
+        # traffic drops instead of carrying their last rate forever.
+        present = {c.chip_id for c in chips}
+        for gone in [cid for cid in self.ici_rates if cid not in present]:
+            del self.ici_rates[gone]
+        for gone in [cid for cid in self._prev_ici if cid not in present]:
+            del self._prev_ici[gone]
+        for c in chips:
+            if c.ici_tx_bytes is None:
+                continue
+            prev = self._prev_ici.get(c.chip_id)
+            if prev is not None:
+                dt_s = ts - prev[0]
+                if dt_s > 0:
+                    tx = max(0.0, (c.ici_tx_bytes - prev[1]) / dt_s)
+                    rx = max(0.0, ((c.ici_rx_bytes or 0) - prev[2]) / dt_s)
+                    self.ici_rates[c.chip_id] = {
+                        "tx_bps": round(tx, 1),
+                        "rx_bps": round(rx, 1),
+                    }
+            self._prev_ici[c.chip_id] = (ts, c.ici_tx_bytes, c.ici_rx_bytes or 0)
+
+    def _record_history(self, ts: float) -> None:
+        host = self.host_data()
+        rec = self.history.record
+        if host:
+            rec("cpu", (host.get("cpu") or {}).get("percent"), ts)
+            rec("memory", (host.get("memory") or {}).get("percent"), ts)
+            rec("disk", (host.get("disk") or {}).get("percent"), ts)
+        chips = self.chips()
+        if chips:
+            duty = [c.mxu_duty_pct for c in chips if c.mxu_duty_pct is not None]
+            hbm = [c.hbm_pct for c in chips if c.hbm_pct is not None]
+            temp = [c.temp_c for c in chips if c.temp_c is not None]
+            if duty:
+                rec("mxu", sum(duty) / len(duty), ts)
+            if hbm:
+                rec("hbm", sum(hbm) / len(hbm), ts)
+            if temp:
+                rec("temp", sum(temp) / len(temp), ts)
+            tx_total = sum(r["tx_bps"] for r in self.ici_rates.values())
+            if self.ici_rates:
+                rec("ici", tx_total, ts)
+            for c in chips:
+                rec(f"chip.{c.chip_id}.mxu", c.mxu_duty_pct, ts)
+                rec(f"chip.{c.chip_id}.hbm", c.hbm_pct, ts)
+        serving = self.serving_data()
+        tokens = [
+            s["tokens_per_sec"] for s in serving if s.get("tokens_per_sec") is not None
+        ]
+        if tokens:
+            rec("tokens_per_sec", sum(tokens), ts)
+        ttfts = [
+            s["ttft_p50_ms"] for s in serving if s.get("ttft_p50_ms") is not None
+        ]
+        if ttfts:
+            rec("ttft_p50_ms", sum(ttfts) / len(ttfts), ts)
+
+    def _evaluate_alerts(self) -> None:
+        # Pod rules only run on a healthy scrape: a failed scrape must not
+        # wipe transition state (restarts/recoveries during the outage
+        # would otherwise go unalerted).
+        k8s_sample = self.latest.get("k8s")
+        self.engine.evaluate(
+            host=self.host_data() or None,
+            chips=self.chips(),
+            slices=self.slices(),
+            pods=self.pods() if (k8s_sample is not None and k8s_sample.ok) else None,
+            serving=self.serving_data() or None,
+        )
+
+    async def tick_fast(self) -> None:
+        """Host + accel sampling, history recording, alert evaluation."""
+        ts = time.time()
+        await asyncio.gather(self._run(self.host), self._run(self.accel))
+        self._update_ici_rates(self.chips(), ts)
+        self._record_history(ts)
+        self._evaluate_alerts()
+
+    async def tick_pods(self) -> None:
+        await self._run(self.k8s)
+
+    async def tick_serving(self) -> None:
+        await self._run(self.serving)
+
+    async def tick_all(self) -> None:
+        await self.tick_pods()
+        await self.tick_serving()
+        await self.tick_fast()
+
+    # ----------------------------- lifecycle -------------------------------
+
+    async def _loop(self, fn, interval_s: float) -> None:
+        while True:
+            t0 = time.monotonic()
+            try:
+                await fn()
+            except Exception:
+                pass  # collectors already degrade; never kill the loop
+            elapsed = time.monotonic() - t0
+            await asyncio.sleep(max(0.05, interval_s - elapsed))
+
+    async def start(self) -> None:
+        await self.tick_all()  # prime state before serving
+        self._tasks = [
+            asyncio.create_task(self._loop(self.tick_fast, self.cfg.sample_interval_s)),
+        ]
+        if self.k8s is not None:
+            self._tasks.append(
+                asyncio.create_task(self._loop(self.tick_pods, self.cfg.pods_interval_s))
+            )
+        if self.serving is not None:
+            self._tasks.append(
+                asyncio.create_task(
+                    self._loop(self.tick_serving, self.cfg.serving_interval_s)
+                )
+            )
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []

@@ -1,0 +1,289 @@
+"""Asyncio HTTP server + JSON API router.
+
+Reference parity (monitor_server.js:240-299): same-origin dashboard +
+JSON API on one port (default 8888), CORS ``*`` with OPTIONS preflight
+(:244-248), 404 for unknown routes (:290), handler exceptions → 500 with
+a JSON error body (:292-294).
+
+Route map (SURVEY §2.3, re-keyed for TPU):
+  /, /monitor.html      dashboard HTML (cached, mtime-refreshed)
+  /logo.svg             original tpumon logo
+  /api/host/metrics     host cards
+  /api/accel/metrics    per-chip TPU metrics + slice rollup (north star;
+                        replaces /api/gpu/metrics)
+  /api/gpu/metrics      reference-shaped compat view over the same chips
+  /api/k8s/pods         pod table
+  /api/history          30-min curves (Prometheus or ring buffer)
+  /api/alerts           last alert evaluation (sampler-owned, not
+                        recomputed per request — fixes SURVEY §5.2)
+  /api/serving          JetStream/MaxText panels
+  /api/topology         slice views
+  /api/health           per-source health + self stats
+  /metrics              in-tree Prometheus exporter
+
+The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
+266-270, a path-traversal risk) is deliberately NOT reproduced (SURVEY
+§2.1).
+
+The HTTP layer is a deliberately small stdlib-only implementation:
+HTTP/1.1, GET/HEAD/OPTIONS, Connection: close. Handlers never block —
+all state comes from the background sampler's snapshots, so request
+latency is O(json.dumps), which is what makes the scrape→render p50
+metric beat a collect-on-request design.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpumon.config import Config
+from tpumon.exporter import render_exporter
+from tpumon.history import HistoryService
+from tpumon.sampler import Sampler
+
+WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+_STATUS_TEXT = {
+    200: "OK",
+    204: "No Content",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    500: "Internal Server Error",
+}
+
+
+@dataclass
+class StaticFile:
+    path: str
+    content_type: str
+    _cache: tuple[float, bytes] | None = field(default=None, repr=False)
+
+    def read(self) -> bytes:
+        mtime = os.path.getmtime(self.path)
+        if self._cache is None or self._cache[0] != mtime:
+            with open(self.path, "rb") as f:
+                self._cache = (mtime, f.read())
+        return self._cache[1]
+
+
+class MonitorServer:
+    def __init__(self, cfg: Config, sampler: Sampler, history: HistoryService):
+        self.cfg = cfg
+        self.sampler = sampler
+        self.history = history
+        self._server: asyncio.Server | None = None
+        self.request_latencies_ms: deque = deque(maxlen=2048)
+        self._dashboard = StaticFile(
+            os.path.join(WEB_DIR, "dashboard.html"), "text/html; charset=utf-8"
+        )
+        self._logo = StaticFile(os.path.join(WEB_DIR, "logo.svg"), "image/svg+xml")
+
+    # ------------------------------ handlers ------------------------------
+
+    def _api_host(self) -> dict:
+        s = self.sampler.sample_of("host")
+        return {
+            **self.sampler.host_data(),
+            "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
+        }
+
+    def _api_accel(self) -> dict:
+        chips = self.sampler.chips()
+        rates = self.sampler.ici_rates
+        chip_json = []
+        for c in chips:
+            d = c.to_json()
+            d.update(rates.get(c.chip_id, {}))
+            chip_json.append(d)
+        s = self.sampler.sample_of("accel")
+        return {
+            "chips": chip_json,
+            "slices": [v.to_json() for v in self.sampler.slices()],
+            "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
+        }
+
+    def _api_gpu_compat(self) -> list[dict]:
+        """Reference-shaped view (monitor_server.js:90): lets clients
+        written against the reference's /api/gpu/metrics keep working."""
+        out = []
+        for c in self.sampler.chips():
+            out.append(
+                {
+                    "name": f"TPU {c.kind} {c.chip_id}",
+                    "utilization": round(c.mxu_duty_pct, 1)
+                    if c.mxu_duty_pct is not None
+                    else None,
+                    "memoryUsed": round(c.hbm_used / 2**20)
+                    if c.hbm_used is not None
+                    else None,
+                    "memoryTotal": round(c.hbm_total / 2**20)
+                    if c.hbm_total is not None
+                    else None,
+                    "temperature": c.temp_c,
+                }
+            )
+        return out
+
+    def _api_pods(self) -> dict:
+        s = self.sampler.sample_of("k8s")
+        return {
+            "pods": self.sampler.pods(),
+            "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
+        }
+
+    def _api_alerts(self) -> dict:
+        return {
+            **self.sampler.engine.last,
+            "evaluated_at": self.sampler.engine.last_ts,
+        }
+
+    def _api_serving(self) -> dict:
+        s = self.sampler.sample_of("serving")
+        return {
+            "targets": self.sampler.serving_data(),
+            "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
+        }
+
+    def _api_health(self) -> dict:
+        lat = list(self.request_latencies_ms)
+        return {
+            **self.sampler.health_json(),
+            "http": {
+                "requests": len(lat),
+                "latency_p50_ms": round(statistics.median(lat), 3) if lat else None,
+            },
+        }
+
+    async def handle(self, method: str, path: str) -> tuple[int, str, bytes]:
+        """Route a request; returns (status, content_type, body)."""
+        if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
+            return 200, self._dashboard.content_type, self._dashboard.read()
+        if path == "/logo.svg":
+            return 200, self._logo.content_type, self._logo.read()
+        if path == "/metrics":
+            return 200, "text/plain; version=0.0.4; charset=utf-8", render_exporter(
+                self.sampler
+            ).encode()
+
+        payload = None
+        if path == "/api/host/metrics":
+            payload = self._api_host()
+        elif path == "/api/accel/metrics":
+            payload = self._api_accel()
+        elif path == "/api/gpu/metrics":
+            payload = self._api_gpu_compat()
+        elif path == "/api/k8s/pods":
+            payload = self._api_pods()
+        elif path == "/api/history":
+            payload = await self.history.snapshot()
+        elif path == "/api/alerts":
+            payload = self._api_alerts()
+        elif path == "/api/serving":
+            payload = self._api_serving()
+        elif path == "/api/topology":
+            payload = {"slices": [v.to_json() for v in self.sampler.slices()]}
+        elif path == "/api/health":
+            payload = self._api_health()
+        if payload is None:
+            raise HttpError(404, "Not Found")
+        return 200, "application/json", json.dumps(payload).encode()
+
+    # ---------------------------- HTTP plumbing ----------------------------
+
+    async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        t0 = time.monotonic()
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), timeout=10)
+            if not request_line:
+                return
+            try:
+                method, target, _version = request_line.decode("latin-1").split()
+            except ValueError:
+                return
+            # Drain headers (we don't need any for GET routing).
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = target.split("?", 1)[0]  # query stripped (monitor_server.js:250)
+
+            if method == "OPTIONS":
+                await self._respond(writer, 204, "text/plain", b"")
+                return
+            if method not in ("GET", "HEAD"):
+                await self._respond(
+                    writer,
+                    405,
+                    "application/json",
+                    json.dumps({"error": "method not allowed"}).encode(),
+                )
+                return
+            try:
+                status, ctype, body = await self.handle(method, path)
+            except HttpError as e:
+                status, ctype = e.status, "application/json"
+                body = json.dumps({"error": e.message}).encode()
+            except Exception as e:  # 500-with-JSON (monitor_server.js:292-294)
+                status, ctype = 500, "application/json"
+                body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+            if method == "HEAD":
+                body = b""
+            await self._respond(writer, status, ctype, body)
+            self.request_latencies_ms.append((time.monotonic() - t0) * 1e3)
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _respond(
+        self, writer: asyncio.StreamWriter, status: int, ctype: str, body: bytes
+    ) -> None:
+        head = (
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            # CORS parity with the reference (monitor_server.js:244-248)
+            "Access-Control-Allow-Origin: *\r\n"
+            "Access-Control-Allow-Methods: GET, OPTIONS\r\n"
+            "Access-Control-Allow-Headers: Content-Type\r\n"
+            "Connection: close\r\n"
+            "\r\n"
+        )
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()
+
+    # ------------------------------ lifecycle ------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._client, host=self.cfg.host, port=self.cfg.port
+        )
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

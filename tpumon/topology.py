@@ -1,0 +1,154 @@
+"""Chip -> host -> slice topology model.
+
+The reference models accelerators as a flat list of GPUs on one host
+(monitor_server.js:90: ``{name, utilization, memoryUsed, memoryTotal,
+temperature}`` parsed from nvidia-smi CSV). SURVEY.md §7 ("Hard parts")
+calls out that this doesn't survive contact with multi-host TPU slices, so
+topology is first-class here: every chip sample carries its host and slice
+identity, and slice-level views (chip counts, aggregate duty cycle, missing
+chips) are derived, not stored.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+# Known TPU generations -> HBM bytes per chip. Used by the fake backend and
+# as a fallback when the real backend can report chip kind but not HBM
+# capacity. Public figures (v5e: 16 GiB, v5p: 95 GiB, v4: 32 GiB, v6e: 32 GiB).
+HBM_BYTES_BY_KIND: dict[str, int] = {
+    "v4": 32 * 1024**3,
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v6e": 32 * 1024**3,
+}
+
+
+def normalize_chip_kind(device_kind: str) -> str:
+    """Map a raw device-kind string (e.g. 'TPU v5 lite') to a short kind."""
+    k = device_kind.lower()
+    if "v5 lite" in k or "v5e" in k or "v5litepod" in k:
+        return "v5e"
+    if "v5p" in k or "v5" in k:
+        return "v5p"
+    if "v6" in k or "trillium" in k:
+        return "v6e"
+    if "v4" in k:
+        return "v4"
+    return device_kind
+
+
+@dataclass(frozen=True)
+class ChipSample:
+    """One chip's metrics at one instant.
+
+    TPU-native replacement for the reference's per-GPU record
+    (monitor_server.js:90): SM-util% -> MXU duty-cycle %, VRAM -> HBM,
+    plus ICI link counters and topology identity.
+    Fields that a backend cannot measure are None — "unknown" is expressed
+    explicitly rather than as 0 (SURVEY §7: honest degraded modes).
+    """
+
+    chip_id: str  # globally unique, e.g. "host-0/chip-3"
+    host: str
+    slice_id: str
+    index: int  # chip index within its host
+    kind: str  # "v5e", "v5p", ...
+    coords: tuple[int, ...] = ()
+    mxu_duty_pct: float | None = None
+    hbm_used: int | None = None
+    hbm_total: int | None = None
+    temp_c: float | None = None
+    ici_tx_bytes: int | None = None  # cumulative counters
+    ici_rx_bytes: int | None = None
+    ici_link_up: bool | None = None
+
+    @property
+    def hbm_pct(self) -> float | None:
+        if self.hbm_used is None or not self.hbm_total:
+            return None
+        return 100.0 * self.hbm_used / self.hbm_total
+
+    def to_json(self) -> dict:
+        d = {
+            "chip": self.chip_id,
+            "host": self.host,
+            "slice": self.slice_id,
+            "index": self.index,
+            "kind": self.kind,
+            "coords": list(self.coords),
+            "mxu_duty_pct": self.mxu_duty_pct,
+            "hbm_used": self.hbm_used,
+            "hbm_total": self.hbm_total,
+            "hbm_pct": self.hbm_pct,
+            "temp_c": self.temp_c,
+            "ici_tx_bytes": self.ici_tx_bytes,
+            "ici_rx_bytes": self.ici_rx_bytes,
+            "ici_link_up": self.ici_link_up,
+        }
+        return d
+
+
+@dataclass
+class SliceView:
+    """Derived per-slice aggregate."""
+
+    slice_id: str
+    hosts: list[str]
+    chips: list[ChipSample]
+    expected_chips: int | None = None
+
+    @property
+    def reporting_chips(self) -> int:
+        return len(self.chips)
+
+    @property
+    def missing_chips(self) -> int:
+        if self.expected_chips is None:
+            return 0
+        return max(0, self.expected_chips - len(self.chips))
+
+    def mean(self, attr: str) -> float | None:
+        vals = [getattr(c, attr) for c in self.chips]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) / len(vals) if vals else None
+
+    def to_json(self) -> dict:
+        return {
+            "slice": self.slice_id,
+            "hosts": sorted(self.hosts),
+            "reporting_chips": self.reporting_chips,
+            "expected_chips": self.expected_chips,
+            "missing_chips": self.missing_chips,
+            "mean_mxu_duty_pct": self.mean("mxu_duty_pct"),
+            "mean_hbm_pct": self.mean("hbm_pct"),
+        }
+
+
+def slice_views(
+    chips: Iterable[ChipSample], expected: Mapping[str, int] | None = None
+) -> list[SliceView]:
+    """Group chip samples into per-slice views (chip->host->slice rollup)."""
+    expected = expected or {}
+    by_slice: dict[str, SliceView] = {}
+    for c in chips:
+        view = by_slice.get(c.slice_id)
+        if view is None:
+            view = by_slice[c.slice_id] = SliceView(
+                slice_id=c.slice_id,
+                hosts=[],
+                chips=[],
+                expected_chips=expected.get(c.slice_id),
+            )
+        view.chips.append(c)
+        if c.host not in view.hosts:
+            view.hosts.append(c.host)
+    # Slices that are expected but entirely absent still get a (empty) view
+    # so the alert engine can flag them.
+    for slice_id, n in expected.items():
+        if slice_id not in by_slice:
+            by_slice[slice_id] = SliceView(
+                slice_id=slice_id, hosts=[], chips=[], expected_chips=n
+            )
+    return [by_slice[k] for k in sorted(by_slice)]
