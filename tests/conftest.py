@@ -1,8 +1,14 @@
 """Test bootstrap.
 
-Forces JAX onto a virtual 8-device CPU mesh before any jax import, so
-sharding/loadgen tests run without TPU hardware (the driver's
-dryrun_multichip uses the same mechanism).
+Forces JAX onto a virtual 8-device CPU mesh so sharding/loadgen tests run
+without TPU hardware (the driver's dryrun_multichip uses the same
+mechanism).
+
+Environment quirk: a sitecustomize hook may import jax at interpreter
+start and latch JAX_PLATFORMS from the parent environment, so setting
+os.environ here can be too late — we must also update jax.config
+directly. XLA_FLAGS still works via env as long as no backend has been
+*initialized* yet (registration alone doesn't initialize).
 """
 
 import os
@@ -14,5 +20,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # jax-less environments still run the pure-Python tests
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

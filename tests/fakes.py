@@ -1,0 +1,115 @@
+"""Fake external backends for integration tests (SURVEY §4.3): a stub
+Prometheus, a fake K8s apiserver, and a fake JetStream /metrics endpoint,
+each a tiny threaded HTTP server on an ephemeral port."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class FakeBackend:
+    """Route-table HTTP server: {path: callable(query) -> (status, ctype, body)}."""
+
+    def __init__(self):
+        self.routes = {}
+        self.requests: list[str] = []
+        backend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                u = urlparse(self.path)
+                backend.requests.append(self.path)
+                fn = backend.routes.get(u.path)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                status, ctype, body = fn(parse_qs(u.query))
+                if isinstance(body, str):
+                    body = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def fake_prometheus(series_value: float = 55.0) -> FakeBackend:
+    """Serves /api/v1/query_range with one synthetic series per query."""
+    b = FakeBackend()
+
+    def query_range(q):
+        start = float(q["start"][0])
+        end = float(q["end"][0])
+        step = float(q["step"][0])
+        values = []
+        t = start
+        while t <= end:
+            values.append([t, str(series_value)])
+            t += step
+        return (
+            200,
+            "application/json",
+            json.dumps(
+                {
+                    "status": "success",
+                    "data": {
+                        "resultType": "matrix",
+                        "result": [{"metric": {"q": q["query"][0]}, "values": values}],
+                    },
+                }
+            ),
+        )
+
+    def query(q):
+        return (
+            200,
+            "application/json",
+            json.dumps(
+                {
+                    "status": "success",
+                    "data": {
+                        "resultType": "vector",
+                        "result": [{"metric": {}, "value": [0, str(series_value)]}],
+                    },
+                }
+            ),
+        )
+
+    b.routes["/api/v1/query_range"] = query_range
+    b.routes["/api/v1/query"] = query
+    return b
+
+
+def fake_k8s_api(pods: list[dict]) -> FakeBackend:
+    b = FakeBackend()
+    b.routes["/api/v1/pods"] = lambda q: (
+        200,
+        "application/json",
+        json.dumps({"kind": "PodList", "items": pods}),
+    )
+    return b
+
+
+def fake_jetstream(text: str) -> FakeBackend:
+    b = FakeBackend()
+    b.routes["/metrics"] = lambda q: (200, "text/plain", text)
+    return b

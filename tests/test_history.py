@@ -1,0 +1,60 @@
+import asyncio
+
+from tpumon.history import PROM_QUERIES, HistoryService, RingHistory, RingSeries
+
+
+def test_ring_series_window_eviction():
+    s = RingSeries(window_s=100)
+    for t in range(0, 300, 10):
+        s.add(float(t), float(t))
+    assert s.points[0][0] >= 290 - 100
+
+
+def test_ring_resample_step_grid():
+    s = RingSeries(window_s=1800)
+    for t in range(0, 120, 1):  # 1 Hz samples for 2 min
+        s.add(1000.0 + t, float(t))
+    grid, vals = s.resample(step_s=30)
+    assert len(grid) == 4  # 0,30,60,90 offsets within the span
+    assert vals[0] == 0.0 and vals[1] == 30.0
+
+
+def test_ring_history_record_and_snapshot():
+    h = RingHistory(window_s=1800)
+    for i in range(10):
+        h.record("cpu", 50.0 + i, ts=1000.0 + 30 * i)
+    snap = h.snapshot_series("cpu", step_s=30)
+    assert len(snap["labels"]) == 10
+    assert snap["data"][0] == 50.0
+    assert h.snapshot_series("nope", 30) == {"labels": [], "data": []}
+    h.record("cpu", None)  # None values ignored
+    assert len(h.series["cpu"].points) == 10
+
+
+def test_history_service_ring_fallback_without_prometheus():
+    ring = RingHistory(1800)
+    ring.record("cpu", 42.0, ts=1000.0)
+    svc = HistoryService(ring, prometheus_url=None)
+    out = asyncio.run(svc.snapshot())
+    assert out["source"] == "ring"
+    assert out["cpu"]["data"] == [42.0]
+    # all contract keys present even when empty
+    for key in PROM_QUERIES:
+        assert key in out
+
+
+def test_history_service_prometheus_unreachable_falls_back():
+    ring = RingHistory(1800)
+    ring.record("mxu", 77.0, ts=1000.0)
+    svc = HistoryService(ring, prometheus_url="http://127.0.0.1:1")
+    out = asyncio.run(svc.snapshot())
+    assert out["source"] == "ring"
+    assert out["mxu"]["data"] == [77.0]
+
+
+def test_per_chip_series_included():
+    ring = RingHistory(1800)
+    ring.record("chip.h0/chip-0.mxu", 50.0, ts=1000.0)
+    svc = HistoryService(ring, prometheus_url=None)
+    out = asyncio.run(svc.snapshot())
+    assert out["per_chip"]["h0/chip-0.mxu"]["data"] == [50.0]

@@ -1,0 +1,138 @@
+"""Golden-input tests for the pod parser (SURVEY §4.1: kubectl -o json pod
+dumps incl. containerStatuses edge cases, shape from monitor_server.js:99-112)."""
+
+import asyncio
+
+from tpumon.collectors.k8s import K8sCollector, humanize_age, parse_pod_list
+
+NOW = 1_700_000_000.0
+
+
+def pod_doc(
+    name="p1",
+    ns="default",
+    phase="Running",
+    restarts=(0,),
+    start_offset_s=3600.0,
+    **extra,
+):
+    statuses = [{"restartCount": r} for r in restarts]
+    import datetime as dt
+
+    start = dt.datetime.fromtimestamp(NOW - start_offset_s, dt.timezone.utc)
+    doc = {
+        "metadata": {"namespace": ns, "name": name, "labels": {}},
+        "spec": {"nodeName": "node-1", "nodeSelector": {}},
+        "status": {
+            "phase": phase,
+            "startTime": start.isoformat().replace("+00:00", "Z"),
+            "containerStatuses": statuses,
+        },
+    }
+    for k, v in extra.items():
+        parts = k.split("__")
+        d = doc
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return doc
+
+
+def test_humanize_age_matches_reference_buckets():
+    # days / hours / minutes (monitor_server.js:106-110)
+    assert humanize_age(2 * 86400 + 5) == "2d"
+    assert humanize_age(3 * 3600 + 100) == "3h"
+    assert humanize_age(150) == "2m"
+    assert humanize_age(10) == "0m"
+
+
+def test_parse_basic_fields():
+    pods = parse_pod_list({"items": [pod_doc(restarts=(2, 3))]}, now=NOW)
+    assert len(pods) == 1
+    p = pods[0]
+    assert p["namespace"] == "default" and p["name"] == "p1"
+    assert p["status"] == "Running"
+    assert p["restarts"] == 5  # summed over containers (monitor_server.js:104)
+    assert p["age"] == "1h"
+    assert p["node"] == "node-1"
+
+
+def test_parse_pending_without_container_statuses():
+    doc = pod_doc(phase="Pending")
+    del doc["status"]["containerStatuses"]
+    del doc["status"]["startTime"]
+    p = parse_pod_list({"items": [doc]}, now=NOW)[0]
+    assert p["restarts"] == 0
+    assert p["age"] == ""
+    assert p["age_s"] is None
+
+
+def test_parse_waiting_reason_crashloop():
+    doc = pod_doc(
+        restarts=(4,),
+        status__containerStatuses=[
+            {
+                "restartCount": 4,
+                "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+            }
+        ],
+    )
+    p = parse_pod_list({"items": [doc]}, now=NOW)[0]
+    assert p["reason"] == "CrashLoopBackOff"
+
+
+def test_parse_oomkilled_from_last_state():
+    doc = pod_doc(
+        status__containerStatuses=[
+            {
+                "restartCount": 1,
+                "state": {"running": {}},
+                "lastState": {"terminated": {"reason": "OOMKilled"}},
+            }
+        ],
+    )
+    p = parse_pod_list({"items": [doc]}, now=NOW)[0]
+    assert p["reason"] == "OOMKilled"
+
+
+def test_completed_termination_not_a_reason():
+    doc = pod_doc(
+        status__containerStatuses=[
+            {"restartCount": 0, "state": {"terminated": {"reason": "Completed"}}}
+        ],
+    )
+    p = parse_pod_list({"items": [doc]}, now=NOW)[0]
+    assert p["reason"] is None
+
+
+def test_tpu_topology_metadata_extracted():
+    doc = pod_doc(
+        spec__nodeSelector={
+            "cloud.google.com/gke-tpu-topology": "4x4",
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+        },
+        metadata__labels={
+            "jobset.sigs.k8s.io/jobset-name": "maxtext-pretrain",
+            "batch.kubernetes.io/job-completion-index": "3",
+        },
+    )
+    p = parse_pod_list({"items": [doc]}, now=NOW)[0]
+    assert p["tpu_topology"] == "4x4"
+    assert p["tpu_accelerator"] == "tpu-v5p-slice"
+    assert p["jobset"] == "maxtext-pretrain"
+    assert p["job_index"] == "3"
+
+
+def test_empty_and_malformed_items():
+    assert parse_pod_list({}) == []
+    assert parse_pod_list({"items": [{}]})[0]["status"] == "Unknown"
+
+
+def test_collector_degrades_when_all_sources_fail():
+    """Reference contract: [] on error (monitor_server.js:113), with the
+    error recorded."""
+    c = K8sCollector(mode="api", api_url="http://127.0.0.1:1")  # nothing listens
+    s = asyncio.run(c.collect())
+    assert not s.ok
+    assert s.data == []
+    assert "ApiPodSource" in s.error

@@ -1,0 +1,71 @@
+import math
+
+from tpumon.metrics_text import (
+    MetricsWriter,
+    histogram_quantile,
+    parse_metrics_text,
+    samples_by_name,
+)
+
+
+def test_writer_basic():
+    w = MetricsWriter()
+    g = w.gauge("tpu_mxu_duty_cycle_pct", "duty")
+    g.add({"chip": "h0/chip-0", "slice": "s0"}, 42.5)
+    g.add({}, 7)
+    c = w.counter("tpu_ici_tx_bytes_total")
+    c.add({"chip": "h0/chip-0"}, 123456789)
+    text = w.render()
+    assert "# HELP tpu_mxu_duty_cycle_pct duty" in text
+    assert "# TYPE tpu_mxu_duty_cycle_pct gauge" in text
+    assert 'tpu_mxu_duty_cycle_pct{chip="h0/chip-0",slice="s0"} 42.5' in text
+    assert "\ntpu_mxu_duty_cycle_pct 7\n" in text
+    assert 'tpu_ici_tx_bytes_total{chip="h0/chip-0"} 123456789' in text
+
+
+def test_writer_escaping_and_roundtrip():
+    w = MetricsWriter()
+    g = w.gauge("weird")
+    g.add({"name": 'quo"te\\back\nnl'}, 1.0)
+    text = w.render()
+    samples = parse_metrics_text(text)
+    assert samples[0].labels["name"] == 'quo"te\\back\nnl'
+
+
+def test_parse_ignores_comments_and_garbage():
+    text = """\
+# HELP x help text
+# TYPE x counter
+x 5
+not a metric line !!!
+y{a="b"} 2.5 1700000000
+z +Inf
+"""
+    samples = parse_metrics_text(text)
+    names = [s.name for s in samples]
+    assert names == ["x", "y", "z"]
+    assert samples[1].labels == {"a": "b"}
+    assert math.isinf(samples[2].value)
+
+
+def test_histogram_quantile_interpolation():
+    # buckets: le=0.1:10, le=0.5:30, le=1:40, le=+Inf:40
+    text = """\
+h_bucket{le="0.1"} 10
+h_bucket{le="0.5"} 30
+h_bucket{le="1"} 40
+h_bucket{le="+Inf"} 40
+"""
+    by = samples_by_name(parse_metrics_text(text))
+    buckets = by["h_bucket"]
+    # p50: rank 20 -> inside (0.1, 0.5]: 0.1 + (20-10)/(30-10)*0.4 = 0.3
+    assert histogram_quantile(buckets, 0.5) == (0.1 + 0.4 * 0.5)
+    # p25: rank 10 -> exactly at first bucket boundary
+    assert histogram_quantile(buckets, 0.25) <= 0.1
+    assert histogram_quantile(buckets, 1.0) == 1.0
+
+
+def test_histogram_quantile_degenerate():
+    assert histogram_quantile([], 0.5) is None
+    zero = parse_metrics_text('h_bucket{le="+Inf"} 0')
+    assert histogram_quantile(zero, 0.5) is None

@@ -1,0 +1,111 @@
+"""Multi-host slice simulation (SURVEY §4.4): a v5p-64 fake slice, fault
+injection by killing hosts, slice-failure alerting and exporter
+aggregation — multi-node behavior without a cluster."""
+
+import asyncio
+
+from tpumon.alerts import AlertEngine
+from tpumon.collectors.accel_fake import FakeTpuCollector
+from tpumon.config import load_config
+from tpumon.exporter import render_exporter
+from tpumon.metrics_text import parse_metrics_text, samples_by_name
+from tpumon.sampler import Sampler
+from tpumon.topology import slice_views
+
+
+def make_sampler(topology="v5p-64", expected=64):
+    cfg = load_config(
+        env={
+            "TPUMON_ACCEL_BACKEND": f"fake:{topology}",
+            "TPUMON_EXPECTED_SLICE_CHIPS": f'{{"slice-0": {expected}}}',
+            "TPUMON_COLLECTORS": "accel",
+        }
+    )
+    accel = FakeTpuCollector(topology=topology)
+    return cfg, accel, Sampler(cfg, accel=accel)
+
+
+def alert_keys(engine: AlertEngine):
+    return {a["key"] for sev in engine.last.values() for a in sev}
+
+
+def test_v5p64_healthy_slice():
+    cfg, accel, sampler = make_sampler()
+    asyncio.run(sampler.tick_fast())
+    views = sampler.slices()
+    assert len(views) == 1
+    assert views[0].reporting_chips == 64
+    assert views[0].missing_chips == 0
+    assert len(views[0].hosts) == 16
+    assert "slice.slice-0.missing" not in alert_keys(sampler.engine)
+
+
+def test_host_failure_triggers_slice_alert():
+    cfg, accel, sampler = make_sampler()
+    asyncio.run(sampler.tick_fast())
+    accel.kill_host("tpu-host-7")  # fault injection: one host of 16 dies
+    asyncio.run(sampler.tick_fast())
+    views = sampler.slices()
+    assert views[0].reporting_chips == 60
+    assert views[0].missing_chips == 4
+    keys = alert_keys(sampler.engine)
+    assert "slice.slice-0.missing" in keys
+    crit = sampler.engine.last["critical"][0]
+    assert "60/64" in crit["desc"]
+
+
+def test_recovery_clears_slice_alert():
+    cfg, accel, sampler = make_sampler()
+    accel.kill_host("tpu-host-3")
+    asyncio.run(sampler.tick_fast())
+    assert "slice.slice-0.missing" in alert_keys(sampler.engine)
+    accel.revive_host("tpu-host-3")
+    asyncio.run(sampler.tick_fast())
+    assert "slice.slice-0.missing" not in alert_keys(sampler.engine)
+
+
+def test_exporter_aggregates_all_hosts():
+    cfg, accel, sampler = make_sampler()
+    asyncio.run(sampler.tick_fast())
+    by = samples_by_name(parse_metrics_text(render_exporter(sampler)))
+    duty = by["tpu_mxu_duty_cycle_pct"]
+    assert len(duty) == 64
+    hosts = {s.labels["host"] for s in duty}
+    assert len(hosts) == 16
+    assert by["tpu_slice_reporting_chips"][0].value == 64
+    assert by["tpu_slice_expected_chips"][0].value == 64
+
+
+def test_ici_rates_prune_dead_hosts():
+    """Aggregate ICI traffic must drop when a host dies (code-review
+    finding: stale rates were carried forever)."""
+    cfg, accel, sampler = make_sampler(topology="v5p-8", expected=8)
+
+    async def scenario():
+        t = [1000.0]
+        accel.clock = lambda: t[0]
+        await sampler.tick_fast()
+        t[0] += 10
+        await sampler.tick_fast()
+        assert len(sampler.ici_rates) == 8
+        accel.kill_host("tpu-host-1")
+        t[0] += 10
+        await sampler.tick_fast()
+        assert len(sampler.ici_rates) == 4
+        assert not any("tpu-host-1" in cid for cid in sampler.ici_rates)
+
+    asyncio.run(scenario())
+
+
+def test_multi_slice_topology():
+    """Two independent fake slices feeding one alert engine — the
+    multi-slice aggregation path."""
+    a = FakeTpuCollector(topology="v5e-8", slice_id="slice-a", host_prefix="ha")
+    b = FakeTpuCollector(topology="v5p-8", slice_id="slice-b", host_prefix="hb")
+    chips = a.chips() + b.chips()
+    views = slice_views(chips, {"slice-a": 8, "slice-b": 8})
+    assert [v.slice_id for v in views] == ["slice-a", "slice-b"]
+    assert all(v.missing_chips == 0 for v in views)
+    engine = AlertEngine()
+    engine.evaluate(chips=chips, slices=views)
+    assert "slice.slice-a.missing" not in alert_keys(engine)

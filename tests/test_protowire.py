@@ -1,0 +1,70 @@
+import pytest
+
+from tpumon import protowire as pw
+from tpumon.collectors.libtpu_grpc import encode_metric_request, extract_gauges
+
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+        buf = pw.encode_varint(v)
+        out, pos = pw.decode_varint(buf, 0)
+        assert out == v and pos == len(buf)
+
+
+def test_negative_int64_two_complement():
+    buf = pw.encode_varint(-1)
+    assert len(buf) == 10  # canonical proto encoding of -1
+    out, _ = pw.decode_varint(buf, 0)
+    assert out == 2**64 - 1
+
+
+def test_string_and_message_roundtrip():
+    inner = pw.encode_int(1, 3) + pw.encode_double(2, 42.5)
+    outer = pw.encode_string(1, "tpu.metric") + pw.encode_message(2, inner)
+    msg = pw.decode_message(outer)
+    assert msg.first(1) == "tpu.metric"
+    sub = msg.first(2)
+    assert isinstance(sub, pw.Message)
+    assert sub.first(1) == 3
+    assert sub.first(2) == 42.5
+
+
+def test_truncated_raises():
+    with pytest.raises(ValueError):
+        pw.decode_message(b"\x08")  # tag then missing varint
+    with pytest.raises(ValueError):
+        pw.decode_message(b"\x0a\x05ab")  # length 5, only 2 bytes
+
+
+def build_metric_response(values: dict[int, float], as_int=False) -> bytes:
+    """Build a libtpu-shaped MetricResponse:
+    MetricResponse{ metric=1: TPUMetric{ name=1, metrics=2: repeated
+    Metric{ attribute=1: {key=1,value=2:{int_attr=1}}, gauge=2:{as_int=1|as_double=2} } } }
+    """
+    entries = b""
+    for idx, val in values.items():
+        attr_value = pw.encode_int(1, idx)
+        attribute = pw.encode_string(1, "device_id") + pw.encode_message(2, attr_value)
+        gauge = pw.encode_int(1, int(val)) if as_int else pw.encode_double(2, val)
+        metric = pw.encode_message(1, attribute) + pw.encode_message(2, gauge)
+        entries += pw.encode_message(2, metric)
+    tpumetric = pw.encode_string(1, "tpu.runtime.hbm.memory.usage.bytes") + entries
+    return pw.encode_message(1, tpumetric)
+
+
+def test_extract_gauges_double():
+    resp = build_metric_response({0: 12.5, 3: 99.0})
+    assert extract_gauges(resp) == {0: 12.5, 3: 99.0}
+
+
+def test_extract_gauges_int64():
+    resp = build_metric_response({0: 8 * 2**30, 1: 4 * 2**30}, as_int=True)
+    out = extract_gauges(resp)
+    assert out[0] == float(8 * 2**30)
+    assert out[1] == float(4 * 2**30)
+
+
+def test_metric_request_shape():
+    req = encode_metric_request("tpu.runtime.tensorcore.dutycycle.percent")
+    msg = pw.decode_message(req)
+    assert msg.first(1) == "tpu.runtime.tensorcore.dutycycle.percent"

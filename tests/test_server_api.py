@@ -1,0 +1,205 @@
+"""Integration tests: the real server wired to fake backends, asserting
+the API contracts of SURVEY §2.3 (re-keyed for TPU)."""
+
+import asyncio
+import dataclasses
+import json
+import urllib.request
+
+import pytest
+
+from tests.fakes import fake_jetstream, fake_k8s_api, fake_prometheus
+from tests.test_k8s import pod_doc
+from tests.test_serving import JETSTREAM_TEXT
+from tpumon.app import build
+from tpumon.config import load_config
+
+
+def serve(env=None):
+    """Build the app from env config; returns (cfg, sampler, server)."""
+    base = {
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+    }
+    base.update(env or {})
+    cfg = load_config(env=base)
+    return build(cfg)
+
+
+async def run_app(sampler, server):
+    await sampler.tick_all()
+    await server.start()
+    return server.port
+
+
+def get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def get_status(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+class TestApiContracts:
+    @pytest.fixture()
+    def app(self):
+        sampler, server = serve()
+        loop = asyncio.new_event_loop()
+        port = loop.run_until_complete(run_app(sampler, server))
+        yield loop, port, sampler
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+    def _get(self, app, path):
+        loop, port, _ = app
+        return loop.run_until_complete(asyncio.to_thread(get_json, port, path))
+
+    def test_host_metrics_contract(self, app):
+        d = self._get(app, "/api/host/metrics")
+        # Reference shape (monitor_server.js:75-79) + health envelope.
+        assert {"load_1min", "percent", "cores"} <= set(d["cpu"])
+        assert {"total", "used", "percent"} <= set(d["memory"])
+        assert {"total", "used", "percent"} <= set(d["disk"])
+        assert d["health"]["ok"] is True
+
+    def test_accel_metrics_contract(self, app):
+        d = self._get(app, "/api/accel/metrics")
+        assert len(d["chips"]) == 8
+        chip = d["chips"][0]
+        assert {
+            "chip", "host", "slice", "kind", "mxu_duty_pct",
+            "hbm_used", "hbm_total", "hbm_pct", "temp_c",
+        } <= set(chip)
+        assert d["slices"][0]["reporting_chips"] == 8
+
+    def test_gpu_compat_contract(self, app):
+        d = self._get(app, "/api/gpu/metrics")
+        # Reference shape: [{name, utilization, memoryUsed, memoryTotal,
+        # temperature}] (monitor_server.js:90).
+        assert len(d) == 8
+        assert {"name", "utilization", "memoryUsed", "memoryTotal", "temperature"} <= set(d[0])
+        assert d[0]["memoryTotal"] == 16 * 1024  # MB
+
+    def test_alerts_contract(self, app):
+        d = self._get(app, "/api/alerts")
+        for sev in ("minor", "serious", "critical"):
+            assert isinstance(d[sev], list)
+            for a in d[sev]:
+                assert {"title", "desc", "fix"} <= set(a)
+
+    def test_history_contract(self, app):
+        d = self._get(app, "/api/history")
+        assert d["source"] == "ring"
+        for key in ("cpu", "memory", "disk", "mxu", "hbm", "temp"):
+            assert "labels" in d[key] and "data" in d[key]
+            assert len(d[key]["labels"]) == len(d[key]["data"])
+
+    def test_metrics_exporter(self, app):
+        loop, port, _ = app
+
+        def fetch():
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                return r.read().decode()
+
+        text = loop.run_until_complete(asyncio.to_thread(fetch))
+        assert "tpu_mxu_duty_cycle_pct{" in text
+        assert "tpu_hbm_used_bytes{" in text
+        assert 'slice="slice-0"' in text
+        assert "tpumon_samples_total{" in text
+
+    def test_dashboard_and_errors(self, app):
+        loop, port, _ = app
+
+        def statuses():
+            return (
+                get_status(port, "/"),
+                get_status(port, "/nope"),
+                get_status(port, "/api/history?x=1"),
+            )
+
+        ok, nf, qs = loop.run_until_complete(asyncio.to_thread(statuses))
+        assert (ok, nf, qs) == (200, 404, 200)
+
+
+def test_full_stack_with_fake_backends():
+    """All fake upstreams live at once: Prometheus, K8s apiserver,
+    JetStream — the §4.3 integration scenario."""
+    prom = fake_prometheus(series_value=61.5)
+    k8s = fake_k8s_api([pod_doc(name="js", phase="Running"), pod_doc(name="bad", phase="Failed")])
+    js = fake_jetstream(JETSTREAM_TEXT)
+    try:
+        sampler, server = serve(
+            {
+                "TPUMON_PROMETHEUS_URL": prom.url,
+                "TPUMON_K8S_MODE": "api",
+                "TPUMON_K8S_API_URL": k8s.url,
+                "TPUMON_SERVING_TARGETS": js.url,
+            }
+        )
+
+        async def scenario():
+            await sampler.tick_all()
+            await server.start()
+            port = server.port
+            pods = await asyncio.to_thread(get_json, port, "/api/k8s/pods")
+            assert [p["name"] for p in pods["pods"]] == ["js", "bad"]
+            assert pods["health"]["ok"] is True
+
+            alerts = await asyncio.to_thread(get_json, port, "/api/alerts")
+            keys = {a["key"] for sev in ("minor", "serious", "critical") for a in alerts[sev]}
+            assert "pod.default/bad.failed" in keys
+
+            hist = await asyncio.to_thread(get_json, port, "/api/history")
+            assert hist["source"] == "prometheus"
+            assert hist["cpu"]["data"][0] == 61.5
+
+            serving = await asyncio.to_thread(get_json, port, "/api/serving")
+            t = serving["targets"][0]
+            assert t["ok"] and t["tokens_total"] == 80000
+
+            health = await asyncio.to_thread(get_json, port, "/api/health")
+            assert set(health["sources"]) == {"host", "accel", "k8s", "serving"}
+            assert all(s["ok"] for s in health["sources"].values())
+            await server.stop()
+
+        asyncio.run(scenario())
+    finally:
+        prom.close()
+        k8s.close()
+        js.close()
+
+
+def test_degraded_sources_render_not_error():
+    """SURVEY §7: every config must render without errors when upstream
+    sources are absent — with explicit source health."""
+    sampler, server = serve(
+        {
+            "TPUMON_PROMETHEUS_URL": "http://127.0.0.1:1",
+            "TPUMON_K8S_MODE": "api",
+            "TPUMON_K8S_API_URL": "http://127.0.0.1:1",
+            "TPUMON_SERVING_TARGETS": "http://127.0.0.1:1",
+        }
+    )
+
+    async def scenario():
+        await sampler.tick_all()
+        await server.start()
+        port = server.port
+        for path in ("/api/host/metrics", "/api/accel/metrics", "/api/k8s/pods",
+                     "/api/history", "/api/alerts", "/api/serving", "/api/health"):
+            d = await asyncio.to_thread(get_json, port, path)
+            assert d is not None
+        pods = await asyncio.to_thread(get_json, port, "/api/k8s/pods")
+        assert pods["pods"] == [] and pods["health"]["ok"] is False
+        hist = await asyncio.to_thread(get_json, port, "/api/history")
+        assert hist["source"] == "ring"  # prometheus down -> ring fallback
+        await server.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,81 @@
+"""Serving-metrics ingest tests against canned JetStream-style exposition
+text (SURVEY §5.7 / BASELINE config 4)."""
+
+import asyncio
+
+from tpumon.collectors.serving import ServingCollector, distill_serving_metrics
+
+JETSTREAM_TEXT = """\
+# HELP jetstream_time_to_first_token TTFT histogram
+# TYPE jetstream_time_to_first_token histogram
+jetstream_time_to_first_token_bucket{le="0.025"} 10
+jetstream_time_to_first_token_bucket{le="0.05"} 60
+jetstream_time_to_first_token_bucket{le="0.1"} 90
+jetstream_time_to_first_token_bucket{le="+Inf"} 100
+jetstream_time_to_first_token_sum 5.5
+jetstream_time_to_first_token_count 100
+# TYPE jetstream_generate_tokens counter
+jetstream_generate_tokens{id="0"} 50000
+jetstream_generate_tokens{id="1"} 30000
+# TYPE jetstream_queue_size gauge
+jetstream_queue_size 7
+# TYPE jetstream_request_count counter
+jetstream_request_count 420
+"""
+
+
+def test_distill_jetstream():
+    d = distill_serving_metrics(JETSTREAM_TEXT, now=1000.0)
+    # p50: rank 50 in (0.025,0.05]: 0.025 + (50-10)/(60-10)*0.025 = 0.045 s
+    assert abs(d["ttft_p50_ms"] - 45.0) < 1e-6
+    assert d["ttft_p99_ms"] > d["ttft_p50_ms"]
+    assert d["tokens_total"] == 80000
+    assert d["queue_depth"] == 7
+    assert d["requests_total"] == 420
+    assert "tokens_per_sec" not in d  # no previous sample yet
+
+
+def test_counter_rates_between_scrapes():
+    prev = distill_serving_metrics(JETSTREAM_TEXT, now=1000.0)
+    later = JETSTREAM_TEXT.replace("50000", "53000").replace("420", "440")
+    d = distill_serving_metrics(later, prev=prev, now=1010.0)
+    assert d["tokens_per_sec"] == 300.0  # +3000 tokens / 10 s
+    assert d["requests_per_sec"] == 2.0
+
+
+def test_counter_reset_no_negative_rate():
+    prev = distill_serving_metrics(JETSTREAM_TEXT, now=1000.0)
+    reset = JETSTREAM_TEXT.replace("50000", "10").replace("30000", "0")
+    d = distill_serving_metrics(reset, prev=prev, now=1010.0)
+    assert "tokens_per_sec" not in d  # reset detected, no bogus negative rate
+
+
+def test_vllm_compat_names():
+    text = """\
+vllm:time_to_first_token_seconds_bucket{le="0.1"} 5
+vllm:time_to_first_token_seconds_bucket{le="+Inf"} 10
+vllm:generation_tokens 1234
+vllm:num_requests_waiting 3
+"""
+    d = distill_serving_metrics(text, now=1.0)
+    assert d["tokens_total"] == 1234
+    assert d["queue_depth"] == 3
+    assert d["ttft_p50_ms"] is not None
+
+
+def test_unknown_deployment_degrades():
+    d = distill_serving_metrics("some_other_metric 1\n", now=1.0)
+    assert d["raw_families"] == 1
+    assert "tokens_total" not in d
+
+
+def test_collector_no_targets():
+    s = asyncio.run(ServingCollector(targets=()).collect())
+    assert s.ok and s.data == []
+
+
+def test_collector_unreachable_target():
+    c = ServingCollector(targets=("http://127.0.0.1:1",), timeout_s=0.5)
+    s = asyncio.run(c.collect())
+    assert not s.ok
+    assert s.data[0]["ok"] is False

@@ -1,0 +1,15 @@
+"""Load-generation / monitoring-validation workloads.
+
+The reference has no way to *exercise* the accelerators it monitors; on
+NVIDIA stacks that role is played by out-of-tree tools (dcgmproftester).
+tpumon ships an in-tree, TPU-native equivalent: a small Llama-style
+transformer (tpumon.loadgen.model) and targeted burn kernels
+(tpumon.loadgen.burn) that drive the MXU, HBM and ICI so the monitoring
+pipeline can be validated end-to-end on real hardware — and so bench.py
+measures scrape→render latency while the chip is actually busy.
+
+Everything here is written jit-first: static shapes, lax control flow,
+bfloat16 matmuls for the MXU, sharding via jax.sharding.Mesh +
+NamedSharding so the same step runs single-chip or over a multi-host
+dp×tp mesh.
+"""

@@ -1,0 +1,121 @@
+"""Targeted burn kernels: drive MXU / HBM / ICI to validate monitoring.
+
+The TPU-native analogue of NVIDIA's dcgmproftester: deterministic
+synthetic load so the exporter's duty-cycle/HBM/ICI readings can be
+checked against a known workload (SURVEY §6: the bench metric is
+measured *under load*).
+
+Each burn is a single jitted program with lax control flow (no Python
+loops inside jit) and static shapes.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@partial(jax.jit, static_argnames=("size", "iters"))
+def _mxu_burn_program(key: jax.Array, size: int, iters: int) -> jax.Array:
+    """Chained bf16 matmuls: 2*size^3*iters FLOPs on the MXU."""
+    a = jax.random.normal(key, (size, size), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (size, size), jnp.bfloat16)
+
+    def body(carry, _):
+        a, b = carry
+        c = a @ b
+        # Renormalize to keep values finite across iterations.
+        c = (c / jnp.float32(size).astype(jnp.bfloat16)).astype(jnp.bfloat16)
+        return (c, b), ()
+
+    (out, _), _ = jax.lax.scan(body, (a, b), None, length=iters)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+def mxu_burn(seconds: float = 2.0, size: int = 4096, iters: int = 64) -> dict:
+    """Run matmul bursts for ~`seconds`; returns achieved TFLOP/s."""
+    key = jax.random.PRNGKey(0)
+    # Warm up / compile.
+    _mxu_burn_program(key, size, iters).block_until_ready()
+    flops_per_call = 2 * size**3 * iters
+    calls = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        _mxu_burn_program(jax.random.fold_in(key, calls), size, iters).block_until_ready()
+        calls += 1
+    dt = time.perf_counter() - t0
+    return {
+        "calls": calls,
+        "seconds": dt,
+        "tflops": flops_per_call * calls / dt / 1e12,
+    }
+
+
+def hbm_fill(fraction: float = 0.5, hbm_bytes: int | None = None) -> list[jax.Array]:
+    """Allocate ~fraction of HBM (holds references; caller drops to free).
+
+    Used to validate the HBM% reading: allocate, observe the exporter
+    report the committed fraction, release.
+    """
+    dev = jax.devices()[0]
+    if hbm_bytes is None:
+        stats = dev.memory_stats() or {}
+        hbm_bytes = stats.get("bytes_limit", 16 * 2**30)
+    n = int(hbm_bytes * fraction) // 4
+    chunk = 64 * 2**20 // 4  # 64 MB chunks avoid one giant alloc
+    arrays = []
+    remaining = n
+    i = 0
+    while remaining > 0:
+        size = min(chunk, remaining)
+        arrays.append(jnp.ones((size,), jnp.float32) * i)
+        remaining -= size
+        i += 1
+    jax.block_until_ready(arrays)
+    return arrays
+
+
+def ici_burn(mesh: Mesh, mb_per_shift: int = 64, iters: int = 8) -> dict:
+    """Ring-permute a sharded buffer around the mesh's first axis,
+    driving ICI links. Uses shard_map + lax.ppermute (the explicit
+    collective is the point here — we are generating interconnect
+    traffic, not letting XLA elide it)."""
+    from jax import shard_map
+
+    axis = mesh.axis_names[0]
+    n = mesh.shape[axis]
+    floats = mb_per_shift * 2**20 // 4
+    x = jnp.arange(n * floats, dtype=jnp.float32).reshape(n, floats)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    def ring(block):
+        def body(b, _):
+            b = jax.lax.ppermute(
+                b, axis, perm=[(i, (i + 1) % n) for i in range(n)]
+            )
+            return b, ()
+
+        out, _ = jax.lax.scan(body, block, None, length=iters)
+        return out
+
+    t0 = time.perf_counter()
+    out = jax.jit(ring)(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    total_bytes = n * floats * 4 * iters
+    return {
+        "devices": n,
+        "bytes_shifted": total_bytes,
+        "seconds": dt,
+        "gbps": total_bytes / dt / 1e9,
+    }

@@ -1,0 +1,239 @@
+"""Mini Llama-style decoder-only transformer in pure JAX.
+
+Purpose (see tpumon.loadgen): a realistic, shardable TPU workload for
+validating the monitoring pipeline and benchmarking scrape→render latency
+under load. It mirrors the architecture family of the models the
+north-star deployment serves (Llama-3 via JetStream, BASELINE config 4):
+RMSNorm, rotary position embeddings, grouped-query attention, SwiGLU MLP,
+untied LM head.
+
+TPU-first design notes:
+- all matmuls in bfloat16 with float32 accumulation (MXU-friendly),
+  params kept in float32 for optimizer stability;
+- static shapes, no data-dependent Python control flow — everything
+  traces once under jit;
+- parallelism is expressed with jax.sharding (Mesh + NamedSharding +
+  with_sharding_constraint): data parallel over axis "data", tensor
+  parallel over axis "model" (attention heads / FFN columns split),
+  letting XLA insert the all-reduces over ICI. No hand-written
+  collectives in the model body.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 512
+    d_model: int = 256
+    n_layers: int = 2
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1024
+    max_seq: int = 256
+    rope_theta: float = 10000.0
+    compute_dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def abstract(self) -> "ModelConfig":
+        assert self.n_heads % self.n_kv_heads == 0
+        return self
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    """Initialize a param pytree (float32 master weights)."""
+    keys = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / shape[0]) ** 0.5
+        return (jax.random.normal(k, shape, jnp.float32) * scale)
+
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+                "wq": dense(next(keys), (cfg.d_model, nh * hd)),
+                "wk": dense(next(keys), (cfg.d_model, nkv * hd)),
+                "wv": dense(next(keys), (cfg.d_model, nkv * hd)),
+                "wo": dense(next(keys), (nh * hd, cfg.d_model)),
+                "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+                "w_gate": dense(next(keys), (cfg.d_model, cfg.d_ff)),
+                "w_up": dense(next(keys), (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(next(keys), (cfg.d_ff, cfg.d_model)),
+            }
+        )
+    return {
+        "embed": dense(next(keys), (cfg.vocab, cfg.d_model), scale=0.02),
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "lm_head": dense(next(keys), (cfg.d_model, cfg.vocab)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules: tensor parallel over "model", replicated elsewhere.
+# Column-parallel for wq/wk/wv/w_gate/w_up, row-parallel for wo/w_down —
+# the standard Megatron-style split, expressed declaratively and applied
+# by XLA (no explicit collectives).
+# ---------------------------------------------------------------------------
+
+PARAM_SPECS = {
+    "embed": P(None, None),
+    "final_norm": P(None),
+    "lm_head": P(None, "model"),
+    "attn_norm": P(None),
+    "mlp_norm": P(None),
+    "wq": P(None, "model"),
+    "wk": P(None, "model"),
+    "wv": P(None, "model"),
+    "wo": P("model", None),
+    "w_gate": P(None, "model"),
+    "w_up": P(None, "model"),
+    "w_down": P("model", None),
+}
+
+
+def param_shardings(mesh: Mesh, params: dict):
+    """Build a NamedSharding pytree matching ``params``."""
+
+    def leaf_spec(path, _leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return NamedSharding(mesh, PARAM_SPECS.get(name, P()))
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x: jax.Array, mesh: Mesh | None, spec: P) -> jax.Array:
+    """Apply a sharding constraint when running over a mesh; no-op on a
+    single device (entry() compiles the same code mesh-less)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
+
+
+def _rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim; x: [B, T, H, D]."""
+    _, t, _, d = x.shape
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(
+    cfg: ModelConfig, layer: dict, x: jax.Array, mesh: Mesh | None = None
+) -> jax.Array:
+    b, t, _ = x.shape
+    nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = x.dtype
+    q = (x @ layer["wq"].astype(dt)).reshape(b, t, nh, hd)
+    k = (x @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd)
+    v = (x @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
+    q = _rope(q, cfg.rope_theta)
+    k = _rope(k, cfg.rope_theta)
+    # Grouped-query attention: repeat kv heads.
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / (hd**0.5)
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(causal[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, nh * hd)
+    out = _constrain(out, mesh, P("data", None, "model"))
+    return out @ layer["wo"].astype(dt)
+
+
+def _mlp(layer: dict, x: jax.Array, mesh: Mesh | None = None) -> jax.Array:
+    dt = x.dtype
+    h = jax.nn.silu(x @ layer["w_gate"].astype(dt)) * (x @ layer["w_up"].astype(dt))
+    h = _constrain(h, mesh, P("data", None, "model"))
+    return h @ layer["w_down"].astype(dt)
+
+
+def forward(
+    cfg: ModelConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32."""
+    dt = jnp.dtype(cfg.compute_dtype)
+    x = params["embed"].astype(dt)[tokens]
+    x = _constrain(x, mesh, P("data", None, None))
+    for layer in params["layers"]:
+        x = x + _attention(cfg, layer, _rms_norm(x, layer["attn_norm"]), mesh)
+        x = x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]), mesh)
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(
+    cfg: ModelConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
+) -> jax.Array:
+    """Next-token cross-entropy over a [B, T] batch."""
+    logits = forward(cfg, params, tokens[:, :-1], mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def sgd_train_step(
+    cfg: ModelConfig,
+    params: dict,
+    tokens: jax.Array,
+    lr: float = 1e-3,
+    mesh: Mesh | None = None,
+) -> tuple[dict, jax.Array]:
+    """One SGD step (kept optimizer-trivial: the workload exists to light
+    up MXU/HBM/ICI, not to converge)."""
+    loss, grads = jax.value_and_grad(partial(loss_fn, cfg))(params, tokens, mesh)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return new_params, loss
+
+
+def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, params: dict):
+    """jit the train step over a dp×tp mesh; returns (step_fn, placed_params).
+
+    Token batches are sharded over "data"; params per PARAM_SPECS. XLA
+    derives the psum/all-reduce pattern (gradients over "data", activation
+    reductions over "model") and routes them over ICI.
+    """
+    shardings = param_shardings(mesh, params)
+    placed = jax.device_put(params, shardings)
+    token_sharding = NamedSharding(mesh, P("data", None))
+
+    @partial(
+        jax.jit,
+        in_shardings=(shardings, token_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+    )
+    def step(p, tokens):
+        return sgd_train_step(cfg, p, tokens, mesh=mesh)
+
+    return step, placed
