@@ -1,0 +1,98 @@
+"""UI smoke via static consistency (SURVEY §4.5).
+
+No browser/JS engine exists in the test environment, so instead of driving
+the page headless we pin the contract between the dashboard script and the
+rest of the system: every endpoint the script fetches must be served, every
+DOM id the script touches must exist in the markup, and the polling
+cadences must match the reference's (monitor.html:605-609)."""
+
+import asyncio
+import os
+import re
+
+import pytest
+
+from tests.test_server_api import serve
+
+HTML_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tpumon", "web", "dashboard.html",
+)
+
+
+@pytest.fixture(scope="module")
+def html():
+    with open(HTML_PATH) as f:
+        return f.read()
+
+
+@pytest.fixture(scope="module")
+def script(html):
+    return html.split("<script>")[1].split("</script>")[0]
+
+
+def test_fetched_endpoints_are_served(script):
+    endpoints = set(re.findall(r'j\("(/api/[^"]+)"\)', script))
+    assert endpoints, "no endpoints referenced?"
+    sampler, server = serve()
+
+    async def check():
+        await sampler.tick_all()
+        for ep in sorted(endpoints):
+            status, _, _ = await server.handle("GET", ep)
+            assert status == 200, ep
+
+    asyncio.run(check())
+
+
+def test_dom_ids_exist(html, script):
+    dom_ids = set(re.findall(r'id="([^"]+)"', html))
+    used = set(re.findall(r'\$\("([^"]+)"\)', script))
+    # ids built dynamically with prefix+suffix (setCard): expand known ones
+    for prefix in ("cpu", "mem", "disk", "mxu"):
+        for suffix in ("-v", "-s", "-b"):
+            used.add(prefix + suffix)
+    missing = {u for u in used if u not in dom_ids}
+    assert not missing, f"script references missing DOM ids: {missing}"
+
+
+def test_polling_cadences_match_reference(script):
+    """Reference cadences: realtime 5s, history 30s, pods 10s, alerts 10s,
+    clock 1s (monitor.html:605-609)."""
+    intervals = dict(re.findall(r"setInterval\((\w+), (\d+)\)", script))
+    assert intervals["fetchRealtime"] == "5000"
+    assert intervals["fetchHistory"] == "30000"
+    assert intervals["fetchPods"] == "10000"
+    assert intervals["fetchAlerts"] == "10000"
+    assert intervals["updateTime"] == "1000"
+
+
+def test_no_external_resources(html):
+    """Air-gapped contract: no CDN scripts/styles (the reference loads
+    Chart.js from a CDN, monitor.html:7 — tpumon must not)."""
+    assert not re.search(r'(src|href)="https?://', html)
+
+
+def test_no_innerhtml_with_data(script):
+    """XSS hygiene (SURVEY §2.1): pod/alert data must go through
+    textContent; innerHTML only with static or numeric template content."""
+    uses = [
+        line.strip()
+        for line in script.splitlines()
+        if "innerHTML" in line and "+=" in line
+    ]
+    assert not uses, f"innerHTML += found: {uses}"
+
+
+def test_example_configs_load():
+    from tpumon.config import load_config
+
+    examples = os.path.join(os.path.dirname(os.path.dirname(HTML_PATH)), "..", "examples")
+    examples = os.path.normpath(examples)
+    loaded = 0
+    for name in sorted(os.listdir(examples)):
+        if name.endswith(".json"):
+            cfg = load_config(path=os.path.join(examples, name), env={})
+            assert cfg.port == 8888
+            loaded += 1
+    assert loaded == 5

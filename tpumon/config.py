@@ -172,6 +172,8 @@ def _coerce_thresholds(raw: Mapping[str, Any], base: Thresholds) -> Thresholds:
 
 def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
     for key, value in raw.items():
+        if key.startswith("_"):  # comment keys in config files
+            continue
         if key in _SCALAR_FIELDS:
             cfg_kw[key] = None if value is None else _SCALAR_FIELDS[key](value)
         elif key in ("history_window", "history_step"):
