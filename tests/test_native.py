@@ -1,0 +1,99 @@
+"""Native host sampler: build, ABI, parity with the pure-Python reader."""
+
+import asyncio
+import shutil
+
+import pytest
+
+from tests.test_host_collector import LOADAVG, MEMINFO, STAT_T0, make_proc
+from tpumon import native
+from tpumon.collectors.host import HostCollector
+
+needs_cxx = pytest.mark.skipif(
+    shutil.which("g++") is None and not native.load(),
+    reason="no g++ and no prebuilt library",
+)
+
+
+@needs_cxx
+def test_build_and_load():
+    lib = native.load(auto_build=True)
+    assert lib is not None
+    assert lib.tpumon_native_abi_version() == native.ABI_VERSION
+
+
+@needs_cxx
+def test_native_sample_real_proc():
+    reader = native.make_reader()
+    assert reader is not None
+    s = reader.sample()
+    assert s["ok_cpu"] and s["ok_mem"] and s["ok_disk"]
+    assert s["cores"] >= 1
+    assert s["mem_total"] > 0
+    assert s["mem_available"] <= s["mem_total"]
+    assert s["cpu_total_jiffies"] > s["cpu_busy_jiffies"] > 0
+    assert s["disk_total"] > s["disk_used"] > 0
+
+
+@needs_cxx
+def test_native_matches_python_on_golden(tmp_path):
+    proc = make_proc(tmp_path)
+    reader = native.make_reader(proc_root=proc)
+    s = reader.sample()
+    assert s["load1"] == 2.45
+    assert s["mem_total"] == 16384000 * 1024
+    assert s["mem_available"] == 8192000 * 1024
+    # busy/total must match the Python parser on the same input
+    from tpumon.collectors.host import _read_proc_stat_cpu
+
+    busy, total = _read_proc_stat_cpu(STAT_T0)
+    assert (s["cpu_busy_jiffies"], s["cpu_total_jiffies"]) == (busy, total)
+
+
+@needs_cxx
+def test_native_degrades_per_subsource(tmp_path):
+    (tmp_path / "loadavg").write_text(LOADAVG)
+    (tmp_path / "stat").write_text(STAT_T0)
+    # no meminfo
+    reader = native.make_reader(proc_root=str(tmp_path))
+    s = reader.sample()
+    assert s["ok_cpu"] and not s["ok_mem"] and s["ok_disk"]
+
+
+@needs_cxx
+def test_collector_uses_native(tmp_path):
+    proc = make_proc(tmp_path)
+    c = HostCollector(cpu_count=8, proc_root=proc, use_native=True)
+    assert c.native_active
+    s = asyncio.run(c.collect())
+    assert s.ok
+    assert s.data["cpu"]["load_1min"] == 2.45
+    assert s.data["memory"]["percent"] == pytest.approx(50.0, abs=0.1)
+
+
+def test_collector_without_native(tmp_path):
+    c = HostCollector(cpu_count=8, proc_root=make_proc(tmp_path), use_native=False)
+    assert not c.native_active
+    s = asyncio.run(c.collect())
+    assert s.ok and s.data["cpu"]["load_1min"] == 2.45
+
+
+@needs_cxx
+def test_native_sampling_faster_or_comparable(tmp_path):
+    """The fast path exists for the samples/sec metric; assert it's at
+    least not slower than the pure-Python reader."""
+    import time
+
+    proc = make_proc(tmp_path)
+    native_c = HostCollector(cpu_count=8, proc_root=proc, use_native=True)
+    python_c = HostCollector(cpu_count=8, proc_root=proc, use_native=False)
+
+    async def rate(c, n=300):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await c.collect()
+        return n / (time.perf_counter() - t0)
+
+    native_rate = asyncio.run(rate(native_c))
+    python_rate = asyncio.run(rate(python_c))
+    assert native_rate > python_rate * 0.8  # allow jitter; expect >=1x

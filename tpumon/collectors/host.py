@@ -13,12 +13,17 @@ Shape of the returned data matches the reference contract (SURVEY §2.3
 /api/host/metrics) with numbers, not stringified floats: the reference
 returns percent fields as toFixed(1) strings (monitor_server.js:76-78), a
 quirk SURVEY §2.1 says to fix.
+
+Fast path: when the native shim (tpumon/native/hostmon.cpp) is built, the
+raw /proc reads + parses happen in C++ in a single call; the Python layer
+only computes deltas and percentages. Each sub-source still degrades
+independently, and the pure-Python reader remains the fallback.
 """
 
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from tpumon.collectors import Sample
 
@@ -58,19 +63,28 @@ class HostCollector:
     cpu_count: int = 0
     disk_mounts: tuple[str, ...] = ("/",)
     proc_root: str = "/proc"  # overridable for golden-input tests
+    use_native: bool = True
 
     _last_cpu: tuple[int, int] | None = None
+    _native: object = field(default=None, repr=False)
+    native_active: bool = field(default=False, repr=False)
 
     def __post_init__(self) -> None:
         self.cpu_count = self.cpu_count or os.cpu_count() or 1
+        if self.use_native:
+            try:
+                from tpumon.native import make_reader
+
+                self._native = make_reader(
+                    proc_root=self.proc_root, mount=self.disk_mounts[0]
+                )
+            except Exception:
+                self._native = None
+            self.native_active = self._native is not None
 
     # -- sub-collectors; each degrades independently (monitor_server.js:80) --
 
-    def _cpu(self) -> dict:
-        with open(os.path.join(self.proc_root, "loadavg")) as f:
-            load1 = float(f.read().split()[0])
-        with open(os.path.join(self.proc_root, "stat")) as f:
-            busy, total = _read_proc_stat_cpu(f.read())
+    def _cpu_pct_from_jiffies(self, busy: int, total: int, load1: float) -> float:
         pct = None
         if self._last_cpu is not None:
             dbusy = busy - self._last_cpu[0]
@@ -82,17 +96,31 @@ class HostCollector:
             # First sample: fall back to the reference's load-based estimate,
             # but with the detected core count (monitor_server.js:76).
             pct = min(100.0, 100.0 * load1 / self.cpu_count)
+        return pct
+
+    def _cpu(self, ns: dict | None) -> dict:
+        if ns is not None and ns["ok_cpu"]:
+            load1 = ns["load1"]
+            busy, total = ns["cpu_busy_jiffies"], ns["cpu_total_jiffies"]
+        else:
+            with open(os.path.join(self.proc_root, "loadavg")) as f:
+                load1 = float(f.read().split()[0])
+            with open(os.path.join(self.proc_root, "stat")) as f:
+                busy, total = _read_proc_stat_cpu(f.read())
         return {
             "load_1min": load1,
             "cores": self.cpu_count,
-            "percent": round(pct, 1),
+            "percent": round(self._cpu_pct_from_jiffies(busy, total, load1), 1),
         }
 
-    def _memory(self) -> dict:
-        with open(os.path.join(self.proc_root, "meminfo")) as f:
-            mi = parse_meminfo(f.read())
-        total = mi["MemTotal"]
-        avail = mi.get("MemAvailable", mi.get("MemFree", 0))
+    def _memory(self, ns: dict | None) -> dict:
+        if ns is not None and ns["ok_mem"]:
+            total, avail = ns["mem_total"], ns["mem_available"]
+        else:
+            with open(os.path.join(self.proc_root, "meminfo")) as f:
+                mi = parse_meminfo(f.read())
+            total = mi["MemTotal"]
+            avail = mi.get("MemAvailable", mi.get("MemFree", 0))
         used = total - avail
         return {
             "total": total,
@@ -101,27 +129,45 @@ class HostCollector:
             "percent": round(100.0 * used / total, 1) if total else None,
         }
 
-    def _disk(self) -> dict:
-        mounts = {}
-        for mount in self.disk_mounts:
-            st = os.statvfs(mount)
-            total = st.f_blocks * st.f_frsize
-            avail = st.f_bavail * st.f_frsize
-            used = total - st.f_bfree * st.f_frsize
-            mounts[mount] = {
+    def _disk_one(self, mount: str) -> dict:
+        st = os.statvfs(mount)
+        total = st.f_blocks * st.f_frsize
+        used = total - st.f_bfree * st.f_frsize
+        return {
+            "total": total,
+            "used": used,
+            "percent": round(100.0 * used / total, 1) if total else None,
+        }
+
+    def _disk(self, ns: dict | None) -> dict:
+        mounts: dict[str, dict] = {}
+        if ns is not None and ns["ok_disk"]:
+            total, used = ns["disk_total"], ns["disk_used"]
+            mounts[self.disk_mounts[0]] = {
                 "total": total,
                 "used": used,
                 "percent": round(100.0 * used / total, 1) if total else None,
             }
+            rest = self.disk_mounts[1:]
+        else:
+            rest = self.disk_mounts
+        for mount in rest:
+            mounts[mount] = self._disk_one(mount)
         primary = mounts[self.disk_mounts[0]]
         return {**primary, "mounts": mounts}
 
     async def collect(self) -> Sample:
+        ns = None
+        if self._native is not None:
+            try:
+                ns = self._native.sample()
+            except Exception:
+                ns = None
         data: dict = {}
         errors: list[str] = []
         for key, fn in (("cpu", self._cpu), ("memory", self._memory), ("disk", self._disk)):
             try:
-                data[key] = fn()
+                data[key] = fn(ns)
             except Exception as e:
                 data[key] = {}
                 errors.append(f"{key}: {type(e).__name__}: {e}")

@@ -1,0 +1,102 @@
+"""ctypes bindings for the native host sampler (hostmon.cpp).
+
+Optional fast path: if the shared library is present (``make -C
+tpumon/native`` or ``python -m tpumon.native build``) the host collector
+samples through it; otherwise the pure-Python reader is used. Bindings are
+ctypes over a C ABI — no pybind11 (not available in this environment).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SO_PATH = os.path.join(_DIR, "libtpumon_host.so")
+ABI_VERSION = 1
+
+OK_CPU, OK_MEM, OK_DISK = 1, 2, 4
+
+
+class HostSampleStruct(ctypes.Structure):
+    _fields_ = [
+        ("load1", ctypes.c_double),
+        ("mem_total", ctypes.c_uint64),
+        ("mem_available", ctypes.c_uint64),
+        ("cpu_busy_jiffies", ctypes.c_uint64),
+        ("cpu_total_jiffies", ctypes.c_uint64),
+        ("disk_total", ctypes.c_uint64),
+        ("disk_used", ctypes.c_uint64),
+        ("cores", ctypes.c_int32),
+        ("ok", ctypes.c_int32),
+    ]
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the shared library in-tree; returns success."""
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=quiet,
+        )
+        return os.path.exists(SO_PATH)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def load(auto_build: bool = False):
+    """Load the native library; returns the ctypes lib or None."""
+    if not os.path.exists(SO_PATH):
+        if not (auto_build and build()):
+            return None
+    try:
+        lib = ctypes.CDLL(SO_PATH)
+        lib.tpumon_host_sample.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(HostSampleStruct),
+        ]
+        lib.tpumon_host_sample.restype = ctypes.c_int
+        lib.tpumon_native_abi_version.restype = ctypes.c_int
+        if lib.tpumon_native_abi_version() != ABI_VERSION:
+            return None
+        return lib
+    except OSError:
+        return None
+
+
+class NativeHostReader:
+    """Samples host metrics through the C++ shim."""
+
+    def __init__(self, lib, proc_root: str = "/proc", mount: str = "/"):
+        self._lib = lib
+        self._proc_root = proc_root.encode()
+        self._mount = mount.encode()
+
+    def sample(self) -> dict:
+        s = HostSampleStruct()
+        self._lib.tpumon_host_sample(
+            self._proc_root, self._mount, ctypes.byref(s)
+        )
+        return {
+            "ok_cpu": bool(s.ok & OK_CPU),
+            "ok_mem": bool(s.ok & OK_MEM),
+            "ok_disk": bool(s.ok & OK_DISK),
+            "load1": s.load1,
+            "cores": s.cores,
+            "cpu_busy_jiffies": s.cpu_busy_jiffies,
+            "cpu_total_jiffies": s.cpu_total_jiffies,
+            "mem_total": s.mem_total,
+            "mem_available": s.mem_available,
+            "disk_total": s.disk_total,
+            "disk_used": s.disk_used,
+        }
+
+
+def make_reader(
+    proc_root: str = "/proc", mount: str = "/", auto_build: bool = True
+) -> NativeHostReader | None:
+    lib = load(auto_build=auto_build)
+    return NativeHostReader(lib, proc_root, mount) if lib else None

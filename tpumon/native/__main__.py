@@ -1,0 +1,12 @@
+"""``python -m tpumon.native build`` — compile the native host sampler."""
+
+import sys
+
+from tpumon.native import SO_PATH, build, load
+
+if len(sys.argv) > 1 and sys.argv[1] == "build":
+    ok = build(quiet=False)
+    print(f"{'built' if ok else 'FAILED to build'} {SO_PATH}")
+    sys.exit(0 if ok else 1)
+lib = load()
+print(f"native host sampler: {'available' if lib else 'not built'} ({SO_PATH})")
