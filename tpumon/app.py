@@ -109,10 +109,22 @@ def main(argv: list[str] | None = None) -> int:
             overrides["port"] = v
         elif arg == "--accel-backend":
             overrides["accel_backend"] = take(arg)
+        elif arg == "--demo":
+            # Fully synthetic deployment: fake v5e-8 chips, fake pods,
+            # fake JetStream target — every dashboard panel populates
+            # with zero external dependencies.
+            overrides.update(
+                {
+                    "accel_backend": "fake:v5e-8",
+                    "k8s_mode": "fake",
+                    "serving_targets": ["fake:jetstream"],
+                    "expected_slice_chips": {"slice-0": 8},
+                }
+            )
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
-                "[--accel-backend auto|jax|fake:v5e-8|none]\n"
+                "[--accel-backend auto|jax|fake:v5e-8|none] [--demo]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

@@ -1,0 +1,118 @@
+"""``python -m tpumon.info`` — terminal chip/host status, tpu-info style.
+
+The reference ecosystem's quick-look tool is ``nvidia-smi`` (shelled out at
+monitor_server.js:85); the TPU ecosystem's is ``tpu-info``. tpumon ships
+its own: a one-shot (or --watch) terminal table of per-chip MXU duty, HBM,
+temperature and ICI rates, plus host metrics — reading through the same
+collector stack as the server, so what the CLI shows is exactly what the
+dashboard and exporter show.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+
+from tpumon.collectors.accel import make_accel_collector
+from tpumon.collectors.host import HostCollector
+from tpumon.config import load_config
+from tpumon.topology import ChipSample, slice_views
+
+
+def _bar(pct: float | None, width: int = 20) -> str:
+    if pct is None:
+        return "·" * width
+    filled = int(round(max(0.0, min(100.0, pct)) / 100 * width))
+    return "█" * filled + "░" * (width - filled)
+
+
+def _fmt_bytes(b: int | None) -> str:
+    if b is None:
+        return "–"
+    return f"{b / 2**30:.1f}G"
+
+
+def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -> str:
+    lines: list[str] = []
+    cpu = host.get("cpu") or {}
+    mem = host.get("memory") or {}
+    lines.append(
+        f"host: cpu {cpu.get('percent', '–')}% (load {cpu.get('load_1min', '–')}, "
+        f"{cpu.get('cores', '?')} cores) · mem {mem.get('percent', '–')}% "
+        f"({_fmt_bytes(mem.get('used'))}/{_fmt_bytes(mem.get('total'))})"
+    )
+    if not chips:
+        lines.append("no TPU chips visible")
+        return "\n".join(lines)
+    for v in slice_views(chips):
+        lines.append(
+            f"slice {v.slice_id}: {v.reporting_chips} chip(s) on "
+            f"{len(v.hosts)} host(s)"
+        )
+    header = (
+        f"{'chip':<24} {'kind':<5} {'MXU%':>6}  {'':20} "
+        f"{'HBM':>12} {'HBM%':>6}  {'temp':>5}  {'ICI tx':>10}"
+    )
+    lines.append(header)
+    for c in chips:
+        duty = f"{c.mxu_duty_pct:.1f}" if c.mxu_duty_pct is not None else "–"
+        hbm_pct = f"{c.hbm_pct:.1f}" if c.hbm_pct is not None else "–"
+        temp = f"{c.temp_c:.0f}°C" if c.temp_c is not None else "–"
+        rate = (ici_rates or {}).get(c.chip_id, {}).get("tx_bps")
+        rate_s = f"{rate / 1e9:.2f}GB/s" if rate is not None else "–"
+        lines.append(
+            f"{c.chip_id:<24} {c.kind:<5} {duty:>6}  {_bar(c.mxu_duty_pct)} "
+            f"{_fmt_bytes(c.hbm_used):>5}/{_fmt_bytes(c.hbm_total):<6} {hbm_pct:>6}  "
+            f"{temp:>5}  {rate_s:>10}"
+        )
+    return "\n".join(lines)
+
+
+async def _run(watch: float | None, backend: str | None) -> int:
+    env = {"TPUMON_COLLECTORS": "host,accel"}
+    if backend:
+        env["TPUMON_ACCEL_BACKEND"] = backend
+    cfg = load_config(env={**os.environ, **env})
+    accel = make_accel_collector(cfg)
+    host = HostCollector(cpu_count=cfg.cpu_count, disk_mounts=cfg.disk_mounts)
+
+    from tpumon.sampler import Sampler
+
+    sampler = Sampler(cfg, host=host, accel=accel)
+    while True:
+        await sampler.tick_fast()
+        out = render(sampler.chips(), sampler.host_data(), sampler.ici_rates)
+        if watch:
+            print("\x1b[2J\x1b[H", end="")  # clear screen
+            print(time.strftime("%H:%M:%S"), "· tpumon info")
+        print(out, flush=True)
+        accel_sample = sampler.sample_of("accel")
+        if accel_sample and accel_sample.error:
+            print(f"[degraded: {accel_sample.error}]", file=sys.stderr)
+        if not watch:
+            return 0
+        await asyncio.sleep(watch)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    watch = None
+    backend = None
+    it = iter(argv)
+    for a in it:
+        if a in ("-w", "--watch"):
+            watch = float(next(it, "1") or 1)
+        elif a == "--backend":
+            backend = next(it, None)
+        elif a in ("-h", "--help"):
+            print("usage: python -m tpumon.info [-w SECONDS] [--backend jax|fake:v5e-8]")
+            return 0
+        else:
+            print(f"unknown argument {a!r}", file=sys.stderr)
+            return 2
+    try:
+        return asyncio.run(_run(watch, backend))
+    except KeyboardInterrupt:
+        return 0

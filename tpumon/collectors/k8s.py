@@ -182,9 +182,90 @@ class KubectlPodSource:
 
 
 @dataclass
+class FakePodSource:
+    """Synthetic PodList for demo mode and tests: a JetStream serving set,
+    system pods, a perpetually-Pending pod and a slow crash-looper whose
+    restart count climbs — enough to exercise every pod alert rule."""
+
+    clock: object = None
+
+    def _start(self, now: float, age_s: float) -> str:
+        t = dt.datetime.fromtimestamp(now - age_s, dt.timezone.utc)
+        return t.isoformat().replace("+00:00", "Z")
+
+    async def fetch_pod_list(self) -> dict:
+        import time as _time
+
+        now = self.clock() if self.clock else _time.time()
+        restarts = int(now // 300) % 50  # climbs every 5 min
+        crashing = (now % 600) < 120  # crash-looping 2 min of every 10
+        items = [
+            {
+                "metadata": {
+                    "namespace": "serving",
+                    "name": f"jetstream-llama3-8b-{i}",
+                    "labels": {JOBSET_NAME_KEY: "jetstream-llama3"},
+                },
+                "spec": {
+                    "nodeName": f"tpu-host-{i}",
+                    "nodeSelector": {
+                        TPU_TOPOLOGY_KEY: "2x4",
+                        TPU_ACCEL_KEY: "tpu-v5-lite-podslice",
+                    },
+                },
+                "status": {
+                    "phase": "Running",
+                    "startTime": self._start(now, 86400 * 2 + i * 3600),
+                    "containerStatuses": [{"restartCount": 0, "state": {"running": {}}}],
+                },
+            }
+            for i in range(2)
+        ]
+        items.append(
+            {
+                "metadata": {"namespace": "kube-system", "name": "kube-dns-7c9", "labels": {}},
+                "spec": {"nodeName": "cpu-node-0", "nodeSelector": {}},
+                "status": {
+                    "phase": "Running",
+                    "startTime": self._start(now, 86400 * 14),
+                    "containerStatuses": [{"restartCount": 1, "state": {"running": {}}}],
+                },
+            }
+        )
+        items.append(
+            {
+                "metadata": {"namespace": "ml", "name": "maxtext-eval-7b", "labels": {}},
+                "spec": {"nodeSelector": {TPU_TOPOLOGY_KEY: "4x4"}},
+                "status": {"phase": "Pending", "reason": "Unschedulable"},
+            }
+        )
+        items.append(
+            {
+                "metadata": {"namespace": "ml", "name": "dataprep-worker", "labels": {}},
+                "spec": {"nodeName": "cpu-node-1", "nodeSelector": {}},
+                "status": {
+                    "phase": "Running",
+                    "startTime": self._start(now, 7200),
+                    "containerStatuses": [
+                        {
+                            "restartCount": restarts,
+                            "state": (
+                                {"waiting": {"reason": "CrashLoopBackOff"}}
+                                if crashing
+                                else {"running": {}}
+                            ),
+                        }
+                    ],
+                },
+            }
+        )
+        return {"kind": "PodList", "items": items}
+
+
+@dataclass
 class K8sCollector:
     name: str = "k8s"
-    mode: str = "auto"  # "auto" | "api" | "kubectl" | "none"
+    mode: str = "auto"  # "auto" | "api" | "kubectl" | "fake" | "none"
     api_url: str | None = None
 
     def _sources(self):
@@ -192,6 +273,8 @@ class K8sCollector:
             return [ApiPodSource(api_url=self.api_url)]
         if self.mode == "kubectl":
             return [KubectlPodSource()]
+        if self.mode == "fake":
+            return [FakePodSource()]
         if self.mode == "none":
             return []
         return [ApiPodSource(api_url=self.api_url), KubectlPodSource()]

@@ -128,6 +128,37 @@ def distill_serving_metrics(
     return out
 
 
+def _fake_exposition(now: float | None = None) -> str:
+    """Synthetic JetStream /metrics for demo mode: counters advance with
+    wall time so rates and quantiles look alive (exercises the same
+    distillation path as a real target)."""
+    import math
+
+    t = time.time() if now is None else now
+    tokens = int(900 * t + 4000 * math.sin(t / 60))  # ~900 tok/s ± wobble
+    requests = int(t / 2)
+    queue = max(0, int(6 + 5 * math.sin(t / 45)))
+    # TTFT histogram drifting between ~40 and ~90 ms p50
+    shift = (math.sin(t / 120) + 1) / 2  # 0..1
+    b1 = int(2000 + 500 * (1 - shift))
+    b2 = int(5500 + 1500 * (1 - shift))
+    total = 8000
+    return f"""\
+# TYPE jetstream_time_to_first_token histogram
+jetstream_time_to_first_token_bucket{{le="0.025"}} {b1}
+jetstream_time_to_first_token_bucket{{le="0.05"}} {b2}
+jetstream_time_to_first_token_bucket{{le="0.1"}} {int(total * 0.97)}
+jetstream_time_to_first_token_bucket{{le="0.5"}} {total}
+jetstream_time_to_first_token_bucket{{le="+Inf"}} {total}
+# TYPE jetstream_generate_tokens counter
+jetstream_generate_tokens {tokens}
+# TYPE jetstream_request_count counter
+jetstream_request_count {requests}
+# TYPE jetstream_queue_size gauge
+jetstream_queue_size {queue}
+"""
+
+
 @dataclass
 class ServingCollector:
     targets: tuple[str, ...] = ()
@@ -136,6 +167,8 @@ class ServingCollector:
     _prev: dict[str, dict] = field(default_factory=dict)
 
     def _fetch(self, url: str) -> str:
+        if url.startswith("fake:"):
+            return _fake_exposition()
         if not url.startswith(("http://", "https://")):
             url = f"http://{url}"
         if not url.rstrip("/").endswith("/metrics"):

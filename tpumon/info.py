@@ -1,0 +1,6 @@
+"""Alias so ``python -m tpumon.info`` works like the tpu-info CLI."""
+
+from tpumon.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,6 +19,9 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/serving          JetStream/MaxText panels
   /api/topology         slice views
   /api/health           per-source health + self stats
+  /api/stream           Server-Sent Events: realtime snapshot pushed on
+                        every sampler tick (the dashboard upgrades from
+                        5s polling to ~1s push when available)
   /metrics              in-tree Prometheus exporter
 
 The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
@@ -158,6 +161,36 @@ class MonitorServer:
             "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
         }
 
+    def realtime_payload(self) -> dict:
+        """The push payload: everything the dashboard's fast loop needs."""
+        return {
+            "host": self._api_host(),
+            "accel": self._api_accel(),
+            "alerts": {
+                sev: len(items)
+                for sev, items in self.sampler.engine.last.items()
+                if isinstance(items, list)
+            },
+        }
+
+    async def _stream(self, writer: asyncio.StreamWriter) -> None:
+        """SSE loop: one event per sample interval until disconnect."""
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-cache\r\n"
+            "Access-Control-Allow-Origin: *\r\n"
+            "Connection: close\r\n\r\n"
+        )
+        writer.write(head.encode("latin-1"))
+        await writer.drain()
+        interval = max(0.25, self.cfg.sample_interval_s)
+        while True:
+            payload = json.dumps(self.realtime_payload())
+            writer.write(f"data: {payload}\n\n".encode())
+            await writer.drain()  # raises once the client is gone
+            await asyncio.sleep(interval)
+
     def _api_health(self) -> dict:
         lat = list(self.request_latencies_ms)
         return {
@@ -223,6 +256,12 @@ class MonitorServer:
 
             if method == "OPTIONS":
                 await self._respond(writer, 204, "text/plain", b"")
+                return
+            if method == "GET" and path == "/api/stream":
+                try:
+                    await self._stream(writer)
+                except (ConnectionError, asyncio.CancelledError, OSError):
+                    pass
                 return
             if method not in ("GET", "HEAD"):
                 await self._respond(
