@@ -1,0 +1,97 @@
+"""Realtime multi-host federation: one instance merges peer instances'
+chips (BASELINE config 5 without Prometheus)."""
+
+import asyncio
+
+from tests.test_server_api import get_json, serve
+from tpumon.collectors.accel_fake import FakeTpuCollector
+from tpumon.collectors.accel_peers import PeerFederatedCollector, chip_from_json
+from tpumon.topology import ChipSample
+
+
+def test_chip_json_roundtrip():
+    c = ChipSample(
+        chip_id="h1/chip-2", host="h1", slice_id="s0", index=2, kind="v5p",
+        coords=(1, 0, 0), mxu_duty_pct=33.5, hbm_used=10, hbm_total=100,
+        temp_c=55.0, ici_tx_bytes=999, ici_rx_bytes=900, ici_link_up=True,
+    )
+    back = chip_from_json(c.to_json())
+    assert back == c
+
+
+def test_federation_two_live_instances():
+    """Two real servers: instance B federates instance A's chips."""
+    # Instance A: 4 fake chips on hosts ha-*.
+    sampler_a, server_a = serve({"TPUMON_ACCEL_BACKEND": "fake:v5e-4"})
+    sampler_a.accel.host_prefix = "ha"
+    sampler_a.accel.slice_id = "slice-a"
+
+    async def scenario():
+        await sampler_a.tick_all()
+        await server_a.start()
+        peer_url = f"http://127.0.0.1:{server_a.port}"
+
+        # Instance B: its own 8 chips + peer A.
+        sampler_b, server_b = serve(
+            {
+                "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+                "TPUMON_PEERS": peer_url,
+                "TPUMON_EXPECTED_SLICE_CHIPS": '{"slice-0": 8, "slice-a": 4}',
+            }
+        )
+        sampler_b.accel.local.host_prefix = "hb"
+        await sampler_b.tick_all()
+        await server_b.start()
+
+        d = await asyncio.to_thread(get_json, server_b.port, "/api/accel/metrics")
+        assert len(d["chips"]) == 12
+        slices = {s["slice"]: s for s in d["slices"]}
+        assert slices["slice-0"]["reporting_chips"] == 8
+        assert slices["slice-a"]["reporting_chips"] == 4
+        assert slices["slice-a"]["missing_chips"] == 0
+        assert d["health"]["ok"] is True
+
+        # Kill the peer: its chips drop out; slice alert fires on B.
+        await server_a.stop()
+        await sampler_b.tick_all()
+        d = await asyncio.to_thread(get_json, server_b.port, "/api/accel/metrics")
+        assert len(d["chips"]) == 8
+        assert d["health"]["ok"] is False  # peer unreachable recorded
+        alerts = await asyncio.to_thread(get_json, server_b.port, "/api/alerts")
+        keys = {a["key"] for sev in ("minor", "serious", "critical") for a in alerts[sev]}
+        assert "slice.slice-a.missing" in keys
+        await server_b.stop()
+
+    asyncio.run(scenario())
+
+
+def test_federation_ici_rates_for_peer_chips():
+    """Peer chips' cumulative ICI counters produce rates in the local
+    sampler, same as local chips."""
+    t = [1000.0]
+    peer_backend = FakeTpuCollector(topology="v5e-4", host_prefix="hp", clock=lambda: t[0])
+
+    class FakePeerCollector(PeerFederatedCollector):
+        async def _peer_chips(self, url):
+            return url, peer_backend.chips()
+
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    cfg = load_config(env={"TPUMON_COLLECTORS": "accel"})
+    fed = PeerFederatedCollector.__new__(FakePeerCollector)
+    fed.local = None
+    fed.peers = ("http://peer",)
+    fed.name = "accel"
+    fed.timeout_s = 1
+    fed.last_peer_status = {}
+    sampler = Sampler(cfg, accel=fed)
+
+    async def scenario():
+        await sampler.tick_fast()
+        t[0] += 10
+        await sampler.tick_fast()
+        assert len(sampler.ici_rates) == 4
+        assert all(r["tx_bps"] > 0 for r in sampler.ici_rates.values())
+
+    asyncio.run(scenario())

@@ -32,9 +32,17 @@ class NullAccelCollector:
 def make_accel_collector(cfg: Config) -> Collector:
     backend = cfg.accel_backend
     if backend == "none":
+        local: Collector | None = None
+    elif backend.startswith("fake:"):
+        local = FakeTpuCollector(topology=backend.split(":", 1)[1])
+    elif backend in ("auto", "jax"):
+        local = JaxTpuCollector()
+    else:
+        raise ValueError(f"unknown accel backend {backend!r}")
+    if cfg.peers:
+        from tpumon.collectors.accel_peers import PeerFederatedCollector
+
+        return PeerFederatedCollector(local=local, peers=cfg.peers)
+    if local is None:
         return NullAccelCollector(reason="accel backend 'none' configured")
-    if backend.startswith("fake:"):
-        return FakeTpuCollector(topology=backend.split(":", 1)[1])
-    if backend in ("auto", "jax"):
-        return JaxTpuCollector()
-    raise ValueError(f"unknown accel backend {backend!r}")
+    return local

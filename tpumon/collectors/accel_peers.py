@@ -1,0 +1,102 @@
+"""Peer federation: merge chips from other tpumon instances.
+
+The reference is strictly single-host for realtime metrics — multi-node
+visibility exists only through Prometheus aggregation of per-node
+exporters (SURVEY §2.5). tpumon keeps that path (PromQL over per-host
+`tpu_*` series) **and** adds a realtime one: an instance configured with
+``peers`` fetches each peer's ``/api/accel/metrics`` in parallel and
+merges their chips with its own, so one dashboard shows a whole v5p
+slice live with per-chip resolution and no Prometheus in the loop
+(BASELINE config 5).
+
+Peer chips keep their original chip_id/host/slice identity; cumulative
+ICI counters survive the merge, so the local sampler computes peer ICI
+rates exactly as it does for local chips. An unreachable peer degrades
+that peer only (its chips drop out, which is precisely what slice-failure
+alerting should see).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+from dataclasses import dataclass, field
+
+from tpumon.collectors import Collector, Sample
+from tpumon.topology import ChipSample
+
+
+def chip_from_json(d: dict) -> ChipSample:
+    """Inverse of ChipSample.to_json (hbm_pct and rates are derived)."""
+    return ChipSample(
+        chip_id=d["chip"],
+        host=d.get("host", ""),
+        slice_id=d.get("slice", "slice-0"),
+        index=int(d.get("index", 0)),
+        kind=d.get("kind", "unknown"),
+        coords=tuple(d.get("coords") or ()),
+        mxu_duty_pct=d.get("mxu_duty_pct"),
+        hbm_used=d.get("hbm_used"),
+        hbm_total=d.get("hbm_total"),
+        temp_c=d.get("temp_c"),
+        ici_tx_bytes=d.get("ici_tx_bytes"),
+        ici_rx_bytes=d.get("ici_rx_bytes"),
+        ici_link_up=d.get("ici_link_up"),
+    )
+
+
+@dataclass
+class PeerFederatedCollector:
+    """Wraps a local accel collector and merges peer instances' chips."""
+
+    local: Collector | None
+    peers: tuple[str, ...] = ()
+    name: str = "accel"
+    timeout_s: float = 3.0
+    last_peer_status: dict[str, str] = field(default_factory=dict)
+
+    def _fetch_peer(self, url: str) -> list[dict]:
+        base = url if url.startswith(("http://", "https://")) else f"http://{url}"
+        with urllib.request.urlopen(
+            f"{base.rstrip('/')}/api/accel/metrics", timeout=self.timeout_s
+        ) as r:
+            return json.load(r).get("chips", [])
+
+    async def _peer_chips(self, url: str) -> tuple[str, list[ChipSample] | None]:
+        try:
+            raw = await asyncio.to_thread(self._fetch_peer, url)
+            return url, [chip_from_json(d) for d in raw]
+        except Exception as e:
+            self.last_peer_status[url] = f"{type(e).__name__}: {e}"
+            return url, None
+
+    async def collect(self) -> Sample:
+        tasks = [self._peer_chips(u) for u in self.peers]
+        local_sample = None
+        if self.local is not None:
+            local_sample = await self.local.collect()
+        peer_results = await asyncio.gather(*tasks)
+
+        chips: list[ChipSample] = []
+        errors: list[str] = []
+        if local_sample is not None:
+            chips.extend(local_sample.data or [])
+            if local_sample.error:
+                errors.append(f"local: {local_sample.error}")
+        seen = {c.chip_id for c in chips}
+        for url, peer_chips in peer_results:
+            if peer_chips is None:
+                errors.append(f"peer {url}: {self.last_peer_status.get(url)}")
+                continue
+            self.last_peer_status[url] = "ok"
+            for c in peer_chips:
+                if c.chip_id not in seen:  # local identity wins on overlap
+                    chips.append(c)
+                    seen.add(c.chip_id)
+        return Sample(
+            source=self.name,
+            ok=not errors,
+            data=chips,
+            error="; ".join(errors) or None,
+        )

@@ -116,6 +116,9 @@ class Config:
     k8s_api_url: str | None = None
     # JetStream / MaxText /metrics scrape targets (SURVEY §5.7)
     serving_targets: tuple[str, ...] = ()
+    # Peer tpumon instances whose chips are merged into this one's view
+    # (realtime multi-host federation, BASELINE config 5)
+    peers: tuple[str, ...] = ()
 
     # --- topology expectations (for slice-failure alerting, SURVEY §2.2) ---
     # e.g. {"slice-0": 8} => alert critical if fewer chips report
@@ -141,7 +144,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "k8s_api_url": str,
 }
 _DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}
-_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets"}
+_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers"}
 
 
 def _coerce_thresholds(raw: Mapping[str, Any], base: Thresholds) -> Thresholds:

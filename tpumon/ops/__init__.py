@@ -1,0 +1,8 @@
+"""Pallas TPU kernels used by the loadgen/burn workloads.
+
+The framework's compute path is the loadgen subsystem (the monitor itself
+runs no XLA programs); these kernels are its hot ops, written the TPU way:
+MXU-shaped bf16 tiles, float32 VMEM accumulation, grid semantics that let
+Mosaic pipeline HBM→VMEM copies. They run in interpret mode on CPU for
+tests and compiled on real TPUs.
+"""
