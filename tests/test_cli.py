@@ -1,0 +1,42 @@
+import asyncio
+
+from tpumon.cli import render
+from tpumon.collectors.accel_fake import FakeTpuCollector
+
+
+def test_render_chip_table():
+    chips = FakeTpuCollector(topology="v5e-8", clock=lambda: 1000.0).chips()
+    host = {
+        "cpu": {"percent": 12.5, "load_1min": 0.5, "cores": 8},
+        "memory": {"percent": 40.0, "used": 8 * 2**30, "total": 16 * 2**30},
+    }
+    out = render(chips, host, {"tpu-host-0/chip-0": {"tx_bps": 2.5e9}})
+    lines = out.splitlines()
+    assert "cpu 12.5%" in lines[0]
+    assert "slice slice-0: 8 chip(s) on 1 host(s)" in out
+    assert sum(1 for line in lines if "chip-" in line) == 8
+    assert "2.50GB/s" in out
+    assert "█" in out  # duty bar drawn
+
+
+def test_render_no_chips():
+    out = render([], {"cpu": {}, "memory": {}})
+    assert "no TPU chips visible" in out
+
+
+def test_render_handles_none_fields():
+    from tpumon.topology import ChipSample
+
+    chip = ChipSample(
+        chip_id="vm/chip-0", host="vm", slice_id="s", index=0, kind="v5e"
+    )
+    out = render([chip], {})
+    assert "–" in out  # unknown values rendered as dashes, not crashes
+
+
+def test_cli_oneshot_exit_code():
+    from tpumon import cli
+
+    assert (
+        asyncio.run(cli._run(watch=None, backend="fake:v5e-4")) == 0
+    )

@@ -95,3 +95,13 @@ def test_federation_ici_rates_for_peer_chips():
         assert all(r["tx_bps"] > 0 for r in sampler.ici_rates.values())
 
     asyncio.run(scenario())
+
+
+def test_fake_backend_host_prefix_spec():
+    """fake:<topo>@<prefix> disambiguates chip ids for federated fakes."""
+    from tpumon.collectors.accel import make_accel_collector
+    from tpumon.config import load_config
+
+    cfg = load_config(env={"TPUMON_ACCEL_BACKEND": "fake:v5e-4@hostA"})
+    chips = make_accel_collector(cfg).chips()
+    assert all(c.chip_id.startswith("hostA-") for c in chips)

@@ -6,7 +6,10 @@ Backend selection (Config.accel_backend):
   reference's "nvidia-smi absent => []" mode, monitor_server.js:94, but
   with the reason recorded).
 - "jax": force the real collector.
-- "fake:<topology>": synthetic chips (v5e-1 / v5e-8 / v5p-64 ...).
+- "fake:<topology>[@<host_prefix>]": synthetic chips (v5e-1 / v5e-8 /
+  v5p-64 ...). The optional host prefix disambiguates chip identities
+  when several fake-backed instances federate (real deployments get
+  distinct identities from their hostnames).
 - "none": disabled.
 """
 
@@ -34,7 +37,10 @@ def make_accel_collector(cfg: Config) -> Collector:
     if backend == "none":
         local: Collector | None = None
     elif backend.startswith("fake:"):
-        local = FakeTpuCollector(topology=backend.split(":", 1)[1])
+        spec = backend.split(":", 1)[1]
+        topology, _, prefix = spec.partition("@")
+        kw = {"host_prefix": prefix} if prefix else {}
+        local = FakeTpuCollector(topology=topology, **kw)
     elif backend in ("auto", "jax"):
         local = JaxTpuCollector()
     else:

@@ -124,6 +124,9 @@ class Config:
     # e.g. {"slice-0": 8} => alert critical if fewer chips report
     expected_slice_chips: Mapping[str, int] = field(default_factory=dict)
 
+    # Per-request access logging (method path status ms) — SURVEY §5.1.
+    access_log: bool = False
+
     thresholds: Thresholds = field(default_factory=Thresholds)
 
     def effective_cpu_count(self) -> int:
@@ -142,6 +145,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "cpu_count": int,
     "k8s_mode": str,
     "k8s_api_url": str,
+    "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
 }
 _DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}
 _LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers"}

@@ -90,6 +90,7 @@ class MonitorServer:
         self.history = history
         self._server: asyncio.Server | None = None
         self.request_latencies_ms: deque = deque(maxlen=2048)
+        self.per_path_latencies_ms: dict[str, deque] = {}
         self._dashboard = StaticFile(
             os.path.join(WEB_DIR, "dashboard.html"), "text/html; charset=utf-8"
         )
@@ -193,11 +194,20 @@ class MonitorServer:
 
     def _api_health(self) -> dict:
         lat = list(self.request_latencies_ms)
+        per_path = {
+            path: {
+                "requests": len(d),
+                "latency_p50_ms": round(statistics.median(d), 3),
+            }
+            for path, d in sorted(self.per_path_latencies_ms.items())
+            if d
+        }
         return {
             **self.sampler.health_json(),
             "http": {
                 "requests": len(lat),
                 "latency_p50_ms": round(statistics.median(lat), 3) if lat else None,
+                "per_path": per_path,
             },
         }
 
@@ -282,7 +292,16 @@ class MonitorServer:
             if method == "HEAD":
                 body = b""
             await self._respond(writer, status, ctype, body)
-            self.request_latencies_ms.append((time.monotonic() - t0) * 1e3)
+            ms = (time.monotonic() - t0) * 1e3
+            self.request_latencies_ms.append(ms)
+            # Per-path stats only for served routes: keying on raw client
+            # paths would let a URL scanner grow the dict without bound.
+            if status != 404:
+                self.per_path_latencies_ms.setdefault(
+                    path, deque(maxlen=512)
+                ).append(ms)
+            if self.cfg.access_log:
+                print(f"{method} {path} {status} {ms:.2f}ms", flush=True)
         except (asyncio.TimeoutError, ConnectionError):
             pass
         finally:
