@@ -1,0 +1,65 @@
+"""Ring attention correctness on the virtual CPU mesh: the sequence-
+parallel implementation must match full-sequence attention exactly."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tpumon.loadgen.ring_attention import (  # noqa: E402
+    reference_attention,
+    ring_attention,
+)
+
+
+def make_qkv(b=2, t=32, h=4, d=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (b, t, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(n_dev, causal):
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    q, k, v = make_qkv(t=32)
+    ref = reference_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, axis="seq", causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ring_with_sharded_inputs():
+    """Inputs already device-put with the sequence sharding (the real
+    long-context layout) work identically."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    q, k, v = make_qkv(t=64)
+    spec = NamedSharding(mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh)
+    assert out.sharding.spec == P(None, "seq", None, None)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_ring_bf16_tolerance():
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    q, k, v = make_qkv(t=32, dtype=jnp.bfloat16)
+    ref = reference_attention(q, k, v)
+    out = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=5e-2, atol=5e-2,
+    )
+
+
+def test_first_row_fully_masked_is_finite():
+    """Causal first token attends only itself; no NaNs from the running
+    -inf max guards."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    q, k, v = make_qkv(t=16)
+    out = ring_attention(q, k, v, mesh)
+    assert bool(jnp.all(jnp.isfinite(out)))

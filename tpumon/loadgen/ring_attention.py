@@ -1,0 +1,119 @@
+"""Ring attention: sequence-parallel attention over a device mesh.
+
+Long-context jobs on TPU pods shard the sequence axis across chips and
+pass K/V blocks around the ICI ring (ring attention / context
+parallelism). tpumon's loadgen includes it for two reasons:
+
+1. It is the *realistic* ICI workload for monitoring validation — unlike
+   the synthetic ``ici_burn``, its traffic pattern (block rotation each
+   step, compute overlapped with the permute) matches what the monitor
+   sees under a real long-context training/serving job.
+2. It documents, in-tree, the sharding pattern the monitor's slice
+   topology model is built to observe (BASELINE config 5).
+
+Implementation: shard_map over the sequence axis; per step each device
+attends its local Q block against the visiting K/V block, accumulating
+with the online-softmax (flash-attention) update, then rotates K/V with
+``lax.ppermute`` — the collective rides the ICI ring. Static step count
+(mesh size), no data-dependent control flow, float32 accumulators.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = float("-inf")
+
+
+def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, D], k/v: [B, Tk, H, D]; m/l: [B, H, Tq]; o like q.
+    q_off/k_off are the blocks' global sequence offsets (traced scalars).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(tq)[:, None]
+        kpos = k_off + jnp.arange(tk)[None, :]
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # exp(-inf - -inf) guards: a fully-masked row keeps m_new == -inf;
+    # use a zeroed-safe exponent there (its p rows are all zero anyway).
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.where(
+        jnp.isneginf(s), 0.0, jnp.exp(s - m_safe[..., None])
+    )  # [B, H, Tq, Tk]
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    # corr: [B, H, Tq] -> broadcast over o's [B, Tq, H, D] layout.
+    corr_o = corr.swapaxes(1, 2)[..., None]
+    o_new = o * corr_o + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Plain full-sequence softmax attention (the correctness oracle)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / d**0.5
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    probs = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """Attention with Q/K/V sharded over `axis` on the sequence dimension.
+
+    Arrays are [B, T, H, D] with T divisible by the mesh axis size.
+    Returns the output with the same sharding as q.
+    """
+    n = mesh.shape[axis]
+    scale = 1.0 / q.shape[-1] ** 0.5
+    spec = P(None, axis, None, None)
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    def run(q_blk, k_blk, v_blk):
+        b, tq, h, _ = q_blk.shape
+        my = jax.lax.axis_index(axis)
+        q_off = my * tq
+        m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+        l = jnp.zeros((b, h, tq), jnp.float32)
+        o = jnp.zeros(q_blk.shape[:3] + (q_blk.shape[3],), jnp.float32)
+        k_cur, v_cur = k_blk, v_blk
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        for step in range(n):
+            # Block j visits us at step s where j = (my - s) mod n.
+            j = (my - step) % n
+            k_off = j * tq
+            m, l, o = _block_attend(
+                q_blk, k_cur, v_cur, q_off, k_off, scale, causal, m, l, o
+            )
+            if step != n - 1:
+                # Rotate K/V around the ICI ring; XLA overlaps this
+                # collective-permute with the next block's compute.
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # [B, H, Tq]
+        out = o / l_safe.swapaxes(1, 2)[..., None]
+        return out.astype(q_blk.dtype)
+
+    return run(q, k, v)
