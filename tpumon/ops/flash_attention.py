@@ -1,0 +1,129 @@
+"""Fused causal flash attention as a Pallas TPU kernel.
+
+The single-chip hot op of the loadgen transformer (its multi-chip
+counterpart is tpumon.loadgen.ring_attention, which rotates K/V blocks
+across chips; this kernel is what each chip would run on its local
+blocks). Standard flash-attention schedule:
+
+  grid = (batch*heads, Tq/block_q, Tk/block_k), K innermost ("arbitrary")
+  so each (bh, iq) output tile keeps its online-softmax state — running
+  max m, denominator l, and the f32 accumulator — in VMEM scratch across
+  K steps; HBM sees each block exactly once.
+
+TPU specifics: m/l live in (block_q, 128) VMEM tiles (min lane width)
+with the statistic broadcast across lanes; causal block skipping uses
+pl.when so fully-masked K blocks cost no MXU work; the in-block mask is
+built from broadcasted iotas (2D, as TPU requires).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    *, block_q: int, block_k: int, k_steps: int, scale: float, causal: bool,
+):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _attend():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            qpos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]  # [block_q]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = (l_ref[:, 0] * corr + jnp.sum(p, axis=1))[:, None] + jnp.zeros_like(l_ref)
+        m_ref[:] = m_new[:, None] + jnp.zeros_like(m_ref)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Skip K blocks entirely above the diagonal: with equal block
+        # sizes, block (iq, ik) is all-masked iff ik > iq.
+        pl.when(ik * block_k <= iq * block_q + (block_q - 1))(_attend)
+    else:
+        _attend()
+
+    @pl.when(ik == k_steps - 1)
+    def _store():
+        l_final = l_ref[:, 0]
+        l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+        out_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """q/k/v: [BH, T, D] -> [BH, T, D] (fold batch*heads before calling)."""
+    bh, t, d = q.shape
+    assert k.shape == v.shape == (bh, t, d)
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+    k_steps = t // block_k
+    scale = 1.0 / d**0.5
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        k_steps=k_steps,
+        scale=scale,
+        causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q, k_steps),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
