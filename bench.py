@@ -60,12 +60,20 @@ async def _bench(iters: int = 50, warmup: int = 5) -> dict:
     from tpumon.app import build
     from tpumon.config import load_config
 
-    # Prefer the real chip; fall back to the fake topology off-TPU.
+    # Prefer the real chip; fall back to the fake topology off-TPU. The
+    # probe runs in a subprocess with a hard timeout because a wedged
+    # device runtime hangs jax.devices() forever — bench must not hang
+    # with it.
     backend = "fake:v5e-8"
     try:
-        import jax
+        import subprocess
 
-        if any(d.platform == "tpu" for d in jax.devices()):
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=90,
+        )
+        if probe.returncode == 0 and probe.stdout.strip() == "tpu":
             backend = "jax"
     except Exception:
         pass
@@ -90,7 +98,8 @@ async def _bench(iters: int = 50, warmup: int = 5) -> dict:
             return json.loads(r.read())
 
     stop = threading.Event()
-    _start_burn(stop)
+    if backend == "jax":  # fake counters are synthetic; no point burning
+        _start_burn(stop)
     try:
         cycle_ms: list[float] = []
         for i in range(warmup + iters):

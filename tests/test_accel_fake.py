@@ -67,3 +67,18 @@ def test_unknown_topology_rejected():
 def test_collect_sample_envelope():
     s = asyncio.run(FakeTpuCollector(topology="v5e-4").collect())
     assert s.ok and s.source == "accel" and len(s.data) == 4
+
+
+def test_jax_collector_init_hang_degrades():
+    """A wedged device runtime must degrade the sample, not hang the
+    monitor (regression for the lost-remote-grant scenario)."""
+    import time as _time
+
+    from tpumon.collectors.accel_jax import JaxTpuCollector
+
+    c = JaxTpuCollector(init_timeout_s=0.2)
+    c._init_devices = lambda: _time.sleep(30)  # simulated wedge
+    s = asyncio.run(c.collect())
+    assert not s.ok
+    assert s.data == []
+    assert "hung" in s.error

@@ -36,6 +36,10 @@ class JaxTpuCollector:
     slice_id: str | None = None  # default: derived from env / "slice-0"
     hostname: str | None = None
     libtpu_addr: str = "localhost:8431"
+    # JAX backend init can hang indefinitely when the device runtime is
+    # wedged (e.g. a lost remote-device grant); a monitor must degrade,
+    # not hang with it.
+    init_timeout_s: float = 60.0
 
     _devices: list | None = field(default=None, repr=False)
     _client: LibtpuMetricsClient | None = field(default=None, repr=False)
@@ -68,7 +72,18 @@ class JaxTpuCollector:
     async def _devices_cached(self) -> list:
         if self._devices is None and self._init_error is None:
             try:
-                self._devices = await asyncio.to_thread(self._init_devices)
+                self._devices = await asyncio.wait_for(
+                    asyncio.to_thread(self._init_devices),
+                    timeout=self.init_timeout_s,
+                )
+            except asyncio.TimeoutError:
+                # The init thread may never return; record the wedge and
+                # stop waiting (the thread is daemonic via executor).
+                self._init_error = (
+                    f"JAX backend init hung >{self.init_timeout_s:.0f}s "
+                    "(wedged device runtime?)"
+                )
+                self._devices = []
             except Exception as e:
                 self._init_error = f"{type(e).__name__}: {e}"
                 self._devices = []
