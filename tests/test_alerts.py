@@ -159,3 +159,20 @@ def test_custom_thresholds_respected():
 def test_empty_inputs_no_alerts():
     r = AlertEngine().evaluate()
     assert all(not v for k, v in r.items())
+
+
+def test_event_timeline_fired_and_resolved():
+    """Alert lifecycle events: appearing alerts record 'fired', clearing
+    ones record 'resolved' (the reference keeps no alert history)."""
+    e = AlertEngine()
+    e.evaluate(host=host(cpu=96))
+    events = e.recent_events()
+    assert events[0]["state"] == "fired"
+    assert events[0]["key"] == "host.cpu.critical"
+    e.evaluate(host=host(cpu=96))  # unchanged: no duplicate events
+    assert len(e.recent_events()) == 1
+    e.evaluate(host=host(cpu=10))  # cleared
+    events = e.recent_events()
+    assert events[0]["state"] == "resolved"
+    assert events[0]["key"] == "host.cpu.critical"
+    assert len(events) == 2

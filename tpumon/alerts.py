@@ -21,6 +21,7 @@ Deliberate fixes over the reference:
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
 
@@ -67,6 +68,10 @@ class AlertEngine:
         self._last_pods: dict[str, dict] | None = None
         self._last_eval: dict[str, list[dict]] = _bucketize([])
         self._last_eval_ts: float | None = None
+        # Fired/resolved event timeline (the reference keeps no alert
+        # history at all — each poll overwrites the last). Bounded ring.
+        self._active_keys: dict[str, dict] = {}
+        self.events: deque = deque(maxlen=500)
 
     # ---------------- host rules (monitor_server.js:162-175) -------------
 
@@ -329,9 +334,23 @@ class AlertEngine:
         if update_pod_state:
             alerts += self._pod_alerts(pods)
         alerts += self._serving_alerts(serving)
+        now = time.time()
+        current = {a.key: a.to_json() for a in alerts}
+        for key, a in current.items():
+            if key not in self._active_keys:
+                self.events.append({"ts": now, "state": "fired", **a})
+        for key, a in self._active_keys.items():
+            if key not in current:
+                self.events.append(
+                    {"ts": now, "state": "resolved", **{**a, "desc": ""}}
+                )
+        self._active_keys = current
         self._last_eval = _bucketize(alerts)
-        self._last_eval_ts = time.time()
+        self._last_eval_ts = now
         return self._last_eval
+
+    def recent_events(self, n: int = 50) -> list[dict]:
+        return list(self.events)[-n:][::-1]  # newest first
 
     @property
     def last(self) -> dict[str, list[dict]]:

@@ -1,0 +1,147 @@
+"""Mixture-of-Experts FFN with expert parallelism (GShard-style).
+
+Completes the loadgen's parallelism coverage: dp (data axis), tp
+(Megatron splits in model.py), sp (ring_attention.py) — and ep here:
+experts sharded over a mesh "expert" axis, tokens dispatched to them
+with dense one-hot dispatch/combine einsums so XLA inserts the
+all-to-all collectives over ICI (the reference pattern from
+GShard/Switch: top-1 routing, fixed expert capacity, dropped overflow).
+
+Everything is static-shaped and jit-friendly: routing uses cumsum of
+one-hot assignments (no sorting, no dynamic shapes), capacity overflow
+tokens pass through on the residual path (combine weights are zero for
+them), and sharding is expressed with with_sharding_constraint only —
+no hand-written collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+
+    def capacity(self, n_tokens: int) -> int:
+        cap = int(self.capacity_factor * n_tokens / self.n_experts)
+        return max(cap, 1)
+
+
+def init_moe_params(cfg: MoEConfig, key: jax.Array) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = (1.0 / cfg.d_model) ** 0.5
+    scale_out = (1.0 / cfg.d_ff) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (cfg.d_model, cfg.n_experts), jnp.float32)
+        * scale_in,
+        "w_in": jax.random.normal(
+            k2, (cfg.n_experts, cfg.d_model, cfg.d_ff), jnp.float32
+        )
+        * scale_in,
+        "w_out": jax.random.normal(
+            k3, (cfg.n_experts, cfg.d_ff, cfg.d_model), jnp.float32
+        )
+        * scale_out,
+    }
+
+
+MOE_PARAM_SPECS = {
+    "router": P(None, None),
+    "w_in": P("expert", None, None),
+    "w_out": P("expert", None, None),
+}
+
+
+def moe_param_shardings(mesh: Mesh, params: dict):
+    return {
+        name: NamedSharding(mesh, MOE_PARAM_SPECS[name]) for name in params
+    }
+
+
+def _route(cfg: MoEConfig, router_w: jax.Array, x: jax.Array, capacity: int):
+    """Top-1 routing with fixed capacity.
+
+    x: [G, d]. Returns (dispatch [G, E, C] one-hot, combine [G, E, C]).
+    """
+    logits = x @ router_w  # [G, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [G]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [G]
+    onehot = jax.nn.one_hot(expert, cfg.n_experts, dtype=jnp.float32)  # [G, E]
+    # Position of each token within its expert's queue (arrival order):
+    # (cumsum - 1) at the assigned column, zero elsewhere.
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [G, E]
+    pos_in_expert = jnp.sum(position, axis=-1)  # [G]
+    kept = pos_in_expert < capacity
+    pos_onehot = jax.nn.one_hot(
+        pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32
+    )
+    dispatch = onehot[:, :, None] * pos_onehot[:, None, :]  # [G, E, C]
+    dispatch = dispatch * kept[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(
+    cfg: MoEConfig,
+    params: dict,
+    x: jax.Array,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """x: [G, d_model] -> [G, d_model]; dropped tokens return zeros
+    (callers add the residual)."""
+    g = x.shape[0]
+    capacity = cfg.capacity(g)
+    dispatch, combine = _route(cfg, params["router"], x, capacity)
+    # Dispatch: [G, d] x [G, E, C] -> [E, C, d]. With tokens sharded over
+    # "data" and experts over "expert", XLA lowers this to an all-to-all.
+    expert_in = jnp.einsum("gd,gec->ecd", x, dispatch)
+    if mesh is not None:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("expert", None, None))
+        )
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, params["w_in"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    if mesh is not None:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P("expert", None, None))
+        )
+    # Combine: [E, C, d] x [G, E, C] -> [G, d] (all-to-all back).
+    out = jnp.einsum("ecd,gec->gd", expert_out, combine)
+    if mesh is not None:
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P("data", None))
+        )
+    return out
+
+
+def make_sharded_moe_step(cfg: MoEConfig, mesh: Mesh, params: dict):
+    """jit a forward+grad step over a (data, expert) mesh."""
+    shardings = moe_param_shardings(mesh, params)
+    placed = jax.device_put(params, shardings)
+    x_sharding = NamedSharding(mesh, P("data", None))
+
+    def loss(p, x):
+        y = moe_ffn(cfg, p, x, mesh)
+        return jnp.mean(jnp.square(y - x))  # autoencoding burn objective
+
+    @partial(
+        jax.jit,
+        in_shardings=(shardings, x_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+    )
+    def step(p, x):
+        l, grads = jax.value_and_grad(loss)(p, x)
+        new_p = jax.tree_util.tree_map(lambda w, g: w - 1e-2 * g, p, grads)
+        return new_p, l
+
+    return step, placed

@@ -153,6 +153,7 @@ class MonitorServer:
         return {
             **self.sampler.engine.last,
             "evaluated_at": self.sampler.engine.last_ts,
+            "events": self.sampler.engine.recent_events(50),
         }
 
     def _api_serving(self) -> dict:
