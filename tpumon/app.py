@@ -89,6 +89,7 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     path = None
     overrides = {}
+    serve_loadgen = False
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -121,10 +122,16 @@ def main(argv: list[str] | None = None) -> int:
                     "expected_slice_chips": {"slice-0": 8},
                 }
             )
+        elif arg == "--serve-loadgen":
+            # In-process JetStream-style serving loadgen (KV-cached
+            # prefill/decode on the local accelerator) scraped as a real
+            # serving target — the north-star loop in one command.
+            serve_loadgen = True
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
-                "[--accel-backend auto|jax|fake:v5e-8|none] [--demo]\n"
+                "[--accel-backend auto|jax|fake:v5e-8|none] [--demo] "
+                "[--serve-loadgen]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0
@@ -132,7 +139,35 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown argument {arg!r}", file=sys.stderr)
             return 2
     cfg = load_config(path=path, overrides=overrides)
-    asyncio.run(run(cfg))
+    loadgen_stop = None
+    if serve_loadgen:
+        # Start only once the config is known-good, and *append* to the
+        # resolved target list so file/env-configured serving targets
+        # keep being scraped alongside the loadgen.
+        import dataclasses
+
+        try:
+            from tpumon.loadgen.serving import start_background
+        except ImportError:
+            print(
+                "--serve-loadgen requires jax (pip install 'tpumon[tpu]')",
+                file=sys.stderr,
+            )
+            return 2
+        _, url, loadgen_stop = start_background()
+        collectors = tuple(cfg.collectors)
+        if "serving" not in collectors:
+            collectors = collectors + ("serving",)
+        cfg = dataclasses.replace(
+            cfg,
+            serving_targets=tuple(cfg.serving_targets) + (url,),
+            collectors=collectors,
+        )
+    try:
+        asyncio.run(run(cfg))
+    finally:
+        if loadgen_stop is not None:
+            loadgen_stop.set()  # drains the arrival loop, closes /metrics
     return 0
 
 

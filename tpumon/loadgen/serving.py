@@ -1,0 +1,490 @@
+"""JetStream-style serving engine: KV-cached prefill/decode + /metrics.
+
+The reference can only watch an LLM serving stack from the outside (its
+README names vLLM metric collection, README.md:73, but ships no serving
+code — SURVEY §5.7). tpumon closes the loop in-tree: this module is a
+minimal continuous-batching inference engine over the loadgen model
+(tpumon.loadgen.model) that exposes JetStream-compatible Prometheus
+metrics — TTFT histogram, token/request counters, queue and slot gauges —
+so the serving collector (tpumon/collectors/serving.py) scrapes it with
+zero special-casing. That reproduces the north-star deployment
+(BASELINE config 4: JetStream serving a Llama-family model on v5e) as a
+self-contained demo: tpumon monitoring a real TPU serving job.
+
+TPU-first design:
+- prefill and decode are each jitted ONCE with static shapes: prompts pad
+  to ``prefill_len``, the KV cache is one preallocated
+  ``[layers, slots, max_seq, n_kv, head_dim]`` buffer per K/V, and all
+  per-slot writes go through ``lax.dynamic_update_slice`` (vmapped over
+  slots in decode) — no retracing as requests come and go;
+- decode advances ALL active slots in one fused step (continuous
+  batching): one embed + per-layer {QKV matmul, cache append, attention
+  over the cache, SwiGLU MLP} for the whole batch — MXU-batched work, no
+  per-request Python in the hot path;
+- cache buffers are donated to the jitted calls so XLA updates them
+  in place on TPU instead of copying ~seq_len × slots of HBM per token;
+- sampling is greedy (argmax), keeping the engine deterministic for the
+  correctness tests (decode must reproduce full-forward logits).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpumon.loadgen.model import ModelConfig, _rms_norm, init_params
+from tpumon.metrics_text import MetricsWriter
+
+# TTFT histogram bucket upper bounds, seconds (JetStream buckets are
+# seconds; the serving distiller converts quantiles to ms).
+TTFT_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    model: ModelConfig = field(default_factory=ModelConfig)
+    slots: int = 4  # concurrent decode slots (continuous batching)
+    prefill_len: int = 64  # static prompt padding length
+
+
+# ---------------------------------------------------------------------------
+# Jittable kernels
+# ---------------------------------------------------------------------------
+
+
+def init_cache(cfg: ServeConfig) -> dict:
+    m = cfg.model
+    shape = (m.n_layers, cfg.slots, m.max_seq, m.n_kv_heads, m.head_dim)
+    dt = jnp.dtype(m.compute_dtype)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _rope_at(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding at explicit positions; x: [B, T, H, D],
+    positions: [B, T] (int)."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _gqa_repeat(kv: jax.Array, n_heads: int) -> jax.Array:
+    nkv = kv.shape[-2]
+    return kv if nkv == n_heads else jnp.repeat(kv, n_heads // nkv, axis=-2)
+
+
+def prefill(cfg: ServeConfig, params: dict, cache: dict, tokens: jax.Array,
+            length: jax.Array, slot: jax.Array) -> tuple[dict, jax.Array]:
+    """Process one padded prompt into cache slot ``slot``.
+
+    tokens: [prefill_len] int32 (padded); length: scalar int32 true length;
+    slot: scalar int32. Returns (cache, logits[vocab] at position
+    length-1). Rows >= length hold padding garbage but are never attended:
+    decode's mask only reaches rows < the slot's current position, and
+    appends overwrite them in order.
+    """
+    m = cfg.model
+    p = cfg.prefill_len
+    dt = jnp.dtype(m.compute_dtype)
+    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
+    x = params["embed"].astype(dt)[tokens][None]  # [1, P, D]
+    pos = jnp.arange(p, dtype=jnp.int32)[None]  # [1, P]
+    causal = jnp.tril(jnp.ones((p, p), bool)) & (
+        jnp.arange(p)[None, :] < length
+    )
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"])
+        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(1, p, nh, hd),
+                     pos, m.rope_theta)
+        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(1, p, nkv, hd),
+                     pos, m.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(1, p, nkv, hd)
+        cache["k"] = lax.dynamic_update_slice(
+            cache["k"], k[None], (li, slot, 0, 0, 0))
+        cache["v"] = lax.dynamic_update_slice(
+            cache["v"], v[None], (li, slot, 0, 0, 0))
+        kr, vr = _gqa_repeat(k, nh), _gqa_repeat(v, nh)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / (hd**0.5)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(1, p, nh * hd)
+        x = x + att @ layer["wo"].astype(dt)
+        hm = _rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
+        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
+            "w_down"].astype(dt)
+    x = _rms_norm(x, params["final_norm"])
+    last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return cache, logits
+
+
+def decode_step(cfg: ServeConfig, params: dict, cache: dict,
+                last_tokens: jax.Array, positions: jax.Array
+                ) -> tuple[dict, jax.Array]:
+    """Advance every slot one token.
+
+    last_tokens: [B] int32 (token to feed per slot); positions: [B] int32
+    (cache row the new token's K/V is written to == current sequence
+    length per slot). Returns (cache, logits [B, vocab]) for the next
+    token. Inactive slots compute garbage that the host ignores; their
+    cache writes land on a stale row and are rewritten on admit.
+    """
+    m = cfg.model
+    dt = jnp.dtype(m.compute_dtype)
+    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
+    b = positions.shape[0]
+    x = params["embed"].astype(dt)[last_tokens][:, None]  # [B, 1, D]
+    pos = positions[:, None]  # [B, 1]
+    row = jnp.arange(m.max_seq, dtype=jnp.int32)
+    mask = (row[None] <= positions[:, None])[:, None, None]  # [B,1,1,S]
+
+    def append(cache_l: jax.Array, kv: jax.Array, p: jax.Array) -> jax.Array:
+        # cache_l: [S, nkv, hd]; kv: [1, nkv, hd] — per-slot row write.
+        return lax.dynamic_update_slice(cache_l, kv, (p, 0, 0))
+
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"])
+        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(b, 1, nh, hd),
+                     pos, m.rope_theta)
+        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, 1, nkv, hd),
+                     pos, m.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(b, 1, nkv, hd)
+        new_k = jax.vmap(append)(cache["k"][li], k, positions)
+        new_v = jax.vmap(append)(cache["v"][li], v, positions)
+        cache["k"] = cache["k"].at[li].set(new_k)
+        cache["v"] = cache["v"].at[li].set(new_v)
+        kr, vr = _gqa_repeat(new_k, nh), _gqa_repeat(new_v, nh)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / (hd**0.5)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, 1, nh * hd)
+        x = x + att @ layer["wo"].astype(dt)
+        hm = _rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
+        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
+            "w_down"].astype(dt)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return cache, logits
+
+
+# ---------------------------------------------------------------------------
+# Host-side engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: list[int]
+    max_new: int
+    enqueued: float
+    ttft_s: float | None = None
+    output: list[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+
+
+class ServingEngine:
+    """Continuous-batching engine: submit() from any thread, step() (or
+    the run loop) drives prefill/decode; /metrics-ready exposition from
+    metrics_text()."""
+
+    def __init__(self, cfg: ServeConfig | None = None,
+                 params: dict | None = None, seed: int = 0,
+                 max_queue: int = 64):
+        self.cfg = cfg or ServeConfig(
+            model=ModelConfig(vocab=512, d_model=128, n_layers=2, n_heads=4,
+                              n_kv_heads=2, d_ff=256, max_seq=128),
+            slots=4, prefill_len=16,
+        )
+        m = self.cfg.model
+        self.params = params if params is not None else init_params(
+            m, jax.random.PRNGKey(seed))
+        self._prefill = jax.jit(
+            partial(prefill, self.cfg, self.params), donate_argnums=(0,))
+        self._decode = jax.jit(
+            partial(decode_step, self.cfg, self.params), donate_argnums=(0,))
+        self.cache = init_cache(self.cfg)
+        self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self._slots: list[Request | None] = [None] * self.cfg.slots
+        self._queue: deque[Request] = deque()
+        self.max_queue = max_queue
+        self._rid = itertools.count()
+        self._lock = threading.Lock()
+        # metrics state (guarded by _lock)
+        self.tokens_total = 0
+        self.requests_total = 0
+        self.rejected_total = 0
+        self.completed_total = 0
+        self.decode_steps_total = 0
+        self._ttft_counts = [0] * len(TTFT_BUCKETS_S)
+        self._ttft_inf = 0
+        self._ttft_sum = 0.0
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new: int = 16) -> Request:
+        """Enqueue a request. When the queue is full the request is
+        rejected immediately (done is set, output stays empty) — the
+        backpressure a real serving frontend applies instead of letting
+        latency grow without bound."""
+        m = self.cfg.model
+        prompt = [t % m.vocab for t in prompt][: self.cfg.prefill_len]
+        req = Request(rid=next(self._rid), prompt=prompt or [0],
+                      max_new=max_new, enqueued=time.monotonic())
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self.rejected_total += 1
+                req.done.set()
+                return req
+            self._queue.append(req)
+            self.requests_total += 1
+        return req
+
+    # -- engine loop --------------------------------------------------------
+
+    def _observe_ttft(self, dt_s: float) -> None:
+        for i, bound in enumerate(TTFT_BUCKETS_S):
+            if dt_s <= bound:
+                self._ttft_counts[i] += 1
+                break
+        else:
+            self._ttft_inf += 1
+        self._ttft_sum += dt_s
+
+    def _admit(self) -> None:
+        for slot in range(self.cfg.slots):
+            if self._slots[slot] is not None:
+                continue
+            with self._lock:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+            n = len(req.prompt)
+            toks = jnp.asarray(
+                req.prompt + [0] * (self.cfg.prefill_len - n), jnp.int32)
+            self.cache, logits = self._prefill(
+                self.cache, toks, jnp.int32(n), jnp.int32(slot))
+            first = int(jnp.argmax(logits))
+            with self._lock:
+                req.ttft_s = time.monotonic() - req.enqueued
+                self._observe_ttft(req.ttft_s)
+                req.output.append(first)
+                self.tokens_total += 1
+            self._slots[slot] = req
+            self.positions = self.positions.at[slot].set(n)
+            self.last_tokens = self.last_tokens.at[slot].set(first)
+
+    def _complete(self, slot: int) -> None:
+        req = self._slots[slot]
+        assert req is not None
+        self._slots[slot] = None
+        with self._lock:
+            self.completed_total += 1
+        req.done.set()
+
+    def step(self) -> bool:
+        """Admit + one decode step; returns True if any work remains."""
+        self._admit()
+        active = [s for s in range(self.cfg.slots) if self._slots[s]]
+        if active:
+            self.cache, logits = self._decode(
+                self.cache, self.last_tokens, self.positions)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            self.last_tokens = nxt
+            self.positions = jnp.minimum(
+                self.positions + 1, self.cfg.model.max_seq - 1)
+            nxt_host = [int(t) for t in nxt]
+            with self._lock:
+                self.decode_steps_total += 1
+                self.tokens_total += len(active)
+            for slot in active:
+                req = self._slots[slot]
+                req.output.append(nxt_host[slot])
+                pos = int(self.positions[slot])
+                if (len(req.output) >= req.max_new + 1
+                        or pos >= self.cfg.model.max_seq - 1):
+                    self._complete(slot)
+        with self._lock:
+            pending = bool(self._queue)
+        return pending or any(s is not None for s in self._slots)
+
+    def drain(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if not self.step():
+                return
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            tokens = self.tokens_total
+            requests = self.requests_total
+            completed = self.completed_total
+            steps = self.decode_steps_total
+            queue = len(self._queue)
+            rejected = self.rejected_total
+            counts = list(self._ttft_counts)
+            inf = self._ttft_inf
+            ttft_sum = self._ttft_sum
+            free = sum(1 for s in self._slots if s is None)
+        w = MetricsWriter()
+        w.counter("jetstream_generate_tokens",
+                  "tokens generated (prefill first-token + decode)"
+                  ).add(value=tokens)
+        w.counter("jetstream_request_count", "requests submitted"
+                  ).add(value=requests)
+        w.counter("tpumon_serving_requests_completed", "requests finished"
+                  ).add(value=completed)
+        w.counter("tpumon_serving_requests_rejected",
+                  "requests dropped by queue backpressure"
+                  ).add(value=rejected)
+        w.counter("tpumon_serving_decode_steps", "fused decode steps"
+                  ).add(value=steps)
+        w.gauge("jetstream_queue_size", "requests waiting for a slot"
+                ).add(value=queue)
+        w.gauge("jetstream_slots_available", "free decode slots"
+                ).add(value=free)
+        lines = [w.render().rstrip("\n")]
+        lines.append("# TYPE jetstream_time_to_first_token histogram")
+        cum = 0
+        for bound, c in zip(TTFT_BUCKETS_S, counts):
+            cum += c
+            lines.append(
+                f'jetstream_time_to_first_token_bucket{{le="{bound}"}} {cum}')
+        total = cum + inf
+        lines.append(
+            f'jetstream_time_to_first_token_bucket{{le="+Inf"}} {total}')
+        lines.append(f"jetstream_time_to_first_token_sum {ttft_sum:.6f}")
+        lines.append(f"jetstream_time_to_first_token_count {total}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# /metrics HTTP endpoint + demo loop
+# ---------------------------------------------------------------------------
+
+
+def start_metrics_server(engine: ServingEngine, port: int = 0,
+                         host: str = "127.0.0.1"):
+    """Serve the engine's exposition on /metrics; returns (server, port).
+    Runs in a daemon thread; call server.shutdown() to stop."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.split("?")[0] != "/metrics":
+                self.send_error(404)
+                return
+            body = engine.metrics_text().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address[1]
+
+
+def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
+                  stop: threading.Event, duration: float = 0.0,
+                  seed: int = 0) -> None:
+    """Poisson-ish synthetic request arrivals + engine stepping until
+    ``stop`` is set (or ``duration`` seconds elapse, if nonzero)."""
+    import random
+
+    rng = random.Random(seed)
+    t0 = time.monotonic()
+    next_arrival = t0
+    while not stop.is_set():
+        now = time.monotonic()
+        if duration and now - t0 >= duration:
+            return
+        while now >= next_arrival:
+            n = rng.randint(2, engine.cfg.prefill_len)
+            engine.submit([rng.randrange(engine.cfg.model.vocab)
+                           for _ in range(n)], max_new=max_new)
+            next_arrival += rng.expovariate(rps)
+        if not engine.step():
+            time.sleep(min(0.05, max(0.0, next_arrival - now)))
+
+
+def start_background(rps: float = 0.5, max_new: int = 16,
+                     cfg: ServeConfig | None = None, port: int = 0,
+                     seed: int = 0):
+    """Run the serving loadgen inside this process: engine loop in a
+    daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
+    Used by ``python -m tpumon --serve-loadgen`` so one command runs the
+    whole north-star loop: a live TPU serving job AND the monitor
+    scraping it."""
+    engine = ServingEngine(cfg=cfg)
+    server, bound = start_metrics_server(engine, port=port)
+    stop = threading.Event()
+
+    def _run():
+        try:
+            _arrival_loop(engine, rps, max_new, stop, seed=seed)
+        finally:
+            server.shutdown()
+
+    threading.Thread(target=_run, daemon=True).start()
+    return engine, f"http://127.0.0.1:{bound}/metrics", stop
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m tpumon.loadgen.serving`` — run the serving loadgen:
+    synthetic request arrivals + /metrics for tpumon to scrape."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--port", type=int, default=9105)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--rps", type=float, default=2.0,
+                    help="synthetic request arrival rate")
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="seconds to run; 0 = forever")
+    args = ap.parse_args(argv)
+
+    engine = ServingEngine(cfg=ServeConfig(
+        model=ModelConfig(vocab=2048, d_model=256, n_layers=4, n_heads=8,
+                          n_kv_heads=4, d_ff=1024, max_seq=256),
+        slots=args.slots, prefill_len=32,
+    ))
+    _, port = start_metrics_server(engine, args.port)
+    print(f"serving loadgen: /metrics on :{port} "
+          f"(point TPUMON_SERVING_TARGETS=http://127.0.0.1:{port}/metrics)")
+    try:
+        _arrival_loop(engine, args.rps, args.max_new, threading.Event(),
+                      duration=args.duration)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
