@@ -97,6 +97,14 @@ def test_queue_overflows_slots_then_drains():
     assert d["queue_depth"] == 0
 
 
+def test_max_new_zero_gets_exactly_one_token():
+    eng = ServingEngine(cfg=CFG)
+    req = eng.submit([1, 2, 3], max_new=0)
+    eng.drain()
+    assert req.done.is_set()
+    assert len(req.output) == 1  # the prefill token only, no decode
+
+
 def test_queue_backpressure_rejects():
     eng = ServingEngine(cfg=CFG, max_queue=3)
     accepted = [eng.submit([1], max_new=2) for _ in range(3)]

@@ -186,6 +186,67 @@ def decode_step(cfg: ServeConfig, params: dict, cache: dict,
 
 
 # ---------------------------------------------------------------------------
+# Sharded (multi-chip) serving: tensor-parallel decode over a mesh
+# ---------------------------------------------------------------------------
+
+
+def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
+    """jit prefill + decode tensor-parallel over mesh axis "model".
+
+    The Megatron-style split from the training path (model.PARAM_SPECS)
+    carries over to serving unchanged: QKV projections column-parallel →
+    each device owns a contiguous block of KV heads, attention is local
+    per head, the output/down projections are row-parallel and XLA
+    inserts the psum over ICI. The KV cache is sharded on its head axis
+    (``[layers, slots, seq, n_kv, head_dim]`` → n_kv split over "model")
+    so per-token cache appends touch only device-local HBM — no
+    collective in the append. Logits are replicated for host-side
+    sampling (one all-gather over the vocab-sharded lm_head output).
+
+    Requires ``n_kv_heads % mesh.shape["model"] == 0`` and
+    ``slots % mesh.shape["data"] == 0`` (slots are data-parallel).
+    Returns (prefill_fn, decode_fn, placed_params, placed_cache).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpumon.loadgen.model import param_shardings
+
+    tp = mesh.shape["model"]
+    dp = mesh.shape.get("data", 1)
+    assert cfg.model.n_kv_heads % tp == 0, (
+        f"n_kv_heads={cfg.model.n_kv_heads} not divisible by tp={tp}")
+    assert cfg.slots % dp == 0, f"slots={cfg.slots} not divisible by dp={dp}"
+    shardings = param_shardings(mesh, params)
+    placed = jax.device_put(params, shardings)
+    cache_sh = {
+        "k": NamedSharding(mesh, P(None, "data", None, "model", None)),
+        "v": NamedSharding(mesh, P(None, "data", None, "model", None)),
+    }
+    rep = NamedSharding(mesh, P())
+    _pre = jax.jit(
+        partial(prefill, cfg),
+        in_shardings=(shardings, cache_sh, rep, rep, rep),
+        out_shardings=(cache_sh, rep),
+        donate_argnums=(1,),
+    )
+    _dec = jax.jit(
+        partial(decode_step, cfg),
+        in_shardings=(shardings, cache_sh, rep, rep),
+        out_shardings=(cache_sh, rep),
+        donate_argnums=(1,),
+    )
+
+    def prefill_fn(cache, tokens, length, slot):
+        return _pre(placed, cache, tokens, length, slot)
+
+    def decode_fn(cache, last_tokens, positions):
+        return _dec(placed, cache, last_tokens, positions)
+
+    placed_cache = jax.device_put(init_cache(cfg), cache_sh)
+    return prefill_fn, decode_fn, placed, placed_cache
+
+
+# ---------------------------------------------------------------------------
 # Host-side engine
 # ---------------------------------------------------------------------------
 
@@ -217,12 +278,16 @@ class ServingEngine:
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
-        self._prefill = jax.jit(
-            partial(prefill, self.cfg, self.params), donate_argnums=(0,))
-        self._decode = jax.jit(
-            partial(decode_step, self.cfg, self.params), donate_argnums=(0,))
+        # params stay a traced argument (closure capture would bake the
+        # weights into the executable as constants, duplicating them in
+        # HBM); only the cache is donated for in-place updates.
+        self._prefill = jax.jit(partial(prefill, self.cfg),
+                                donate_argnums=(1,))
+        self._decode = jax.jit(partial(decode_step, self.cfg),
+                               donate_argnums=(1,))
         self.cache = init_cache(self.cfg)
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
         self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._slots: list[Request | None] = [None] * self.cfg.slots
         self._queue: deque[Request] = deque()
@@ -282,7 +347,7 @@ class ServingEngine:
             toks = jnp.asarray(
                 req.prompt + [0] * (self.cfg.prefill_len - n), jnp.int32)
             self.cache, logits = self._prefill(
-                self.cache, toks, jnp.int32(n), jnp.int32(slot))
+                self.params, self.cache, toks, jnp.int32(n), jnp.int32(slot))
             first = int(jnp.argmax(logits))
             with self._lock:
                 req.ttft_s = time.monotonic() - req.enqueued
@@ -291,7 +356,10 @@ class ServingEngine:
                 self.tokens_total += 1
             self._slots[slot] = req
             self.positions = self.positions.at[slot].set(n)
+            self._host_positions[slot] = n
             self.last_tokens = self.last_tokens.at[slot].set(first)
+            if len(req.output) >= req.max_new + 1:  # max_new == 0
+                self._complete(slot)
 
     def _complete(self, slot: int) -> None:
         req = self._slots[slot]
@@ -307,21 +375,25 @@ class ServingEngine:
         active = [s for s in range(self.cfg.slots) if self._slots[s]]
         if active:
             self.cache, logits = self._decode(
-                self.cache, self.last_tokens, self.positions)
+                self.params, self.cache, self.last_tokens, self.positions)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             self.last_tokens = nxt
             self.positions = jnp.minimum(
                 self.positions + 1, self.cfg.model.max_seq - 1)
-            nxt_host = [int(t) for t in nxt]
+            # ONE host-device sync per step; positions tracked host-side.
+            nxt_host = jax.device_get(nxt).tolist()
             with self._lock:
                 self.decode_steps_total += 1
                 self.tokens_total += len(active)
             for slot in active:
                 req = self._slots[slot]
                 req.output.append(nxt_host[slot])
-                pos = int(self.positions[slot])
+                self._host_positions[slot] = min(
+                    self._host_positions[slot] + 1,
+                    self.cfg.model.max_seq - 1)
                 if (len(req.output) >= req.max_new + 1
-                        or pos >= self.cfg.model.max_seq - 1):
+                        or self._host_positions[slot]
+                        >= self.cfg.model.max_seq - 1):
                     self._complete(slot)
         with self._lock:
             pending = bool(self._queue)
