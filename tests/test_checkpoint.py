@@ -1,0 +1,104 @@
+"""Orbax checkpoint/resume of loadgen model params (SURVEY §5.4).
+
+Covers the TPU-native resume path: params saved from one process layout
+restore directly onto a dp×tp jax.sharding.Mesh (no gather-to-host), the
+latest-step pointer survives partial writes, and a config mismatch
+refuses to resume rather than loading an incompatible tree.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+
+from tpumon.loadgen.checkpoint import (
+    latest_step,
+    restore_checkpoint,
+    save_checkpoint,
+    saved_model_config,
+)
+from tpumon.loadgen.model import (
+    ModelConfig,
+    init_params,
+    param_shardings,
+)
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=64, max_seq=16
+)
+
+
+@pytest.fixture
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def trees_equal(a, b) -> bool:
+    return all(
+        jax.tree.leaves(jax.tree.map(lambda x, y: bool(jnp.allclose(x, y)), a, b))
+    )
+
+
+def test_save_restore_round_trip(tmp_path, params):
+    d = str(tmp_path)
+    save_checkpoint(d, params, step=3, cfg=CFG)
+    assert latest_step(d) == 3
+    assert saved_model_config(d) == CFG
+    restored, step = restore_checkpoint(d, like=params, cfg=CFG)
+    assert step == 3
+    assert trees_equal(params, restored)
+
+
+def test_restore_latest_of_many_steps(tmp_path, params):
+    d = str(tmp_path)
+    save_checkpoint(d, params, step=1, cfg=CFG)
+    bumped = jax.tree.map(lambda x: x + 1, params)
+    save_checkpoint(d, bumped, step=2, cfg=CFG)
+    restored, step = restore_checkpoint(d, like=params)
+    assert step == 2
+    assert trees_equal(bumped, restored)
+
+
+def test_restore_onto_sharded_mesh(tmp_path, params):
+    """Params saved unsharded restore straight onto a dp×tp mesh with the
+    training shardings — each leaf lands distributed, not single-device."""
+    d = str(tmp_path)
+    save_checkpoint(d, params, step=0, cfg=CFG)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
+    shardings = param_shardings(mesh, params)
+    like = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        params,
+        shardings,
+    )
+    restored, _ = restore_checkpoint(d, like=like, cfg=CFG)
+    leaves, s_leaves = jax.tree.leaves(restored), jax.tree.leaves(shardings)
+    assert all(
+        leaf.sharding == s for leaf, s in zip(leaves, s_leaves)
+    )
+    assert trees_equal(params, restored)
+
+
+def test_nothing_to_resume(tmp_path, params):
+    assert latest_step(str(tmp_path)) is None
+    assert restore_checkpoint(str(tmp_path), like=params) is None
+
+
+def test_config_mismatch_refuses_resume(tmp_path, params):
+    d = str(tmp_path)
+    save_checkpoint(d, params, step=0, cfg=CFG)
+    other = dataclasses.replace(CFG, n_layers=2)
+    assert restore_checkpoint(d, like=params, cfg=other) is None
+
+
+def test_meta_pointing_at_missing_step_dir(tmp_path, params):
+    import shutil
+
+    d = str(tmp_path)
+    path = save_checkpoint(d, params, step=5, cfg=CFG)
+    shutil.rmtree(path)  # simulate a partially-deleted checkpoint
+    assert latest_step(d) is None
+    assert restore_checkpoint(d, like=params) is None

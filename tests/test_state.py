@@ -1,0 +1,119 @@
+"""Checkpoint/resume of monitor state (tpumon.state, SURVEY §5.4).
+
+The reference loses all state on restart (monitor_server.js:157); these
+tests pin the upgrade: ring history, alert timeline and pod-transition
+baseline round-trip through a StateStore snapshot, and a pod restart
+*while the monitor was down* still alerts after resume.
+"""
+
+import json
+import time
+
+from tpumon.app import build
+from tpumon.config import load_config
+from tpumon.state import StateStore, restore_state, snapshot_state
+
+ENV = {
+    "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+    "TPUMON_K8S_MODE": "none",
+    "TPUMON_COLLECTORS": "host,accel",
+    "TPUMON_PORT": "0",
+}
+
+
+def make_sampler():
+    sampler, _ = build(load_config(env=ENV))
+    return sampler
+
+
+def pods(status="Running", restarts=0):
+    return [
+        {"namespace": "ns", "name": "job-0", "status": status, "restarts": restarts}
+    ]
+
+
+def test_round_trip_history_and_alert_state():
+    a = make_sampler()
+    now = time.time()
+    a.history.record("cpu", 42.0, ts=now - 60)
+    a.history.record("cpu", 43.0, ts=now)
+    a.history.record("chip.h0/chip-0.mxu", 71.5, ts=now)
+    a.engine.evaluate(host={"cpu": {"percent": 96.0}}, pods=pods(restarts=1))
+
+    b = make_sampler()
+    assert restore_state(b, snapshot_state(a))
+    assert b.history.snapshot_series("cpu", 30)["data"][-1] == 43.0
+    assert b.history.snapshot_series("chip.h0/chip-0.mxu", 30)["data"] == [71.5]
+    # Timeline survived; active keys survived so the same alert doesn't
+    # re-append a duplicate "fired" event after resume.
+    fired = [e for e in b.engine.events if e["state"] == "fired"]
+    assert any(e["key"] == "host.cpu.critical" for e in fired)
+    n_events = len(b.engine.events)
+    b.engine.evaluate(host={"cpu": {"percent": 96.0}}, pods=pods(restarts=1))
+    assert len(b.engine.events) == n_events
+
+
+def test_pod_restart_during_downtime_alerts_after_resume():
+    a = make_sampler()
+    a.engine.evaluate(pods=pods(restarts=0))
+    state = snapshot_state(a)
+
+    b = make_sampler()
+    assert restore_state(b, state)
+    r = b.engine.evaluate(pods=pods(restarts=2))  # restarted while down
+    assert any(x["key"] == "pod.ns/job-0.restarted" for x in r["serious"])
+
+
+def test_restore_prunes_points_outside_window():
+    a = make_sampler()
+    now = time.time()
+    a.history.record("cpu", 1.0, ts=now - a.history.window_s - 600)
+    a.history.record("cpu", 2.0, ts=now)
+    b = make_sampler()
+    assert restore_state(b, snapshot_state(a))
+    assert b.history.snapshot_series("cpu", 30)["data"] == [2.0]
+
+
+def test_stale_or_malformed_snapshot_rejected():
+    b = make_sampler()
+    good = snapshot_state(make_sampler())
+    assert not restore_state(b, {"version": 99})
+    assert not restore_state(b, {**good, "saved_at": time.time() - 90000})
+    assert not restore_state(b, {**good, "history": "nope"})
+
+
+def test_statestore_file_round_trip_and_corruption(tmp_path):
+    path = tmp_path / "state.json"
+    store = StateStore(str(path))
+    a = make_sampler()
+    a.history.record("cpu", 7.0)
+    assert store.save(a)
+    assert store.last_save_ts is not None
+
+    b = make_sampler()
+    assert StateStore(str(path)).restore_into(b)
+    assert b.history.snapshot_series("cpu", 30)["data"] == [7.0]
+
+    path.write_text("{corrupt")
+    c = make_sampler()
+    assert not StateStore(str(path)).restore_into(c)  # degrades, no raise
+    assert not StateStore(str(tmp_path / "missing.json")).restore_into(c)
+
+
+def test_snapshot_is_json_serializable_end_to_end(tmp_path):
+    a = make_sampler()
+    a.engine.evaluate(
+        host={"cpu": {"percent": 96.0}},
+        pods=pods(status="Pending"),
+        serving=[{"target": "t", "ok": False, "error": "down"}],
+    )
+    # The exact bytes the StateStore writes must round-trip through json.
+    assert restore_state(make_sampler(), json.loads(json.dumps(snapshot_state(a))))
+
+
+def test_config_state_keys():
+    cfg = load_config(
+        env={**ENV, "TPUMON_STATE_PATH": "/tmp/s.json", "TPUMON_STATE_INTERVAL_S": "5"}
+    )
+    assert cfg.state_path == "/tmp/s.json"
+    assert cfg.state_interval_s == 5.0

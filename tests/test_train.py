@@ -1,0 +1,121 @@
+"""Training-loop checkpoint/resume (tpumon.loadgen.train).
+
+Pins the elastic-recovery contract (SURVEY §5.3/§5.4): a killed run
+resumed from its checkpoint produces the SAME final params as an
+uninterrupted run — synthetic batches are deterministic per step, so
+resume continues the exact data order.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from tpumon.loadgen.model import ModelConfig
+from tpumon.loadgen.serving import ServingEngine
+from tpumon.loadgen.train import TrainConfig, run_train, synthetic_batch
+
+MODEL = ModelConfig(
+    vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=64, max_seq=32
+)
+
+
+def cfg(**kw):
+    base = dict(model=MODEL, steps=6, batch=4, seq=16, ckpt_every=3)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def max_param_diff(a, b) -> float:
+    return max(
+        jax.tree.leaves(
+            jax.tree.map(lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)
+        )
+    )
+
+
+def test_synthetic_batches_deterministic():
+    c = cfg()
+    assert jnp.array_equal(synthetic_batch(c, 3), synthetic_batch(c, 3))
+    assert not jnp.array_equal(synthetic_batch(c, 3), synthetic_batch(c, 4))
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+
+    full = run_train(cfg(), mesh=mesh)  # no checkpointing: ground truth
+
+    d = str(tmp_path)
+    first = run_train(cfg(steps=3, ckpt_dir=d), mesh=mesh)  # "killed" at 3
+    assert first["resumed_from"] is None
+    second = run_train(cfg(ckpt_dir=d), mesh=mesh)  # same command, rerun
+    assert second["resumed_from"] == 3
+    assert second["step"] == 5
+    assert max_param_diff(full["params"], second["params"]) < 1e-5
+    assert abs(full["loss"] - second["loss"]) < 1e-5
+
+
+def test_completed_run_resumes_to_noop(tmp_path):
+    d = str(tmp_path)
+    done = run_train(cfg(ckpt_dir=d))
+    again = run_train(cfg(ckpt_dir=d))
+    assert again["resumed_from"] == 6  # past the last step: loop body skipped
+    assert again["loss"] is None  # no steps ran; no fake/NaN loss reported
+    assert max_param_diff(done["params"], again["params"]) == 0.0
+
+
+def test_single_device_path(tmp_path, monkeypatch):
+    import tpumon.loadgen.train as train_mod
+
+    monkeypatch.setattr(train_mod, "_default_mesh", lambda: None)
+    d = str(tmp_path)
+    out = train_mod.run_train(cfg(steps=2, ckpt_dir=d, ckpt_every=1))
+    assert np.isfinite(out["loss"])
+    again = train_mod.run_train(cfg(steps=2, ckpt_dir=d, ckpt_every=1))
+    assert again["resumed_from"] == 2
+
+
+def test_serving_engine_serves_trained_checkpoint(tmp_path):
+    d = str(tmp_path)
+    trained = run_train(cfg(ckpt_dir=d))
+
+    from tpumon.loadgen.serving import ServeConfig
+
+    engine = ServingEngine(
+        cfg=ServeConfig(model=MODEL, slots=2, prefill_len=8), ckpt_dir=d
+    )
+    assert engine.ckpt_step == 5
+    host_params = jax.device_get(trained["params"])
+    assert max_param_diff(host_params, jax.device_get(engine.params)) < 1e-6
+    r = engine.submit([1, 2, 3], max_new=2)
+    while not r.done.is_set():
+        engine.step()
+    assert len(r.output) >= 2  # prefill's first token + decode steps
+
+
+def test_serving_engine_ignores_mismatched_checkpoint(tmp_path):
+    d = str(tmp_path)
+    run_train(cfg(steps=2, ckpt_dir=d))
+
+    from tpumon.loadgen.serving import ServeConfig
+
+    other = ModelConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        max_seq=32,
+    )
+    engine = ServingEngine(
+        cfg=ServeConfig(model=other, slots=2, prefill_len=8), ckpt_dir=d
+    )
+    assert engine.ckpt_step is None  # cold init, no crash
+
+
+def test_serving_engine_adopts_checkpoint_config(tmp_path):
+    """The --loadgen-ckpt CLI path: no explicit ServeConfig, so the engine
+    must take the architecture from the checkpoint's meta — otherwise the
+    default config can never match and trained weights silently never
+    load."""
+    d = str(tmp_path)
+    run_train(cfg(steps=2, ckpt_dir=d))
+    engine = ServingEngine(ckpt_dir=d)
+    assert engine.cfg.model == MODEL
+    assert engine.ckpt_step == 1

@@ -352,6 +352,25 @@ class AlertEngine:
     def recent_events(self, n: int = 50) -> list[dict]:
         return list(self.events)[-n:][::-1]  # newest first
 
+    # ------------- checkpoint/resume (tpumon.state, SURVEY §5.4) ----------
+
+    def to_state(self) -> dict:
+        """Stateful parts worth surviving a restart: the pod-transition
+        baseline (so restarts/recoveries during monitor downtime still
+        alert), active alert keys (so unchanged alerts don't re-fire
+        into the timeline) and the event timeline itself."""
+        return {
+            "last_pods": self._last_pods,
+            "active_keys": self._active_keys,
+            "events": list(self.events),
+        }
+
+    def load_state(self, state: dict) -> None:
+        last_pods = state.get("last_pods")
+        self._last_pods = dict(last_pods) if last_pods is not None else None
+        self._active_keys = dict(state.get("active_keys") or {})
+        self.events.extend(state.get("events") or [])
+
     @property
     def last(self) -> dict[str, list[dict]]:
         return self._last_eval

@@ -65,7 +65,16 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
 
 async def run(cfg: Config) -> None:
     sampler, server = build(cfg)
+    store = None
+    if cfg.state_path:
+        from tpumon.state import StateStore
+
+        store = StateStore(cfg.state_path, interval_s=cfg.state_interval_s)
+        if store.restore_into(sampler):
+            print(f"tpumon resumed state from {cfg.state_path}", flush=True)
     await sampler.start()
+    if store is not None:
+        await store.start(sampler)
     await server.start()
     print(
         f"tpumon listening on http://{cfg.host}:{server.port} "
@@ -83,6 +92,8 @@ async def run(cfg: Config) -> None:
     print("tpumon shutting down...", flush=True)
     await server.stop()
     await sampler.stop()
+    if store is not None:
+        await store.stop(sampler)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -90,6 +101,7 @@ def main(argv: list[str] | None = None) -> int:
     path = None
     overrides = {}
     serve_loadgen = False
+    loadgen_ckpt = None
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -127,11 +139,18 @@ def main(argv: list[str] | None = None) -> int:
             # prefill/decode on the local accelerator) scraped as a real
             # serving target — the north-star loop in one command.
             serve_loadgen = True
+        elif arg == "--loadgen-ckpt":
+            # Serve weights resumed from a tpumon.loadgen.train orbax
+            # checkpoint directory (implies --serve-loadgen).
+            loadgen_ckpt = take(arg)
+            serve_loadgen = True
+        elif arg == "--state":
+            overrides["state_path"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
                 "[--accel-backend auto|jax|fake:v5e-8|none] [--demo] "
-                "[--serve-loadgen]\n"
+                "[--serve-loadgen] [--loadgen-ckpt DIR] [--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0
@@ -154,7 +173,7 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
-        _, url, loadgen_stop = start_background()
+        _, url, loadgen_stop = start_background(ckpt_dir=loadgen_ckpt)
         collectors = tuple(cfg.collectors)
         if "serving" not in collectors:
             collectors = collectors + ("serving",)

@@ -124,6 +124,13 @@ class Config:
     # e.g. {"slice-0": 8} => alert critical if fewer chips report
     expected_slice_chips: Mapping[str, int] = field(default_factory=dict)
 
+    # --- checkpoint/resume (SURVEY §5.4; tpumon.state) ---
+    # Path for the monitor-state snapshot (ring history, alert timeline,
+    # pod-transition baseline). None => reference behavior: state dies
+    # with the process (monitor_server.js:157).
+    state_path: str | None = None
+    state_interval_s: float = 60.0
+
     # Per-request access logging (method path status ms) — SURVEY §5.1.
     access_log: bool = False
 
@@ -145,6 +152,8 @@ _SCALAR_FIELDS: dict[str, type] = {
     "cpu_count": int,
     "k8s_mode": str,
     "k8s_api_url": str,
+    "state_path": str,
+    "state_interval_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
 }
 _DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}

@@ -1,0 +1,130 @@
+"""Orbax checkpoint/resume for loadgen & serving model params.
+
+SURVEY §5.4: the reference has no checkpointing at all (its only state is
+one in-memory dict, monitor_server.js:157). For the *monitor* tpumon
+keeps the same stateless stance (tpumon.state is a warm-start snapshot);
+for the *TPU workloads* the framework ships — the Llama-style loadgen
+trainer and the JetStream-style serving engine — checkpoint/resume is a
+real obligation, and is done the TPU-native way: orbax saves the jax
+pytree with its shardings, and restore places leaves directly onto the
+target `jax.sharding.Mesh` (each host restores only its shards; no
+gather-to-host round trip).
+
+Layout: one orbax StandardCheckpointer directory per step
+(``<dir>/step_<n>``) plus a tiny ``meta.json`` naming the latest step and
+the ModelConfig it was saved with, so resume can refuse a mismatched
+architecture instead of loading garbage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax
+
+from tpumon.loadgen.model import ModelConfig
+
+_META = "meta.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"step_{step:08d}")
+
+
+def save_checkpoint(
+    directory: str, params: Any, step: int, cfg: ModelConfig | None = None
+) -> str:
+    """Save a params pytree at ``<directory>/step_<step>``; updates
+    meta.json last so a crash mid-save never points latest at a partial
+    checkpoint. Returns the step directory path."""
+    os.makedirs(directory, exist_ok=True)
+    path = _step_dir(directory, step)
+    ckptr = _checkpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    meta = {
+        "latest_step": step,
+        "model_config": dataclasses.asdict(cfg) if cfg is not None else None,
+    }
+    tmp = os.path.join(directory, _META + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(directory, _META))
+    return path
+
+
+def latest_step(directory: str) -> int | None:
+    """The step named by meta.json, or None if no usable checkpoint."""
+    try:
+        with open(os.path.join(directory, _META)) as f:
+            meta = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    step = meta.get("latest_step")
+    if not isinstance(step, int) or not os.path.isdir(_step_dir(directory, step)):
+        return None
+    return step
+
+
+def saved_model_config(directory: str) -> ModelConfig | None:
+    try:
+        with open(os.path.join(directory, _META)) as f:
+            raw = json.load(f).get("model_config")
+        return ModelConfig(**raw) if raw else None
+    except (OSError, json.JSONDecodeError, TypeError):
+        # TypeError: meta written by a build whose ModelConfig had
+        # different fields — treat as no usable config, caller cold-starts.
+        return None
+
+
+def restore_checkpoint(
+    directory: str,
+    like: Any,
+    step: int | None = None,
+    cfg: ModelConfig | None = None,
+) -> tuple[Any, int] | None:
+    """Restore ``(params, step)`` from the latest (or given) step.
+
+    ``like`` is a pytree of arrays or jax.ShapeDtypeStruct with the
+    target shardings — orbax restores each leaf straight onto its
+    devices. Returns None when there is nothing (or nothing compatible)
+    to resume from; the caller then cold-starts, which keeps resume
+    strictly best-effort like the rest of tpumon's degraded modes.
+    """
+    if step is None:
+        step = latest_step(directory)
+    if step is None:
+        return None
+    if cfg is not None:
+        saved = saved_model_config(directory)
+        if saved is not None and saved != cfg:
+            return None  # architecture changed under the checkpoint dir
+    abstract = jax.tree.map(
+        lambda x: x
+        if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_sharding_of(x)),
+        like,
+    )
+    try:
+        params = _checkpointer().restore(_step_dir(directory, step), abstract)
+    except Exception:
+        return None
+    return params, step
+
+
+def _sharding_of(x: Any):
+    s = getattr(x, "sharding", None)
+    # SingleDeviceShardings on a to-be-sharded tree would pin restore to
+    # one device; let orbax pick placement instead.
+    if s is not None and isinstance(s, jax.sharding.NamedSharding):
+        return s
+    return None

@@ -30,6 +30,7 @@ TPU-first design:
 from __future__ import annotations
 
 import itertools
+import sys
 import threading
 import time
 from collections import deque
@@ -269,7 +270,17 @@ class ServingEngine:
 
     def __init__(self, cfg: ServeConfig | None = None,
                  params: dict | None = None, seed: int = 0,
-                 max_queue: int = 64):
+                 max_queue: int = 64, ckpt_dir: str | None = None):
+        if cfg is None and ckpt_dir:
+            # No explicit config: adopt the checkpoint's own architecture
+            # so --loadgen-ckpt serves the trained weights instead of
+            # silently falling back to a mismatched default init.
+            from tpumon.loadgen.checkpoint import saved_model_config
+
+            saved = saved_model_config(ckpt_dir)
+            if saved is not None:
+                cfg = ServeConfig(model=saved, slots=4,
+                                  prefill_len=min(16, saved.max_seq // 2))
         self.cfg = cfg or ServeConfig(
             model=ModelConfig(vocab=512, d_model=128, n_layers=2, n_heads=4,
                               n_kv_heads=2, d_ff=256, max_seq=128),
@@ -278,6 +289,23 @@ class ServingEngine:
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
+        self.ckpt_step: int | None = None
+        if params is None and ckpt_dir:
+            # Serve trained weights: resume from the trainer's orbax
+            # checkpoint (tpumon.loadgen.train) when the architecture
+            # matches; otherwise keep the fresh init (best-effort, like
+            # every other tpumon resume path) — but say so, loudly.
+            from tpumon.loadgen.checkpoint import restore_checkpoint
+
+            restored = restore_checkpoint(ckpt_dir, like=self.params, cfg=m)
+            if restored is not None:
+                self.params, self.ckpt_step = restored
+            else:
+                print(
+                    f"serving: no compatible checkpoint in {ckpt_dir!r}; "
+                    "serving FRESH INIT weights",
+                    file=sys.stderr,
+                )
         # params stay a traced argument (closure capture would bake the
         # weights into the executable as constants, duplicating them in
         # HBM); only the cache is donated for in-place updates.
@@ -507,13 +535,13 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
 
 def start_background(rps: float = 0.5, max_new: int = 16,
                      cfg: ServeConfig | None = None, port: int = 0,
-                     seed: int = 0):
+                     seed: int = 0, ckpt_dir: str | None = None):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
     whole north-star loop: a live TPU serving job AND the monitor
     scraping it."""
-    engine = ServingEngine(cfg=cfg)
+    engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
 

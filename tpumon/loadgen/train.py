@@ -1,0 +1,159 @@
+"""Training loop with checkpoint/resume for the loadgen model.
+
+``python -m tpumon.loadgen.train --steps 200 --ckpt-dir /tmp/ckpt`` runs
+the Llama-style model's sharded SGD loop on synthetic data, saving orbax
+checkpoints (tpumon.loadgen.checkpoint) every ``--ckpt-every`` steps and
+resuming from the latest one on restart — kill it mid-run and rerun the
+same command to watch it continue from the saved step. This is the
+elastic-recovery loop SURVEY §5.3/§5.4 calls for on the workload side:
+a preempted/failed TPU job restarts from its checkpoint, and the monitor
+alerts on the pod transition while it happens.
+
+Sharding: on >1 device the step runs over a dp×tp
+``jax.sharding.Mesh`` (model.make_sharded_train_step — XLA derives the
+gradient psum over "data" and activation reductions over "model");
+single-device falls back to a plain jit.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpumon.loadgen.checkpoint import restore_checkpoint, save_checkpoint
+from tpumon.loadgen.model import (
+    ModelConfig,
+    init_params,
+    make_sharded_train_step,
+    param_shardings,
+    sgd_train_step,
+)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    model: ModelConfig
+    steps: int = 100
+    batch: int = 8
+    seq: int = 64
+    lr: float = 1e-3
+    ckpt_dir: str | None = None
+    ckpt_every: int = 20
+    seed: int = 0
+
+
+def _default_mesh() -> Mesh | None:
+    """dp×tp mesh over all local devices; None for a single device."""
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    tp = 1
+    for cand in (4, 8, 2):
+        if len(devices) % cand == 0:
+            tp = cand
+            break
+    dp = len(devices) // tp
+    return Mesh(np.array(devices).reshape(dp, tp), ("data", "model"))
+
+
+def synthetic_batch(cfg: TrainConfig, step: int) -> jax.Array:
+    """Deterministic per-step token batch — resume reproduces the exact
+    data order, so a resumed run's loss curve continues the original's."""
+    key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed ^ 0x5EED), step)
+    return jax.random.randint(
+        key, (cfg.batch, cfg.seq), 0, cfg.model.vocab, dtype=jnp.int32
+    )
+
+
+def run_train(
+    cfg: TrainConfig, mesh: Mesh | None = None, log=lambda s: None
+) -> dict:
+    """Run (or resume) the loop; returns {step, loss, resumed_from, ...}."""
+    if mesh is None:
+        mesh = _default_mesh()
+    params = init_params(cfg.model, jax.random.PRNGKey(cfg.seed))
+
+    if mesh is not None:
+        step_fn, placed = make_sharded_train_step(cfg.model, mesh, params)
+        token_sharding = NamedSharding(mesh, P("data", None))
+        like = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            params,
+            param_shardings(mesh, params),
+        )
+    else:
+        step_fn = jax.jit(
+            partial(sgd_train_step, cfg.model, lr=cfg.lr)
+        )
+        placed, token_sharding, like = params, None, params
+
+    start = 0
+    resumed_from = None
+    if cfg.ckpt_dir:
+        restored = restore_checkpoint(cfg.ckpt_dir, like=like, cfg=cfg.model)
+        if restored is not None:
+            placed, saved_step = restored
+            start = resumed_from = saved_step + 1
+            log(f"resumed from step {saved_step}")
+
+    loss = None  # stays None when resume lands at/past the final step
+    t0 = time.perf_counter()
+    tokens_seen = 0
+    for step in range(start, cfg.steps):
+        tokens = synthetic_batch(cfg, step)
+        if token_sharding is not None:
+            tokens = jax.device_put(tokens, token_sharding)
+        placed, loss_arr = step_fn(placed, tokens)
+        tokens_seen += cfg.batch * cfg.seq
+        if cfg.ckpt_dir and (
+            (step + 1) % cfg.ckpt_every == 0 or step == cfg.steps - 1
+        ):
+            jax.block_until_ready(placed)
+            save_checkpoint(cfg.ckpt_dir, placed, step=step, cfg=cfg.model)
+            log(f"step {step}: loss {float(loss_arr):.4f} (checkpointed)")
+        loss = loss_arr
+    jax.block_until_ready(placed)
+    dt = time.perf_counter() - t0
+    return {
+        "step": cfg.steps - 1,
+        "loss": float(loss) if loss is not None else None,
+        "resumed_from": resumed_from,
+        "tokens_per_sec": round(tokens_seen / dt, 1) if dt > 0 else 0.0,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "params": placed,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--ckpt-every", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    cfg = TrainConfig(
+        model=ModelConfig(
+            vocab=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
+            d_ff=1024, max_seq=max(64, args.seq),
+        ),
+        steps=args.steps, batch=args.batch, seq=args.seq,
+        ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+    )
+    out = run_train(cfg, log=print)
+    out.pop("params")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
