@@ -1,0 +1,109 @@
+"""Device trace capture (tpumon.profiler + /api/profile, SURVEY §5.1)."""
+
+import asyncio
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tests.test_server_api import get_json, run_app, serve
+from tpumon.profiler import ProfileBusy, ProfilerService
+
+
+def device_work(stop: threading.Event):
+    x = jnp.ones((64, 64))
+    while not stop.is_set():
+        (x @ x).block_until_ready()
+
+
+def test_capture_produces_xplane_dump(tmp_path):
+    svc = ProfilerService(base_dir=str(tmp_path))
+    stop = threading.Event()
+    t = threading.Thread(target=device_work, args=(stop,), daemon=True)
+    t.start()
+    try:
+        result = asyncio.run(svc.capture(seconds=0.3))
+    finally:
+        stop.set()
+        t.join()
+    assert result["total_bytes"] > 0
+    assert any(f["file"].endswith(".xplane.pb") for f in result["files"])
+    assert result["dir"].startswith(str(tmp_path))
+    assert svc.status()["last"] == result
+    assert svc.status()["busy"] is False
+
+
+def test_capture_clamps_seconds(tmp_path):
+    svc = ProfilerService(base_dir=str(tmp_path), max_seconds=0.2)
+    result = asyncio.run(svc.capture(seconds=999))
+    assert result["seconds"] < 2.0  # clamped to max_seconds, not 999
+
+
+def test_single_capture_at_a_time(tmp_path):
+    svc = ProfilerService(base_dir=str(tmp_path))
+
+    async def two():
+        first = asyncio.create_task(svc.capture(seconds=0.5))
+        await asyncio.sleep(0.1)  # let the first actually start
+        with pytest.raises(ProfileBusy):
+            await svc.capture(seconds=0.1)
+        return await first
+
+    assert asyncio.run(two())["seconds"] >= 0.5
+
+
+class TestProfileEndpoint:
+    @pytest.fixture()
+    def app(self):
+        sampler, server = serve()
+        loop = asyncio.new_event_loop()
+        port = loop.run_until_complete(run_app(sampler, server))
+        yield loop, port
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+    def _get_threaded(self, loop, port, path):
+        """GET from a worker thread while the loop serves."""
+        out = {}
+
+        def fetch():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}"
+                ) as r:
+                    out["status"], out["body"] = r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                out["status"], out["body"] = e.code, json.loads(e.read())
+
+        t = threading.Thread(target=fetch)
+        t.start()
+        while t.is_alive():
+            loop.run_until_complete(asyncio.sleep(0.02))
+        return out["status"], out["body"]
+
+    def test_status_without_seconds(self, app):
+        loop, port = app
+        status, body = self._get_threaded(loop, port, "/api/profile")
+        assert status == 200
+        assert body["busy"] is False
+
+    def test_capture_via_endpoint(self, app):
+        loop, port = app
+        status, body = self._get_threaded(
+            loop, port, "/api/profile?seconds=0.2"
+        )
+        assert status == 200
+        assert body["total_bytes"] > 0
+        assert body["seconds"] >= 0.2
+
+    def test_bad_seconds_is_400(self, app):
+        loop, port = app
+        status, body = self._get_threaded(
+            loop, port, "/api/profile?seconds=nope"
+        )
+        assert status == 400
+        assert "seconds" in body["error"]

@@ -1,0 +1,93 @@
+"""On-demand device trace capture (SURVEY §5.1).
+
+The reference has no tracing at all — its only introspection is
+``console.error`` on Prometheus failures (monitor_server.js:34,50).
+tpumon already measures its own pipeline (per-request latency,
+per-source sample stats); this module adds the TPU-native half:
+``GET /api/profile?seconds=N`` captures a **jax.profiler trace** of
+whatever this process is running on the device — the ``--serve-loadgen``
+engine, the MXU burn, or an embedding application's own computation —
+and writes a TensorBoard/XProf-loadable xplane dump. That turns the
+monitor from "MXU duty is low" into "open the trace and see *why*".
+
+One capture at a time (jax has a single global profiler session); the
+capture runs in a worker thread so the event loop keeps serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import tempfile
+import time
+
+
+class ProfileBusy(Exception):
+    """A capture is already in progress."""
+
+
+class ProfilerService:
+    def __init__(self, base_dir: str | None = None, max_seconds: float = 30.0):
+        self.base_dir = base_dir or os.path.join(
+            tempfile.gettempdir(), "tpumon-profiles"
+        )
+        self.max_seconds = max_seconds
+        self._busy = False
+        self.last: dict | None = None  # last capture summary
+
+    def _capture_sync(self, seconds: float, log_dir: str) -> dict:
+        import jax
+
+        t0 = time.time()
+        jax.profiler.start_trace(log_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        files = []
+        for root, _dirs, names in os.walk(log_dir):
+            for name in names:
+                p = os.path.join(root, name)
+                files.append(
+                    {
+                        "file": os.path.relpath(p, log_dir),
+                        "bytes": os.path.getsize(p),
+                    }
+                )
+        return {
+            "dir": log_dir,
+            "seconds": round(time.time() - t0, 3),
+            "files": sorted(files, key=lambda f: f["file"]),
+            "total_bytes": sum(f["bytes"] for f in files),
+            "captured_at": t0,
+            "hint": "load with: tensorboard --logdir <dir> (profile plugin) "
+            "or xprof",
+        }
+
+    async def capture(self, seconds: float) -> dict:
+        """Capture a trace for ``seconds`` (clamped to [0.1, max_seconds]).
+        Raises ProfileBusy if a capture is already running."""
+        if self._busy:
+            raise ProfileBusy("a profile capture is already in progress")
+        seconds = min(max(seconds, 0.1), self.max_seconds)
+        log_dir = os.path.join(
+            self.base_dir, time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        # _busy is only touched on the event loop; the thread below never
+        # writes it, so this check-then-set cannot race.
+        self._busy = True
+        try:
+            result = await asyncio.to_thread(self._capture_sync, seconds, log_dir)
+        finally:
+            self._busy = False
+        self.last = result
+        return result
+
+    def status(self) -> dict:
+        return {
+            "busy": self._busy,
+            "base_dir": self.base_dir,
+            "max_seconds": self.max_seconds,
+            "last": self.last,
+        }

@@ -22,6 +22,9 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/stream           Server-Sent Events: realtime snapshot pushed on
                         every sampler tick (the dashboard upgrades from
                         5s polling to ~1s push when available)
+  /api/profile          GET ?seconds=N: capture a jax.profiler device
+                        trace of this process (SURVEY §5.1); without
+                        ?seconds returns capture status
   /metrics              in-tree Prometheus exporter
 
 The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
@@ -63,9 +66,12 @@ class HttpError(Exception):
 _STATUS_TEXT = {
     200: "OK",
     204: "No Content",
+    400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    409: "Conflict",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 
@@ -95,6 +101,7 @@ class MonitorServer:
             os.path.join(WEB_DIR, "dashboard.html"), "text/html; charset=utf-8"
         )
         self._logo = StaticFile(os.path.join(WEB_DIR, "logo.svg"), "image/svg+xml")
+        self._profiler = None  # built lazily; jax may be absent
 
     # ------------------------------ handlers ------------------------------
 
@@ -212,7 +219,32 @@ class MonitorServer:
             },
         }
 
-    async def handle(self, method: str, path: str) -> tuple[int, str, bytes]:
+    async def _api_profile(self, query: str) -> dict:
+        from tpumon.profiler import ProfileBusy, ProfilerService
+
+        try:
+            import jax  # noqa: F401 — capture needs it; fail before starting
+        except ImportError:
+            raise HttpError(503, "profiling requires jax")
+        if self._profiler is None:
+            self._profiler = ProfilerService()
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        if "seconds" not in params:
+            return self._profiler.status()
+        try:
+            seconds = float(params["seconds"])
+        except ValueError:
+            raise HttpError(400, f"bad seconds value {params['seconds']!r}")
+        try:
+            return await self._profiler.capture(seconds)
+        except ProfileBusy as e:
+            raise HttpError(409, str(e))
+
+    async def handle(
+        self, method: str, path: str, query: str = ""
+    ) -> tuple[int, str, bytes]:
         """Route a request; returns (status, content_type, body)."""
         if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
             return 200, self._dashboard.content_type, self._dashboard.read()
@@ -242,6 +274,8 @@ class MonitorServer:
             payload = {"slices": [v.to_json() for v in self.sampler.slices()]}
         elif path == "/api/health":
             payload = self._api_health()
+        elif path == "/api/profile":
+            payload = await self._api_profile(query)
         if payload is None:
             raise HttpError(404, "Not Found")
         return 200, "application/json", json.dumps(payload).encode()
@@ -263,7 +297,9 @@ class MonitorServer:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            path = target.split("?", 1)[0]  # query stripped (monitor_server.js:250)
+            # Query stripped from routing (monitor_server.js:250) but kept
+            # for the routes that take parameters (/api/profile).
+            path, _, query = target.partition("?")
 
             if method == "OPTIONS":
                 await self._respond(writer, 204, "text/plain", b"")
@@ -283,7 +319,7 @@ class MonitorServer:
                 )
                 return
             try:
-                status, ctype, body = await self.handle(method, path)
+                status, ctype, body = await self.handle(method, path, query)
             except HttpError as e:
                 status, ctype = e.status, "application/json"
                 body = json.dumps({"error": e.message}).encode()
