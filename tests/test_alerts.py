@@ -176,3 +176,57 @@ def test_event_timeline_fired_and_resolved():
     assert events[0]["state"] == "resolved"
     assert events[0]["key"] == "host.cpu.critical"
     assert len(events) == 2
+
+
+def test_fire_hold_suppresses_transient_spikes():
+    """Prometheus "for" semantics: the condition must hold fire_hold_s
+    before the alert fires (default 0 = the reference's instant fire)."""
+    e = AlertEngine(Thresholds(fire_hold_s=10.0))
+    t0 = 1000.0
+    r = e.evaluate(host=host(cpu=96), now=t0)
+    assert not r["critical"]  # pending, not fired
+    assert e.recent_events() == []
+    # Spike clears before the hold elapses: never fires.
+    e.evaluate(host=host(cpu=10), now=t0 + 5)
+    e.evaluate(host=host(cpu=96), now=t0 + 6)  # new spike, hold restarts
+    r = e.evaluate(host=host(cpu=96), now=t0 + 15)
+    assert not r["critical"]  # only 9s into the new hold
+    r = e.evaluate(host=host(cpu=96), now=t0 + 16)
+    assert [a["key"] for a in r["critical"]] == ["host.cpu.critical"]
+    assert e.recent_events()[0]["state"] == "fired"
+
+
+def test_resolve_hold_suppresses_flapping():
+    """"keep_firing_for" semantics: brief dips below the threshold no
+    longer emit fired/resolved event pairs (the flap the reference's
+    1-sample evaluation produces at every crossing)."""
+    e = AlertEngine(Thresholds(resolve_hold_s=10.0))
+    t0 = 1000.0
+    e.evaluate(host=host(cpu=96), now=t0)  # fires instantly (fire_hold 0)
+    r = e.evaluate(host=host(cpu=10), now=t0 + 1)  # dip: held, still served
+    assert [a["key"] for a in r["critical"]] == ["host.cpu.critical"]
+    e.evaluate(host=host(cpu=96), now=t0 + 2)  # back: hold cancelled
+    assert len(e.recent_events()) == 1  # just the original fired
+    # Now stays clear past the hold: resolves once, with the clear time.
+    e.evaluate(host=host(cpu=10), now=t0 + 3)
+    r = e.evaluate(host=host(cpu=10), now=t0 + 14)
+    assert not r["critical"]
+    events = e.recent_events()
+    assert [ev["state"] for ev in events] == ["resolved", "fired"]
+
+
+def test_hold_state_survives_checkpoint():
+    """The anti-flap timers round-trip through to_state/load_state, so a
+    restart mid-hold neither refires nor insta-resolves."""
+    e = AlertEngine(Thresholds(resolve_hold_s=10.0))
+    t0 = 1000.0
+    e.evaluate(host=host(cpu=96), now=t0)
+    e.evaluate(host=host(cpu=10), now=t0 + 1)  # enter resolve hold
+
+    e2 = AlertEngine(Thresholds(resolve_hold_s=10.0))
+    e2.load_state(e.to_state())
+    r = e2.evaluate(host=host(cpu=10), now=t0 + 5)  # still inside hold
+    assert [a["key"] for a in r["critical"]] == ["host.cpu.critical"]
+    r = e2.evaluate(host=host(cpu=10), now=t0 + 12)  # hold expired
+    assert not r["critical"]
+    assert e2.recent_events()[0]["state"] == "resolved"

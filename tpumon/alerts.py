@@ -72,6 +72,11 @@ class AlertEngine:
         # history at all — each poll overwrites the last). Bounded ring.
         self._active_keys: dict[str, dict] = {}
         self.events: deque = deque(maxlen=500)
+        # Anti-flap hold bookkeeping (Thresholds.fire_hold_s /
+        # resolve_hold_s): key -> ts the condition was first seen pending
+        # fire / first seen clear pending resolve.
+        self._pending_fire: dict[str, float] = {}
+        self._pending_resolve: dict[str, float] = {}
 
     # ---------------- host rules (monitor_server.js:162-175) -------------
 
@@ -326,6 +331,7 @@ class AlertEngine:
         pods: list[dict] | None = None,
         serving: list[dict] | None = None,
         update_pod_state: bool = True,
+        now: float | None = None,
     ) -> dict[str, list[dict]]:
         alerts: list[Alert] = []
         alerts += self._host_alerts(host)
@@ -334,18 +340,45 @@ class AlertEngine:
         if update_pod_state:
             alerts += self._pod_alerts(pods)
         alerts += self._serving_alerts(serving)
-        now = time.time()
-        current = {a.key: a.to_json() for a in alerts}
-        for key, a in current.items():
-            if key not in self._active_keys:
+        now = time.time() if now is None else now
+        raw = {a.key: a.to_json() for a in alerts}
+
+        # Fire side: a new condition becomes active once it has held for
+        # fire_hold_s (Prometheus "for"); 0 = instantly, the reference's
+        # behavior. A condition that clears while pending never fires.
+        for key, a in raw.items():
+            if key in self._active_keys:
+                self._active_keys[key] = a  # refresh desc with latest values
+                continue
+            first_seen = self._pending_fire.setdefault(key, now)
+            if now - first_seen >= self.t.fire_hold_s:
+                self._active_keys[key] = a
                 self.events.append({"ts": now, "state": "fired", **a})
-        for key, a in self._active_keys.items():
-            if key not in current:
+        for key in [
+            k for k in self._pending_fire if k not in raw or k in self._active_keys
+        ]:
+            del self._pending_fire[key]
+
+        # Resolve side: an active alert resolves once its condition has
+        # stayed clear for resolve_hold_s ("keep_firing_for") — brief dips
+        # below a threshold no longer spam fired/resolved event pairs.
+        for key in list(self._active_keys):
+            if key in raw:
+                self._pending_resolve.pop(key, None)
+                continue
+            first_clear = self._pending_resolve.setdefault(key, now)
+            if now - first_clear >= self.t.resolve_hold_s:
+                a = self._active_keys.pop(key)
+                del self._pending_resolve[key]
                 self.events.append(
                     {"ts": now, "state": "resolved", **{**a, "desc": ""}}
                 )
-        self._active_keys = current
-        self._last_eval = _bucketize(alerts)
+
+        # Served buckets are the *held* view: pending-fire alerts aren't
+        # shown yet, held-resolving ones still are.
+        self._last_eval = {s: [] for s in SEVERITIES}
+        for a in self._active_keys.values():
+            self._last_eval[a["severity"]].append(a)
         self._last_eval_ts = now
         return self._last_eval
 
@@ -363,6 +396,8 @@ class AlertEngine:
             "last_pods": self._last_pods,
             "active_keys": self._active_keys,
             "events": list(self.events),
+            "pending_fire": self._pending_fire,
+            "pending_resolve": self._pending_resolve,
         }
 
     def load_state(self, state: dict) -> None:
@@ -370,6 +405,8 @@ class AlertEngine:
         self._last_pods = dict(last_pods) if last_pods is not None else None
         self._active_keys = dict(state.get("active_keys") or {})
         self.events.extend(state.get("events") or [])
+        self._pending_fire = dict(state.get("pending_fire") or {})
+        self._pending_resolve = dict(state.get("pending_resolve") or {})
 
     @property
     def last(self) -> dict[str, list[dict]]:

@@ -86,6 +86,13 @@ class Thresholds:
     # job (serious).
     mxu_idle_pct: float = 5.0
     mxu_idle_hbm_gate_pct: float = 50.0
+    # Anti-flap holds (Prometheus "for" / "keep_firing_for" semantics):
+    # a condition must hold fire_hold_s before the alert fires, and must
+    # stay clear resolve_hold_s before it resolves. 0/0 = the reference's
+    # instant per-evaluation behavior (its 1-sample alerts flap at every
+    # threshold crossing).
+    fire_hold_s: float = 0.0
+    resolve_hold_s: float = 0.0
 
 
 @dataclass(frozen=True)
