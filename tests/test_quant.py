@@ -1,0 +1,109 @@
+"""Weight-only int8 quantization (tpumon.loadgen.quant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpumon.loadgen.model import ModelConfig, forward, init_params
+from tpumon.loadgen.quant import (
+    QTensor,
+    param_bytes,
+    quantize,
+    quantize_params,
+)
+from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+CFG = ModelConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64, max_seq=32
+)
+
+
+def test_quantize_round_trip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+    qt = quantize(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (32,)
+    deq = qt.astype(jnp.float32)
+    # Symmetric per-channel int8: max error <= scale/2 per channel.
+    err = jnp.max(jnp.abs(deq - w), axis=0)
+    assert bool(jnp.all(err <= qt.scale * 0.5 + 1e-7))
+
+
+def test_exact_values_survive():
+    # Columns whose max is 127*x quantize exactly on the grid.
+    w = jnp.array([[127.0, -64.0], [0.0, 64.0], [-127.0, 0.0]])
+    deq = quantize(w).astype(jnp.float32)
+    assert np.allclose(deq, w)
+
+
+def test_zero_column_does_not_nan():
+    w = jnp.zeros((8, 4)).at[:, 0].set(1.0)
+    deq = quantize(w).astype(jnp.float32)
+    assert bool(jnp.all(jnp.isfinite(deq)))
+    assert np.allclose(deq[:, 1:], 0.0)
+
+
+def test_quantize_params_skips_norms_and_embed():
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    layer = params["layers"][0]
+    assert isinstance(layer["wq"], QTensor)
+    assert isinstance(layer["w_down"], QTensor)
+    assert isinstance(params["lm_head"], QTensor)
+    assert not isinstance(params["embed"], QTensor)  # gather can't fuse
+    assert not isinstance(layer["attn_norm"], QTensor)
+
+
+def test_param_bytes_shrink():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    full = param_bytes(params)
+    quant = param_bytes(quantize_params(params))
+    # f32 -> int8 on the matmul weights: ~4x there; embed stays f32.
+    assert quant < full / 2
+
+
+def test_forward_works_quantized_and_stays_close():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab)
+    ref = forward(CFG, params, tokens)
+    out = jax.jit(lambda p, t: forward(CFG, p, t))(quantize_params(params), tokens)
+    assert out.shape == ref.shape
+    # Weight-only int8 should track the f32 logits closely.
+    denom = float(jnp.sqrt(jnp.mean(ref**2))) + 1e-9
+    rel = float(jnp.sqrt(jnp.mean((out - ref) ** 2))) / denom
+    assert rel < 0.05, rel
+
+
+def test_engine_serves_quantized():
+    engine = ServingEngine(
+        cfg=ServeConfig(model=CFG, slots=2, prefill_len=8, quantize="int8")
+    )
+    assert isinstance(engine.params["lm_head"], QTensor)
+    r = engine.submit([1, 2, 3], max_new=4)
+    while not r.done.is_set():
+        engine.step()
+    assert len(r.output) >= 4
+    assert "tpumon_serving_weight_bytes" in engine.metrics_text()
+
+
+def test_engine_rejects_unknown_quant_mode():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ServingEngine(cfg=ServeConfig(model=CFG, quantize="fp4"))
+
+
+def test_greedy_decode_mostly_matches_unquantized():
+    """Same prompt, quantized vs full precision: the argmax token stream
+    should agree for most steps (weight-only int8 is near-lossless)."""
+    full = ServingEngine(cfg=ServeConfig(model=CFG, slots=1, prefill_len=8))
+    q = ServingEngine(
+        cfg=ServeConfig(model=CFG, slots=1, prefill_len=8, quantize="int8")
+    )
+    outs = []
+    for engine in (full, q):
+        r = engine.submit([5, 6, 7, 8], max_new=8)
+        while not r.done.is_set():
+            engine.step()
+        outs.append(r.output)
+    matches = sum(a == b for a, b in zip(*outs))
+    assert matches >= len(outs[0]) // 2, outs

@@ -102,6 +102,7 @@ def main(argv: list[str] | None = None) -> int:
     overrides = {}
     serve_loadgen = False
     loadgen_ckpt = None
+    loadgen_quant = None
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -144,13 +145,19 @@ def main(argv: list[str] | None = None) -> int:
             # checkpoint directory (implies --serve-loadgen).
             loadgen_ckpt = take(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-quant":
+            # Weight-only quantization for the loadgen engine ("int8");
+            # implies --serve-loadgen.
+            loadgen_quant = take(arg)
+            serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
                 "[--accel-backend auto|jax|fake:v5e-8|none] [--demo] "
-                "[--serve-loadgen] [--loadgen-ckpt DIR] [--state FILE]\n"
+                "[--serve-loadgen] [--loadgen-ckpt DIR] "
+                "[--loadgen-quant int8] [--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0
@@ -173,7 +180,9 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
-        _, url, loadgen_stop = start_background(ckpt_dir=loadgen_ckpt)
+        _, url, loadgen_stop = start_background(
+            ckpt_dir=loadgen_ckpt, quantize=loadgen_quant
+        )
         collectors = tuple(cfg.collectors)
         if "serving" not in collectors:
             collectors = collectors + ("serving",)

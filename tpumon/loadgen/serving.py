@@ -56,6 +56,9 @@ class ServeConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     slots: int = 4  # concurrent decode slots (continuous batching)
     prefill_len: int = 64  # static prompt padding length
+    # Weight-only quantization: None (compute dtype) or "int8"
+    # (tpumon.loadgen.quant — halves decode's HBM weight traffic vs bf16).
+    quantize: str | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -270,7 +273,8 @@ class ServingEngine:
 
     def __init__(self, cfg: ServeConfig | None = None,
                  params: dict | None = None, seed: int = 0,
-                 max_queue: int = 64, ckpt_dir: str | None = None):
+                 max_queue: int = 64, ckpt_dir: str | None = None,
+                 quantize: str | None = None):
         if cfg is None and ckpt_dir:
             # No explicit config: adopt the checkpoint's own architecture
             # so --loadgen-ckpt serves the trained weights instead of
@@ -286,6 +290,10 @@ class ServingEngine:
                               n_kv_heads=2, d_ff=256, max_seq=128),
             slots=4, prefill_len=16,
         )
+        if quantize is not None:
+            import dataclasses
+
+            self.cfg = dataclasses.replace(self.cfg, quantize=quantize)
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -306,6 +314,14 @@ class ServingEngine:
                     "serving FRESH INIT weights",
                     file=sys.stderr,
                 )
+        if self.cfg.quantize == "int8":
+            # Quantize AFTER any checkpoint restore: int8 is a serving-time
+            # representation, never what the trainer writes.
+            from tpumon.loadgen.quant import quantize_params
+
+            self.params = quantize_params(self.params)
+        elif self.cfg.quantize is not None:
+            raise ValueError(f"unknown quantize mode {self.cfg.quantize!r}")
         # params stay a traced argument (closure capture would bake the
         # weights into the executable as constants, duplicating them in
         # HBM); only the cache is donated for in-place updates.
@@ -463,6 +479,11 @@ class ServingEngine:
                 ).add(value=queue)
         w.gauge("jetstream_slots_available", "free decode slots"
                 ).add(value=free)
+        from tpumon.loadgen.quant import param_bytes
+
+        w.gauge("tpumon_serving_weight_bytes",
+                "resident model weight bytes (int8 when quantized)"
+                ).add(value=param_bytes(self.params))
         lines = [w.render().rstrip("\n")]
         lines.append("# TYPE jetstream_time_to_first_token histogram")
         cum = 0
@@ -535,13 +556,14 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
 
 def start_background(rps: float = 0.5, max_new: int = 16,
                      cfg: ServeConfig | None = None, port: int = 0,
-                     seed: int = 0, ckpt_dir: str | None = None):
+                     seed: int = 0, ckpt_dir: str | None = None,
+                     quantize: str | None = None):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
     whole north-star loop: a live TPU serving job AND the monitor
     scraping it."""
-    engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir)
+    engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
 
@@ -563,6 +585,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=main.__doc__)
     ap.add_argument("--port", type=int, default=9105)
     ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--quant", choices=["int8"], default=None,
+                    help="weight-only quantization (tpumon.loadgen.quant)")
     ap.add_argument("--rps", type=float, default=2.0,
                     help="synthetic request arrival rate")
     ap.add_argument("--max-new", type=int, default=32)
@@ -573,7 +597,7 @@ def main(argv: list[str] | None = None) -> int:
     engine = ServingEngine(cfg=ServeConfig(
         model=ModelConfig(vocab=2048, d_model=256, n_layers=4, n_heads=8,
                           n_kv_heads=4, d_ff=1024, max_seq=256),
-        slots=args.slots, prefill_len=32,
+        slots=args.slots, prefill_len=32, quantize=args.quant,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
