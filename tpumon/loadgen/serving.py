@@ -23,8 +23,11 @@ TPU-first design:
   per-request Python in the hot path;
 - cache buffers are donated to the jitted calls so XLA updates them
   in place on TPU instead of copying ~seq_len × slots of HBM per token;
-- sampling is greedy (argmax), keeping the engine deterministic for the
-  correctness tests (decode must reproduce full-forward logits).
+- sampling defaults to greedy (argmax), keeping the engine deterministic
+  for the correctness tests (decode must reproduce full-forward logits);
+  per-request temperature / top-k sampling runs on device in the same
+  dispatch (``sample_tokens``: top-k mask + categorical, keyed by one
+  base seed + step counter, so sampled runs are reproducible too).
 """
 
 from __future__ import annotations
@@ -261,9 +264,33 @@ class Request:
     prompt: list[int]
     max_new: int
     enqueued: float
+    temperature: float = 0.0  # 0 = greedy (deterministic)
+    top_k: int = 0  # 0 = full vocab
     ttft_s: float | None = None
     output: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+
+
+@jax.jit
+def sample_tokens(logits: jax.Array, base_key: jax.Array, ctr: jax.Array,
+                  temps: jax.Array, topk: jax.Array) -> jax.Array:
+    """Per-slot token selection on device, one dispatch for the batch.
+
+    logits [B, V]; temps [B] (<=0 -> greedy argmax, the default); topk [B]
+    (0 -> full vocab). Top-k keeps each row's k highest logits, then
+    temperature-scaled categorical sampling. The PRNG key folds a host
+    step counter into one base key, so a run is reproducible per seed.
+    """
+    v = logits.shape[-1]
+    key = jax.random.fold_in(base_key, ctr)
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    k_idx = jnp.clip(jnp.where(topk > 0, topk, v) - 1, 0, v - 1)
+    thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    masked = jnp.where(logits >= thresh, logits, -1e30)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
 class ServingEngine:
@@ -333,6 +360,11 @@ class ServingEngine:
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
         self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
+        # Per-slot sampling settings (device-resident; updated on admit).
+        self.temps = jnp.zeros((self.cfg.slots,), jnp.float32)
+        self.topks = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self._sample_key = jax.random.PRNGKey(seed ^ 0x7A11)
+        self._sample_ctr = 0
         self._slots: list[Request | None] = [None] * self.cfg.slots
         self._queue: deque[Request] = deque()
         self.max_queue = max_queue
@@ -350,15 +382,18 @@ class ServingEngine:
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, prompt: list[int], max_new: int = 16) -> Request:
+    def submit(self, prompt: list[int], max_new: int = 16,
+               temperature: float = 0.0, top_k: int = 0) -> Request:
         """Enqueue a request. When the queue is full the request is
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
-        latency grow without bound."""
+        latency grow without bound. temperature 0 = greedy; top_k 0 =
+        full vocab."""
         m = self.cfg.model
         prompt = [t % m.vocab for t in prompt][: self.cfg.prefill_len]
         req = Request(rid=next(self._rid), prompt=prompt or [0],
-                      max_new=max_new, enqueued=time.monotonic())
+                      max_new=max_new, enqueued=time.monotonic(),
+                      temperature=float(temperature), top_k=int(top_k))
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 self.rejected_total += 1
@@ -392,7 +427,11 @@ class ServingEngine:
                 req.prompt + [0] * (self.cfg.prefill_len - n), jnp.int32)
             self.cache, logits = self._prefill(
                 self.params, self.cache, toks, jnp.int32(n), jnp.int32(slot))
-            first = int(jnp.argmax(logits))
+            self._sample_ctr += 1
+            first = int(sample_tokens(
+                logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
+                jnp.full((1,), req.temperature, jnp.float32),
+                jnp.full((1,), req.top_k, jnp.int32))[0])
             with self._lock:
                 req.ttft_s = time.monotonic() - req.enqueued
                 self._observe_ttft(req.ttft_s)
@@ -402,6 +441,8 @@ class ServingEngine:
             self.positions = self.positions.at[slot].set(n)
             self._host_positions[slot] = n
             self.last_tokens = self.last_tokens.at[slot].set(first)
+            self.temps = self.temps.at[slot].set(req.temperature)
+            self.topks = self.topks.at[slot].set(req.top_k)
             if len(req.output) >= req.max_new + 1:  # max_new == 0
                 self._complete(slot)
 
@@ -420,7 +461,10 @@ class ServingEngine:
         if active:
             self.cache, logits = self._decode(
                 self.params, self.cache, self.last_tokens, self.positions)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            self._sample_ctr += 1
+            nxt = sample_tokens(logits, self._sample_key,
+                                jnp.uint32(self._sample_ctr),
+                                self.temps, self.topks)
             self.last_tokens = nxt
             self.positions = jnp.minimum(
                 self.positions + 1, self.cfg.model.max_seq - 1)
@@ -533,7 +577,8 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
 
 def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
                   stop: threading.Event, duration: float = 0.0,
-                  seed: int = 0) -> None:
+                  seed: int = 0, temperature: float = 0.0,
+                  top_k: int = 0) -> None:
     """Poisson-ish synthetic request arrivals + engine stepping until
     ``stop`` is set (or ``duration`` seconds elapse, if nonzero)."""
     import random
@@ -548,7 +593,8 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
         while now >= next_arrival:
             n = rng.randint(2, engine.cfg.prefill_len)
             engine.submit([rng.randrange(engine.cfg.model.vocab)
-                           for _ in range(n)], max_new=max_new)
+                           for _ in range(n)], max_new=max_new,
+                          temperature=temperature, top_k=top_k)
             next_arrival += rng.expovariate(rps)
         if not engine.step():
             time.sleep(min(0.05, max(0.0, next_arrival - now)))
@@ -587,6 +633,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--quant", choices=["int8"], default=None,
                     help="weight-only quantization (tpumon.loadgen.quant)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature (0 = greedy)")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="top-k sampling cutoff (0 = full vocab)")
     ap.add_argument("--rps", type=float, default=2.0,
                     help="synthetic request arrival rate")
     ap.add_argument("--max-new", type=int, default=32)
@@ -604,7 +654,8 @@ def main(argv: list[str] | None = None) -> int:
           f"(point TPUMON_SERVING_TARGETS=http://127.0.0.1:{port}/metrics)")
     try:
         _arrival_loop(engine, args.rps, args.max_new, threading.Event(),
-                      duration=args.duration)
+                      duration=args.duration, temperature=args.temperature,
+                      top_k=args.top_k)
     except KeyboardInterrupt:
         pass
     return 0
