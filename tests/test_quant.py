@@ -107,3 +107,31 @@ def test_greedy_decode_mostly_matches_unquantized():
         outs.append(r.output)
     matches = sum(a == b for a, b in zip(*outs))
     assert matches >= len(outs[0]) // 2, outs
+
+
+def test_quantized_tensor_parallel_serving():
+    """int8 weights compose with tensor parallelism: param_shardings maps
+    q to the weight's layout and scale to its last-axis spec, so sharded
+    prefill/decode run on quantized params without resharding."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpumon.loadgen.model import init_params, param_shardings
+    from tpumon.loadgen.serving import make_sharded_serving
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)))
+    sh = param_shardings(mesh, params)
+    wq_sh = sh["layers"][0]["wq"]
+    assert wq_sh.q.spec == P(None, "model")
+    assert wq_sh.scale.spec == P("model")  # column-parallel scale
+    assert sh["layers"][0]["w_down"].scale.spec == P(None)  # row-parallel
+
+    scfg = ServeConfig(model=CFG, slots=2, prefill_len=8, quantize="int8")
+    pre, dec, placed, cache = make_sharded_serving(scfg, mesh, params)
+    assert placed["layers"][0]["wq"].q.dtype == jnp.int8
+    toks = jnp.array([1, 2, 3, 0, 0, 0, 0, 0], jnp.int32)
+    cache, plog = pre(cache, toks, jnp.int32(3), jnp.int32(0))
+    cache, dlog = dec(cache, jnp.zeros((2,), jnp.int32),
+                      jnp.array([3, 0], jnp.int32))
+    assert bool(jnp.all(jnp.isfinite(dlog)))

@@ -107,11 +107,26 @@ PARAM_SPECS = {
 
 
 def param_shardings(mesh: Mesh, params: dict):
-    """Build a NamedSharding pytree matching ``params``."""
+    """Build a NamedSharding pytree matching ``params``.
+
+    Handles quantized trees too (tpumon.loadgen.quant.QTensor): the int8
+    ``q`` array keeps the full weight's layout, and the per-output-channel
+    ``scale`` shards like the weight's last axis — so column-parallel
+    weights get model-sharded scales and row-parallel weights replicated
+    ones, with no resharding inside the dequantizing matmul.
+    """
 
     def leaf_spec(path, _leaf):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        return NamedSharding(mesh, PARAM_SPECS.get(name, P()))
+        key = getattr(path[-1], "key", None)
+        if isinstance(key, str):
+            return NamedSharding(mesh, PARAM_SPECS.get(key, P()))
+        # Flattened child of a custom node (QTensor): path[-2] names the
+        # weight; child 0 is q, child 1 is scale.
+        name = getattr(path[-2], "key", None)
+        spec = PARAM_SPECS.get(name, P())
+        if key == 0 or not len(spec):
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P(spec[-1]))
 
     return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
