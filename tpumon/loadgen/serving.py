@@ -95,24 +95,30 @@ def _gqa_repeat(kv: jax.Array, n_heads: int) -> jax.Array:
 
 
 def prefill(cfg: ServeConfig, params: dict, cache: dict, tokens: jax.Array,
-            length: jax.Array, slot: jax.Array) -> tuple[dict, jax.Array]:
-    """Process one padded prompt into cache slot ``slot``.
+            length: jax.Array, slot: jax.Array,
+            start: jax.Array | int = 0) -> tuple[dict, jax.Array]:
+    """Process one padded prompt *chunk* into cache slot ``slot``.
 
-    tokens: [prefill_len] int32 (padded); length: scalar int32 true length;
-    slot: scalar int32. Returns (cache, logits[vocab] at position
-    length-1). Rows >= length hold padding garbage but are never attended:
-    decode's mask only reaches rows < the slot's current position, and
-    appends overwrite them in order.
+    tokens: [prefill_len] int32 (padded); length: scalar int32 true length
+    within this chunk; slot: scalar int32; start: scalar int32 cache row
+    the chunk begins at (0 for the first/only chunk). Chunk queries attend
+    to every earlier row of the slot's cache plus the causal prefix of the
+    chunk itself, so a long prompt runs as ceil(n/prefill_len) fixed-shape
+    calls (chunked prefill — no retracing, prompt length bounded by
+    max_seq rather than prefill_len). Returns (cache, logits[vocab] at
+    local position length-1; meaningful for the final chunk). Padding
+    rows hold garbage but are never attended: the row mask stops at the
+    causal frontier, and decode appends overwrite them in order.
     """
     m = cfg.model
     p = cfg.prefill_len
     dt = jnp.dtype(m.compute_dtype)
     nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
     x = params["embed"].astype(dt)[tokens][None]  # [1, P, D]
-    pos = jnp.arange(p, dtype=jnp.int32)[None]  # [1, P]
-    causal = jnp.tril(jnp.ones((p, p), bool)) & (
-        jnp.arange(p)[None, :] < length
-    )
+    pos = start + jnp.arange(p, dtype=jnp.int32)[None]  # [1, P] global rows
+    row = jnp.arange(m.max_seq, dtype=jnp.int32)
+    # mask[i, row]: row <= start + i — prior chunks + causal within chunk.
+    mask = (row[None, :] <= pos[0][:, None])[None, None]  # [1,1,P,S]
     for li, layer in enumerate(params["layers"]):
         h = _rms_norm(x, layer["attn_norm"])
         q = _rope_at((h @ layer["wq"].astype(dt)).reshape(1, p, nh, hd),
@@ -121,15 +127,23 @@ def prefill(cfg: ServeConfig, params: dict, cache: dict, tokens: jax.Array,
                      pos, m.rope_theta)
         v = (h @ layer["wv"].astype(dt)).reshape(1, p, nkv, hd)
         cache["k"] = lax.dynamic_update_slice(
-            cache["k"], k[None], (li, slot, 0, 0, 0))
+            cache["k"], k[None], (li, slot, start, 0, 0))
         cache["v"] = lax.dynamic_update_slice(
-            cache["v"], v[None], (li, slot, 0, 0, 0))
-        kr, vr = _gqa_repeat(k, nh), _gqa_repeat(v, nh)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+            cache["v"], v[None], (li, slot, start, 0, 0))
+        # Attend over the slot's whole cache (like decode): earlier chunks
+        # are already there, this chunk was just written.
+        ck = lax.dynamic_slice(
+            cache["k"], (li, slot, 0, 0, 0), (1, 1, m.max_seq, nkv, hd)
+        )[0, 0]
+        cv = lax.dynamic_slice(
+            cache["v"], (li, slot, 0, 0, 0), (1, 1, m.max_seq, nkv, hd)
+        )[0, 0]
+        kr, vr = _gqa_repeat(ck, nh), _gqa_repeat(cv, nh)
+        scores = jnp.einsum("bqhd,khd->bhqk", q, kr).astype(jnp.float32)
         scores = scores / (hd**0.5)
-        scores = jnp.where(causal[None, None], scores, -1e30)
+        scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(1, p, nh * hd)
+        att = jnp.einsum("bhqk,khd->bqhd", probs, vr).reshape(1, p, nh * hd)
         x = x + att @ layer["wo"].astype(dt)
         hm = _rms_norm(x, layer["mlp_norm"])
         gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
@@ -232,7 +246,7 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
     rep = NamedSharding(mesh, P())
     _pre = jax.jit(
         partial(prefill, cfg),
-        in_shardings=(shardings, cache_sh, rep, rep, rep),
+        in_shardings=(shardings, cache_sh, rep, rep, rep, rep),
         out_shardings=(cache_sh, rep),
         donate_argnums=(1,),
     )
@@ -243,8 +257,10 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
         donate_argnums=(1,),
     )
 
-    def prefill_fn(cache, tokens, length, slot):
-        return _pre(placed, cache, tokens, length, slot)
+    def prefill_fn(cache, tokens, length, slot, start=None):
+        if start is None:
+            start = jnp.int32(0)
+        return _pre(placed, cache, tokens, length, slot, start)
 
     def decode_fn(cache, last_tokens, positions):
         return _dec(placed, cache, last_tokens, positions)
@@ -388,9 +404,10 @@ class ServingEngine:
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
         latency grow without bound. temperature 0 = greedy; top_k 0 =
-        full vocab."""
+        full vocab. Prompts may exceed prefill_len — they run as chunked
+        prefill — but are capped at max_seq-1 (room for decode rows)."""
         m = self.cfg.model
-        prompt = [t % m.vocab for t in prompt][: self.cfg.prefill_len]
+        prompt = [t % m.vocab for t in prompt][: m.max_seq - 1]
         req = Request(rid=next(self._rid), prompt=prompt or [0],
                       max_new=max_new, enqueued=time.monotonic(),
                       temperature=float(temperature), top_k=int(top_k))
@@ -423,10 +440,16 @@ class ServingEngine:
                     return
                 req = self._queue.popleft()
             n = len(req.prompt)
-            toks = jnp.asarray(
-                req.prompt + [0] * (self.cfg.prefill_len - n), jnp.int32)
-            self.cache, logits = self._prefill(
-                self.params, self.cache, toks, jnp.int32(n), jnp.int32(slot))
+            p = self.cfg.prefill_len
+            # Chunked prefill: one fixed-shape call per prefill_len chunk;
+            # only the final chunk's logits matter (position n-1).
+            for c0 in range(0, n, p):
+                chunk = req.prompt[c0:c0 + p]
+                ln = len(chunk)
+                toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
+                self.cache, logits = self._prefill(
+                    self.params, self.cache, toks, jnp.int32(ln),
+                    jnp.int32(slot), jnp.int32(c0))
             self._sample_ctr += 1
             first = int(sample_tokens(
                 logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
