@@ -189,3 +189,38 @@ def test_prompt_capped_at_max_seq():
     eng.drain()
     assert r.done.is_set()
     assert len(r.output) >= 1  # capped, served, no crash
+
+
+def test_engine_lifecycle_fuzz():
+    """Random submit/step interleavings: every request terminates, slots
+    never leak, token accounting stays consistent — the invariants that
+    continuous batching must keep under churn."""
+    import random
+
+    rng = random.Random(42)
+    eng = ServingEngine(cfg=CFG, max_queue=8)
+    reqs = []
+    for _ in range(120):
+        action = rng.random()
+        if action < 0.4:
+            n = rng.randint(1, CFG.model.max_seq + 10)  # incl. over-length
+            reqs.append(eng.submit(
+                [rng.randrange(CFG.model.vocab) for _ in range(n)],
+                max_new=rng.randint(0, 6),
+                temperature=rng.choice([0.0, 0.0, 1.0]),
+                top_k=rng.choice([0, 4]),
+            ))
+        else:
+            eng.step()
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    served = [r for r in reqs if r.output]
+    rejected = [r for r in reqs if not r.output]
+    assert len(served) + len(rejected) == len(reqs)
+    assert eng.completed_total == len(served)
+    assert eng.rejected_total == len(rejected)
+    assert eng.tokens_total == sum(len(r.output) for r in served)
+    assert all(s is None for s in eng._slots)  # no leaked slots
+    for r in served:
+        assert all(0 <= t < CFG.model.vocab for t in r.output)
+        assert len(r.output) <= r.max_new + 1

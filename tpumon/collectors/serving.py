@@ -125,6 +125,9 @@ def distill_serving_metrics(
     slots = _sum_samples(by_name, SLOTS_GAUGES)
     if slots:
         out["slots"] = slots[1]
+    weights = _sum_samples(by_name, ("tpumon_serving_weight_bytes",))
+    if weights:
+        out["weight_bytes"] = weights[1]  # drops ~4x when served int8
     return out
 
 
