@@ -1,0 +1,108 @@
+"""Pipeline parallelism (tpumon.loadgen.pipeline).
+
+Correctness oracle: the sequential single-device forward/loss from
+tpumon.loadgen.model on the same (unstacked) params. With float32
+compute the pipelined schedule must reproduce it to numerical noise —
+the microbatch interleaving and ppermute hand-offs change execution
+order, not math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpumon.loadgen.model import ModelConfig, forward, init_params, loss_fn
+from tpumon.loadgen.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    make_pipeline_train_step,
+    pipeline_forward,
+    pipeline_loss,
+    stack_pipeline_params,
+)
+
+MCFG = ModelConfig(
+    vocab=128, d_model=32, n_layers=4, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=16, compute_dtype="float32",
+)
+
+
+def _mesh(dp, pp):
+    devices = jax.devices()[: dp * pp]
+    if len(devices) < dp * pp:
+        pytest.skip(f"needs {dp * pp} devices")
+    return Mesh(np.array(devices).reshape(dp, pp), ("data", "pipe"))
+
+
+def _tokens(key, b, t=12):
+    return jax.random.randint(key, (b, t), 0, MCFG.vocab)
+
+
+@pytest.mark.parametrize("pp,m", [(4, 4), (2, 6), (4, 8)])
+def test_forward_matches_sequential(pp, m):
+    cfg = PipelineConfig(model=MCFG, n_stages=pp, n_microbatches=m)
+    mesh = _mesh(1, pp)
+    params = init_params(MCFG, jax.random.PRNGKey(0))
+    tokens = _tokens(jax.random.PRNGKey(1), b=m * 2)
+
+    want = forward(MCFG, params, tokens)
+    got = pipeline_forward(cfg, stack_pipeline_params(cfg, params), tokens, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_loss_matches_sequential():
+    cfg = PipelineConfig(model=MCFG, n_stages=2, n_microbatches=4)
+    mesh = _mesh(2, 2)  # composes with data parallelism
+    params = init_params(MCFG, jax.random.PRNGKey(2))
+    tokens = _tokens(jax.random.PRNGKey(3), b=8)
+
+    want = float(loss_fn(MCFG, params, tokens))
+    got = float(pipeline_loss(cfg, stack_pipeline_params(cfg, params), tokens, mesh))
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_train_step_matches_single_device_grads():
+    cfg = PipelineConfig(model=MCFG, n_stages=2, n_microbatches=4)
+    mesh = _mesh(2, 2)
+    params = init_pipeline_params(cfg, jax.random.PRNGKey(4))
+    tokens = _tokens(jax.random.PRNGKey(5), b=8)
+
+    step, placed = make_pipeline_train_step(cfg, mesh, params)
+    new_params, loss = step(placed, tokens)
+    assert np.isfinite(float(loss))
+
+    # Single-device oracle: same SGD update on the stacked tree via the
+    # sequential loss over a trivial 1x1 mesh-free path is not directly
+    # available, so check the update direction instead: one step must
+    # reduce the pipeline loss on the same batch.
+    _, loss2 = step(new_params, tokens)
+    assert float(loss2) < float(loss)
+
+
+def test_grads_match_sequential_model():
+    """Pipeline grads == sequential grads, leaf for leaf (float32)."""
+    cfg = PipelineConfig(model=MCFG, n_stages=4, n_microbatches=4)
+    mesh = _mesh(1, 4)
+    params = init_params(MCFG, jax.random.PRNGKey(6))
+    tokens = _tokens(jax.random.PRNGKey(7), b=8)
+
+    seq_grads = jax.grad(lambda p: loss_fn(MCFG, p, tokens))(params)
+    stacked = stack_pipeline_params(cfg, params)
+    pipe_grads = jax.grad(lambda p: pipeline_loss(cfg, p, tokens, mesh))(stacked)
+
+    want = stack_pipeline_params(cfg, seq_grads)
+    for path, got in jax.tree_util.tree_flatten_with_path(pipe_grads)[0]:
+        exp = want
+        for p in path:
+            exp = exp[p.key if hasattr(p, "key") else p.idx]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(exp), atol=5e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_bad_stage_count_rejected():
+    with pytest.raises(AssertionError):
+        PipelineConfig(model=MCFG, n_stages=3, n_microbatches=4).check()

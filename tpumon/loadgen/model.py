@@ -207,15 +207,22 @@ def forward(
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
 
 
+def next_token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean cross-entropy of [B, T, V] logits against [B, T] targets.
+
+    Shared by the sequential (here) and pipelined (pipeline.py) paths so
+    the loss definition can't diverge between them."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
 def loss_fn(
     cfg: ModelConfig, params: dict, tokens: jax.Array, mesh: Mesh | None = None
 ) -> jax.Array:
     """Next-token cross-entropy over a [B, T] batch."""
     logits = forward(cfg, params, tokens[:, :-1], mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return next_token_nll(logits, tokens[:, 1:])
 
 
 def sgd_train_step(

@@ -1,0 +1,226 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the mesh.
+
+Completes the loadgen's parallelism coverage alongside dp/tp (model.py),
+ep (moe.py) and sp (ring_attention.py): the transformer stack is split
+into S stages sharded over a mesh "pipe" axis, and a batch is fed
+through as M microbatches. Each tick every stage applies its layers to
+the activation it holds and hands the result to the next stage with
+``lax.ppermute`` — the activation hand-off rides the ICI ring, exactly
+the traffic pattern tpumon's ICI panels monitor for pipelined training
+jobs (the reference monitors only flat per-device GPU counters,
+monitor_server.js:83-95; slice/pipeline topology is the TPU-native
+extension, SURVEY §2.5).
+
+TPU-first notes:
+- the schedule is a single ``lax.scan`` over M + S - 1 ticks — static
+  trip count, no data-dependent control flow, traced once under jit;
+- per-stage layers are stacked leaves scanned with ``lax.scan`` (one
+  compiled block body regardless of depth);
+- bubble overhead is the standard GPipe (S-1)/(M+S-1) — callers pick
+  M >= S to keep MXU duty high, and the monitor's MXU panel is how you
+  see it;
+- backward needs no hand-written schedule: AD transposes ``ppermute``
+  into the reverse ring rotation, so the cooldown phase emerges from
+  the same scan.
+
+Composes with data parallelism: the mesh is ("data", "pipe"); the
+microbatch batch dim shards over "data", stages over "pipe".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpumon.loadgen.model import (
+    ModelConfig,
+    _attention,
+    _mlp,
+    _rms_norm,
+    init_params,
+    next_token_nll,
+)
+
+
+@dataclass(frozen=True)
+class PipelineConfig:
+    model: ModelConfig = ModelConfig()
+    n_stages: int = 2
+    n_microbatches: int = 4
+
+    def check(self) -> "PipelineConfig":
+        assert self.model.n_layers % self.n_stages == 0, (
+            f"n_layers={self.model.n_layers} must divide into "
+            f"n_stages={self.n_stages}"
+        )
+        assert self.n_microbatches >= 1
+        return self
+
+
+def stack_pipeline_params(cfg: PipelineConfig, params: dict) -> dict:
+    """Regroup a model.init_params tree for the pipeline.
+
+    The per-layer dicts become stacked leaves of shape
+    [n_stages, layers_per_stage, ...] so stage s owns layers
+    [s*Lps, (s+1)*Lps) and scans them in order. Embed/head/final-norm
+    stay top-level (they run outside the shard_map, replicated).
+    """
+    cfg.check()
+    layers = params["layers"]
+    lps = cfg.model.n_layers // cfg.n_stages
+    stacked = {
+        key: jnp.stack(
+            [
+                jnp.stack([layers[s * lps + j][key] for j in range(lps)])
+                for s in range(cfg.n_stages)
+            ]
+        )
+        for key in layers[0]
+    }
+    return {
+        "embed": params["embed"],
+        "stages": stacked,
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def init_pipeline_params(cfg: PipelineConfig, key: jax.Array) -> dict:
+    return stack_pipeline_params(cfg, init_params(cfg.model, key))
+
+
+def pipeline_param_shardings(mesh: Mesh, params: dict):
+    """Stage leaves shard over "pipe" on their leading axis; the
+    embedding/head ends are replicated (they run on every device)."""
+
+    def spec(path, leaf):
+        if getattr(path[0], "key", None) == "stages":
+            return NamedSharding(mesh, P("pipe", *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def _stage_apply(cfg: ModelConfig, stage: dict, x: jax.Array) -> jax.Array:
+    """Run one stage's stacked layers (leaves [Lps, ...]) over x."""
+
+    def body(h, layer):
+        h = h + _attention(cfg, layer, _rms_norm(h, layer["attn_norm"]))
+        h = h + _mlp(layer, _rms_norm(h, layer["mlp_norm"]))
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, stage)
+    return x
+
+
+def pipeline_forward(
+    cfg: PipelineConfig, params: dict, tokens: jax.Array, mesh: Mesh
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab] float32.
+
+    B must equal n_microbatches * microbatch size, and the microbatch
+    size must divide by the mesh's "data" axis.
+    """
+    cfg.check()
+    mcfg = cfg.model
+    s_count, m_count = cfg.n_stages, cfg.n_microbatches
+    b, t = tokens.shape
+    assert b % m_count == 0, f"batch {b} not divisible by M={m_count}"
+    mb = b // m_count
+    dp = mesh.shape["data"]
+    assert mb % dp == 0, f"microbatch size {mb} not divisible by dp={dp}"
+    dt = jnp.dtype(mcfg.compute_dtype)
+
+    # Embed outside the pipeline (replicated — it's the stage-0 input
+    # producer and tiny next to the stack).
+    x = params["embed"].astype(dt)[tokens].reshape(m_count, mb, t, mcfg.d_model)
+
+    stage_specs = jax.tree.map(
+        lambda a: P("pipe", *([None] * (a.ndim - 1))), params["stages"]
+    )
+    x_spec = P(None, "data", None, None)
+    perm = [(i, (i + 1) % s_count) for i in range(s_count)]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(stage_specs, x_spec),
+        out_specs=x_spec,
+    )
+    def run(stages, xs):
+        # Local views: stage leaves [1, Lps, ...] -> [Lps, ...];
+        # xs [M, mb/dp, T, D].
+        stages = jax.tree.map(lambda a: a[0], stages)
+        my = jax.lax.axis_index("pipe")
+        # The carries become device-varying over "pipe" after one tick;
+        # mark the (all-zero) initial values the same way so the scan
+        # carry type is stable.
+        state = jax.lax.pcast(jnp.zeros_like(xs[0]), ("pipe",), to="varying")
+        outbuf = jax.lax.pcast(jnp.zeros_like(xs), ("pipe",), to="varying")
+
+        def tick(carry, i):
+            state, outbuf = carry
+            # Stage 0 picks up microbatch i during warm-up; later stages
+            # consume what the previous stage permuted over last tick.
+            fresh = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(i, 0, m_count - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(my == 0, fresh, state)
+            y = _stage_apply(mcfg, stages, x_in)
+            # The last stage finishes microbatch i-(S-1) at tick i.
+            out_i = i - (s_count - 1)
+            slot = jnp.clip(out_i, 0, m_count - 1)
+            cur = jax.lax.dynamic_index_in_dim(outbuf, slot, 0, keepdims=False)
+            write = jnp.where((my == s_count - 1) & (out_i >= 0), y, cur)
+            outbuf = jax.lax.dynamic_update_index_in_dim(outbuf, write, slot, 0)
+            # Hand activations to the next stage over the ICI ring.
+            state = jax.lax.ppermute(y, "pipe", perm)
+            return (state, outbuf), None
+
+        (state, outbuf), _ = jax.lax.scan(
+            tick, (state, outbuf), jnp.arange(m_count + s_count - 1)
+        )
+        # Only the last stage holds real outputs; one masked psum at
+        # pipeline flush broadcasts them back to every stage.
+        outbuf = jnp.where(my == s_count - 1, outbuf, 0.0)
+        return jax.lax.psum(outbuf, "pipe")
+
+    x = run(params["stages"], x).reshape(b, t, mcfg.d_model)
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+
+
+def pipeline_loss(
+    cfg: PipelineConfig, params: dict, tokens: jax.Array, mesh: Mesh
+) -> jax.Array:
+    logits = pipeline_forward(cfg, params, tokens[:, :-1], mesh)
+    return next_token_nll(logits, tokens[:, 1:])
+
+
+def make_pipeline_train_step(cfg: PipelineConfig, mesh: Mesh, params: dict):
+    """jit one SGD step over a (data, pipe) mesh; returns (step, placed).
+
+    ``params`` is a stacked tree (init_pipeline_params /
+    stack_pipeline_params output).
+    """
+    shardings = pipeline_param_shardings(mesh, params)
+    placed = jax.device_put(params, shardings)
+    token_sharding = NamedSharding(mesh, P("data", None))
+
+    @partial(
+        jax.jit,
+        in_shardings=(shardings, token_sharding),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+    )
+    def step(p, tokens):
+        loss, grads = jax.value_and_grad(partial(pipeline_loss, cfg))(
+            p, tokens, mesh
+        )
+        new_p = jax.tree_util.tree_map(lambda w, g: w - 1e-3 * g, p, grads)
+        return new_p, loss
+
+    return step, placed
