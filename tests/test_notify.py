@@ -1,0 +1,204 @@
+"""Alert webhook notification sinks (tpumon.notify).
+
+The reference delivers alerts nowhere — they exist only while a browser
+polls /api/alerts (monitor_server.js:282-288). These tests pin tpumon's
+push path: fired/resolved timeline events reach webhook sinks exactly
+once, Slack sinks get message-shaped payloads, severity filtering works,
+and sink failures are counted instead of raised.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpumon.alerts import AlertEngine
+from tpumon.app import build
+from tpumon.config import load_config
+from tpumon.notify import WebhookNotifier, slack_text
+from tpumon.sampler import Sampler
+
+
+class WebhookReceiver:
+    """In-process HTTP sink capturing POSTed JSON bodies."""
+
+    def __init__(self, status: int = 200):
+        self.bodies: list[dict] = []
+        received = self.bodies
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(status)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_port}/hook"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def fired(key="host.cpu.critical", severity="critical", seq=1):
+    return {
+        "seq": seq,
+        "ts": 0.0,
+        "state": "fired",
+        "severity": severity,
+        "title": "CPU usage critical",
+        "desc": "CPU at 97.0%",
+        "fix": "scale out",
+        "key": key,
+    }
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_generic_sink_receives_event_batch():
+    rx = WebhookReceiver()
+    try:
+
+        async def go():
+            n = WebhookNotifier(urls=(rx.url,))
+            n.notify([fired()])
+            await n.close()
+
+        run(go())
+        assert len(rx.bodies) == 1
+        body = rx.bodies[0]
+        assert body["source"] == "tpumon"
+        assert body["events"][0]["key"] == "host.cpu.critical"
+        assert body["events"][0]["state"] == "fired"
+    finally:
+        rx.close()
+
+
+def test_slack_sink_gets_text_payload():
+    rx = WebhookReceiver()
+    try:
+
+        async def go():
+            n = WebhookNotifier(urls=("slack+" + rx.url,))
+            n.notify([fired(), {**fired(seq=2), "state": "resolved"}])
+            await n.close()
+
+        run(go())
+        assert len(rx.bodies) == 1
+        text = rx.bodies[0]["text"]
+        assert "CPU usage critical" in text
+        assert "resolved" in text
+        assert "events" not in rx.bodies[0]
+    finally:
+        rx.close()
+
+
+def test_min_severity_filters_fires_but_not_resolves():
+    rx = WebhookReceiver()
+    try:
+
+        async def go():
+            n = WebhookNotifier(urls=(rx.url,), min_severity="critical")
+            n.notify([fired(severity="minor", key="host.cpu.minor")])
+            n.notify(
+                [{**fired(severity="minor", seq=2), "state": "resolved"}]
+            )
+            await n.close()
+
+        run(go())
+        # Minor fire suppressed; the resolve still went out.
+        assert len(rx.bodies) == 1
+        assert rx.bodies[0]["events"][0]["state"] == "resolved"
+    finally:
+        rx.close()
+
+
+def test_sink_failure_counted_not_raised():
+    async def go():
+        n = WebhookNotifier(urls=("http://127.0.0.1:9/unroutable",), timeout_s=0.5)
+        n.notify([fired()])
+        await n.close()
+        return n
+
+    n = run(go())
+    assert n.sinks[0].failures == 1
+    assert n.sinks[0].last_error
+    assert "unroutable" not in (n.sinks[0].last_error or "")  # sanity: message is the exception
+
+
+def test_sampler_dispatches_each_event_once():
+    rx = WebhookReceiver()
+    try:
+        cfg = load_config(
+            env={
+                "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+                "TPUMON_K8S_MODE": "none",
+                "TPUMON_COLLECTORS": "host,accel",
+                "TPUMON_PORT": "0",
+                "TPUMON_ALERT_WEBHOOKS": rx.url,
+            }
+        )
+        sampler, _ = build(cfg)
+        assert isinstance(sampler.notifier, WebhookNotifier)
+
+        async def go():
+            # Drive the engine directly (deterministic) through the
+            # sampler's dispatch path.
+            sampler.engine.evaluate(host={"cpu": {"percent": 97.0}})
+            sampler._notify_new_events()
+            sampler._notify_new_events()  # no new events => no second POST
+            sampler.engine.evaluate(host={"cpu": {"percent": 97.0}})
+            sampler._notify_new_events()  # still-active alert => no event
+            await sampler.notifier.close()
+
+        run(go())
+        assert len(rx.bodies) == 1
+        keys = [e["key"] for e in rx.bodies[0]["events"]]
+        assert "host.cpu.critical" in keys
+    finally:
+        rx.close()
+
+
+def test_restored_events_not_repaged():
+    engine = AlertEngine()
+    engine.evaluate(host={"cpu": {"percent": 97.0}})
+    state = engine.to_state()
+
+    cfg = load_config(
+        env={
+            "TPUMON_ACCEL_BACKEND": "none",
+            "TPUMON_K8S_MODE": "none",
+            "TPUMON_COLLECTORS": "host",
+            "TPUMON_PORT": "0",
+        }
+    )
+    sampler, _ = build(cfg)
+    sampler.engine.load_state(state)
+    sampler.mark_events_notified()
+    rxed: list = []
+    sampler.notifier = type(
+        "N", (), {"notify": lambda self, ev: rxed.append(ev)}
+    )()
+    sampler._notify_new_events()
+    assert rxed == []
+    # But a genuinely new event after restore still dispatches.
+    sampler.engine.evaluate(host={"memory": {"percent": 97.0}})
+    sampler._notify_new_events()
+    assert len(rxed) == 1
+
+
+def test_slack_text_formats_fix_line():
+    text = slack_text([fired()], hostname="host-a")
+    assert "host-a" in text and "fix: scale out" in text

@@ -72,6 +72,9 @@ class AlertEngine:
         # history at all — each poll overwrites the last). Bounded ring.
         self._active_keys: dict[str, dict] = {}
         self.events: deque = deque(maxlen=500)
+        # Monotonic id per timeline event so consumers (webhook notifier,
+        # SSE clients) can track "what's new" across the bounded ring.
+        self._event_seq = 0
         # Anti-flap hold bookkeeping (Thresholds.fire_hold_s /
         # resolve_hold_s): key -> ts the condition was first seen pending
         # fire / first seen clear pending resolve.
@@ -353,7 +356,10 @@ class AlertEngine:
             first_seen = self._pending_fire.setdefault(key, now)
             if now - first_seen >= self.t.fire_hold_s:
                 self._active_keys[key] = a
-                self.events.append({"ts": now, "state": "fired", **a})
+                self._event_seq += 1
+                self.events.append(
+                    {"seq": self._event_seq, "ts": now, "state": "fired", **a}
+                )
         for key in [
             k for k in self._pending_fire if k not in raw or k in self._active_keys
         ]:
@@ -370,8 +376,14 @@ class AlertEngine:
             if now - first_clear >= self.t.resolve_hold_s:
                 a = self._active_keys.pop(key)
                 del self._pending_resolve[key]
+                self._event_seq += 1
                 self.events.append(
-                    {"ts": now, "state": "resolved", **{**a, "desc": ""}}
+                    {
+                        "seq": self._event_seq,
+                        "ts": now,
+                        "state": "resolved",
+                        **{**a, "desc": ""},
+                    }
                 )
 
         # Served buckets are the *held* view: pending-fire alerts aren't
@@ -405,6 +417,9 @@ class AlertEngine:
         self._last_pods = dict(last_pods) if last_pods is not None else None
         self._active_keys = dict(state.get("active_keys") or {})
         self.events.extend(state.get("events") or [])
+        self._event_seq = max(
+            (e.get("seq", 0) for e in self.events), default=self._event_seq
+        )
         self._pending_fire = dict(state.get("pending_fire") or {})
         self._pending_resolve = dict(state.get("pending_resolve") or {})
 

@@ -44,6 +44,15 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         else None
     )
     ring = RingHistory(window_s=cfg.history_window_s)
+    notifier = None
+    if cfg.alert_webhooks:
+        from tpumon.notify import WebhookNotifier
+
+        notifier = WebhookNotifier(
+            urls=tuple(cfg.alert_webhooks),
+            min_severity=cfg.webhook_min_severity,
+            timeout_s=cfg.webhook_timeout_s,
+        )
     sampler = Sampler(
         cfg,
         host=host,
@@ -52,6 +61,7 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         serving=serving,
         history=ring,
         engine=AlertEngine(cfg.thresholds),
+        notifier=notifier,
     )
     history = HistoryService(
         ring,

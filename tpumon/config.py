@@ -138,6 +138,14 @@ class Config:
     state_path: str | None = None
     state_interval_s: float = 60.0
 
+    # --- alert webhook sinks (tpumon.notify; reference has no alert
+    # delivery — alerts live only as long as a browser polls) ---
+    # URLs receive fired/resolved events as JSON POSTs; prefix "slack+"
+    # (or use a hooks.slack.com URL) for Slack-message payloads.
+    alert_webhooks: tuple[str, ...] = ()
+    webhook_min_severity: str = "minor"  # minor | serious | critical
+    webhook_timeout_s: float = 5.0
+
     # Per-request access logging (method path status ms) — SURVEY §5.1.
     access_log: bool = False
 
@@ -161,10 +169,12 @@ _SCALAR_FIELDS: dict[str, type] = {
     "k8s_api_url": str,
     "state_path": str,
     "state_interval_s": float,
+    "webhook_min_severity": str,
+    "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
 }
 _DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}
-_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers"}
+_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers", "alert_webhooks"}
 
 
 def _coerce_thresholds(raw: Mapping[str, Any], base: Thresholds) -> Thresholds:

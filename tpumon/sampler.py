@@ -67,6 +67,7 @@ class Sampler:
         serving: Collector | None = None,
         history: RingHistory | None = None,
         engine: AlertEngine | None = None,
+        notifier=None,
     ):
         self.cfg = cfg
         self.host = host
@@ -75,6 +76,11 @@ class Sampler:
         self.serving = serving
         self.history = history if history is not None else RingHistory(cfg.history_window_s)
         self.engine = engine or AlertEngine(cfg.thresholds)
+        # Webhook sink (tpumon.notify.WebhookNotifier or None). The
+        # sampler is the single dispatcher: events restored from a state
+        # snapshot are marked already-notified so restarts don't re-page.
+        self.notifier = notifier
+        self._notified_seq = 0
 
         self.latest: dict[str, Sample] = {}
         self.stats: dict[str, SourceStats] = {}
@@ -110,6 +116,11 @@ class Sampler:
     def health_json(self) -> dict:
         return {
             "uptime_s": round(time.time() - self.started_at, 1),
+            **(
+                {"webhooks": self.notifier.to_json()}
+                if self.notifier is not None
+                else {}
+            ),
             "sources": {
                 name: {
                     **(self.latest[name].health_json() if name in self.latest else {}),
@@ -201,6 +212,28 @@ class Sampler:
             pods=self.pods() if (k8s_sample is not None and k8s_sample.ok) else None,
             serving=self.serving_data() or None,
         )
+        self._notify_new_events()
+
+    def mark_events_notified(self) -> None:
+        """Treat every event currently on the timeline as delivered —
+        called after a state restore so historical events don't re-page."""
+        self._notified_seq = max(
+            (e.get("seq", 0) for e in self.engine.events), default=0
+        )
+
+    def _notify_new_events(self) -> None:
+        if self.notifier is None:
+            return
+        new = [
+            e for e in self.engine.events if e.get("seq", 0) > self._notified_seq
+        ]
+        if not new:
+            return
+        self._notified_seq = max(e.get("seq", 0) for e in new)
+        try:
+            self.notifier.notify(new)
+        except RuntimeError:
+            pass  # no running loop (sync test context): skip delivery
 
     async def tick_fast(self) -> None:
         """Host + accel sampling, history recording, alert evaluation."""
@@ -250,6 +283,8 @@ class Sampler:
             )
 
     async def stop(self) -> None:
+        # Tick loops stop first — a tick firing during notifier.close()
+        # would schedule a dispatch task nobody awaits.
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -258,3 +293,5 @@ class Sampler:
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks = []
+        if self.notifier is not None:
+            await self.notifier.close()

@@ -80,6 +80,9 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
     for name, value, ts in points:
         sampler.history.record(name, value, ts=ts)
     sampler.engine.load_state(alert_state)
+    # Restored timeline events were delivered (or intentionally not) in a
+    # previous life — never re-page them through the webhook notifier.
+    sampler.mark_events_notified()
     return True
 
 
