@@ -15,8 +15,11 @@ def test_ring_resample_step_grid():
     for t in range(0, 120, 1):  # 1 Hz samples for 2 min
         s.add(1000.0 + t, float(t))
     grid, vals = s.resample(step_s=30)
-    assert len(grid) == 4  # 0,30,60,90 offsets within the span
+    # 0,30,60,90 offsets within the span, plus the closing end point so
+    # the freshest sample always renders.
+    assert len(grid) == 5
     assert vals[0] == 0.0 and vals[1] == 30.0
+    assert grid[-1] == 1119.0 and vals[-1] == 119.0
 
 
 def test_ring_history_record_and_snapshot():
@@ -58,3 +61,69 @@ def test_per_chip_series_included():
     svc = HistoryService(ring, prometheus_url=None)
     out = asyncio.run(svc.snapshot())
     assert out["per_chip"]["h0/chip-0.mxu"]["data"] == [50.0]
+
+
+# ---------------- long-window coarse tier (?window=) -------------------
+
+
+def test_coarse_tier_accumulates_bucket_means():
+    s = RingSeries(window_s=100, long_window_s=3600, coarse_step_s=60)
+    # Two full 60 s buckets of 1 Hz values, then one point in a third.
+    for t in range(0, 121):
+        s.add(float(t), 10.0 if t < 60 else 20.0)
+    assert len(s.coarse) == 2
+    assert s.coarse[0][1] == 10.0
+    # bucket 1 holds ts 60..119 => mean 20, plus live bucket at t=120
+    assert s.coarse[1][1] == 20.0
+
+
+def test_long_window_resample_merges_coarse_and_fine():
+    s = RingSeries(window_s=100, long_window_s=3600, coarse_step_s=60)
+    for t in range(0, 1000, 5):
+        s.add(float(t), float(t))
+    grid, vals = s.resample(step_s=100, window_s=1000)
+    # Covers the full kilosecond, not just the 100 s fine window.
+    assert grid[0] < 300 and grid[-1] >= 900
+    # Values ascend (coarse means of an ascending series stay ascending).
+    assert vals == sorted(vals)
+    # Fine-window query unchanged by the coarse tier.
+    g2, _ = s.resample(step_s=10)
+    assert g2[0] >= 1000 - 100 - 10
+
+
+def test_coarse_tier_evicts_beyond_long_window():
+    s = RingSeries(window_s=60, long_window_s=300, coarse_step_s=60)
+    for t in range(0, 1200, 10):
+        s.add(float(t), 1.0)
+    assert s.coarse[0][0] >= 1190 - 300
+
+
+def test_history_service_window_param():
+    ring = RingHistory(window_s=100, long_window_s=3600, coarse_step_s=60)
+    for t in range(0, 1000, 5):
+        ring.record("cpu", float(t), ts=float(t))
+    svc = HistoryService(ring, prometheus_url=None, window_s=100, step_s=10)
+    out = asyncio.run(svc.snapshot(window_s=900.0))
+    assert out["window_s"] == 900.0
+    assert out["step_s"] >= 10
+    assert len(out["cpu"]["data"]) > 10
+    # Clamped to the long window; floor of 60 s.
+    assert svc.clamp_window(10 ** 9) == 3600
+    assert svc.clamp_window(1) == 60
+
+
+def test_restore_coarse_feeds_long_window_view():
+    ring = RingHistory(window_s=100, long_window_s=3600, coarse_step_s=60)
+    ring.restore_coarse("cpu", [(30.0, 5.0), (90.0, 6.0)])
+    ring.record("cpu", 7.0, ts=500.0)
+    snap = ring.snapshot_series("cpu", step_s=60, window_s=600)
+    assert 5.0 in snap["data"] and 7.0 in snap["data"]
+
+
+def test_coarse_only_series_renders_newest_value():
+    # Regression: with no fine points, the newest coarse point must render
+    # (a restored-but-gone chip's series is coarse-only after restart).
+    s = RingSeries(window_s=100, long_window_s=3600, coarse_step_s=60)
+    s.coarse.extend([(30.0, 5.0), (90.0, 6.0), (150.0, 7.0)])
+    grid, vals = s.resample(step_s=60, window_s=600)
+    assert vals[-1] == 7.0

@@ -101,6 +101,21 @@ class TestApiContracts:
             assert "labels" in d[key] and "data" in d[key]
             assert len(d[key]["labels"]) == len(d[key]["data"])
 
+    def test_history_window_param(self, app):
+        loop, port, _ = app
+        d = self._get(app, "/api/history?window=3h")
+        assert d["window_s"] == 3 * 3600
+        assert d["step_s"] >= 30
+        # Oversized windows clamp to the long tier; junk is a 400.
+        d = self._get(app, "/api/history?window=99d")
+        assert d["window_s"] == 24 * 3600
+        assert (
+            loop.run_until_complete(
+                asyncio.to_thread(get_status, port, "/api/history?window=bogus")
+            )
+            == 400
+        )
+
     def test_metrics_exporter(self, app):
         loop, port, _ = app
 

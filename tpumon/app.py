@@ -43,7 +43,11 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         if "serving" in enabled and cfg.serving_targets
         else None
     )
-    ring = RingHistory(window_s=cfg.history_window_s)
+    ring = RingHistory(
+        window_s=cfg.history_window_s,
+        long_window_s=cfg.history_long_window_s,
+        coarse_step_s=cfg.history_coarse_step_s,
+    )
     notifier = None
     if cfg.alert_webhooks:
         from tpumon.notify import WebhookNotifier

@@ -105,6 +105,10 @@ class Config:
     prometheus_url: str | None = None  # None => ring-buffer-only degraded mode
     history_window_s: float = 30 * 60
     history_step_s: float = 30
+    # Long-range tier: /api/history?window= up to this span, served from
+    # coarse (bucket-mean) ring data when Prometheus is absent.
+    history_long_window_s: float = 24 * 3600
+    history_coarse_step_s: float = 60
 
     # --- sampling (replaces per-request execSync collection, SURVEY §3.2) ---
     sample_interval_s: float = 1.0
@@ -173,7 +177,12 @@ _SCALAR_FIELDS: dict[str, type] = {
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
 }
-_DURATION_FIELDS = {"history_window_s": "history_window", "history_step_s": "history_step"}
+_DURATION_FIELDS = {
+    "history_window_s": "history_window",
+    "history_step_s": "history_step",
+    "history_long_window_s": "history_long_window",
+    "history_coarse_step_s": "history_coarse_step",
+}
 _LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers", "alert_webhooks"}
 
 

@@ -46,26 +46,92 @@ def format_hhmm(ts: float) -> str:
     return time.strftime("%H:%M", time.localtime(ts))
 
 
+def format_label(ts: float, window_s: float) -> str:
+    """HH:MM for intraday windows; month-day prefix once a window is long
+    enough that the same wall-clock time appears twice."""
+    if window_s > 12 * 3600:
+        return time.strftime("%m-%d %H:%M", time.localtime(ts))
+    return format_hhmm(ts)
+
+
 @dataclass
 class RingSeries:
-    """One bounded time series of (ts, value)."""
+    """One bounded time series: a fine tier of raw (ts, value) points over
+    ``window_s``, plus an optional coarse tier of ``coarse_step_s``-bucket
+    means retained for ``long_window_s`` — long-range charts without
+    keeping every 1 s sample for a day."""
 
     window_s: float
-    points: deque = field(default_factory=deque)  # (ts, value)
+    long_window_s: float = 0.0  # 0 => fine tier only
+    coarse_step_s: float = 60.0
+    points: deque = field(default_factory=deque)  # fine: (ts, value)
+    coarse: deque = field(default_factory=deque)  # (bucket_mid_ts, mean)
+    _bucket: int | None = field(default=None, repr=False)
+    _bucket_sum: float = field(default=0.0, repr=False)
+    _bucket_n: int = field(default=0, repr=False)
 
     def add(self, ts: float, value: float) -> None:
         self.points.append((ts, value))
         cutoff = ts - self.window_s
         while self.points and self.points[0][0] < cutoff:
             self.points.popleft()
+        if self.long_window_s > self.window_s:
+            b = int(ts // self.coarse_step_s)
+            if self._bucket is not None and b != self._bucket:
+                self._flush_bucket()
+            self._bucket = b
+            self._bucket_sum += value
+            self._bucket_n += 1
+            long_cutoff = ts - self.long_window_s
+            while self.coarse and self.coarse[0][0] < long_cutoff:
+                self.coarse.popleft()
 
-    def resample(self, step_s: float, end: float | None = None) -> tuple[list[float], list[float]]:
+    def _flush_bucket(self) -> None:
+        if self._bucket is not None and self._bucket_n:
+            mid = (self._bucket + 0.5) * self.coarse_step_s
+            self.coarse.append((mid, self._bucket_sum / self._bucket_n))
+        self._bucket_sum, self._bucket_n = 0.0, 0
+
+    def merged_points(self, window_s: float, end: float) -> list[tuple[float, float]]:
+        """Points covering [end - window_s, end]: coarse tier for the span
+        older than the fine tier, fine points (raw) for the recent span."""
+        start = end - window_s
+        fine = [(t, v) for t, v in self.points if t >= start]
+        # No fine points => every coarse point qualifies (an empty fine
+        # tier must not mask the newest coarse value).
+        fine_start = fine[0][0] if fine else float("inf")
+        out = [(t, v) for t, v in self.coarse if start <= t < fine_start]
+        # The live (unflushed) bucket only matters when it predates fine.
+        if self._bucket is not None and self._bucket_n:
+            mid = (self._bucket + 0.5) * self.coarse_step_s
+            if start <= mid < fine_start:
+                out.append((mid, self._bucket_sum / self._bucket_n))
+        out.extend(fine)
+        return out
+
+    def resample(
+        self,
+        step_s: float,
+        end: float | None = None,
+        window_s: float | None = None,
+    ) -> tuple[list[float], list[float]]:
         """Downsample to a fixed step grid (last-value-wins per bucket)."""
-        if not self.points:
+        window_s = window_s if window_s is not None else self.window_s
+        if end is None:
+            last_fine = self.points[-1][0] if self.points else None
+            last_coarse = self.coarse[-1][0] if self.coarse else None
+            candidates = [t for t in (last_fine, last_coarse) if t is not None]
+            if not candidates:
+                return [], []
+            end = max(candidates)
+        pts = (
+            self.merged_points(window_s, end)
+            if window_s > self.window_s
+            else [(t, v) for t, v in self.points if t >= end - window_s]
+        )
+        if not pts:
             return [], []
-        pts = list(self.points)
-        end = end if end is not None else pts[-1][0]
-        start = max(pts[0][0], end - self.window_s)
+        start = max(pts[0][0], end - window_s)
         times = [t for t, _ in pts]
         grid: list[float] = []
         vals: list[float] = []
@@ -76,14 +142,27 @@ class RingSeries:
                 grid.append(t)
                 vals.append(pts[i][1])
             t += step_s
+        # The grid is start-anchored; when end isn't a whole step away it
+        # would miss the newest sample — a monitor must show the freshest
+        # value, so close the grid at end.
+        if grid and end - grid[-1] > 1e-9:
+            grid.append(end)
+            vals.append(pts[-1][1])
         return grid, vals
 
 
 class RingHistory:
     """Named ring-buffer series, fed by the sampler each tick."""
 
-    def __init__(self, window_s: float = 1800):
+    def __init__(
+        self,
+        window_s: float = 1800,
+        long_window_s: float = 24 * 3600,
+        coarse_step_s: float = 60.0,
+    ):
         self.window_s = window_s
+        self.long_window_s = max(long_window_s, window_s)
+        self.coarse_step_s = coarse_step_s
         self.series: dict[str, RingSeries] = {}
 
     def record(self, name: str, value: float | None, ts: float | None = None) -> None:
@@ -92,16 +171,38 @@ class RingHistory:
         ts = time.time() if ts is None else ts
         s = self.series.get(name)
         if s is None:
-            s = self.series[name] = RingSeries(window_s=self.window_s)
+            s = self.series[name] = RingSeries(
+                window_s=self.window_s,
+                long_window_s=self.long_window_s,
+                coarse_step_s=self.coarse_step_s,
+            )
         s.add(ts, float(value))
 
-    def snapshot_series(self, name: str, step_s: float) -> dict:
+    def restore_coarse(self, name: str, points: list[tuple[float, float]]) -> None:
+        """Seed a series' coarse tier from a state snapshot (tpumon.state).
+        Caller guarantees points are time-ordered and predate any fine
+        points subsequently replayed through record()."""
+        if not points:
+            return
+        s = self.series.get(name)
+        if s is None:
+            s = self.series[name] = RingSeries(
+                window_s=self.window_s,
+                long_window_s=self.long_window_s,
+                coarse_step_s=self.coarse_step_s,
+            )
+        s.coarse.extend((float(t), float(v)) for t, v in points)
+
+    def snapshot_series(
+        self, name: str, step_s: float, window_s: float | None = None
+    ) -> dict:
         s = self.series.get(name)
         if s is None:
             return {"labels": [], "data": []}
-        grid, vals = s.resample(step_s)
+        window = window_s if window_s is not None else self.window_s
+        grid, vals = s.resample(step_s, window_s=window)
         return {
-            "labels": [format_hhmm(t) for t in grid],
+            "labels": [format_label(t, window) for t in grid],
             "data": [round(v, 2) for v in vals],
         }
 
@@ -123,13 +224,25 @@ class HistoryService:
         self.prom = PrometheusClient(prometheus_url) if prometheus_url else None
         self.last_prom_ok: bool | None = None
 
-    async def _prom_series(self) -> dict[str, dict] | None:
+    def clamp_window(self, window_s: float) -> float:
+        return min(max(window_s, 60.0), self.ring.long_window_s)
+
+    def step_for(self, window_s: float) -> float:
+        """Step targeting ~60 rendered points, never finer than the
+        configured base step (the reference's 30 s)."""
+        if window_s <= self.window_s:
+            return self.step_s
+        return max(self.step_s, round(window_s / 60.0))
+
+    async def _prom_series(
+        self, window_s: float, step_s: float
+    ) -> dict[str, dict] | None:
         if self.prom is None:
             return None
         names = list(PROM_QUERIES)
         results = await asyncio.gather(
             *(
-                self.prom.query_range(PROM_QUERIES[n], self.window_s, self.step_s)
+                self.prom.query_range(PROM_QUERIES[n], window_s, step_s)
                 for n in names
             )
         )
@@ -141,21 +254,27 @@ class HistoryService:
             any_ok = True
             s = series_list[0]
             out[name] = {
-                "labels": [format_hhmm(t) for t in s.times],
+                "labels": [format_label(t, window_s) for t in s.times],
                 "data": [round(v, 2) for v in s.values],
             }
         self.last_prom_ok = any_ok
         return out if any_ok else None
 
-    async def snapshot(self) -> dict:
-        prom = await self._prom_series()
-        out: dict = {"source": "prometheus" if prom else "ring"}
+    async def snapshot(self, window_s: float | None = None) -> dict:
+        window = self.clamp_window(window_s) if window_s else self.window_s
+        step = self.step_for(window)
+        prom = await self._prom_series(window, step)
+        out: dict = {
+            "source": "prometheus" if prom else "ring",
+            "window_s": window,
+            "step_s": step,
+        }
         # Per-series fallback: Prometheus result wins, ring fills gaps.
         for name in PROM_QUERIES:
             if prom and name in prom:
                 out[name] = prom[name]
             else:
-                out[name] = self.ring.snapshot_series(name, self.step_s)
+                out[name] = self.ring.snapshot_series(name, step, window_s=window)
         # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
         # drill-down charts; Prometheus equivalents are labelled series the
         # client can also get via its own PromQL if deployed.
@@ -163,7 +282,7 @@ class HistoryService:
         for name in self.ring.series:
             if name.startswith("chip."):
                 per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
-                    name, self.step_s
+                    name, step, window_s=window
                 )
         if per_chip:
             out["per_chip"] = per_chip

@@ -60,8 +60,11 @@ class SinkStats:
     last_sent_ts: float | None = None
 
     def to_json(self) -> dict:
+        # Redact path+query: webhook URLs carry capability tokens (Slack
+        # webhook paths ARE the secret) and /api/health is unauthenticated.
+        parts = urllib.parse.urlsplit(self.url)
         return {
-            "url": self.url,
+            "url": f"{parts.scheme}://{parts.netloc}/…",
             "kind": self.kind,
             "sent": self.sent,
             "failures": self.failures,
@@ -93,6 +96,11 @@ class WebhookNotifier:
             elif urllib.parse.urlsplit(url).hostname == "hooks.slack.com":
                 kind = "slack"
             self.sinks.append(SinkStats(url=url, kind=kind))
+        # Per-sink delivery locks: batches must reach each sink in the
+        # order notify() was called (a fast "resolved" POST overtaking its
+        # slow "fired" would leave a pager stuck active). asyncio.Lock is
+        # FIFO-fair, and notify() runs on the event loop in order.
+        self._locks = [asyncio.Lock() for _ in self.sinks]
         self._inflight: set[asyncio.Task] = set()
 
     # ------------------------------------------------------------------
@@ -129,9 +137,18 @@ class WebhookNotifier:
             sink.failures += 1
             sink.last_error = f"{type(e).__name__}: {e}"
 
+    async def _post_ordered(
+        self, sink: SinkStats, lock: asyncio.Lock, events: list[dict]
+    ) -> None:
+        async with lock:
+            await asyncio.to_thread(self._post, sink, events)
+
     async def _dispatch(self, events: list[dict]) -> None:
         await asyncio.gather(
-            *(asyncio.to_thread(self._post, s, events) for s in self.sinks)
+            *(
+                self._post_ordered(s, lock, events)
+                for s, lock in zip(self.sinks, self._locks)
+            )
         )
 
     def notify(self, events: list[dict]) -> None:

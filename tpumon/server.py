@@ -13,7 +13,8 @@ Route map (SURVEY §2.3, re-keyed for TPU):
                         replaces /api/gpu/metrics)
   /api/gpu/metrics      reference-shaped compat view over the same chips
   /api/k8s/pods         pod table
-  /api/history          30-min curves (Prometheus or ring buffer)
+  /api/history          curves (Prometheus or ring buffer); ?window=30m|3h|24h
+                        selects the span (coarse ring tier beyond 30 min)
   /api/alerts           last alert evaluation (sampler-owned, not
                         recomputed per request — fixes SURVEY §5.2)
   /api/serving          JetStream/MaxText panels
@@ -48,12 +49,16 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from tpumon.config import Config
+from tpumon.config import Config, parse_duration
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.sampler import Sampler
 
 WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
+
+
+def parse_query(query: str) -> dict[str, str]:
+    return dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
 
 
 class HttpError(Exception):
@@ -228,9 +233,7 @@ class MonitorServer:
             raise HttpError(503, "profiling requires jax")
         if self._profiler is None:
             self._profiler = ProfilerService()
-        params = dict(
-            kv.split("=", 1) for kv in query.split("&") if "=" in kv
-        )
+        params = parse_query(query)
         if "seconds" not in params:
             return self._profiler.status()
         try:
@@ -265,7 +268,13 @@ class MonitorServer:
         elif path == "/api/k8s/pods":
             payload = self._api_pods()
         elif path == "/api/history":
-            payload = await self.history.snapshot()
+            params = parse_query(query)
+            window_s = None
+            if "window" in params:
+                window_s = parse_duration(params["window"], default=-1.0)
+                if window_s <= 0:
+                    raise HttpError(400, f"bad window {params['window']!r}")
+            payload = await self.history.snapshot(window_s=window_s)
         elif path == "/api/alerts":
             payload = self._api_alerts()
         elif path == "/api/serving":

@@ -43,6 +43,13 @@ def snapshot_state(sampler: Sampler) -> dict:
             name: [[round(t, 3), v] for t, v in s.points]
             for name, s in sampler.history.series.items()
         },
+        # Coarse long-window tier (bucket means) — kept separately so the
+        # 24 h view also survives a restart.
+        "history_coarse": {
+            name: [[round(t, 3), v] for t, v in s.coarse]
+            for name, s in sampler.history.series.items()
+            if s.coarse
+        },
         "alerts": sampler.engine.to_state(),
     }
 
@@ -68,6 +75,13 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
             for t, v in pts
             if float(t) >= cutoff
         ]
+        long_cutoff = now - sampler.history.long_window_s
+        coarse = {
+            str(name): [
+                (float(t), float(v)) for t, v in pts if float(t) >= long_cutoff
+            ]
+            for name, pts in (state.get("history_coarse") or {}).items()
+        }
         alerts = state["alerts"]
         last_pods = alerts.get("last_pods")
         alert_state = {
@@ -77,6 +91,17 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
         }
     except (AttributeError, KeyError, TypeError, ValueError):
         return False
+    # Coarse tiers first: replaying fine points through record() re-derives
+    # the coarse buckets they cover, so restored coarse entries must only
+    # predate each series' oldest fine point to keep the deque time-ordered.
+    oldest_fine: dict[str, float] = {}
+    for name, _value, ts in points:
+        oldest_fine[name] = min(oldest_fine.get(name, ts), ts)
+    for name, pts in coarse.items():
+        bound = oldest_fine.get(name)
+        sampler.history.restore_coarse(
+            name, [p for p in pts if bound is None or p[0] < bound]
+        )
     for name, value, ts in points:
         sampler.history.record(name, value, ts=ts)
     sampler.engine.load_state(alert_state)
