@@ -90,3 +90,18 @@ def test_scalar_for_trilevel_threshold_rejected():
     # scalar for scalar field is fine
     cfg = load_config(env={"TPUMON_THRESHOLDS": json.dumps({"mxu_idle_pct": 2.5})})
     assert cfg.thresholds.mxu_idle_pct == 2.5
+
+
+def test_long_window_duration_keys_configurable():
+    # Regression: the coarse-tier durations must be reachable from env
+    # (and thus config files), "48h"-style strings included.
+    from tpumon.config import load_config
+
+    cfg = load_config(
+        env={
+            "TPUMON_HISTORY_LONG_WINDOW": "48h",
+            "TPUMON_HISTORY_COARSE_STEP": "2m",
+        }
+    )
+    assert cfg.history_long_window_s == 48 * 3600
+    assert cfg.history_coarse_step_s == 120

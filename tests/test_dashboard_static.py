@@ -32,8 +32,9 @@ def script(html):
 
 
 def test_fetched_endpoints_are_served(script):
-    endpoints = set(re.findall(r'j\("(/api/[^"]+)"\)', script))
-    assert endpoints, "no endpoints referenced?"
+    # Both j("/api/x") and j("/api/x?param=" + v) forms; query stripped.
+    endpoints = {e.split("?")[0] for e in re.findall(r'j\("(/api/[^"]+)"', script)}
+    assert {"/api/history", "/api/accel/metrics"} <= endpoints
     sampler, server = serve()
 
     async def check():
@@ -96,3 +97,11 @@ def test_example_configs_load():
             assert cfg.port == 8888
             loaded += 1
     assert loaded == 5
+
+
+def test_topology_map_wired(script):
+    """The ICI topology map renders from the same accel payload as the
+    chip grid (coords + tx_bps are served by /api/accel/metrics)."""
+    assert "function renderTopo" in script
+    assert "renderTopo(accel)" in script
+    assert "tx_bps" in script and "coords" in script

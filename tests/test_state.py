@@ -117,3 +117,24 @@ def test_config_state_keys():
     )
     assert cfg.state_path == "/tmp/s.json"
     assert cfg.state_interval_s == 5.0
+
+
+def test_restore_coarse_seam_bucket_not_duplicated():
+    # Regression: a fine point mid-bucket must evict the snapshot's
+    # full-bucket coarse mean for that bucket (one entry per bucket, the
+    # replayed one), not coexist with it at the same timestamp.
+    import time as _time
+
+    a = make_sampler()
+    now = _time.time()
+    step = a.history.coarse_step_s
+    bucket = int((now - 600) // step)
+    seam_ts = (bucket + 0.6) * step  # fine point lands mid-bucket
+    for i in range(40):  # enough fine points to span several buckets
+        a.history.record("cpu", 10.0, ts=seam_ts + i * 10)
+    state = snapshot_state(a)
+
+    b = make_sampler()
+    assert restore_state(b, state)
+    coarse_ts = [t for t, _ in b.history.series["cpu"].coarse]
+    assert len(coarse_ts) == len(set(coarse_ts)), "duplicate seam bucket"

@@ -177,11 +177,13 @@ _SCALAR_FIELDS: dict[str, type] = {
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
 }
-_DURATION_FIELDS = {
-    "history_window_s": "history_window",
-    "history_step_s": "history_step",
-    "history_long_window_s": "history_long_window",
-    "history_coarse_step_s": "history_coarse_step",
+# Config-file/env key -> Config field for duration-valued settings
+# ("30m"-style strings accepted via parse_duration).
+_DURATION_KEYS = {
+    "history_window": "history_window_s",
+    "history_step": "history_step_s",
+    "history_long_window": "history_long_window_s",
+    "history_coarse_step": "history_coarse_step_s",
 }
 _LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers", "alert_webhooks"}
 
@@ -218,8 +220,8 @@ def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
             continue
         if key in _SCALAR_FIELDS:
             cfg_kw[key] = None if value is None else _SCALAR_FIELDS[key](value)
-        elif key in ("history_window", "history_step"):
-            cfg_kw[key + "_s"] = parse_duration(value)
+        elif key in _DURATION_KEYS:
+            cfg_kw[_DURATION_KEYS[key]] = parse_duration(value)
         elif key in _LIST_FIELDS:
             if isinstance(value, str):
                 value = [v.strip() for v in value.split(",") if v.strip()]

@@ -92,15 +92,21 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
     except (AttributeError, KeyError, TypeError, ValueError):
         return False
     # Coarse tiers first: replaying fine points through record() re-derives
-    # the coarse buckets they cover, so restored coarse entries must only
-    # predate each series' oldest fine point to keep the deque time-ordered.
+    # every coarse bucket the fine points touch — including a partial
+    # re-derivation of the bucket the oldest fine point lands mid-way in.
+    # Restored coarse entries must therefore stop at that bucket's START
+    # boundary (not the raw fine timestamp), or the seam bucket appears
+    # twice and the partial mean shadows the correct full-bucket mean.
+    step = sampler.history.coarse_step_s
     oldest_fine: dict[str, float] = {}
     for name, _value, ts in points:
         oldest_fine[name] = min(oldest_fine.get(name, ts), ts)
     for name, pts in coarse.items():
         bound = oldest_fine.get(name)
+        bucket_start = None if bound is None else (bound // step) * step
         sampler.history.restore_coarse(
-            name, [p for p in pts if bound is None or p[0] < bound]
+            name,
+            [p for p in pts if bucket_start is None or p[0] < bucket_start],
         )
     for name, value, ts in points:
         sampler.history.record(name, value, ts=ts)
