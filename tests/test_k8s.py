@@ -136,3 +136,32 @@ def test_collector_degrades_when_all_sources_fail():
     assert not s.ok
     assert s.data == []
     assert "ApiPodSource" in s.error
+
+
+def test_tpu_request_parsed_from_resources():
+    from tpumon.collectors.k8s import parse_pod_list
+
+    pods = parse_pod_list(
+        {
+            "items": [
+                {
+                    "metadata": {"namespace": "s", "name": "tpu-pod"},
+                    "spec": {
+                        "containers": [
+                            {"resources": {"requests": {"google.com/tpu": "4"}}},
+                            {"resources": {"limits": {"google.com/tpu": "4"}}},
+                            {"resources": {}},
+                        ]
+                    },
+                    "status": {"phase": "Running"},
+                },
+                {
+                    "metadata": {"namespace": "s", "name": "cpu-pod"},
+                    "spec": {"containers": [{"resources": {"requests": {"cpu": "1"}}}]},
+                    "status": {"phase": "Running"},
+                },
+            ]
+        }
+    )
+    assert pods[0]["tpu_request"] == 8
+    assert pods[1]["tpu_request"] == 0

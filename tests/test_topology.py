@@ -55,3 +55,70 @@ def test_slice_json_shape():
     assert j["reporting_chips"] == 1
     assert j["missing_chips"] == 0
     assert j["mean_hbm_pct"] == 50.0
+
+
+# ---------------- pod -> chip attribution ------------------------------
+
+
+def _chip(host, index, chip_id=None):
+    from tpumon.topology import ChipSample
+
+    return ChipSample(
+        chip_id=chip_id or f"{host}/chip-{index}",
+        host=host,
+        slice_id="slice-0",
+        index=index,
+        kind="v5e",
+    )
+
+
+def test_attribute_single_pod_owns_all_host_chips():
+    from tpumon.topology import attribute_pods
+
+    chips = [_chip("tpu-host-0", i) for i in range(4)]
+    pods = [
+        {"namespace": "serving", "name": "js-0", "node": "tpu-host-0",
+         "tpu_request": 4},
+        {"namespace": "ml", "name": "cpu-job", "node": "cpu-node-1",
+         "tpu_request": 0},
+    ]
+    out = attribute_pods(chips, pods)
+    assert out == {c.chip_id: "serving/js-0" for c in chips}
+
+
+def test_attribute_splits_chips_proportionally():
+    from tpumon.topology import attribute_pods
+
+    chips = [_chip("h0", i) for i in range(4)]
+    pods = [
+        {"namespace": "a", "name": "p1", "node": "h0", "tpu_request": 1},
+        {"namespace": "a", "name": "p2", "node": "h0", "tpu_request": 3},
+    ]
+    out = attribute_pods(chips, pods)
+    assert out["h0/chip-0"] == "a/p1"
+    assert out["h0/chip-1"] == "a/p2"
+    assert out["h0/chip-3"] == "a/p2"
+
+
+def test_attribute_no_tpu_pods_or_no_chips():
+    from tpumon.topology import attribute_pods
+
+    assert attribute_pods([], [{"node": "h0", "tpu_request": 8}]) == {}
+    assert attribute_pods([_chip("h0", 0)], None) == {}
+    # Pod on a host with no reporting chips attributes nothing.
+    assert attribute_pods(
+        [_chip("h1", 0)],
+        [{"namespace": "x", "name": "p", "node": "h0", "tpu_request": 8}],
+    ) == {}
+
+
+def test_attribute_excess_chips_stay_unowned():
+    # Regression: chips beyond the host's requested total must not be
+    # clamped onto the last pod.
+    from tpumon.topology import attribute_pods
+
+    chips = [_chip("h0", i) for i in range(8)]
+    pods = [{"namespace": "a", "name": "p", "node": "h0", "tpu_request": 2}]
+    out = attribute_pods(chips, pods)
+    assert len(out) == 2
+    assert "h0/chip-7" not in out

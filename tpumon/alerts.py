@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from typing import Iterable
 
 from tpumon.config import Thresholds
-from tpumon.topology import ChipSample, SliceView
+from tpumon.topology import ChipSample, SliceView, attribute_pods
 
 SEVERITIES = ("minor", "serious", "critical")
 
@@ -133,9 +133,16 @@ class AlertEngine:
 
     # ------------- per-chip rules (re-keyed monitor_server.js:178-184) ----
 
-    def _chip_alerts(self, chips: list[ChipSample]) -> list[Alert]:
+    def _chip_alerts(
+        self, chips: list[ChipSample], owners: dict[str, str] | None = None
+    ) -> list[Alert]:
         alerts: list[Alert] = []
+        owners = owners or {}
         for c in chips:
+            # Owning pod (pod->chip attribution): names the workload in the
+            # alert text so remediation starts at the right pod.
+            pod = owners.get(c.chip_id)
+            pod_note = f" — pod {pod}" if pod else ""
             hbm = c.hbm_pct
             if hbm is not None:
                 sev = self.t.hbm_pct.severity(hbm)
@@ -146,7 +153,7 @@ class AlertEngine:
                             title=f"HBM pressure on {c.chip_id}",
                             desc=f"HBM at {hbm:.1f}% "
                             f"({(c.hbm_used or 0) / 2**30:.1f} / "
-                            f"{(c.hbm_total or 0) / 2**30:.1f} GiB)",
+                            f"{(c.hbm_total or 0) / 2**30:.1f} GiB){pod_note}",
                             fix="Reduce batch size or sequence length, shard the "
                             "model over more chips, or enable rematerialization "
                             "(jax.checkpoint) to trade FLOPs for HBM.",
@@ -182,7 +189,7 @@ class AlertEngine:
                         severity="serious",
                         title=f"Chip {c.chip_id} stalled",
                         desc=f"HBM {hbm:.0f}% committed but MXU duty cycle only "
-                        f"{c.mxu_duty_pct:.1f}%",
+                        f"{c.mxu_duty_pct:.1f}%{pod_note}",
                         fix="The job holds memory but isn't computing: look for "
                         "a host-side input bottleneck, a hung collective "
                         "(one host of the slice down?), or a deadlocked step.",
@@ -195,7 +202,7 @@ class AlertEngine:
                         severity="critical",
                         title=f"ICI link down on {c.chip_id}",
                         desc="Inter-chip interconnect link reports down; "
-                        "collectives crossing it will hang or fail.",
+                        f"collectives crossing it will hang or fail.{pod_note}",
                         fix="Drain the slice and file a hardware case; a single "
                         "bad ICI link poisons every collective in the slice.",
                         key=f"chip.{c.chip_id}.ici_down",
@@ -338,7 +345,14 @@ class AlertEngine:
     ) -> dict[str, list[dict]]:
         alerts: list[Alert] = []
         alerts += self._host_alerts(host)
-        alerts += self._chip_alerts(chips or [])
+        # Attribution uses the freshest pod view available: this
+        # evaluation's pods, else the last healthy scrape's baseline.
+        owner_pods = (
+            pods if pods is not None else list((self._last_pods or {}).values())
+        )
+        alerts += self._chip_alerts(
+            chips or [], attribute_pods(chips or [], owner_pods)
+        )
         alerts += self._slice_alerts(slices or [])
         if update_pod_state:
             alerts += self._pod_alerts(pods)

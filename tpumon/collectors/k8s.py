@@ -72,6 +72,18 @@ def parse_pod_list(obj: dict, now: float | None = None) -> list[dict]:
         labels = meta.get("labels") or {}
         node_selector = spec.get("nodeSelector") or {}
         phase = status.get("phase", "Unknown")
+        # TPU chips requested (google.com/tpu), summed over containers —
+        # the basis for pod->chip attribution in the accel view.
+        tpu_request = 0
+        for ctr in spec.get("containers") or []:
+            res = ctr.get("resources") or {}
+            v = (res.get("requests") or {}).get("google.com/tpu") or (
+                res.get("limits") or {}
+            ).get("google.com/tpu")
+            try:
+                tpu_request += int(v)
+            except (TypeError, ValueError):
+                pass
         # Surface container-level waiting/terminated reasons (CrashLoopBackOff,
         # OOMKilled, ...) the reference can't see — it only looks at phase.
         reason = status.get("reason")
@@ -97,6 +109,7 @@ def parse_pod_list(obj: dict, now: float | None = None) -> list[dict]:
                 "age": humanize_age(age_s) if age_s is not None else "",
                 "age_s": age_s,
                 "node": spec.get("nodeName"),
+                "tpu_request": tpu_request,
                 "tpu_topology": node_selector.get(TPU_TOPOLOGY_KEY),
                 "tpu_accelerator": node_selector.get(TPU_ACCEL_KEY),
                 "jobset": labels.get(JOBSET_NAME_KEY),
@@ -212,6 +225,14 @@ class FakePodSource:
                         TPU_TOPOLOGY_KEY: "2x4",
                         TPU_ACCEL_KEY: "tpu-v5-lite-podslice",
                     },
+                    "containers": [
+                        {
+                            "resources": {
+                                "requests": {"google.com/tpu": "8"},
+                                "limits": {"google.com/tpu": "8"},
+                            }
+                        }
+                    ],
                 },
                 "status": {
                     "phase": "Running",

@@ -53,6 +53,7 @@ from tpumon.config import Config, parse_duration
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.sampler import Sampler
+from tpumon.topology import attribute_pods
 
 WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 
@@ -120,10 +121,12 @@ class MonitorServer:
     def _api_accel(self) -> dict:
         chips = self.sampler.chips()
         rates = self.sampler.ici_rates
+        owners = attribute_pods(chips, self.sampler.pods())
         chip_json = []
         for c in chips:
             d = c.to_json()
             d.update(rates.get(c.chip_id, {}))
+            d["pod"] = owners.get(c.chip_id)
             chip_json.append(d)
         s = self.sampler.sample_of("accel")
         return {
@@ -156,8 +159,17 @@ class MonitorServer:
 
     def _api_pods(self) -> dict:
         s = self.sampler.sample_of("k8s")
+        # Copies: handlers must not write into sampler-owned pod dicts.
+        pods = [dict(p) for p in self.sampler.pods()]
+        # Reverse attribution: how many live chips each TPU pod owns.
+        owners = attribute_pods(self.sampler.chips(), pods)
+        counts: dict[str, int] = {}
+        for owner in owners.values():
+            counts[owner] = counts.get(owner, 0) + 1
+        for p in pods:
+            p["chips"] = counts.get(f"{p.get('namespace')}/{p.get('name')}", 0)
         return {
-            "pods": self.sampler.pods(),
+            "pods": pods,
             "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
         }
 

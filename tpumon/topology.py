@@ -126,6 +126,41 @@ class SliceView:
         }
 
 
+def attribute_pods(
+    chips: Iterable[ChipSample], pods: Iterable[Mapping] | None
+) -> dict[str, str]:
+    """chip_id -> "namespace/name" of the TPU-requesting pod on the chip's
+    host. On GKE a TPU host's chips are device-plugin-assigned to the pod
+    that requested ``google.com/tpu`` on that node; with several such pods
+    on one node, chips are split in index order proportional to each pod's
+    request (the device plugin's assignment isn't observable from here, so
+    this is the best-effort view; one-pod-per-host — the common case — is
+    exact)."""
+    chips = list(chips)
+    by_node: dict[str, list[Mapping]] = {}
+    for p in pods or []:
+        if (p.get("tpu_request") or 0) > 0 and p.get("node"):
+            by_node.setdefault(p["node"], []).append(p)
+    out: dict[str, str] = {}
+    for node, cands in by_node.items():
+        cands.sort(key=lambda p: (p.get("namespace", ""), p.get("name", "")))
+        node_chips = sorted(
+            (c for c in chips if c.host == node), key=lambda c: c.index
+        )
+        if not node_chips:
+            continue
+        slots: list[str] = []
+        for p in cands:
+            slots += [f"{p.get('namespace')}/{p.get('name')}"] * int(
+                p.get("tpu_request") or 0
+            )
+        # Chips beyond the host's total requested count are unowned —
+        # clamping them to the last pod would misdirect alerts.
+        for i, c in enumerate(node_chips[: len(slots)]):
+            out[c.chip_id] = slots[i]
+    return out
+
+
 def slice_views(
     chips: Iterable[ChipSample], expected: Mapping[str, int] | None = None
 ) -> list[SliceView]:
