@@ -46,3 +46,59 @@ def test_burn_uses_pallas_flag():
 
     out = mxu_burn(seconds=0.2, size=128, iters=2, use_pallas=False)
     assert out["tflops"] > 0 and out["pallas"] is False
+
+
+# ---------------- int8 weight-only matmul (tpumon.ops.quant_matmul) ----
+
+
+def test_quantized_matmul_matches_dequant_reference():
+    from tpumon.loadgen.quant import quantize
+    from tpumon.ops.quant_matmul import quantized_matmul_pallas
+
+    a = jax.random.normal(jax.random.PRNGKey(0), (256, 512), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (512, 512), jnp.float32)
+    qt = quantize(w)
+    out = quantized_matmul_pallas(
+        a, qt.q, qt.scale, block_m=128, block_n=128, block_k=128,
+        interpret=True,
+    )
+    ref = a @ qt.astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_quantized_matmul_scale_applied_once_across_k_steps():
+    # Two K steps with a non-trivial scale: wrong placement of the scale
+    # (inside the K loop) would double-apply it.
+    from tpumon.ops.quant_matmul import quantized_matmul_pallas
+
+    a = jnp.ones((128, 256), jnp.float32)
+    q = jnp.ones((256, 128), jnp.int8)
+    scale = jnp.full((128,), 0.5, jnp.float32)
+    out = quantized_matmul_pallas(
+        a, q, scale, block_m=128, block_n=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), 256 * 0.5)
+
+
+def test_quantized_matmul_fallback_for_decode_shapes():
+    from tpumon.loadgen.quant import quantize
+    from tpumon.ops.quant_matmul import quantized_matmul
+
+    a = jax.random.normal(jax.random.PRNGKey(0), (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 48), jnp.float32)
+    qt = quantize(w)
+    out = quantized_matmul(a, qt.q, qt.scale, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(a @ qt.astype(jnp.float32)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_int8_burn_runs_off_tpu():
+    from tpumon.loadgen.burn import int8_burn
+
+    out = int8_burn(seconds=0.2, size=128, iters=2, use_pallas=False)
+    assert out["tflops"] > 0 and out["weight_gbps"] > 0
+    assert out["pallas"] is False

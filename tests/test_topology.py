@@ -122,3 +122,17 @@ def test_attribute_excess_chips_stay_unowned():
     out = attribute_pods(chips, pods)
     assert len(out) == 2
     assert "h0/chip-7" not in out
+
+
+def test_attribute_stable_when_low_index_chips_vanish():
+    # Regression: ownership keys off the chip's host-local index, so a
+    # pod's surviving chips keep their owner when earlier chips die.
+    from tpumon.topology import attribute_pods
+
+    pods = [
+        {"namespace": "a", "name": "p1", "node": "h0", "tpu_request": 4},
+        {"namespace": "a", "name": "p2", "node": "h0", "tpu_request": 4},
+    ]
+    surviving = [_chip("h0", i) for i in range(4, 8)]  # p2's chips only
+    out = attribute_pods(surviving, pods)
+    assert all(v == "a/p2" for v in out.values()) and len(out) == 4

@@ -79,6 +79,72 @@ def mxu_burn(
     }
 
 
+@partial(jax.jit, static_argnames=("size", "iters", "use_pallas"))
+def _int8_burn_program(
+    key: jax.Array, size: int, iters: int, use_pallas: bool = False
+) -> jax.Array:
+    """Chained int8-weight matmuls: the serving engine's quantized hot op
+    (activations bf16, weights streamed as int8 + per-channel scale)."""
+    a = jax.random.normal(key, (size, size), jnp.bfloat16)
+    q = jax.random.randint(
+        jax.random.fold_in(key, 1), (size, size), -127, 128, jnp.int8
+    )
+    scale = jnp.full((size,), 1.0 / 127.0, jnp.float32)
+
+    if use_pallas:
+        from tpumon.ops.quant_matmul import quantized_matmul_pallas
+
+    def body(carry, _):
+        a = carry
+        if use_pallas:
+            c = quantized_matmul_pallas(a, q, scale)
+        else:
+            # Tie q to the carry (adds a value-preserving 0) so XLA can't
+            # hoist the loop-invariant dequant out of the scan — otherwise
+            # the loop would stream a materialized bf16 copy and the
+            # 1-byte/weight accounting below would be a lie.
+            jitter = (a[0, 0] * 0).astype(jnp.int8)
+            c = a @ (
+                (q + jitter).astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)
+            )
+        c = (c / jnp.float32(size).astype(jnp.bfloat16)).astype(jnp.bfloat16)
+        return c, ()
+
+    out, _ = jax.lax.scan(body, a, None, length=iters)
+    return jnp.sum(out.astype(jnp.float32))
+
+
+def int8_burn(
+    seconds: float = 2.0,
+    size: int = 4096,
+    iters: int = 64,
+    use_pallas: bool | None = None,
+) -> dict:
+    """Int8 weight-only matmul bursts; reports TFLOP/s and the effective
+    int8 weight-streaming rate (the bandwidth decode is bound by)."""
+    key = jax.random.PRNGKey(0)
+    if use_pallas is None:
+        use_pallas = jax.devices()[0].platform == "tpu" and size % 512 == 0
+    _int8_burn_program(key, size, iters, use_pallas).block_until_ready()
+    flops_per_call = 2 * size**3 * iters
+    weight_bytes_per_call = size * size * iters  # int8: 1 byte/weight
+    calls = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        _int8_burn_program(
+            jax.random.fold_in(key, calls), size, iters, use_pallas
+        ).block_until_ready()
+        calls += 1
+    dt = time.perf_counter() - t0
+    return {
+        "calls": calls,
+        "seconds": dt,
+        "pallas": use_pallas,
+        "tflops": flops_per_call * calls / dt / 1e12,
+        "weight_gbps": weight_bytes_per_call * calls / dt / 1e9,
+    }
+
+
 def hbm_fill(fraction: float = 0.5, hbm_bytes: int | None = None) -> list[jax.Array]:
     """Allocate ~fraction of HBM (holds references; caller drops to free).
 

@@ -14,6 +14,13 @@ kernel changes, and inside jit XLA fuses the dequant multiply into the
 consuming matmul — the int8 array is what lives in and streams from
 HBM. Symmetric per-output-channel scales keep the matmul error small
 without zero-points (cheap on MXU, standard for weight-only quant).
+
+For explicit control of the tiling/dequant schedule on large
+(prefill-sized) shapes there is also a hand-written Pallas kernel,
+``tpumon.ops.quant_matmul.quantized_matmul`` — int8 tiles widened in
+VMEM, scale applied once to the f32 accumulator at store — with the
+fused XLA path as its automatic fallback for decode-sized batches;
+``tpumon.loadgen.burn.int8_burn`` measures it under load.
 """
 
 from __future__ import annotations

@@ -1,0 +1,109 @@
+"""Int8 weight-only matmul Pallas kernel.
+
+C[M,N] = A[M,K] @ (Q[K,N].astype * scale[N]) — the serving-engine hot op
+when weights are quantized (tpumon.loadgen.quant): activations stay
+bf16/f32, weights stream from HBM as int8 and are widened in VMEM, and
+the per-output-channel scale is applied ONCE to the f32 accumulator at
+store time (scale depends only on N, so it commutes past the K sum).
+That keeps HBM traffic at 1 byte/weight — the whole point of int8 on a
+bandwidth-bound decode — while the MXU still sees its preferred wide
+dtype.
+
+Same schedule as tpumon.ops.matmul: (M/bm, N/bn, K/bk) grid, K
+innermost/"arbitrary", f32 VMEM scratch accumulator written back on the
+last K step. ``quantized_matmul`` falls back to the fused XLA path for
+shapes that don't tile (tiny decode batches), so callers can use it
+unconditionally.
+
+Measured on v5e (4096³, 32 chained iterations, dependency-forcing scan):
+512³ blocks run ~3.4× faster than XLA's fused dequant-matmul of the same
+program; 256-row M blocks are catastrophically slower (sub-MXU-height
+tiles), hence the 512 defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _q_matmul_kernel(a_ref, q_ref, s_ref, out_ref, acc_ref, *, k_steps: int):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        a_ref[:], q_ref[:].astype(a_ref.dtype), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _store():
+        # scale[1, bn] broadcasts over the M rows of the accumulator.
+        out_ref[:] = (acc_ref[:] * s_ref[:].astype(jnp.float32)).astype(
+            out_ref.dtype
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_m", "block_n", "block_k", "interpret")
+)
+def quantized_matmul_pallas(
+    a: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """A[M,K] @ dequant(Q[K,N], scale[N]); shapes must divide the blocks."""
+    m, k = a.shape
+    k2, n = q.shape
+    assert k == k2 and scale.shape == (n,), (a.shape, q.shape, scale.shape)
+    assert q.dtype == jnp.int8, q.dtype
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, (
+        f"shapes {(m, k, n)} must divide blocks {(block_m, block_k, block_n)}"
+    )
+    k_steps = k // block_k
+    return pl.pallas_call(
+        functools.partial(_q_matmul_kernel, k_steps=k_steps),
+        grid=(m // block_m, n // block_n, k_steps),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, block_n), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(a, q, scale.reshape(1, n))
+
+
+def quantized_matmul(
+    a: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    block_m: int = 512,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas int8 matmul when the shapes tile, fused XLA path otherwise
+    (decode-sized M is far below a useful MXU tile)."""
+    m, k = a.shape
+    n = q.shape[1]
+    if m % block_m == 0 and n % block_n == 0 and k % block_k == 0:
+        return quantized_matmul_pallas(
+            a, q, scale,
+            block_m=block_m, block_n=block_n, block_k=block_k,
+            interpret=interpret,
+        )
+    return a @ (q.astype(a.dtype) * scale.astype(a.dtype))

@@ -154,10 +154,15 @@ def attribute_pods(
             slots += [f"{p.get('namespace')}/{p.get('name')}"] * int(
                 p.get("tpu_request") or 0
             )
-        # Chips beyond the host's total requested count are unowned —
-        # clamping them to the last pod would misdirect alerts.
-        for i, c in enumerate(node_chips[: len(slots)]):
-            out[c.chip_id] = slots[i]
+        # Slots are indexed by the chip's own host-local index, not its
+        # position among *reporting* chips — if low-index chips stop
+        # reporting, the survivors must keep their original owner instead
+        # of shifting onto the first pod's slots. Chips beyond the host's
+        # total requested count are unowned (clamping them to the last
+        # pod would misdirect alerts).
+        for c in node_chips:
+            if 0 <= c.index < len(slots):
+                out[c.chip_id] = slots[c.index]
     return out
 
 
