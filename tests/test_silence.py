@@ -1,0 +1,219 @@
+"""Alert silencing (Alertmanager-style mutes).
+
+A silence is a key prefix + expiry: matching alerts leave the served
+severity buckets and stop paging webhooks, but their lifecycle (active
+keys, fired/resolved timeline) keeps recording, and silences survive
+restarts via the state snapshot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+from tpumon.alerts import AlertEngine
+
+
+def hot_host(pct=97.0):
+    return {"cpu": {"percent": pct}}
+
+
+def test_silenced_alert_leaves_buckets_but_keeps_lifecycle():
+    e = AlertEngine()
+    out = e.evaluate(host=hot_host(), now=1000.0)
+    assert len(out["critical"]) == 1
+
+    e.silence("host.cpu", 600, now=1001.0)
+    out = e.evaluate(host=hot_host(), now=1002.0)
+    assert out["critical"] == []
+    assert [a["key"] for a in e.last_silenced] == ["host.cpu.critical"]
+    # Lifecycle continues: condition clears -> resolved event recorded.
+    e.evaluate(host=hot_host(10.0), now=1003.0)
+    assert any(
+        ev["state"] == "resolved" and ev["key"] == "host.cpu.critical"
+        for ev in e.events
+    )
+
+
+def test_silence_expires_and_unsilence():
+    e = AlertEngine()
+    e.silence("host.cpu", 10, now=1000.0)
+    e.evaluate(host=hot_host(), now=1005.0)
+    assert e.last["critical"] == []
+    out = e.evaluate(host=hot_host(), now=1011.0)  # expired
+    assert len(out["critical"]) == 1
+    assert e.silences == {}  # expired silences pruned
+
+    e.silence("host.", 600, now=1012.0)
+    assert e.unsilence("host.") is True
+    assert e.unsilence("host.") is False
+    out = e.evaluate(host=hot_host(), now=1013.0)
+    assert len(out["critical"]) == 1
+
+
+def test_prefix_matches_family_of_keys():
+    e = AlertEngine()
+    e.silence("host.", 600, now=1000.0)
+    out = e.evaluate(
+        host={"cpu": {"percent": 97.0}, "memory": {"percent": 88.0}}, now=1001.0
+    )
+    assert out["critical"] == [] and out["serious"] == []
+    assert len(e.last_silenced) == 2
+
+
+def test_silences_survive_state_round_trip():
+    e = AlertEngine()
+    e.silence("chip.", 3600, now=1000.0)
+    e2 = AlertEngine()
+    e2.load_state(json.loads(json.dumps(e.to_state())))
+    assert "chip." in e2.silences
+
+
+def test_silenced_events_do_not_page_webhooks():
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    cfg = load_config(
+        env={
+            "TPUMON_ACCEL_BACKEND": "none",
+            "TPUMON_K8S_MODE": "none",
+            "TPUMON_COLLECTORS": "host",
+            "TPUMON_PORT": "0",
+        }
+    )
+    sampler, _ = build(cfg)
+    rxed: list = []
+    sampler.notifier = type("N", (), {"notify": lambda self, ev: rxed.append(ev)})()
+    sampler.engine.silence("host.cpu", 3600)
+    sampler.engine.evaluate(host=hot_host())
+    sampler._notify_new_events()
+    assert rxed == []
+    # A non-silenced alert still pages.
+    sampler.engine.evaluate(host={"memory": {"percent": 97.0}})
+    sampler._notify_new_events()
+    assert len(rxed) == 1
+    assert all(e["key"].startswith("host.memory") for e in rxed[0])
+
+
+def test_silence_http_routes():
+    from tests.test_server_api import serve, run_app
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    port = loop.run_until_complete(run_app(sampler, server))
+    try:
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read())
+
+        def run(fn, *a):
+            return loop.run_until_complete(asyncio.to_thread(fn, *a))
+
+        status, body = run(post, "/api/silence", {"key": "chip.", "duration": "2h"})
+        assert status == 200 and body["silenced"] == "chip."
+        assert "chip." in sampler.engine.silences
+
+        def get_alerts():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/alerts"
+            ) as r:
+                return json.loads(r.read())
+
+        alerts = run(get_alerts)
+        assert alerts["silences"][0]["key"] == "chip."
+
+        status, body = run(post, "/api/unsilence", {"key": "chip."})
+        assert status == 200 and body["existed"] is True
+
+        # Error paths: missing key, bad duration, POST elsewhere.
+        assert run(post, "/api/silence", {})[0] == 400
+        assert run(post, "/api/silence", {"key": "x", "duration": "nope"})[0] == 400
+        assert run(post, "/api/alerts", {"key": "x"})[0] == 405
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+
+def test_suppressed_fire_repages_after_silence_expires():
+    # Regression: an alert that fires during a silence and outlives it
+    # must page once the silence ends (Alertmanager re-notify semantics).
+    e = AlertEngine()
+    e.silence("host.cpu", 10, now=1000.0)
+    e.evaluate(host=hot_host(), now=1001.0)  # fires, suppressed
+    fired = [ev for ev in e.events if ev["state"] == "fired"]
+    assert len(fired) == 1
+    e.evaluate(host=hot_host(), now=1011.0)  # silence expired, still hot
+    fired = [ev for ev in e.events if ev["state"] == "fired"]
+    assert len(fired) == 2  # fresh event => fresh seq => webhook delivery
+    assert fired[1]["seq"] > fired[0]["seq"]
+    # No third fire on the next tick.
+    e.evaluate(host=hot_host(), now=1012.0)
+    assert len([ev for ev in e.events if ev["state"] == "fired"]) == 2
+
+
+def test_resolution_of_silenced_alert_still_pages():
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    cfg = load_config(
+        env={
+            "TPUMON_ACCEL_BACKEND": "none",
+            "TPUMON_K8S_MODE": "none",
+            "TPUMON_COLLECTORS": "host",
+            "TPUMON_PORT": "0",
+        }
+    )
+    sampler, _ = build(cfg)
+    rxed: list = []
+    sampler.notifier = type("N", (), {"notify": lambda self, ev: rxed.append(ev)})()
+    sampler.engine.evaluate(host=hot_host())
+    sampler._notify_new_events()  # fire pages
+    sampler.engine.silence("host.cpu", 3600)
+    sampler.engine.evaluate(host=hot_host(10.0))  # clears under silence
+    sampler._notify_new_events()
+    resolved = [e for batch in rxed for e in batch if e["state"] == "resolved"]
+    assert len(resolved) == 1  # the incident closes despite the silence
+
+
+def test_cross_origin_post_refused():
+    from tests.test_server_api import serve, run_app
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    port = loop.run_until_complete(run_app(sampler, server))
+    try:
+
+        def post(headers):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/silence",
+                data=b'{"key": "x.", "duration": "1h"}',
+                headers=headers,
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status
+            except urllib.error.HTTPError as err:
+                return err.code
+
+        def run(fn, *a):
+            return loop.run_until_complete(asyncio.to_thread(fn, *a))
+
+        assert run(post, {"Origin": "http://evil.example"}) == 403
+        assert "x." not in sampler.engine.silences
+        # Same-origin browser POST and origin-less curl both pass.
+        assert run(post, {"Origin": f"http://127.0.0.1:{port}"}) == 200
+        assert run(post, {}) == 200
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()

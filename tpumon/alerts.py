@@ -80,6 +80,16 @@ class AlertEngine:
         # fire / first seen clear pending resolve.
         self._pending_fire: dict[str, float] = {}
         self._pending_resolve: dict[str, float] = {}
+        # Silences: key-prefix -> expiry ts. A silenced alert keeps its
+        # full lifecycle (state tracking, timeline) but is excluded from
+        # the served severity buckets and from webhook delivery —
+        # Alertmanager semantics: mute the noise, don't blind the record.
+        self.silences: dict[str, float] = {}
+        self._last_silenced: list[dict] = []
+        # Fired events suppressed by a silence: if the alert is still
+        # active when its silence ends, a fresh "fired" event re-notifies
+        # (Alertmanager re-notifies on silence expiry).
+        self._suppressed_fires: set[str] = set()
 
     # ---------------- host rules (monitor_server.js:162-175) -------------
 
@@ -374,6 +384,8 @@ class AlertEngine:
                 self.events.append(
                     {"seq": self._event_seq, "ts": now, "state": "fired", **a}
                 )
+                if self.is_silenced(key, now):
+                    self._suppressed_fires.add(key)
         for key in [
             k for k in self._pending_fire if k not in raw or k in self._active_keys
         ]:
@@ -391,22 +403,76 @@ class AlertEngine:
                 a = self._active_keys.pop(key)
                 del self._pending_resolve[key]
                 self._event_seq += 1
+                # An incident whose fire was suppressed by a silence never
+                # paged — mark its resolution so delivery skips it too
+                # (a "resolved" for an unknown incident is pager noise).
+                suppressed = key in self._suppressed_fires
+                self._suppressed_fires.discard(key)
                 self.events.append(
                     {
                         "seq": self._event_seq,
                         "ts": now,
                         "state": "resolved",
                         **{**a, "desc": ""},
+                        **({"suppressed": True} if suppressed else {}),
                     }
                 )
 
         # Served buckets are the *held* view: pending-fire alerts aren't
-        # shown yet, held-resolving ones still are.
+        # shown yet, held-resolving ones still are. Silenced alerts move
+        # to their own list instead of a severity bucket.
+        for prefix in [p for p, until in self.silences.items() if until <= now]:
+            del self.silences[prefix]
+        # Re-fire: an alert whose "fired" event was suppressed and that is
+        # still active once no silence covers it gets a fresh timeline
+        # event — so it pages after the silence expires or is removed.
+        for key in sorted(self._suppressed_fires):
+            if key not in self._active_keys:
+                self._suppressed_fires.discard(key)
+            elif not self.is_silenced(key, now):
+                self._suppressed_fires.discard(key)
+                self._event_seq += 1
+                self.events.append(
+                    {
+                        "seq": self._event_seq,
+                        "ts": now,
+                        "state": "fired",
+                        **self._active_keys[key],
+                    }
+                )
         self._last_eval = {s: [] for s in SEVERITIES}
+        silenced: list[dict] = []
         for a in self._active_keys.values():
-            self._last_eval[a["severity"]].append(a)
+            if self.is_silenced(a["key"], now):
+                silenced.append(a)
+            else:
+                self._last_eval[a["severity"]].append(a)
+        self._last_silenced = silenced
         self._last_eval_ts = now
         return self._last_eval
+
+    # ------------- silences (Alertmanager-style mutes) --------------------
+
+    def silence(self, key_prefix: str, duration_s: float, now: float | None = None) -> float:
+        """Mute alerts whose key starts with ``key_prefix`` for
+        ``duration_s``; returns the expiry timestamp."""
+        now = time.time() if now is None else now
+        until = now + max(0.0, duration_s)
+        self.silences[key_prefix] = until
+        return until
+
+    def unsilence(self, key_prefix: str) -> bool:
+        return self.silences.pop(key_prefix, None) is not None
+
+    def is_silenced(self, key: str, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        return any(
+            key.startswith(p) for p, until in self.silences.items() if until > now
+        )
+
+    @property
+    def last_silenced(self) -> list[dict]:
+        return self._last_silenced
 
     def recent_events(self, n: int = 50) -> list[dict]:
         return list(self.events)[-n:][::-1]  # newest first
@@ -424,6 +490,8 @@ class AlertEngine:
             "events": list(self.events),
             "pending_fire": self._pending_fire,
             "pending_resolve": self._pending_resolve,
+            "silences": self.silences,
+            "suppressed_fires": sorted(self._suppressed_fires),
         }
 
     def load_state(self, state: dict) -> None:
@@ -436,6 +504,10 @@ class AlertEngine:
         )
         self._pending_fire = dict(state.get("pending_fire") or {})
         self._pending_resolve = dict(state.get("pending_resolve") or {})
+        self.silences = {
+            str(k): float(v) for k, v in (state.get("silences") or {}).items()
+        }
+        self._suppressed_fires = set(state.get("suppressed_fires") or [])
 
     @property
     def last(self) -> dict[str, list[dict]]:

@@ -230,6 +230,19 @@ class Sampler:
         if not new:
             return
         self._notified_seq = max(e.get("seq", 0) for e in new)
+        # Silenced *fires* stay on the timeline but must not page — the
+        # engine re-fires them as fresh events if they outlive the
+        # silence. Resolutions deliver even under a silence (close the
+        # loop for incidents that paged) unless the engine marked the
+        # whole incident suppressed (its fire never paged).
+        def deliverable(e: dict) -> bool:
+            if e.get("state") == "resolved":
+                return not e.get("suppressed")
+            return not self.engine.is_silenced(e.get("key", ""))
+
+        new = [e for e in new if deliverable(e)]
+        if not new:
+            return
         try:
             self.notifier.notify(new)
         except RuntimeError:

@@ -16,7 +16,11 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/history          curves (Prometheus or ring buffer); ?window=30m|3h|24h
                         selects the span (coarse ring tier beyond 30 min)
   /api/alerts           last alert evaluation (sampler-owned, not
-                        recomputed per request — fixes SURVEY §5.2)
+                        recomputed per request — fixes SURVEY §5.2),
+                        + silenced list and active silences
+  /api/silence          POST {"key": <prefix>, "duration": "1h"} mutes
+                        matching alerts (buckets + webhooks; timeline
+                        still records); /api/unsilence removes a mute
   /api/serving          JetStream/MaxText panels
   /api/topology         slice views
   /api/health           per-source health + self stats
@@ -46,6 +50,7 @@ import json
 import os
 import statistics
 import time
+import urllib.parse
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -174,10 +179,15 @@ class MonitorServer:
         }
 
     def _api_alerts(self) -> dict:
+        engine = self.sampler.engine
         return {
-            **self.sampler.engine.last,
-            "evaluated_at": self.sampler.engine.last_ts,
-            "events": self.sampler.engine.recent_events(50),
+            **engine.last,
+            "evaluated_at": engine.last_ts,
+            "events": engine.recent_events(50),
+            "silenced": engine.last_silenced,
+            "silences": [
+                {"key": k, "until": until} for k, until in sorted(engine.silences.items())
+            ],
         }
 
     def _api_serving(self) -> dict:
@@ -257,10 +267,34 @@ class MonitorServer:
         except ProfileBusy as e:
             raise HttpError(409, str(e))
 
+    def _handle_post(self, path: str, body: bytes) -> tuple[int, str, bytes]:
+        """POST routes: alert silences (Alertmanager-style mutes)."""
+        if path not in ("/api/silence", "/api/unsilence"):
+            raise HttpError(405, "method not allowed")
+        try:
+            data = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise HttpError(400, f"bad JSON body: {e}")
+        key = data.get("key")
+        if not key or not isinstance(key, str):
+            raise HttpError(400, 'body wants {"key": "<alert key prefix>", ...}')
+        if path == "/api/unsilence":
+            removed = self.sampler.engine.unsilence(key)
+            payload = {"unsilenced": key, "existed": removed}
+        else:
+            duration = parse_duration(data.get("duration", "1h"), default=-1.0)
+            if duration <= 0:
+                raise HttpError(400, f"bad duration {data.get('duration')!r}")
+            until = self.sampler.engine.silence(key, duration)
+            payload = {"silenced": key, "until": until}
+        return 200, "application/json", json.dumps(payload).encode()
+
     async def handle(
-        self, method: str, path: str, query: str = ""
+        self, method: str, path: str, query: str = "", body: bytes = b""
     ) -> tuple[int, str, bytes]:
         """Route a request; returns (status, content_type, body)."""
+        if method == "POST":
+            return self._handle_post(path, body)
         if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
             return 200, self._dashboard.content_type, self._dashboard.read()
         if path == "/logo.svg":
@@ -313,11 +347,24 @@ class MonitorServer:
                 method, target, _version = request_line.decode("latin-1").split()
             except ValueError:
                 return
-            # Drain headers (we don't need any for GET routing).
+            # Drain headers; Content-Length is the only one routing needs
+            # (POST bodies for the silence routes).
+            content_length = 0
+            origin = host_hdr = None
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
+                lower = line.lower()
+                if lower.startswith(b"content-length:"):
+                    try:
+                        content_length = int(line.split(b":", 1)[1])
+                    except ValueError:
+                        pass
+                elif lower.startswith(b"origin:"):
+                    origin = line.split(b":", 1)[1].strip().decode("latin-1")
+                elif lower.startswith(b"host:"):
+                    host_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
             # Query stripped from routing (monitor_server.js:250) but kept
             # for the routes that take parameters (/api/profile).
             path, _, query = target.partition("?")
@@ -331,7 +378,7 @@ class MonitorServer:
                 except (ConnectionError, asyncio.CancelledError, OSError):
                     pass
                 return
-            if method not in ("GET", "HEAD"):
+            if method not in ("GET", "HEAD", "POST"):
                 await self._respond(
                     writer,
                     405,
@@ -339,8 +386,29 @@ class MonitorServer:
                     json.dumps({"error": "method not allowed"}).encode(),
                 )
                 return
+            # CSRF guard for the state-mutating POST routes: a browser
+            # always sends Origin on cross-origin POSTs; reject any whose
+            # host differs from the Host we're being addressed as.
+            # Non-browser clients (curl, scripts) send no Origin and pass.
+            if method == "POST" and origin and host_hdr:
+                origin_host = urllib.parse.urlsplit(origin).netloc
+                if origin_host and origin_host != host_hdr:
+                    await self._respond(
+                        writer,
+                        403,
+                        "application/json",
+                        json.dumps(
+                            {"error": f"cross-origin POST from {origin} refused"}
+                        ).encode(),
+                    )
+                    return
+            req_body = b""
+            if method == "POST" and 0 < content_length <= 65536:
+                req_body = await asyncio.wait_for(
+                    reader.readexactly(content_length), timeout=10
+                )
             try:
-                status, ctype, body = await self.handle(method, path, query)
+                status, ctype, body = await self.handle(method, path, query, req_body)
             except HttpError as e:
                 status, ctype = e.status, "application/json"
                 body = json.dumps({"error": e.message}).encode()
@@ -360,7 +428,7 @@ class MonitorServer:
                 ).append(ms)
             if self.cfg.access_log:
                 print(f"{method} {path} {status} {ms:.2f}ms", flush=True)
-        except (asyncio.TimeoutError, ConnectionError):
+        except (asyncio.TimeoutError, ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
             try:
@@ -378,7 +446,7 @@ class MonitorServer:
             f"Content-Length: {len(body)}\r\n"
             # CORS parity with the reference (monitor_server.js:244-248)
             "Access-Control-Allow-Origin: *\r\n"
-            "Access-Control-Allow-Methods: GET, OPTIONS\r\n"
+            "Access-Control-Allow-Methods: GET, POST, OPTIONS\r\n"
             "Access-Control-Allow-Headers: Content-Type\r\n"
             "Connection: close\r\n"
             "\r\n"
