@@ -217,3 +217,33 @@ def test_cross_origin_post_refused():
     finally:
         loop.run_until_complete(server.stop())
         loop.close()
+
+
+def test_null_origin_post_refused():
+    # Regression: "Origin: null" (sandboxed iframe / data: URL) must be
+    # treated as cross-origin, not waved through.
+    from tests.test_server_api import serve, run_app
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    port = loop.run_until_complete(run_app(sampler, server))
+    try:
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/silence",
+                data=b'{"key": "y.", "duration": "1h"}',
+                headers={"Origin": "null"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status
+            except urllib.error.HTTPError as err:
+                return err.code
+
+        assert loop.run_until_complete(asyncio.to_thread(post)) == 403
+        assert "y." not in sampler.engine.silences
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()

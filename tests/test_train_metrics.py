@@ -1,0 +1,102 @@
+"""Training-metrics ingest: trainer /metrics -> collector -> panel data.
+
+The trainer (tpumon.loadgen.train) publishes tpumon_train_* families;
+the serving collector distills them into the training panel's fields
+(step, loss, step time, token rate, goodput, checkpoint step).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import urllib.request
+
+from tpumon.collectors.serving import (
+    ServingCollector,
+    distill_serving_metrics,
+)
+from tpumon.loadgen.train import TrainMetrics, start_metrics_server
+
+
+def test_train_metrics_text_shape():
+    m = TrainMetrics()
+    m.observe_step(0, 0.5, 512)
+    m.observe_step(1, 0.3, 512)
+    m.loss = 3.25
+    m.ckpt_step = 1
+    text = m.metrics_text()
+    assert "tpumon_train_step 1" in text
+    assert "tpumon_train_tokens_total 1024" in text
+    assert "tpumon_train_loss 3.25" in text
+    assert "tpumon_train_checkpoint_step 1" in text
+    assert "tpumon_train_goodput_pct" in text
+    # EMA moved from 0.5 toward 0.3.
+    assert "tpumon_train_step_time_seconds 0.48" in text
+
+
+def test_distill_train_fields_and_token_rate():
+    m = TrainMetrics()
+    m.observe_step(9, 0.4, 4096)
+    m.loss = 2.5
+    first = distill_serving_metrics(m.metrics_text(), now=1000.0)
+    assert first["train_step"] == 9
+    assert first["train_loss"] == 2.5
+    assert first["train_step_time_ms"] == 400.0
+    m.observe_step(10, 0.4, 4096)
+    second = distill_serving_metrics(m.metrics_text(), prev=first, now=1002.0)
+    assert second["train_tokens_per_sec"] == 4096 / 2.0
+
+
+def test_trainer_http_scrape_end_to_end():
+    m = TrainMetrics()
+    m.observe_step(3, 0.2, 256)
+    httpd, url = start_metrics_server(m, port=0)
+    try:
+        with urllib.request.urlopen(url) as r:
+            assert b"tpumon_train_step 3" in r.read()
+        collector = ServingCollector(targets=(url,))
+        sample = asyncio.run(collector.collect())
+        assert sample.ok
+        assert sample.data[0]["train_step"] == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_fake_trainer_target():
+    collector = ServingCollector(targets=("fake:trainer",))
+    sample = asyncio.run(collector.collect())
+    d = sample.data[0]
+    assert d["ok"] and d["train_step"] >= 0
+    assert d["train_loss"] > 0 and d["train_goodput_pct"] > 0
+
+
+def test_run_train_feeds_metrics():
+    import jax
+
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.train import TrainConfig, run_train
+
+    m = TrainMetrics()
+    cfg = TrainConfig(
+        model=ModelConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2,
+            d_ff=64, max_seq=16,
+        ),
+        steps=3, batch=2, seq=8,
+    )
+    out = run_train(cfg, mesh=None, metrics=m)
+    assert m.step == 2
+    assert m.tokens_total == 3 * 2 * 8
+    assert m.loss is not None and abs(m.loss - out["loss"]) < 1e-6
+    assert m.step_time_ema_s is not None and m.step_time_ema_s > 0
+
+
+def test_sentinel_gauges_omitted_before_first_step():
+    m = TrainMetrics()
+    text = m.metrics_text()
+    assert "tpumon_train_step " not in text
+    assert "tpumon_train_checkpoint_step" not in text
+    m.observe_step(0, 0.1, 64)
+    text = m.metrics_text()
+    assert "tpumon_train_step 0" in text
+    assert "tpumon_train_checkpoint_step" not in text  # no --ckpt-dir

@@ -145,7 +145,7 @@ def main(argv: list[str] | None = None) -> int:
                 {
                     "accel_backend": "fake:v5e-8",
                     "k8s_mode": "fake",
-                    "serving_targets": ["fake:jetstream"],
+                    "serving_targets": ["fake:jetstream", "fake:trainer"],
                     "expected_slice_chips": {"slice-0": 8},
                 }
             )

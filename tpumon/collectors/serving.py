@@ -61,6 +61,17 @@ SLOTS_GAUGES = (
     "jetstream_slots_available",
 )
 
+# Training-job telemetry (tpumon.loadgen.train publishes these; any
+# trainer exporting the same families joins the training panel).
+TRAIN_GAUGES = {
+    "train_step": "tpumon_train_step",
+    "train_loss": "tpumon_train_loss",
+    "train_goodput_pct": "tpumon_train_goodput_pct",
+    "train_ckpt_step": "tpumon_train_checkpoint_step",
+}
+TRAIN_STEP_TIME = "tpumon_train_step_time_seconds"
+TRAIN_TOKEN_COUNTER = "tpumon_train_tokens_total"
+
 
 def _sum_samples(by_name: dict, names: tuple[str, ...]) -> tuple[str, float] | None:
     for name in names:
@@ -128,6 +139,22 @@ def distill_serving_metrics(
     weights = _sum_samples(by_name, ("tpumon_serving_weight_bytes",))
     if weights:
         out["weight_bytes"] = weights[1]  # drops ~4x when served int8
+
+    # Training targets (tpumon_train_* families).
+    for field_name, metric in TRAIN_GAUGES.items():
+        got = _sum_samples(by_name, (metric,))
+        if got:
+            out[field_name] = got[1]
+    step_time = _sum_samples(by_name, (TRAIN_STEP_TIME,))
+    if step_time:
+        out["train_step_time_ms"] = step_time[1] * 1e3
+    train_tokens = _sum_samples(by_name, (TRAIN_TOKEN_COUNTER,))
+    if train_tokens:
+        out["train_tokens_total"] = train_tokens[1]
+        if prev and "train_tokens_total" in prev and prev["ts"] < now:
+            delta = train_tokens[1] - prev["train_tokens_total"]
+            if delta >= 0:
+                out["train_tokens_per_sec"] = delta / (now - prev["ts"])
     return out
 
 
@@ -162,6 +189,31 @@ jetstream_queue_size {queue}
 """
 
 
+def _fake_train_exposition(now: float | None = None) -> str:
+    """Synthetic trainer /metrics for demo mode: a 2k-step epoch loop
+    with decaying loss, steady step time, periodic checkpoints."""
+    import math
+
+    t = time.time() if now is None else now
+    step = int(t / 0.4) % 2000  # ~2.5 steps/s, "epoch" wraps
+    loss = 6.0 * math.exp(-step / 600) + 1.8 + 0.05 * math.sin(t / 7)
+    tokens = int(t * 1280)  # batch*seq per step at the same cadence
+    return f"""\
+# TYPE tpumon_train_step gauge
+tpumon_train_step {step}
+# TYPE tpumon_train_loss gauge
+tpumon_train_loss {loss:.4f}
+# TYPE tpumon_train_step_time_seconds gauge
+tpumon_train_step_time_seconds {0.4 + 0.02 * math.sin(t / 11):.4f}
+# TYPE tpumon_train_tokens_total counter
+tpumon_train_tokens_total {tokens}
+# TYPE tpumon_train_goodput_pct gauge
+tpumon_train_goodput_pct {92 + 4 * math.sin(t / 90):.2f}
+# TYPE tpumon_train_checkpoint_step gauge
+tpumon_train_checkpoint_step {max(0, (step // 100) * 100)}
+"""
+
+
 @dataclass
 class ServingCollector:
     targets: tuple[str, ...] = ()
@@ -170,6 +222,8 @@ class ServingCollector:
     _prev: dict[str, dict] = field(default_factory=dict)
 
     def _fetch(self, url: str) -> str:
+        if url == "fake:trainer":
+            return _fake_train_exposition()
         if url.startswith("fake:"):
             return _fake_exposition()
         if not url.startswith(("http://", "https://")):

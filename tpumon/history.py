@@ -39,6 +39,8 @@ PROM_QUERIES: dict[str, str] = {
     "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
     "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
+    "train_loss": "avg(tpumon_train_loss)",
+    "train_tokens_per_sec": "sum(rate(tpumon_train_tokens_total[1m]))",
 }
 
 

@@ -71,8 +71,88 @@ def synthetic_batch(cfg: TrainConfig, step: int) -> jax.Array:
     )
 
 
+class TrainMetrics:
+    """Live training telemetry, exposed as Prometheus text.
+
+    The trainer-side half of the monitor's training panel: step progress,
+    loss, amortized step time, token throughput and goodput (productive
+    step time over wall time — checkpoint saves and restore stalls are
+    the non-productive remainder). Updates are plain attribute writes
+    from the train loop; the HTTP scrape thread only formats them.
+    """
+
+    def __init__(self) -> None:
+        self.started = time.time()
+        self.step = -1
+        self.loss: float | None = None
+        self.step_time_ema_s: float | None = None
+        self.tokens_total = 0
+        self.ckpt_step = -1
+        self.productive_s = 0.0
+
+    def observe_step(self, step: int, dt_s: float, batch_tokens: int) -> None:
+        self.step = step
+        self.tokens_total += batch_tokens
+        self.productive_s += dt_s
+        ema = self.step_time_ema_s
+        self.step_time_ema_s = dt_s if ema is None else 0.9 * ema + 0.1 * dt_s
+
+    def metrics_text(self) -> str:
+        wall = max(1e-9, time.time() - self.started)
+        lines = [
+            "# TYPE tpumon_train_tokens_total counter",
+            f"tpumon_train_tokens_total {self.tokens_total}",
+            "# TYPE tpumon_train_goodput_pct gauge",
+            f"tpumon_train_goodput_pct {100.0 * min(1.0, self.productive_s / wall):.2f}",
+        ]
+        # -1 sentinels (no step yet / no checkpointing) are not data —
+        # omit the gauges so the panel shows its "–" placeholder.
+        if self.step >= 0:
+            lines += ["# TYPE tpumon_train_step gauge",
+                      f"tpumon_train_step {self.step}"]
+        if self.ckpt_step >= 0:
+            lines += ["# TYPE tpumon_train_checkpoint_step gauge",
+                      f"tpumon_train_checkpoint_step {self.ckpt_step}"]
+        if self.loss is not None:
+            lines += ["# TYPE tpumon_train_loss gauge",
+                      f"tpumon_train_loss {self.loss:.6f}"]
+        if self.step_time_ema_s is not None:
+            lines += ["# TYPE tpumon_train_step_time_seconds gauge",
+                      f"tpumon_train_step_time_seconds {self.step_time_ema_s:.6f}"]
+        return "\n".join(lines) + "\n"
+
+
+def start_metrics_server(metrics: TrainMetrics, port: int = 0):
+    """Serve ``metrics.metrics_text()`` on /metrics; returns (httpd, url)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = metrics.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_port}/metrics"
+
+
 def run_train(
-    cfg: TrainConfig, mesh: Mesh | None = None, log=lambda s: None
+    cfg: TrainConfig,
+    mesh: Mesh | None = None,
+    log=lambda s: None,
+    metrics: TrainMetrics | None = None,
 ) -> dict:
     """Run (or resume) the loop; returns {step, loss, resumed_from, ...}."""
     if mesh is None:
@@ -106,18 +186,31 @@ def run_train(
     t0 = time.perf_counter()
     tokens_seen = 0
     for step in range(start, cfg.steps):
+        t_step = time.perf_counter()
         tokens = synthetic_batch(cfg, step)
         if token_sharding is not None:
             tokens = jax.device_put(tokens, token_sharding)
         placed, loss_arr = step_fn(placed, tokens)
         tokens_seen += cfg.batch * cfg.seq
+        if metrics is not None:
+            # Loop dt amortizes to true step time once async dispatch
+            # saturates the device queue; loss syncs only on checkpoint
+            # steps below to keep the hot loop dispatch-only.
+            metrics.observe_step(
+                step, time.perf_counter() - t_step, cfg.batch * cfg.seq
+            )
         if cfg.ckpt_dir and (
             (step + 1) % cfg.ckpt_every == 0 or step == cfg.steps - 1
         ):
             jax.block_until_ready(placed)
             save_checkpoint(cfg.ckpt_dir, placed, step=step, cfg=cfg.model)
+            if metrics is not None:
+                metrics.ckpt_step = step
+                metrics.loss = float(loss_arr)
             log(f"step {step}: loss {float(loss_arr):.4f} (checkpointed)")
         loss = loss_arr
+    if metrics is not None and loss is not None:
+        metrics.loss = float(loss)
     jax.block_until_ready(placed)
     dt = time.perf_counter() - t0
     return {
@@ -139,6 +232,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=20)
+    ap.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="expose tpumon_train_* Prometheus metrics on this port "
+        "(0 = ephemeral); add the printed URL to tpumon's serving_targets",
+    )
     args = ap.parse_args(argv)
 
     cfg = TrainConfig(
@@ -149,9 +249,17 @@ def main(argv: list[str] | None = None) -> int:
         steps=args.steps, batch=args.batch, seq=args.seq,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
     )
-    out = run_train(cfg, log=print)
+    metrics = httpd = None
+    if args.metrics_port is not None:
+        metrics = TrainMetrics()
+        httpd, url = start_metrics_server(metrics, port=args.metrics_port)
+        print(f"train metrics at {url}")
+    out = run_train(cfg, log=print, metrics=metrics)
     out.pop("params")
     print(out)
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
     return 0
 
 

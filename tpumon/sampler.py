@@ -199,6 +199,18 @@ class Sampler:
         ]
         if ttfts:
             rec("ttft_p50_ms", sum(ttfts) / len(ttfts), ts)
+        losses = [
+            s["train_loss"] for s in serving if s.get("train_loss") is not None
+        ]
+        if losses:
+            rec("train_loss", sum(losses) / len(losses), ts)
+        train_tps = [
+            s["train_tokens_per_sec"]
+            for s in serving
+            if s.get("train_tokens_per_sec") is not None
+        ]
+        if train_tps:
+            rec("train_tokens_per_sec", sum(train_tps), ts)
 
     def _evaluate_alerts(self) -> None:
         # Pod rules only run on a healthy scrape: a failed scrape must not

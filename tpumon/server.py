@@ -391,8 +391,11 @@ class MonitorServer:
             # host differs from the Host we're being addressed as.
             # Non-browser clients (curl, scripts) send no Origin and pass.
             if method == "POST" and origin and host_hdr:
+                # "Origin: null" (sandboxed iframe, data: URL) and
+                # unparsable origins are cross-origin too — anything that
+                # is present but doesn't match Host is refused.
                 origin_host = urllib.parse.urlsplit(origin).netloc
-                if origin_host and origin_host != host_hdr:
+                if origin_host != host_hdr:
                     await self._respond(
                         writer,
                         403,
