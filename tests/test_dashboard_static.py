@@ -105,3 +105,11 @@ def test_topology_map_wired(script):
     assert "function renderTopo" in script
     assert "renderTopo(accel)" in script
     assert "tx_bps" in script and "coords" in script
+
+
+def test_per_chip_drilldown_wired(script, html):
+    """Per-chip ring series must be rendered, not just collected (the
+    reference's gpuTemp was fetched and never drawn — SURVEY §2.1)."""
+    assert "per_chip" in script
+    assert "openChipModal" in script and "closeChipModal" in script
+    assert 'id="chip-modal"' in html and 'id="c-chip"' in html

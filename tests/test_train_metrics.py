@@ -100,3 +100,29 @@ def test_sentinel_gauges_omitted_before_first_step():
     text = m.metrics_text()
     assert "tpumon_train_step 0" in text
     assert "tpumon_train_checkpoint_step" not in text  # no --ckpt-dir
+
+
+def test_exporter_reexports_train_series():
+    # The tpumon_train_* PROM_QUERIES re-keys must resolve against our own
+    # /metrics even when Prometheus doesn't scrape each trainer directly.
+    import asyncio as _asyncio
+
+    from tpumon.app import build
+    from tpumon.config import load_config
+    from tpumon.exporter import render_exporter
+
+    cfg = load_config(
+        env={
+            "TPUMON_ACCEL_BACKEND": "none",
+            "TPUMON_K8S_MODE": "none",
+            "TPUMON_COLLECTORS": "host,serving",
+            "TPUMON_SERVING_TARGETS": "fake:trainer",
+            "TPUMON_PORT": "0",
+        }
+    )
+    sampler, _ = build(cfg)
+    _asyncio.run(sampler.tick_serving())
+    text = render_exporter(sampler)
+    assert 'tpumon_monitor_train_step{target="fake:trainer"}' in text
+    assert 'tpumon_monitor_train_loss{target="fake:trainer"}' in text
+    assert "tpumon_monitor_train_tokens_total" in text

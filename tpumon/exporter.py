@@ -122,6 +122,32 @@ def render_exporter(sampler: Sampler) -> str:
                 ttft.add(labels, s["ttft_p50_ms"])
             if s.get("queue_depth") is not None:
                 queue.add(labels, s["queue_depth"])
+        # Training targets re-exported (one-stop Prometheus scrape when
+        # Prometheus doesn't reach each trainer directly). Distinct
+        # tpumon_monitor_train_* names: re-using the trainers' own
+        # tpumon_train_* names would double-count in deployments where
+        # Prometheus scrapes both; PROM_QUERIES prefers the direct series
+        # and falls back to these via PromQL `or`.
+        if any(s.get("train_step") is not None for s in serving):
+            step = w.gauge("tpumon_monitor_train_step", "Training step (re-exported)")
+            loss = w.gauge("tpumon_monitor_train_loss", "Training loss (re-exported)")
+            tokens = w.counter(
+                "tpumon_monitor_train_tokens_total", "Trained tokens (re-exported)"
+            )
+            goodput = w.gauge(
+                "tpumon_monitor_train_goodput_pct", "Training goodput percent"
+            )
+            for s in serving:
+                if s.get("train_step") is None:
+                    continue
+                labels = {"target": s.get("target", "")}
+                step.add(labels, s["train_step"])
+                if s.get("train_loss") is not None:
+                    loss.add(labels, s["train_loss"])
+                if s.get("train_tokens_total") is not None:
+                    tokens.add(labels, s["train_tokens_total"])
+                if s.get("train_goodput_pct") is not None:
+                    goodput.add(labels, s["train_goodput_pct"])
 
     # ---- self metrics ----
     samples = w.counter("tpumon_samples_total", "Collection attempts per source")

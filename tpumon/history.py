@@ -39,8 +39,13 @@ PROM_QUERIES: dict[str, str] = {
     "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
     "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
-    "train_loss": "avg(tpumon_train_loss)",
-    "train_tokens_per_sec": "sum(rate(tpumon_train_tokens_total[1m]))",
+    # Direct trainer series preferred; tpumon's re-export (distinct name,
+    # tpumon/exporter.py) is the fallback when Prometheus only scrapes us.
+    "train_loss": "avg(tpumon_train_loss) or avg(tpumon_monitor_train_loss)",
+    "train_tokens_per_sec": (
+        "sum(rate(tpumon_train_tokens_total[1m])) or "
+        "sum(rate(tpumon_monitor_train_tokens_total[1m]))"
+    ),
 }
 
 
