@@ -126,3 +126,68 @@ def test_exporter_reexports_train_series():
     assert 'tpumon_monitor_train_step{target="fake:trainer"}' in text
     assert 'tpumon_monitor_train_loss{target="fake:trainer"}' in text
     assert "tpumon_monitor_train_tokens_total" in text
+
+
+# ---------------- training-stall alert rule ----------------------------
+
+
+def _train_target(step, ok=True):
+    return [{"target": "t:9177", "ok": ok, "train_step": step}]
+
+
+def test_train_stall_alert_fires_and_clears():
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+
+    e = AlertEngine(Thresholds(train_stall_s=60))
+    e.evaluate(serving=_train_target(10), now=1000.0)
+    # Advancing step: healthy.
+    e.evaluate(serving=_train_target(11), now=1030.0)
+    assert e.last["serious"] == []
+    # Stuck for under the threshold: not yet.
+    out = e.evaluate(serving=_train_target(11), now=1080.0)
+    assert out["serious"] == []
+    # Stuck past the threshold: fires with the stuck duration.
+    out = e.evaluate(serving=_train_target(11), now=1095.0)
+    assert [a["key"] for a in out["serious"]] == ["train.t:9177.stalled"]
+    # Progress resumes: resolves.
+    out = e.evaluate(serving=_train_target(12), now=1100.0)
+    assert out["serious"] == []
+
+
+def test_train_stall_ignores_unreachable_and_disabled():
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+
+    e = AlertEngine(Thresholds(train_stall_s=60))
+    # Unreachable target: the scrape-failure rule owns it, not the stall
+    # rule (step field may be stale garbage).
+    e.evaluate(serving=_train_target(5, ok=False), now=1000.0)
+    e.evaluate(serving=_train_target(5, ok=False), now=2000.0)
+    assert all(a["key"] != "train.t:9177.stalled" for a in e.last["serious"])
+    # Disabled via threshold 0.
+    e2 = AlertEngine(Thresholds(train_stall_s=0))
+    e2.evaluate(serving=_train_target(5), now=1000.0)
+    out = e2.evaluate(serving=_train_target(5), now=9000.0)
+    assert out["serious"] == []
+
+
+def test_train_stall_clock_resets_after_outage():
+    # Regression: a trainer that recovers from an outage at the same step
+    # (checkpoint restart) must get a fresh observation window, not an
+    # instant stall page computed against the pre-outage timestamp.
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+
+    e = AlertEngine(Thresholds(train_stall_s=60))
+    e.evaluate(serving=_train_target(10), now=1000.0)
+    for t in (1100.0, 1500.0):  # 400s unreachable
+        e.evaluate(serving=_train_target(10, ok=False), now=t)
+    out = e.evaluate(serving=_train_target(10), now=1600.0)  # recovered
+    assert all(a["key"] != "train.t:9177.stalled" for a in out["serious"])
+    # But genuinely stuck after recovery still fires.
+    out = e.evaluate(serving=_train_target(10), now=1700.0)
+    assert any(a["key"] == "train.t:9177.stalled" for a in out["serious"])
+    # Vanished targets are pruned from the progress map.
+    e.evaluate(serving=[], now=1800.0)
+    assert e._train_progress == {}

@@ -80,6 +80,9 @@ class AlertEngine:
         # fire / first seen clear pending resolve.
         self._pending_fire: dict[str, float] = {}
         self._pending_resolve: dict[str, float] = {}
+        # Training-stall tracking: target -> (last seen step, ts it was
+        # first seen at that step).
+        self._train_progress: dict[str, tuple[float, float]] = {}
         # Silences: key-prefix -> expiry ts. A silenced alert keeps its
         # full lifecycle (state tracking, timeline) but is excluded from
         # the served severity buckets and from webhook delivery —
@@ -324,9 +327,47 @@ class AlertEngine:
 
     # ------------- serving rules (BASELINE config 4) ----------------------
 
-    def _serving_alerts(self, serving: list[dict] | None) -> list[Alert]:
+    def _serving_alerts(
+        self, serving: list[dict] | None, now: float
+    ) -> list[Alert]:
         alerts: list[Alert] = []
+        # Prune stall clocks for targets that vanished from the config —
+        # a target re-added later must start a fresh observation window.
+        current = {s.get("target") for s in serving or []}
+        for gone in [t for t in self._train_progress if t not in current]:
+            del self._train_progress[gone]
         for s in serving or []:
+            # Training-stall rule: the step counter is the job's
+            # heartbeat — a reachable trainer whose step stops advancing
+            # is wedged (hung collective, input starvation, stuck
+            # checkpoint write) even though its process scrapes fine.
+            target = s.get("target")
+            step = s.get("train_step")
+            if not s.get("ok"):
+                # Unreachable: the scrape-failure rule owns it. Drop the
+                # stall clock — a trainer that recovers at the same step
+                # (restart from checkpoint) must not page instantly.
+                self._train_progress.pop(target, None)
+            if s.get("ok") and step is not None and self.t.train_stall_s > 0:
+                prev = self._train_progress.get(target)
+                if prev is None or step != prev[0]:
+                    self._train_progress[target] = (step, now)
+                elif now - prev[1] >= self.t.train_stall_s:
+                    alerts.append(
+                        Alert(
+                            severity="serious",
+                            title=f"Training stalled on {target}",
+                            desc=f"Step counter stuck at {step:.0f} for "
+                            f"{now - prev[1]:.0f}s "
+                            f"(threshold {self.t.train_stall_s:.0f}s)",
+                            fix="Check the job's logs for a hung collective "
+                            "(a peer host down?), host-side input "
+                            "starvation, or a checkpoint write that never "
+                            "returned; restart from the last checkpoint "
+                            "if wedged.",
+                            key=f"train.{target}.stalled",
+                        )
+                    )
             if not s.get("ok"):
                 alerts.append(
                     Alert(
@@ -353,6 +394,7 @@ class AlertEngine:
         update_pod_state: bool = True,
         now: float | None = None,
     ) -> dict[str, list[dict]]:
+        now = time.time() if now is None else now
         alerts: list[Alert] = []
         alerts += self._host_alerts(host)
         # Attribution uses the freshest pod view available: this
@@ -366,8 +408,7 @@ class AlertEngine:
         alerts += self._slice_alerts(slices or [])
         if update_pod_state:
             alerts += self._pod_alerts(pods)
-        alerts += self._serving_alerts(serving)
-        now = time.time() if now is None else now
+        alerts += self._serving_alerts(serving, now)
         raw = {a.key: a.to_json() for a in alerts}
 
         # Fire side: a new condition becomes active once it has held for

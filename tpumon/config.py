@@ -86,6 +86,10 @@ class Thresholds:
     # job (serious).
     mxu_idle_pct: float = 5.0
     mxu_idle_hbm_gate_pct: float = 50.0
+    # A training target whose step counter hasn't advanced for this long
+    # is stalled (serious) — wedged collective, input starvation, or a
+    # checkpoint write that never returns. 0 disables.
+    train_stall_s: float = 120.0
     # Anti-flap holds (Prometheus "for" / "keep_firing_for" semantics):
     # a condition must hold fire_hold_s before the alert fires, and must
     # stay clear resolve_hold_s before it resolves. 0/0 = the reference's

@@ -41,6 +41,11 @@ PROM_QUERIES: dict[str, str] = {
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
     # Direct trainer series preferred; tpumon's re-export (distinct name,
     # tpumon/exporter.py) is the fallback when Prometheus only scrapes us.
+    # Limitation: PromQL `or` is all-or-nothing — in a mixed deployment
+    # where Prometheus reaches some trainers directly and others only via
+    # the re-export, the left side wins and re-export-only trainers drop
+    # out of the aggregate. Scrape uniformly (all direct or all via
+    # tpumon) for exact aggregates.
     "train_loss": "avg(tpumon_train_loss) or avg(tpumon_monitor_train_loss)",
     "train_tokens_per_sec": (
         "sum(rate(tpumon_train_tokens_total[1m])) or "
