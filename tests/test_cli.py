@@ -40,3 +40,119 @@ def test_cli_oneshot_exit_code():
     assert (
         asyncio.run(cli._run(watch=None, backend="fake:v5e-4")) == 0
     )
+
+
+def test_render_status_lines_alerts_and_targets():
+    from tpumon.cli import render_status_lines
+
+    alerts = {
+        "critical": [{"title": "HBM full", "desc": "chip-0 at 97%", "fix": "x"}],
+        "serious": [],
+        "minor": [{"title": "warm", "desc": "", "fix": ""}],
+        "silenced": [{"title": "muted"}],
+    }
+    serving = {
+        "targets": [
+            {"target": "js:9100", "ok": True, "tokens_per_sec": 1234.5,
+             "ttft_p50_ms": 42.0},
+            {"target": "trainer:9200", "ok": True, "train_step": 310.0,
+             "train_loss": 2.345, "train_goodput_pct": 91.0},
+            {"target": "dead:9300", "ok": False, "error": "connection refused"},
+        ]
+    }
+    lines = render_status_lines(alerts, serving)
+    text = "\n".join(lines)
+    assert "1🔴 0🟠 1🟡" in text and "(1 silenced)" in text
+    assert "[critical] HBM full: chip-0 at 97%" in text
+    assert "serve js:9100: 1234 tok/s · TTFT p50 42ms" in text
+    assert "train trainer:9200: step 310 · loss 2.345 · goodput 91%" in text
+    assert "target dead:9300: DOWN (connection refused)" in text
+
+
+def test_render_status_lines_empty():
+    from tpumon.cli import render_status_lines
+
+    assert render_status_lines(None, None) == []
+    assert render_status_lines({}, {"targets": []}) == []
+
+
+def test_remote_oneshot_against_live_server(capsys):
+    """--remote renders a running server's chips without local collectors."""
+    from tests.test_server_api import run_app, serve
+    from tpumon import cli
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    try:
+        port = loop.run_until_complete(run_app(sampler, server))
+        rc = loop.run_until_complete(
+            cli._run_remote(f"127.0.0.1:{port}", watch=None)
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert sum(1 for line in out.splitlines() if "chip-" in line) == 8
+        assert "alerts:" in out
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()
+
+
+def test_remote_unreachable_exits_nonzero(capsys):
+    from tpumon import cli
+
+    rc = asyncio.run(cli._run_remote("127.0.0.1:1", watch=None))
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().err
+
+
+def test_remote_partial_failure_reports_degraded(capsys):
+    """Endpoints that fail are named on stderr, not silently blank."""
+    import http.server
+    import json
+    import threading
+
+    from tpumon import cli
+
+    class HostOnly(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/api/host/metrics":
+                body = json.dumps({"cpu": {}, "memory": {}}).encode()
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(500)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), HostOnly)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        rc = asyncio.run(
+            cli._run_remote(f"127.0.0.1:{srv.server_address[1]}", watch=None)
+        )
+        assert rc == 0
+        cap = capsys.readouterr()
+        assert "no TPU chips visible" in cap.out
+        assert "[degraded:" in cap.err
+        assert "/api/accel/metrics: HTTPError" in cap.err
+    finally:
+        srv.shutdown()
+
+
+def test_remote_and_backend_mutually_exclusive(capsys):
+    from tpumon import cli
+
+    rc = cli.main(["--remote", "h:8888", "--backend", "fake:v5e-8"])
+    assert rc == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_remote_rejects_flag_shaped_url(capsys):
+    from tpumon import cli
+
+    rc = cli.main(["--remote", "--watch"])
+    assert rc == 2
+    assert "requires a tpumon URL" in capsys.readouterr().err

@@ -70,6 +70,99 @@ def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -
     return "\n".join(lines)
 
 
+def render_status_lines(alerts: dict | None, serving: dict | None) -> list[str]:
+    """Alert/serving/training summary lines for the remote view."""
+    lines: list[str] = []
+    if alerts:
+        n = {s: len(alerts.get(s) or []) for s in ("critical", "serious", "minor")}
+        silenced = len(alerts.get("silenced") or [])
+        line = f"alerts: {n['critical']}🔴 {n['serious']}🟠 {n['minor']}🟡"
+        if silenced:
+            line += f" ({silenced} silenced)"
+        lines.append(line)
+        for sev in ("critical", "serious"):
+            for a in alerts.get(sev) or []:
+                lines.append(f"  [{sev}] {a.get('title')}: {a.get('desc')}")
+    for t in (serving or {}).get("targets") or []:
+        if t.get("train_step") is not None:
+            loss = t.get("train_loss")
+            gp = t.get("train_goodput_pct")
+            lines.append(
+                f"train {t.get('target')}: step {t['train_step']:.0f}"
+                + (f" · loss {loss:.3f}" if loss is not None else "")
+                + (f" · goodput {gp:.0f}%" if gp is not None else "")
+            )
+        elif t.get("ok"):
+            tps = t.get("tokens_per_sec")
+            ttft = t.get("ttft_p50_ms")
+            lines.append(
+                f"serve {t.get('target')}:"
+                + (f" {tps:.0f} tok/s" if tps is not None else "")
+                + (f" · TTFT p50 {ttft:.0f}ms" if ttft is not None else "")
+            )
+        else:
+            # a down target carries no train_* fields, so we can't tell
+            # trainer from server here — keep the label neutral
+            lines.append(f"target {t.get('target')}: DOWN ({t.get('error')})")
+    return lines
+
+
+async def _run_remote(url: str, watch: float | None) -> int:
+    """Render a running tpumon server's view (no local collectors/jax)."""
+    import json
+    import urllib.request
+
+    from tpumon.collectors.accel_peers import chip_from_json, normalize_base_url
+
+    base = normalize_base_url(url)
+    failed: list[str] = []
+
+    def get(path: str) -> dict | None:
+        try:
+            with urllib.request.urlopen(f"{base}{path}", timeout=5) as r:
+                return json.load(r)
+        except Exception as e:
+            failed.append(f"{path}: {type(e).__name__}")
+            return None
+
+    first = True
+    while True:
+        failed.clear()
+        accel, host, alerts, serving = await asyncio.gather(
+            *(asyncio.to_thread(get, p) for p in (
+                "/api/accel/metrics", "/api/host/metrics",
+                "/api/alerts", "/api/serving",
+            ))
+        )
+        if accel is None and host is None:
+            print(f"tpumon at {base} unreachable", file=sys.stderr)
+            if first or not watch:
+                return 1
+            # transient failure mid-watch: keep polling, the server may
+            # be restarting (matches the local loop's degraded behavior)
+            await asyncio.sleep(watch)
+            continue
+        first = False
+        chips = [chip_from_json(c) for c in (accel or {}).get("chips") or []]
+        rates = {
+            c.get("chip"): {"tx_bps": c["tx_bps"]}
+            for c in (accel or {}).get("chips") or []
+            if c.get("tx_bps") is not None
+        }
+        if watch:
+            print("\x1b[2J\x1b[H", end="")
+            print(time.strftime("%H:%M:%S"), f"· tpumon info · {base}")
+        print(render(chips, host or {}, rates))
+        for line in render_status_lines(alerts, serving):
+            print(line)
+        if failed:
+            print(f"[degraded: {', '.join(sorted(failed))}]", file=sys.stderr)
+        sys.stdout.flush()
+        if not watch:
+            return 0
+        await asyncio.sleep(watch)
+
+
 async def _run(watch: float | None, backend: str | None) -> int:
     env = {"TPUMON_COLLECTORS": "host,accel"}
     if backend:
@@ -100,19 +193,35 @@ def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     watch = None
     backend = None
+    remote = None
     it = iter(argv)
     for a in it:
         if a in ("-w", "--watch"):
             watch = float(next(it, "1") or 1)
         elif a == "--backend":
             backend = next(it, None)
+        elif a == "--remote":
+            remote = next(it, None)
+            if not remote or remote.startswith("-"):
+                print("--remote requires a tpumon URL", file=sys.stderr)
+                return 2
         elif a in ("-h", "--help"):
-            print("usage: python -m tpumon.info [-w SECONDS] [--backend jax|fake:v5e-8]")
+            print(
+                "usage: python -m tpumon.info [-w SECONDS] "
+                "[--backend jax|fake:v5e-8] [--remote HOST:8888]\n"
+                "--remote renders a running tpumon server's view (chips, "
+                "alerts, serving/training) without local collectors"
+            )
             return 0
         else:
             print(f"unknown argument {a!r}", file=sys.stderr)
             return 2
+    if remote and backend:
+        print("--remote and --backend are mutually exclusive", file=sys.stderr)
+        return 2
     try:
+        if remote:
+            return asyncio.run(_run_remote(remote, watch))
         return asyncio.run(_run(watch, backend))
     except KeyboardInterrupt:
         return 0

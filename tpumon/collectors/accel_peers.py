@@ -27,6 +27,12 @@ from tpumon.collectors import Collector, Sample
 from tpumon.topology import ChipSample
 
 
+def normalize_base_url(url: str) -> str:
+    """`host:port` or full URL → scheme-qualified base with no trailing slash."""
+    base = url if url.startswith(("http://", "https://")) else f"http://{url}"
+    return base.rstrip("/")
+
+
 def chip_from_json(d: dict) -> ChipSample:
     """Inverse of ChipSample.to_json (hbm_pct and rates are derived)."""
     return ChipSample(
@@ -57,9 +63,9 @@ class PeerFederatedCollector:
     last_peer_status: dict[str, str] = field(default_factory=dict)
 
     def _fetch_peer(self, url: str) -> list[dict]:
-        base = url if url.startswith(("http://", "https://")) else f"http://{url}"
+        base = normalize_base_url(url)
         with urllib.request.urlopen(
-            f"{base.rstrip('/')}/api/accel/metrics", timeout=self.timeout_s
+            f"{base}/api/accel/metrics", timeout=self.timeout_s
         ) as r:
             return json.load(r).get("chips", [])
 
