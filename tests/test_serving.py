@@ -35,6 +35,20 @@ def test_distill_jetstream():
     assert "tokens_per_sec" not in d  # no previous sample yet
 
 
+def test_distill_spec_acceptance():
+    text = JETSTREAM_TEXT + (
+        "# TYPE tpumon_serving_spec_proposed counter\n"
+        "tpumon_serving_spec_proposed 200\n"
+        "# TYPE tpumon_serving_spec_accepted counter\n"
+        "tpumon_serving_spec_accepted 150\n"
+    )
+    d = distill_serving_metrics(text, now=1000.0)
+    assert d["spec_accept_pct"] == 75.0
+    # Absent (or zero-proposal) spec counters must not emit the field.
+    assert "spec_accept_pct" not in distill_serving_metrics(
+        JETSTREAM_TEXT, now=1000.0)
+
+
 def test_counter_rates_between_scrapes():
     prev = distill_serving_metrics(JETSTREAM_TEXT, now=1000.0)
     later = JETSTREAM_TEXT.replace("50000", "53000").replace("420", "440")

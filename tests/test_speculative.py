@@ -1,0 +1,188 @@
+"""Speculative decoding tests (tpumon.loadgen.speculative).
+
+The load-bearing invariant: under greedy decoding, speculative output is
+IDENTICAL to plain decode no matter how good or bad the draft model is —
+only the dispatch count changes. Both directions are pinned: a perfect
+draft (self-speculation) accepts everything, a mismatched draft still
+produces the same tokens.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpumon.loadgen.model import ModelConfig, init_params
+from tpumon.loadgen.serving import (
+    ServeConfig,
+    ServingEngine,
+    decode_step,
+    init_cache,
+    prefill,
+)
+from tpumon.loadgen.speculative import decode_block, greedy_accept_len
+
+# float32 compute so plain and speculative paths argmax identically
+# (bfloat16 reassociation across different dispatch shapes could flip
+# near-ties and make the equality tests flaky).
+SMALL = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=64,
+                    compute_dtype="float32")
+
+
+def _prefilled(cfg: ServeConfig, params, prompts):
+    cache = init_cache(cfg)
+    for slot, prompt in enumerate(prompts):
+        toks = jnp.asarray(
+            prompt + [0] * (cfg.prefill_len - len(prompt)), jnp.int32)
+        cache, _ = prefill(cfg, params, cache, toks,
+                           jnp.int32(len(prompt)), jnp.int32(slot))
+    return cache
+
+
+class TestDecodeBlock:
+    def test_t1_matches_decode_step(self):
+        cfg = ServeConfig(model=SMALL, slots=2, prefill_len=8)
+        params = init_params(SMALL, jax.random.PRNGKey(0))
+        prompts = [[3, 5, 7], [11, 13, 17, 19]]
+        cache_a = _prefilled(cfg, params, prompts)
+        cache_b = jax.tree.map(jnp.copy, cache_a)
+        feed = jnp.asarray([21, 23], jnp.int32)
+        pos = jnp.asarray([3, 4], jnp.int32)
+        _, la = decode_step(cfg, params, cache_a, feed, pos)
+        _, lb = decode_block(cfg, params, cache_b, feed[:, None], pos)
+        assert jnp.allclose(la, lb[:, 0], atol=1e-5)
+
+    def test_block_matches_sequential_steps(self):
+        """T sequential decode_steps == one decode_block over the same
+        tokens: identical logits at every position and identical cache."""
+        cfg = ServeConfig(model=SMALL, slots=2, prefill_len=8)
+        params = init_params(SMALL, jax.random.PRNGKey(1))
+        prompts = [[2, 4, 6, 8], [10, 12]]
+        cache_seq = _prefilled(cfg, params, prompts)
+        cache_blk = jax.tree.map(jnp.copy, cache_seq)
+        tokens = jnp.asarray([[30, 31, 32], [40, 41, 42]], jnp.int32)
+        pos0 = jnp.asarray([4, 2], jnp.int32)
+
+        seq_logits = []
+        for t in range(3):
+            cache_seq, lg = decode_step(
+                cfg, params, cache_seq, tokens[:, t], pos0 + t)
+            seq_logits.append(lg)
+        cache_blk, blk_logits = decode_block(
+            cfg, params, cache_blk, tokens, pos0)
+        for t in range(3):
+            assert jnp.allclose(seq_logits[t], blk_logits[:, t], atol=1e-4)
+        for name in ("k", "v"):
+            assert jnp.allclose(
+                cache_seq[name], cache_blk[name], atol=1e-5)
+
+
+def _engine_outputs(prompts, max_new=12, **cfg_kw):
+    eng = ServingEngine(cfg=ServeConfig(
+        model=SMALL, slots=2, prefill_len=8, **cfg_kw))
+    reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    return eng, [r.output for r in reqs]
+
+
+PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7], [2, 7]]
+
+
+class TestSpeculativeEngine:
+    def test_self_speculation_matches_plain_and_accepts_all(self):
+        _, plain = _engine_outputs(PROMPTS)
+        eng, spec = _engine_outputs(PROMPTS, spec_len=4)
+        assert spec == plain
+        assert eng.spec_rounds_total > 0
+        # Perfect draft: every proposal accepted, so rounds shrink by
+        # ~spec_len+1 vs plain's one-token steps.
+        assert eng.spec_accepted_total == eng.spec_proposed_total
+
+    def test_weak_draft_is_still_lossless(self):
+        draft = dataclasses.replace(SMALL, n_layers=1, d_ff=64)
+        _, plain = _engine_outputs(PROMPTS)
+        eng, spec = _engine_outputs(PROMPTS, spec_len=3, draft_model=draft)
+        assert spec == plain  # the speculative-decoding contract
+        assert eng.spec_proposed_total > 0
+        assert eng.spec_accepted_total <= eng.spec_proposed_total
+
+    def test_fewer_target_dispatches_than_plain(self):
+        eng_plain, _ = _engine_outputs(PROMPTS, max_new=16)
+        eng_spec, _ = _engine_outputs(PROMPTS, max_new=16, spec_len=4)
+        assert eng_spec.decode_steps_total < eng_plain.decode_steps_total
+
+    def test_temperature_slot_in_spec_batch(self):
+        eng = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8, spec_len=3))
+        greedy = eng.submit([3, 1, 4], max_new=8)
+        sampled = eng.submit([9, 2, 6], max_new=8, temperature=0.8,
+                             top_k=16)
+        eng.drain()
+        assert len(greedy.output) == 9 and len(sampled.output) == 9
+        assert all(0 <= t < SMALL.vocab for t in sampled.output)
+        # Greedy slot still matches the plain-engine result even when a
+        # sampling request shares its batch.
+        _, plain = _engine_outputs([[3, 1, 4]], max_new=8)
+        assert greedy.output == plain[0]
+
+    def test_all_temperature_batch_skips_spec_rounds(self):
+        """Spec rounds for temperature-only batches are pure overhead
+        (zero drafts acceptable) — the engine must fall back to plain."""
+        eng = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8, spec_len=3))
+        eng.submit([3, 1, 4], max_new=6, temperature=0.9)
+        eng.submit([9, 2, 6], max_new=6, temperature=0.7)
+        eng.drain()
+        assert eng.spec_rounds_total == 0
+
+    def test_draft_catchup_after_plain_fallback(self):
+        """Plain-step fallbacks advance the sequence without the draft
+        cache; when spec rounds resume the draft must be caught up or
+        self-speculation acceptance silently collapses."""
+        eng = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8, spec_len=3))
+        greedy = eng.submit([3, 1, 4, 1], max_new=20)
+        # Force plain fallbacks directly, then let spec rounds resume.
+        for _ in range(4):
+            eng._admit()
+            active = [s for s in range(eng.cfg.slots) if eng._slots[s]]
+            eng._plain_step(active)
+        assert eng._draft_pos[0] < eng._host_positions[0]  # hole exists
+        eng.drain()
+        assert greedy.done.is_set()
+        assert eng.spec_rounds_total > 0
+        # Self-speculating draft, so after catch-up every proposal must
+        # still be accepted — catch-up failure would show up right here.
+        assert eng.spec_accepted_total == eng.spec_proposed_total
+        _, plain = _engine_outputs([[3, 1, 4, 1]], max_new=20)
+        assert greedy.output == plain[0]
+
+    def test_draft_vocab_mismatch_rejected(self):
+        bad = dataclasses.replace(SMALL, vocab=SMALL.vocab * 2)
+        with pytest.raises(ValueError, match="vocab"):
+            ServingEngine(cfg=ServeConfig(
+                model=SMALL, slots=2, prefill_len=8, spec_len=2,
+                draft_model=bad))
+
+    def test_negative_spec_len_rejected(self):
+        with pytest.raises(ValueError, match="spec_len"):
+            ServingEngine(cfg=ServeConfig(
+                model=SMALL, slots=2, prefill_len=8, spec_len=-1))
+
+    def test_spec_metrics_exported(self):
+        eng, _ = _engine_outputs(PROMPTS, spec_len=4)
+        text = eng.metrics_text()
+        assert "tpumon_serving_spec_rounds" in text
+        assert "tpumon_serving_spec_proposed" in text
+        assert "tpumon_serving_spec_accepted" in text
+
+
+def test_greedy_accept_len():
+    assert greedy_accept_len([1, 2, 3], [1, 2, 3, 9]) == 3
+    assert greedy_accept_len([1, 2, 3], [1, 9, 3, 9]) == 1
+    assert greedy_accept_len([1, 2, 3], [9, 9, 9, 9]) == 0
+    assert greedy_accept_len([], [7]) == 0

@@ -139,6 +139,12 @@ def distill_serving_metrics(
     weights = _sum_samples(by_name, ("tpumon_serving_weight_bytes",))
     if weights:
         out["weight_bytes"] = weights[1]  # drops ~4x when served int8
+    # Speculative decoding acceptance (tpumon.loadgen.speculative):
+    # lifetime ratio of draft tokens the target verify accepted.
+    spec_prop = _sum_samples(by_name, ("tpumon_serving_spec_proposed",))
+    spec_acc = _sum_samples(by_name, ("tpumon_serving_spec_accepted",))
+    if spec_prop and spec_prop[1] > 0 and spec_acc:
+        out["spec_accept_pct"] = 100.0 * spec_acc[1] / spec_prop[1]
 
     # Training targets (tpumon_train_* families).
     for field_name, metric in TRAIN_GAUGES.items():

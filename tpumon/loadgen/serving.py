@@ -62,6 +62,14 @@ class ServeConfig:
     # Weight-only quantization: None (compute dtype) or "int8"
     # (tpumon.loadgen.quant — halves decode's HBM weight traffic vs bf16).
     quantize: str | None = None
+    # Speculative decoding (tpumon.loadgen.speculative): propose spec_len
+    # draft tokens per round, verify them in one target dispatch. 0 = off.
+    # draft_model None = self-speculation (draft shares target weights —
+    # 100% acceptance; the correctness/demo mode). Greedy output matches
+    # plain decode regardless of draft quality (see
+    # tpumon.loadgen.speculative on bf16 argmax near-ties).
+    spec_len: int = 0
+    draft_model: ModelConfig | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -165,45 +173,15 @@ def decode_step(cfg: ServeConfig, params: dict, cache: dict,
     length per slot). Returns (cache, logits [B, vocab]) for the next
     token. Inactive slots compute garbage that the host ignores; their
     cache writes land on a stale row and are rewritten on admit.
+
+    The T == 1 case of ``speculative.decode_block`` — one layer body,
+    no drift between the plain and speculative paths.
     """
-    m = cfg.model
-    dt = jnp.dtype(m.compute_dtype)
-    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
-    b = positions.shape[0]
-    x = params["embed"].astype(dt)[last_tokens][:, None]  # [B, 1, D]
-    pos = positions[:, None]  # [B, 1]
-    row = jnp.arange(m.max_seq, dtype=jnp.int32)
-    mask = (row[None] <= positions[:, None])[:, None, None]  # [B,1,1,S]
+    from tpumon.loadgen.speculative import decode_block
 
-    def append(cache_l: jax.Array, kv: jax.Array, p: jax.Array) -> jax.Array:
-        # cache_l: [S, nkv, hd]; kv: [1, nkv, hd] — per-slot row write.
-        return lax.dynamic_update_slice(cache_l, kv, (p, 0, 0))
-
-    for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["attn_norm"])
-        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(b, 1, nh, hd),
-                     pos, m.rope_theta)
-        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, 1, nkv, hd),
-                     pos, m.rope_theta)
-        v = (h @ layer["wv"].astype(dt)).reshape(b, 1, nkv, hd)
-        new_k = jax.vmap(append)(cache["k"][li], k, positions)
-        new_v = jax.vmap(append)(cache["v"][li], v, positions)
-        cache["k"] = cache["k"].at[li].set(new_k)
-        cache["v"] = cache["v"].at[li].set(new_v)
-        kr, vr = _gqa_repeat(new_k, nh), _gqa_repeat(new_v, nh)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
-        scores = scores / (hd**0.5)
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, 1, nh * hd)
-        x = x + att @ layer["wo"].astype(dt)
-        hm = _rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
-        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
-            "w_down"].astype(dt)
-    x = _rms_norm(x, params["final_norm"])
-    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
-    return cache, logits
+    cache, logits = decode_block(cfg, params, cache,
+                                 last_tokens[:, None], positions)
+    return cache, logits[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -317,7 +295,8 @@ class ServingEngine:
     def __init__(self, cfg: ServeConfig | None = None,
                  params: dict | None = None, seed: int = 0,
                  max_queue: int = 64, ckpt_dir: str | None = None,
-                 quantize: str | None = None):
+                 quantize: str | None = None,
+                 draft_params: dict | None = None):
         if cfg is None and ckpt_dir:
             # No explicit config: adopt the checkpoint's own architecture
             # so --loadgen-ckpt serves the trained weights instead of
@@ -372,10 +351,52 @@ class ServingEngine:
                                 donate_argnums=(1,))
         self._decode = jax.jit(partial(decode_step, self.cfg),
                                donate_argnums=(1,))
+        # Speculative decoding state (after quantization so a self-
+        # speculating draft shares the quantized weights, not a second
+        # f32 copy).
+        self.spec_len = self.cfg.spec_len
+        if self.spec_len < 0:
+            raise ValueError(f"spec_len must be >= 0, got {self.spec_len}")
+        if self.spec_len:
+            from tpumon.loadgen.speculative import decode_block
+
+            dm = self.cfg.draft_model or m
+            if dm.vocab != m.vocab or dm.max_seq != m.max_seq:
+                raise ValueError(
+                    "draft_model must share vocab and max_seq with the "
+                    f"target (draft {dm.vocab}/{dm.max_seq} vs "
+                    f"target {m.vocab}/{m.max_seq})")
+            self._draft_scfg = ServeConfig(
+                model=dm, slots=self.cfg.slots,
+                prefill_len=self.cfg.prefill_len)
+            if draft_params is not None:
+                self.draft_params = draft_params
+            elif self.cfg.draft_model is None:
+                self.draft_params = self.params  # self-speculation
+            else:
+                self.draft_params = init_params(
+                    dm, jax.random.PRNGKey(seed + 1))
+            self._draft_prefill = jax.jit(
+                partial(prefill, self._draft_scfg), donate_argnums=(1,))
+            self._draft_decode = jax.jit(
+                partial(decode_step, self._draft_scfg), donate_argnums=(1,))
+            self._verify = jax.jit(
+                partial(decode_block, self.cfg), donate_argnums=(1,))
+            self.draft_cache = init_cache(self._draft_scfg)
+            # Per-slot draft cache write frontier: rows < _draft_pos[s]
+            # hold valid K/V of the true sequence. Falls behind the
+            # target position when plain-step fallbacks run (they never
+            # touch the draft cache); _spec_round catches it up before
+            # proposing so acceptance doesn't silently collapse.
+            self._draft_pos = [0] * self.cfg.slots
+        self.spec_rounds_total = 0
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
         self.cache = init_cache(self.cfg)
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
         self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self._host_last = [0] * self.cfg.slots  # mirror of last_tokens
         # Per-slot sampling settings (device-resident; updated on admit).
         self.temps = jnp.zeros((self.cfg.slots,), jnp.float32)
         self.topks = jnp.zeros((self.cfg.slots,), jnp.int32)
@@ -450,6 +471,13 @@ class ServingEngine:
                 self.cache, logits = self._prefill(
                     self.params, self.cache, toks, jnp.int32(ln),
                     jnp.int32(slot), jnp.int32(c0))
+                if self.spec_len:
+                    # Draft needs the prompt's K/V too — same chunks.
+                    self.draft_cache, _ = self._draft_prefill(
+                        self.draft_params, self.draft_cache, toks,
+                        jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
+            if self.spec_len:
+                self._draft_pos[slot] = n
             self._sample_ctr += 1
             first = int(sample_tokens(
                 logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
@@ -464,6 +492,7 @@ class ServingEngine:
             self.positions = self.positions.at[slot].set(n)
             self._host_positions[slot] = n
             self.last_tokens = self.last_tokens.at[slot].set(first)
+            self._host_last[slot] = first
             self.temps = self.temps.at[slot].set(req.temperature)
             self.topks = self.topks.at[slot].set(req.top_k)
             if len(req.output) >= req.max_new + 1:  # max_new == 0
@@ -478,37 +507,165 @@ class ServingEngine:
         req.done.set()
 
     def step(self) -> bool:
-        """Admit + one decode step; returns True if any work remains."""
+        """Admit + one decode step (plain or speculative round);
+        returns True if any work remains."""
         self._admit()
         active = [s for s in range(self.cfg.slots) if self._slots[s]]
         if active:
-            self.cache, logits = self._decode(
-                self.params, self.cache, self.last_tokens, self.positions)
-            self._sample_ctr += 1
-            nxt = sample_tokens(logits, self._sample_key,
-                                jnp.uint32(self._sample_ctr),
-                                self.temps, self.topks)
-            self.last_tokens = nxt
-            self.positions = jnp.minimum(
-                self.positions + 1, self.cfg.model.max_seq - 1)
-            # ONE host-device sync per step; positions tracked host-side.
-            nxt_host = jax.device_get(nxt).tolist()
-            with self._lock:
-                self.decode_steps_total += 1
-                self.tokens_total += len(active)
-            for slot in active:
-                req = self._slots[slot]
-                req.output.append(nxt_host[slot])
-                self._host_positions[slot] = min(
-                    self._host_positions[slot] + 1,
-                    self.cfg.model.max_seq - 1)
-                if (len(req.output) >= req.max_new + 1
-                        or self._host_positions[slot]
-                        >= self.cfg.model.max_seq - 1):
-                    self._complete(slot)
+            # Speculative round needs room for spec_len+1 cache rows in
+            # every active slot, and at least one greedy slot to profit
+            # (temperature slots accept zero drafts — a spec round for
+            # them alone is strictly slower than plain decode).
+            if (
+                self.spec_len
+                and any(self._slots[s].temperature <= 0 for s in active)
+                and all(
+                    self._host_positions[s]
+                    <= self.cfg.model.max_seq - 2 - self.spec_len
+                    for s in active
+                )
+            ):
+                self._spec_round(active)
+            else:
+                self._plain_step(active)
         with self._lock:
             pending = bool(self._queue)
         return pending or any(s is not None for s in self._slots)
+
+    def _plain_step(self, active: list[int]) -> None:
+        self.cache, logits = self._decode(
+            self.params, self.cache, self.last_tokens, self.positions)
+        self._sample_ctr += 1
+        nxt = sample_tokens(logits, self._sample_key,
+                            jnp.uint32(self._sample_ctr),
+                            self.temps, self.topks)
+        self.last_tokens = nxt
+        self.positions = jnp.minimum(
+            self.positions + 1, self.cfg.model.max_seq - 1)
+        # ONE host-device sync per step; positions tracked host-side.
+        nxt_host = jax.device_get(nxt).tolist()
+        self._host_last = list(nxt_host)
+        with self._lock:
+            self.decode_steps_total += 1
+            self.tokens_total += len(active)
+        for slot in active:
+            req = self._slots[slot]
+            req.output.append(nxt_host[slot])
+            self._host_positions[slot] = min(
+                self._host_positions[slot] + 1,
+                self.cfg.model.max_seq - 1)
+            if (len(req.output) >= req.max_new + 1
+                    or self._host_positions[slot]
+                    >= self.cfg.model.max_seq - 1):
+                self._complete(slot)
+
+    def _seq_token(self, req: Request, i: int) -> int:
+        """Token at sequence index ``i``: prompt, then emitted output."""
+        n = len(req.prompt)
+        return req.prompt[i] if i < n else req.output[i - n]
+
+    def _spec_round(self, active: list[int]) -> None:
+        """One speculative round: spec_len draft steps + one verify
+        dispatch; accept the longest agreed prefix per greedy slot plus
+        the target's bonus token. Temperature>0 slots emit one sampled
+        token from the verified logits (== plain decode for them)."""
+        g = self.spec_len
+        # Catch the draft cache up to the target frontier first:
+        # plain-step fallbacks advance the sequence without touching the
+        # draft cache, and proposing over those K/V holes would degrade
+        # acceptance for the rest of the request.
+        deficit = max(
+            self._host_positions[s] - self._draft_pos[s] for s in active)
+        for d in range(deficit):
+            toks, rows = [], []
+            for s in range(self.cfg.slots):
+                req = self._slots[s]
+                p_s = self._host_positions[s]
+                f = self._draft_pos[s] + d
+                if req is not None and f < p_s:
+                    toks.append(self._seq_token(req, f))
+                    rows.append(f)
+                else:
+                    # Caught-up or empty slot: rewrite the row the
+                    # proposal loop writes first anyway — idempotent.
+                    toks.append(self._host_last[s])
+                    rows.append(p_s)
+            self.draft_cache, _ = self._draft_decode(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(toks, jnp.int32), jnp.asarray(rows, jnp.int32))
+        dt_tok = self.last_tokens
+        dpos = self.positions
+        drafts = []
+        for _ in range(g):
+            self.draft_cache, dlogits = self._draft_decode(
+                self.draft_params, self.draft_cache, dt_tok, dpos)
+            dt_tok = jnp.argmax(dlogits, axis=-1).astype(jnp.int32)
+            drafts.append(dt_tok)
+            dpos = dpos + 1
+        # One extra draft step feeding the last proposal: when all g
+        # drafts are accepted the sequence includes d_g, whose K/V the
+        # proposal loop never wrote — without this the draft cache has a
+        # hole at row p+g and every later draft round degrades. The
+        # proposal it returns is discarded; if acceptance stops short the
+        # row is stale-but-masked like any rejected row.
+        self.draft_cache, _ = self._draft_decode(
+            self.draft_params, self.draft_cache, dt_tok, dpos)
+        proposed = jnp.stack(drafts, axis=1)  # [B, g]
+        ver_in = jnp.concatenate(
+            [self.last_tokens[:, None], proposed], axis=1)  # [B, g+1]
+        self.cache, vlogits = self._verify(
+            self.params, self.cache, ver_in, self.positions)
+        tgt = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, g+1]
+        # The sampling dispatch (full-vocab sort for top-k) only pays
+        # off when a temperature slot shares the batch; all-greedy
+        # rounds take tgt_h directly.
+        any_temp = any(self._slots[s].temperature > 0 for s in active)
+        if any_temp:
+            self._sample_ctr += 1
+            samp0 = sample_tokens(vlogits[:, 0], self._sample_key,
+                                  jnp.uint32(self._sample_ctr),
+                                  self.temps, self.topks)
+            # ONE host-device sync per round.
+            prop_h, tgt_h, samp_h = (
+                a.tolist() for a in jax.device_get((proposed, tgt, samp0)))
+        else:
+            prop_h, tgt_h = (
+                a.tolist() for a in jax.device_get((proposed, tgt)))
+            samp_h = None
+        emitted_n = 0
+        accepted_n = 0
+        proposed_n = 0  # greedy slots only: temp slots can't accept
+        for slot in active:
+            req = self._slots[slot]
+            if req.temperature > 0:
+                a = 0
+                emitted = [samp_h[slot]]
+            else:
+                from tpumon.loadgen.speculative import greedy_accept_len
+
+                a = greedy_accept_len(prop_h[slot], tgt_h[slot])
+                emitted = prop_h[slot][:a] + [tgt_h[slot][a]]
+                proposed_n += g
+            accepted_n += a
+            room = req.max_new + 1 - len(req.output)
+            emitted = emitted[:room]  # room >= 1: full slots completed
+            req.output.extend(emitted)
+            self._host_positions[slot] += len(emitted)
+            self._host_last[slot] = emitted[-1]
+            self._draft_pos[slot] = self._host_positions[slot]
+            emitted_n += len(emitted)
+            if (len(req.output) >= req.max_new + 1
+                    or self._host_positions[slot]
+                    >= self.cfg.model.max_seq - 1):
+                self._complete(slot)
+        self.positions = jnp.asarray(self._host_positions, jnp.int32)
+        self.last_tokens = jnp.asarray(self._host_last, jnp.int32)
+        with self._lock:
+            self.decode_steps_total += 1
+            self.spec_rounds_total += 1
+            self.spec_proposed_total += proposed_n
+            self.spec_accepted_total += accepted_n
+            self.tokens_total += emitted_n
 
     def drain(self, max_steps: int = 10_000) -> None:
         for _ in range(max_steps):
@@ -529,6 +686,9 @@ class ServingEngine:
             inf = self._ttft_inf
             ttft_sum = self._ttft_sum
             free = sum(1 for s in self._slots if s is None)
+            spec_rounds = self.spec_rounds_total
+            spec_proposed = self.spec_proposed_total
+            spec_accepted = self.spec_accepted_total
         w = MetricsWriter()
         w.counter("jetstream_generate_tokens",
                   "tokens generated (prefill first-token + decode)"
@@ -551,6 +711,14 @@ class ServingEngine:
         w.gauge("tpumon_serving_weight_bytes",
                 "resident model weight bytes (int8 when quantized)"
                 ).add(value=param_bytes(self.params))
+        w.counter("tpumon_serving_spec_rounds",
+                  "speculative decode rounds (0 when disabled)"
+                  ).add(value=spec_rounds)
+        w.counter("tpumon_serving_spec_proposed",
+                  "draft tokens proposed").add(value=spec_proposed)
+        w.counter("tpumon_serving_spec_accepted",
+                  "draft tokens the target verify accepted"
+                  ).add(value=spec_accepted)
         lines = [w.render().rstrip("\n")]
         lines.append("# TYPE jetstream_time_to_first_token histogram")
         cum = 0
@@ -665,12 +833,27 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--duration", type=float, default=0.0,
                     help="seconds to run; 0 = forever")
+    ap.add_argument("--spec-len", type=int, default=0,
+                    help="speculative decoding: draft tokens per round "
+                         "(0 = off)")
+    ap.add_argument("--spec-draft-layers", type=int, default=0,
+                    help="draft model layer count (0 = self-speculation: "
+                         "draft shares the target weights)")
     args = ap.parse_args(argv)
+    if args.spec_draft_layers and not args.spec_len:
+        ap.error("--spec-draft-layers requires --spec-len > 0")
+    if args.spec_len < 0:
+        ap.error("--spec-len must be >= 0")
 
+    import dataclasses
+
+    model = ModelConfig(vocab=2048, d_model=256, n_layers=4, n_heads=8,
+                        n_kv_heads=4, d_ff=1024, max_seq=256)
+    draft = (dataclasses.replace(model, n_layers=args.spec_draft_layers)
+             if args.spec_draft_layers else None)
     engine = ServingEngine(cfg=ServeConfig(
-        model=ModelConfig(vocab=2048, d_model=256, n_layers=4, n_heads=8,
-                          n_kv_heads=4, d_ff=1024, max_seq=256),
-        slots=args.slots, prefill_len=32, quantize=args.quant,
+        model=model, slots=args.slots, prefill_len=32, quantize=args.quant,
+        spec_len=args.spec_len, draft_model=draft,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "

@@ -1,0 +1,106 @@
+"""Speculative decoding: draft-propose + one-dispatch target verify.
+
+Beyond-reference capability (the reference ships no serving code at all —
+SURVEY §5.7; its README only *names* vLLM metric collection): tpumon's
+in-tree serving engine (tpumon.loadgen.serving) gains the standard
+latency optimization of production TPU serving stacks. A cheap draft
+model proposes ``spec_len`` tokens autoregressively; the target model
+scores all of them in ONE multi-token forward; the longest prefix the
+target agrees with is accepted plus one bonus token from the target's
+own distribution. Under greedy decoding the output matches plain decode
+whatever the draft quality — only the number of target dispatches
+changes. (Exactly so in deterministic dtypes, which the tests pin in
+float32; under bfloat16 the block-shaped verify can reassociate
+reductions differently from a [B, 1] step and flip an argmax near-tie.)
+
+TPU-first design:
+- ``decode_block`` is the verify kernel: advance every slot ``T`` tokens
+  in one fused dispatch — the same batched cache-append/attention
+  structure as ``decode_step`` but with a [B, T] token block, so the
+  MXU sees a T-times-larger matmul instead of T serial launches. Jitted
+  once per (B, T); T = spec_len+1 is static.
+- rejection needs no cache rollback: K/V for rejected rows are written
+  but the per-slot position pointer simply doesn't advance past the
+  accepted frontier; attention masks rows ``> position`` and later
+  appends overwrite stale rows in order (the same mechanism that makes
+  slot reuse safe in the engine).
+- mixed batches degrade gracefully: slots sampling with temperature > 0
+  accept zero drafts and emit one token from the target's verified
+  logits at their current position — exactly plain decode — while
+  greedy slots in the same round still get multi-token acceptance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpumon.loadgen.model import _rms_norm
+
+
+def decode_block(cfg, params: dict, cache: dict, tokens: jax.Array,
+                 positions: jax.Array) -> tuple[dict, jax.Array]:
+    """Advance every slot ``T`` tokens in one dispatch.
+
+    tokens: [B, T] int32 (token block per slot; tokens[:, 0] is the
+    feed token at row ``positions``); positions: [B] int32 start rows.
+    Returns (cache, logits [B, T, vocab]) where logits[:, t] predicts
+    the token at row ``positions + t + 1``. Generalizes
+    ``serving.decode_step`` (T == 1 produces identical logits); the
+    serving engine uses it as the speculative verify step.
+    """
+    m = cfg.model
+    dt = jnp.dtype(m.compute_dtype)
+    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
+    b, t = tokens.shape
+    x = params["embed"].astype(dt)[tokens]  # [B, T, D]
+    pos = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
+    row = jnp.arange(m.max_seq, dtype=jnp.int32)
+    # mask[b, 1, t, row]: row <= positions[b] + t — prior context plus
+    # causal order within the block (same frontier rule as decode_step).
+    mask = (row[None, None] <= pos[:, :, None])[:, None]  # [B, 1, T, S]
+
+    from tpumon.loadgen.serving import _gqa_repeat, _rope_at
+
+    def append(cache_l: jax.Array, kv: jax.Array, p: jax.Array) -> jax.Array:
+        # cache_l: [S, nkv, hd]; kv: [T, nkv, hd] — contiguous T-row write.
+        return lax.dynamic_update_slice(cache_l, kv, (p, 0, 0))
+
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"])
+        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(b, t, nh, hd),
+                     pos, m.rope_theta)
+        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd),
+                     pos, m.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
+        new_k = jax.vmap(append)(cache["k"][li], k, positions)
+        new_v = jax.vmap(append)(cache["v"][li], v, positions)
+        cache["k"] = cache["k"].at[li].set(new_k)
+        cache["v"] = cache["v"].at[li].set(new_v)
+        kr, vr = _gqa_repeat(new_k, nh), _gqa_repeat(new_v, nh)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / (hd**0.5)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
+        x = x + att @ layer["wo"].astype(dt)
+        hm = _rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
+        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
+            "w_down"].astype(dt)
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return cache, logits
+
+
+def greedy_accept_len(proposed: list[int], target: list[int]) -> int:
+    """Longest prefix of draft proposals the target's greedy choice
+    agrees with. proposed: the spec_len draft tokens for one slot;
+    target: the target's argmax at each verified position (len
+    spec_len+1; target[i] is what the target would emit after consuming
+    proposed[:i])."""
+    a = 0
+    while a < len(proposed) and proposed[a] == target[a]:
+        a += 1
+    return a
