@@ -1,0 +1,110 @@
+"""Static validation of the shipped ops examples (examples/): every
+PromQL expression in the Grafana dashboard and the Prometheus alert
+rules must reference only metric families the exporter (or the serving
+engine/trainer expositions) actually publishes — a renamed gauge must
+fail here, not in a user's Grafana."""
+
+import asyncio
+import json
+import os
+import re
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+# grafana $__all regex values and rate() wrappers stripped by the name
+# extractor below.
+METRIC_RE = re.compile(r"\b(tpu_[a-z0-9_]+|tpumon_[a-z0-9_]+)\b")
+
+
+def exported_families() -> set[str]:
+    """All families tpumon can publish: monitor exporter (fake v5e-8 +
+    serving + train re-export) plus the engine/trainer expositions."""
+    from tests.test_server_api import serve
+    from tpumon.exporter import render_exporter
+    from tpumon.metrics_text import parse_metrics_text, samples_by_name
+
+    sampler, server = serve({
+        "TPUMON_COLLECTORS": "host,accel",
+        "TPUMON_EXPECTED_SLICE_CHIPS": '{"slice-0": 8}',
+    })
+    asyncio.run(sampler.tick_all())
+    text = render_exporter(sampler)
+    names = set(samples_by_name(parse_metrics_text(text)))
+    # Families gated on live serving/k8s/train targets (exporter.py): the
+    # exporter publishes them only when those sources report, so add the
+    # documented names directly rather than spinning up a serving stack.
+    names |= {
+        "tpumon_serving_tokens_per_sec", "tpumon_serving_ttft_p50_ms",
+        "tpumon_serving_queue_depth", "tpumon_serving_up",
+        "tpumon_pods_by_phase",
+        "tpumon_monitor_train_step", "tpumon_monitor_train_loss",
+        "tpumon_monitor_train_tokens_total",
+        "tpumon_monitor_train_goodput_pct",
+    }
+    src = open(os.path.join(EXAMPLES, "..", "tpumon", "exporter.py")).read()
+    for extra in names:
+        if extra.startswith("tpumon_serving") or extra.startswith(
+                "tpumon_monitor") or extra == "tpumon_pods_by_phase":
+            assert extra in src, f"{extra} not found in exporter.py"
+    return names
+
+
+def referenced_metrics(text: str) -> set[str]:
+    return set(METRIC_RE.findall(text))
+
+
+def test_grafana_dashboard_metrics_exist():
+    path = os.path.join(EXAMPLES, "grafana-dashboard.json")
+    dash = json.load(open(path))
+    exprs = [
+        t["expr"]
+        for p in dash["panels"]
+        for t in p.get("targets", [])
+    ]
+    assert exprs, "dashboard has no queries"
+    families = exported_families()
+    for name in referenced_metrics("\n".join(exprs)):
+        base = name.removesuffix("_total") if (
+            name.endswith("_bytes_total")) else name
+        assert name in families or base in families, (
+            f"dashboard queries unknown family {name}")
+
+
+def test_grafana_dashboard_no_dual_axis():
+    """One measure per axis: no panel mixes units via overrides."""
+    dash = json.load(open(os.path.join(EXAMPLES, "grafana-dashboard.json")))
+    for p in dash["panels"]:
+        overrides = p.get("fieldConfig", {}).get("overrides", [])
+        assert not any(
+            prop.get("id") == "unit"
+            for o in overrides
+            for prop in o.get("properties", [])
+        ), f"panel {p['title']!r} mixes units on one axis"
+
+
+def test_prometheus_rules_metrics_exist():
+    path = os.path.join(EXAMPLES, "prometheus-rules.yml")
+    text = open(path).read()
+    families = exported_families()
+    for name in referenced_metrics(text):
+        base = name.removesuffix("_total") if (
+            name.endswith("_bytes_total")) else name
+        assert name in families or base in families, (
+            f"alert rules reference unknown family {name}")
+
+
+def test_prometheus_rules_parse_as_yaml():
+    import importlib.util
+
+    if importlib.util.find_spec("yaml") is None:  # stdlib-only env
+        return
+    import yaml
+
+    doc = yaml.safe_load(open(os.path.join(EXAMPLES, "prometheus-rules.yml")))
+    groups = doc["groups"]
+    rules = [r for g in groups for r in g["rules"]]
+    assert len(rules) >= 10
+    for r in rules:
+        assert set(r) >= {"alert", "expr", "labels", "annotations"}
+        assert r["labels"]["severity"] in ("minor", "serious", "critical")
+        assert "fix" in r["annotations"]  # the engine's remediation field

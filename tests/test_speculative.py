@@ -180,6 +180,29 @@ class TestSpeculativeEngine:
         assert "tpumon_serving_spec_proposed" in text
         assert "tpumon_serving_spec_accepted" in text
 
+    def test_weight_bytes_counts_distinct_draft(self):
+        """A separate draft model's resident weights are reported; a
+        self-speculating draft (shared params) adds nothing."""
+        from tpumon.loadgen.quant import param_bytes
+
+        def weight_gauge(eng):
+            for line in eng.metrics_text().splitlines():
+                if line.startswith("tpumon_serving_weight_bytes"):
+                    return float(line.split()[-1])
+            raise AssertionError("gauge missing")
+
+        base = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8))
+        selfspec = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8, spec_len=2))
+        draft = dataclasses.replace(SMALL, n_layers=1)
+        distinct = ServingEngine(cfg=ServeConfig(
+            model=SMALL, slots=2, prefill_len=8, spec_len=2,
+            draft_model=draft))
+        assert weight_gauge(selfspec) == weight_gauge(base)
+        assert weight_gauge(distinct) == weight_gauge(base) + param_bytes(
+            distinct.draft_params)
+
 
 def test_greedy_accept_len():
     assert greedy_accept_len([1, 2, 3], [1, 2, 3, 9]) == 3

@@ -632,6 +632,8 @@ class ServingEngine:
             prop_h, tgt_h = (
                 a.tolist() for a in jax.device_get((proposed, tgt)))
             samp_h = None
+        from tpumon.loadgen.speculative import greedy_accept_len
+
         emitted_n = 0
         accepted_n = 0
         proposed_n = 0  # greedy slots only: temp slots can't accept
@@ -641,8 +643,6 @@ class ServingEngine:
                 a = 0
                 emitted = [samp_h[slot]]
             else:
-                from tpumon.loadgen.speculative import greedy_accept_len
-
                 a = greedy_accept_len(prop_h[slot], tgt_h[slot])
                 emitted = prop_h[slot][:a] + [tgt_h[slot][a]]
                 proposed_n += g
@@ -708,9 +708,14 @@ class ServingEngine:
                 ).add(value=free)
         from tpumon.loadgen.quant import param_bytes
 
+        weight_bytes = param_bytes(self.params)
+        if self.spec_len and self.draft_params is not self.params:
+            # A distinct draft model's weights are resident too;
+            # self-speculation shares the target's and adds nothing.
+            weight_bytes += param_bytes(self.draft_params)
         w.gauge("tpumon_serving_weight_bytes",
                 "resident model weight bytes (int8 when quantized)"
-                ).add(value=param_bytes(self.params))
+                ).add(value=weight_bytes)
         w.counter("tpumon_serving_spec_rounds",
                   "speculative decode rounds (0 when disabled)"
                   ).add(value=spec_rounds)
