@@ -1,0 +1,113 @@
+"""Prefix caching tests (tpumon.loadgen.prefix_cache).
+
+The load-bearing invariant: a cache hit restores bit-identical K/V, so
+greedy outputs never change — only prefill work does.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpumon.loadgen.model import ModelConfig
+from tpumon.loadgen.prefix_cache import PrefixCache
+from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+SMALL = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=64,
+                    compute_dtype="float32")
+
+
+def make_engine(entries=4, **kw):
+    return ServingEngine(cfg=ServeConfig(
+        model=SMALL, slots=2, prefill_len=8,
+        prefix_cache_entries=entries, **kw))
+
+
+SYS = [7, 1, 8, 2, 8, 1, 8, 2]  # exactly one chunk (prefill_len=8)
+PROMPT_A = SYS + [3, 1, 4, 1, 5]
+PROMPT_B = SYS + [9, 2, 6, 5]
+
+
+class TestPrefixCacheUnit:
+    def test_strict_prefix_only(self):
+        pc = PrefixCache(chunk=8)
+        # A chunk-aligned prompt must never be served entirely from
+        # cache: the final chunk is recomputed for first-token logits.
+        assert pc.cached_prefix_len(list(range(8))) == 0  # m would be n
+        assert pc.cached_prefix_len(list(range(5))) == 0
+
+    def test_lru_eviction_bounds_entries(self):
+        eng = make_engine(entries=2)
+        for i in range(5):
+            eng.submit(SYS[:-1] + [i] + [i, i + 1], max_new=1)
+            eng.drain()
+        assert eng.prefix_cache.entries <= 2
+        # Incremental byte accounting survives evictions: 2 entries of
+        # one 8-row chunk each, k+v, f32.
+        m = SMALL
+        per_entry = 2 * m.n_layers * 8 * m.n_kv_heads * m.head_dim * 4
+        assert eng.prefix_cache.resident_bytes() == 2 * per_entry
+
+
+class TestPrefixCacheEngine:
+    def test_hit_outputs_match_cold_outputs(self):
+        cold = make_engine(entries=0)
+        r1 = cold.submit(PROMPT_A, max_new=10)
+        cold.drain()
+
+        warm = make_engine(entries=4)
+        w1 = warm.submit(PROMPT_A, max_new=10)
+        warm.drain()
+        assert warm.prefix_cache.hits == 0  # first sight: miss
+        w2 = warm.submit(PROMPT_A, max_new=10)
+        warm.drain()
+        assert warm.prefix_cache.hits == 1
+        assert warm.prefix_cache.saved_tokens == 8
+        # Restored K/V is bit-identical, so all three greedy outputs
+        # agree (cold, warm-miss, warm-hit).
+        assert r1.output == w1.output == w2.output
+
+    def test_shared_prefix_across_different_tails(self):
+        eng = make_engine(entries=4)
+        eng.submit(PROMPT_A, max_new=6)
+        eng.drain()
+        rb = eng.submit(PROMPT_B, max_new=6)
+        eng.drain()
+        assert eng.prefix_cache.hits == 1  # B reuses A's SYS chunk
+
+        cold = make_engine(entries=0)
+        rb_cold = cold.submit(PROMPT_B, max_new=6)
+        cold.drain()
+        assert rb.output == rb_cold.output
+
+    def test_composes_with_speculative_decoding(self):
+        plain = make_engine(entries=0)
+        r0 = plain.submit(PROMPT_A, max_new=10)
+        plain.drain()
+
+        eng = make_engine(entries=4, spec_len=3)
+        eng.submit(PROMPT_A, max_new=10)
+        eng.drain()
+        r2 = eng.submit(PROMPT_A, max_new=10)
+        eng.drain()
+        assert eng.prefix_cache.hits == 1
+        assert eng.spec_rounds_total > 0
+        # Draft cache is prefilled fully (prefix cache holds target K/V
+        # only), so self-speculation still accepts everything.
+        assert eng.spec_accepted_total == eng.spec_proposed_total
+        assert r2.output == r0.output
+
+    def test_metrics_exported(self):
+        eng = make_engine(entries=4)
+        eng.submit(PROMPT_A, max_new=2)
+        eng.drain()
+        eng.submit(PROMPT_A, max_new=2)
+        eng.drain()
+        text = eng.metrics_text()
+        assert "tpumon_serving_prefix_hits 1" in text
+        assert "tpumon_serving_prefix_saved_tokens 8" in text
+        assert "tpumon_serving_prefix_bytes" in text
+        # Disabled engine exports no prefix families at all.
+        off = make_engine(entries=0)
+        assert "prefix_hits" not in off.metrics_text()

@@ -1,0 +1,128 @@
+"""Prefix caching: reuse prompt K/V across requests sharing a prefix.
+
+Beyond-reference serving capability (the reference ships no serving
+code — SURVEY §5.7): requests in real serving traffic share long system
+prompts, so production TPU engines cache the KV of common prompt
+prefixes and skip recomputing them. tpumon's engine
+(tpumon.loadgen.serving) does the same at **chunk granularity**: after
+a prompt is prefilled, the K/V rows of its chunk-aligned prefix are
+snapshotted; a later prompt starting with the same tokens restores
+those rows with one HBM-to-HBM copy and prefills only the tail.
+
+TPU-first design:
+- restore/extract are single ``dynamic_update_slice`` /
+  ``dynamic_slice`` ops over ``[layers, rows, kv_heads, head_dim]``
+  blocks — pure HBM bandwidth, no MXU work, no per-layer Python loop
+  on the hot path. Each distinct chunk count compiles once (row count
+  must be static under jit); prompts are already chunked by
+  ``prefill_len``, so the shape set is tiny.
+- keys are exact token tuples at chunk boundaries, so a restored row
+  is bit-identical to the prefill that produced it — greedy decode
+  outputs are unchanged by cache hits, which the tests pin.
+- entries pin device HBM (the point: trading memory for prefill
+  FLOPs), so the store is a bounded LRU; eviction frees the arrays.
+- the cached prefix is always strictly shorter than the prompt (the
+  chunk containing the last token is recomputed) so the engine still
+  gets first-token logits from a real prefill call.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _restore(cache_kv: jax.Array, slot: jax.Array,
+             block: jax.Array) -> jax.Array:
+    """Write ``block`` [layers, rows, nkv, hd] into rows 0..rows-1 of
+    ``slot`` in cache_kv [layers, slots, seq, nkv, hd]. One compile per
+    distinct row count (the block's static shape)."""
+    return lax.dynamic_update_slice(
+        cache_kv, block[:, None], (0, slot, 0, 0, 0))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _extract(cache_kv: jax.Array, slot: jax.Array, rows: int) -> jax.Array:
+    """Read rows 0..rows-1 of ``slot`` → [layers, rows, nkv, hd]."""
+    layers, _, _, nkv, hd = cache_kv.shape
+    return lax.dynamic_slice(
+        cache_kv, (0, slot, 0, 0, 0), (layers, 1, rows, nkv, hd))[:, 0]
+
+
+@dataclass
+class PrefixCache:
+    """Bounded LRU of chunk-aligned prompt-prefix K/V blocks.
+
+    ``chunk`` is the engine's prefill_len; keys are
+    ``tuple(prompt[:m])`` with m a multiple of chunk.
+    """
+
+    chunk: int
+    max_entries: int = 16
+    _store: OrderedDict = field(default_factory=OrderedDict)
+    hits: int = 0
+    misses: int = 0
+    saved_tokens: int = 0
+    _resident: int = 0  # bytes; kept incrementally so /metrics readers
+    # in other threads never iterate the live OrderedDict
+
+    def cached_prefix_len(self, prompt: list[int]) -> int:
+        """Longest cached chunk-aligned strict prefix of ``prompt``
+        (strict: the chunk holding the last token is never served from
+        cache so prefill still produces first-token logits)."""
+        n = len(prompt)
+        m = ((n - 1) // self.chunk) * self.chunk
+        while m >= self.chunk:
+            if tuple(prompt[:m]) in self._store:
+                return m
+            m -= self.chunk
+        return 0
+
+    def restore(self, cache: dict, prompt: list[int], slot) -> int:
+        """If a prefix of ``prompt`` is cached, write it into ``slot``
+        (mutating ``cache`` in place) and return its length, else 0."""
+        m = self.cached_prefix_len(prompt)
+        if not m:
+            self.misses += 1
+            return 0
+        key = tuple(prompt[:m])
+        blocks = self._store[key]
+        self._store.move_to_end(key)  # LRU touch
+        for name in ("k", "v"):
+            cache[name] = _restore(cache[name], slot, blocks[name])
+        self.hits += 1
+        self.saved_tokens += m
+        return m
+
+    def store(self, cache: dict, prompt: list[int], slot) -> None:
+        """Snapshot the chunk-aligned strict prefix of ``prompt`` from
+        ``slot`` (a no-op if already cached or shorter than one chunk)."""
+        n = len(prompt)
+        m = ((n - 1) // self.chunk) * self.chunk
+        if m < self.chunk:
+            return
+        key = tuple(prompt[:m])
+        if key in self._store:
+            self._store.move_to_end(key)
+            return
+        blocks = {
+            name: _extract(cache[name], slot, m) for name in ("k", "v")
+        }
+        self._store[key] = blocks
+        self._resident += sum(b.nbytes for b in blocks.values())
+        while len(self._store) > self.max_entries:
+            _, evicted = self._store.popitem(last=False)  # frees the HBM
+            self._resident -= sum(b.nbytes for b in evicted.values())
+
+    @property
+    def entries(self) -> int:
+        return len(self._store)
+
+    def resident_bytes(self) -> int:
+        return self._resident

@@ -70,6 +70,10 @@ class ServeConfig:
     # tpumon.loadgen.speculative on bf16 argmax near-ties).
     spec_len: int = 0
     draft_model: ModelConfig | None = None
+    # Prefix caching (tpumon.loadgen.prefix_cache): LRU entries of
+    # chunk-aligned prompt-prefix K/V; 0 = off. Each entry pins HBM —
+    # the deliberate trade of memory for prefill FLOPs.
+    prefix_cache_entries: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -392,6 +396,13 @@ class ServingEngine:
         self.spec_rounds_total = 0
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
+        self.prefix_cache = None
+        if self.cfg.prefix_cache_entries:
+            from tpumon.loadgen.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                chunk=self.cfg.prefill_len,
+                max_entries=self.cfg.prefix_cache_entries)
         self.cache = init_cache(self.cfg)
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
@@ -462,21 +473,36 @@ class ServingEngine:
                 req = self._queue.popleft()
             n = len(req.prompt)
             p = self.cfg.prefill_len
+            # Prefix cache: restore a previously-computed chunk-aligned
+            # prefix's K/V (one HBM copy) and prefill only the tail. The
+            # restored prefix is strictly shorter than the prompt, so
+            # the final chunk always runs and yields first-token logits.
+            start = 0
+            if self.prefix_cache is not None:
+                start = self.prefix_cache.restore(
+                    self.cache, req.prompt, jnp.int32(slot))
             # Chunked prefill: one fixed-shape call per prefill_len chunk;
             # only the final chunk's logits matter (position n-1).
-            for c0 in range(0, n, p):
+            for c0 in range(start, n, p):
                 chunk = req.prompt[c0:c0 + p]
                 ln = len(chunk)
                 toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
                 self.cache, logits = self._prefill(
                     self.params, self.cache, toks, jnp.int32(ln),
                     jnp.int32(slot), jnp.int32(c0))
-                if self.spec_len:
-                    # Draft needs the prompt's K/V too — same chunks.
+            if self.prefix_cache is not None:
+                self.prefix_cache.store(
+                    self.cache, req.prompt, jnp.int32(slot))
+            if self.spec_len:
+                # Draft needs the full prompt's K/V (the prefix cache
+                # holds target K/V only — draft prefill is cheap).
+                for c0 in range(0, n, p):
+                    chunk = req.prompt[c0:c0 + p]
+                    ln = len(chunk)
+                    toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
                     self.draft_cache, _ = self._draft_prefill(
                         self.draft_params, self.draft_cache, toks,
                         jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
-            if self.spec_len:
                 self._draft_pos[slot] = n
             self._sample_ctr += 1
             first = int(sample_tokens(
@@ -724,6 +750,19 @@ class ServingEngine:
         w.counter("tpumon_serving_spec_accepted",
                   "draft tokens the target verify accepted"
                   ).add(value=spec_accepted)
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            w.counter("tpumon_serving_prefix_hits",
+                      "admissions served a cached prompt prefix"
+                      ).add(value=pc.hits)
+            w.counter("tpumon_serving_prefix_misses",
+                      "admissions with no cached prefix").add(value=pc.misses)
+            w.counter("tpumon_serving_prefix_saved_tokens",
+                      "prompt tokens whose prefill was skipped"
+                      ).add(value=pc.saved_tokens)
+            w.gauge("tpumon_serving_prefix_bytes",
+                    "HBM pinned by cached prefix K/V"
+                    ).add(value=pc.resident_bytes())
         lines = [w.render().rstrip("\n")]
         lines.append("# TYPE jetstream_time_to_first_token histogram")
         cum = 0
@@ -776,10 +815,20 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
                   seed: int = 0, temperature: float = 0.0,
                   top_k: int = 0) -> None:
     """Poisson-ish synthetic request arrivals + engine stepping until
-    ``stop`` is set (or ``duration`` seconds elapse, if nonzero)."""
+    ``stop`` is set (or ``duration`` seconds elapse, if nonzero).
+
+    When the engine has a prefix cache, arrivals model real traffic's
+    shared system prompt: every request starts with the same
+    two-chunk prefix plus a random tail, so the cache actually hits.
+    """
     import random
 
     rng = random.Random(seed)
+    shared: list[int] = []
+    if engine.prefix_cache is not None:
+        srng = random.Random(seed ^ 0x5A5)
+        shared = [srng.randrange(engine.cfg.model.vocab)
+                  for _ in range(2 * engine.cfg.prefill_len)]
     t0 = time.monotonic()
     next_arrival = t0
     while not stop.is_set():
@@ -788,8 +837,9 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
             return
         while now >= next_arrival:
             n = rng.randint(2, engine.cfg.prefill_len)
-            engine.submit([rng.randrange(engine.cfg.model.vocab)
-                           for _ in range(n)], max_new=max_new,
+            tail = [rng.randrange(engine.cfg.model.vocab)
+                    for _ in range(n)]
+            engine.submit(shared + tail, max_new=max_new,
                           temperature=temperature, top_k=top_k)
             next_arrival += rng.expovariate(rps)
         if not engine.step():
@@ -844,6 +894,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--spec-draft-layers", type=int, default=0,
                     help="draft model layer count (0 = self-speculation: "
                          "draft shares the target weights)")
+    ap.add_argument("--prefix-cache", type=int, default=0,
+                    help="prompt-prefix KV cache LRU entries (0 = off)")
     args = ap.parse_args(argv)
     if args.spec_draft_layers and not args.spec_len:
         ap.error("--spec-draft-layers requires --spec-len > 0")
@@ -859,6 +911,7 @@ def main(argv: list[str] | None = None) -> int:
     engine = ServingEngine(cfg=ServeConfig(
         model=model, slots=args.slots, prefill_len=32, quantize=args.quant,
         spec_len=args.spec_len, draft_model=draft,
+        prefix_cache_entries=args.prefix_cache,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
