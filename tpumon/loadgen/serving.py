@@ -33,6 +33,7 @@ TPU-first design:
 from __future__ import annotations
 
 import itertools
+import queue
 import sys
 import threading
 import time
@@ -267,6 +268,20 @@ class Request:
     ttft_s: float | None = None
     output: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
+    # Streaming: tokens are pushed here as they are emitted (None = end
+    # of stream), so a consumer sees the first token at TTFT instead of
+    # waiting for completion. Created by submit(stream=True).
+    stream: "object | None" = None
+
+    def emit(self, tokens: list[int]) -> None:
+        self.output.extend(tokens)
+        if self.stream is not None:
+            for t in tokens:
+                self.stream.put(t)
+
+    def finish_stream(self) -> None:
+        if self.stream is not None:
+            self.stream.put(None)
 
 
 @jax.jit
@@ -431,21 +446,26 @@ class ServingEngine:
     # -- submission ---------------------------------------------------------
 
     def submit(self, prompt: list[int], max_new: int = 16,
-               temperature: float = 0.0, top_k: int = 0) -> Request:
+               temperature: float = 0.0, top_k: int = 0,
+               stream: bool = False) -> Request:
         """Enqueue a request. When the queue is full the request is
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
         latency grow without bound. temperature 0 = greedy; top_k 0 =
         full vocab. Prompts may exceed prefill_len — they run as chunked
-        prefill — but are capped at max_seq-1 (room for decode rows)."""
+        prefill — but are capped at max_seq-1 (room for decode rows).
+        stream=True attaches a queue (req.stream) that receives each
+        token as it is emitted, None at end of stream."""
         m = self.cfg.model
         prompt = [t % m.vocab for t in prompt][: m.max_seq - 1]
         req = Request(rid=next(self._rid), prompt=prompt or [0],
                       max_new=max_new, enqueued=time.monotonic(),
-                      temperature=float(temperature), top_k=int(top_k))
+                      temperature=float(temperature), top_k=int(top_k),
+                      stream=queue.Queue() if stream else None)
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 self.rejected_total += 1
+                req.finish_stream()
                 req.done.set()
                 return req
             self._queue.append(req)
@@ -512,7 +532,7 @@ class ServingEngine:
             with self._lock:
                 req.ttft_s = time.monotonic() - req.enqueued
                 self._observe_ttft(req.ttft_s)
-                req.output.append(first)
+                req.emit([first])
                 self.tokens_total += 1
             self._slots[slot] = req
             self.positions = self.positions.at[slot].set(n)
@@ -530,6 +550,7 @@ class ServingEngine:
         self._slots[slot] = None
         with self._lock:
             self.completed_total += 1
+        req.finish_stream()
         req.done.set()
 
     def step(self) -> bool:
@@ -576,7 +597,7 @@ class ServingEngine:
             self.tokens_total += len(active)
         for slot in active:
             req = self._slots[slot]
-            req.output.append(nxt_host[slot])
+            req.emit([nxt_host[slot]])
             self._host_positions[slot] = min(
                 self._host_positions[slot] + 1,
                 self.cfg.model.max_seq - 1)
@@ -675,7 +696,7 @@ class ServingEngine:
             accepted_n += a
             room = req.max_new + 1 - len(req.output)
             emitted = emitted[:room]  # room >= 1: full slots completed
-            req.output.extend(emitted)
+            req.emit(emitted)
             self._host_positions[slot] += len(emitted)
             self._host_last[slot] = emitted[-1]
             self._draft_pos[slot] = self._host_positions[slot]
@@ -785,22 +806,94 @@ class ServingEngine:
 
 def start_metrics_server(engine: ServingEngine, port: int = 0,
                          host: str = "127.0.0.1"):
-    """Serve the engine's exposition on /metrics; returns (server, port).
+    """Serve /metrics and /generate; returns (server, port).
+
+    /generate is the inference API (the engine loop must be running —
+    the arrival loop or any thread calling step()):
+      GET /generate?prompt=1,2,3&max_new=8            → JSON when done
+      GET /generate?prompt=1,2,3&max_new=8&stream=1   → SSE, one
+          ``data: <token>`` event per token as it is emitted, then
+          ``event: done``. First event arrives at TTFT, not completion.
     Runs in a daemon thread; call server.shutdown() to stop."""
+    import json as _json
+    import urllib.parse
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib API name)
-            if self.path.split("?")[0] != "/metrics":
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                self._send(200, engine.metrics_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/generate":
+                self._generate(urllib.parse.parse_qs(query))
+            else:
                 self.send_error(404)
-                return
-            body = engine.metrics_text().encode()
-            self.send_response(200)
-            self.send_header(
-                "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+
+        def _send(self, code, body, ctype):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _generate(self, q):
+            try:
+                prompt = [int(t) for t in q["prompt"][0].split(",") if t]
+                max_new = int(q.get("max_new", ["16"])[0])
+                temp = float(q.get("temperature", ["0"])[0])
+                top_k = int(q.get("top_k", ["0"])[0])
+            except (KeyError, ValueError):
+                self._send(400, b'{"error": "bad prompt/max_new"}',
+                           "application/json")
+                return
+            streaming = q.get("stream", ["0"])[0] not in ("0", "")
+            req = engine.submit(prompt, max_new=max_new, temperature=temp,
+                                top_k=top_k, stream=streaming)
+            if req.done.is_set() and not req.output:
+                # Queue-full backpressure must be visible to clients
+                # (retry logic keys off the status code, not the body).
+                self._send(429, b'{"error": "queue full"}',
+                           "application/json")
+                return
+            if not streaming:
+                if not req.done.wait(timeout=60):
+                    self._send(504, b'{"error": "timeout"}',
+                               "application/json")
+                    return
+                body = _json.dumps({
+                    "rid": req.rid, "tokens": req.output,
+                    "ttft_ms": None if req.ttft_s is None
+                    else req.ttft_s * 1e3,
+                }).encode()
+                self._send(200, body, "application/json")
+                return
+            # SSE: stream tokens as the engine emits them.
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            try:
+                while True:
+                    try:
+                        tok = req.stream.get(timeout=60)
+                    except queue.Empty:
+                        # Engine stalled: terminate explicitly so SSE
+                        # clients don't auto-reconnect and enqueue a
+                        # duplicate generation.
+                        self.wfile.write(
+                            b'event: error\ndata: {"error": "stalled"}'
+                            b"\n\n")
+                        self.wfile.flush()
+                        return
+                    if tok is None:
+                        self.wfile.write(b"event: done\ndata: {}\n\n")
+                        self.wfile.flush()
+                        return
+                    self.wfile.write(f"data: {tok}\n\n".encode())
+                    self.wfile.flush()
+            except Exception:
+                return  # client went away; just stop
 
         def log_message(self, *a):  # quiet
             pass
