@@ -33,6 +33,31 @@ def test_train_metrics_text_shape():
     assert "tpumon_train_step_time_seconds 0.48" in text
 
 
+def test_mfu_computed_and_distilled():
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.train import detect_peak_flops, flops_per_token
+
+    cfg = ModelConfig(vocab=256, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq=64)
+    fpt = flops_per_token(cfg, seq=32)
+    # 6N dominates and both terms are positive.
+    assert fpt > 6 * (2 * 256 * 64)
+    # Peak 1 TFLOP/s, one step of 1024 tokens in 1s -> MFU% directly.
+    m = TrainMetrics(flops_per_token=fpt, peak_flops=1e12)
+    m.observe_step(0, 1.0, 1024)
+    expect = 100.0 * 1024 * fpt / 1e12
+    assert abs(m.mfu_pct - expect) < 1e-6
+    d = distill_serving_metrics(m.metrics_text(), now=1000.0)
+    assert abs(d["train_mfu_pct"] - round(expect, 2)) < 0.01
+
+    # Unknown hardware: no peak -> no MFU gauge at all.
+    m2 = TrainMetrics(flops_per_token=fpt, peak_flops=None)
+    m2.observe_step(0, 1.0, 1024)
+    assert "mfu" not in m2.metrics_text()
+    # CPU test mesh has no TPU kind -> detection declines to guess.
+    assert detect_peak_flops() is None
+
+
 def test_distill_train_fields_and_token_rate():
     m = TrainMetrics()
     m.observe_step(9, 0.4, 4096)

@@ -68,6 +68,7 @@ TRAIN_GAUGES = {
     "train_loss": "tpumon_train_loss",
     "train_goodput_pct": "tpumon_train_goodput_pct",
     "train_ckpt_step": "tpumon_train_checkpoint_step",
+    "train_mfu_pct": "tpumon_train_mfu_pct",
 }
 TRAIN_STEP_TIME = "tpumon_train_step_time_seconds"
 TRAIN_TOKEN_COUNTER = "tpumon_train_tokens_total"
@@ -215,6 +216,8 @@ tpumon_train_step_time_seconds {0.4 + 0.02 * math.sin(t / 11):.4f}
 tpumon_train_tokens_total {tokens}
 # TYPE tpumon_train_goodput_pct gauge
 tpumon_train_goodput_pct {92 + 4 * math.sin(t / 90):.2f}
+# TYPE tpumon_train_mfu_pct gauge
+tpumon_train_mfu_pct {46 + 3 * math.sin(t / 60):.2f}
 # TYPE tpumon_train_checkpoint_step gauge
 tpumon_train_checkpoint_step {max(0, (step // 100) * 100)}
 """

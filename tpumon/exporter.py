@@ -137,6 +137,10 @@ def render_exporter(sampler: Sampler) -> str:
             goodput = w.gauge(
                 "tpumon_monitor_train_goodput_pct", "Training goodput percent"
             )
+            mfu = w.gauge(
+                "tpumon_monitor_train_mfu_pct",
+                "Training model-FLOPs utilization percent",
+            )
             for s in serving:
                 if s.get("train_step") is None:
                     continue
@@ -148,6 +152,8 @@ def render_exporter(sampler: Sampler) -> str:
                     tokens.add(labels, s["train_tokens_total"])
                 if s.get("train_goodput_pct") is not None:
                     goodput.add(labels, s["train_goodput_pct"])
+                if s.get("train_mfu_pct") is not None:
+                    mfu.add(labels, s["train_mfu_pct"])
 
     # ---- self metrics ----
     samples = w.counter("tpumon_samples_total", "Collection attempts per source")

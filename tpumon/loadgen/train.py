@@ -71,17 +71,65 @@ def synthetic_batch(cfg: TrainConfig, step: int) -> jax.Array:
     )
 
 
+# Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets);
+# the basis of MFU. Unknown kinds (CPU test meshes) report no MFU unless
+# an explicit peak is passed.
+PEAK_TFLOPS_BY_KIND = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def detect_peak_flops() -> float | None:
+    """Total peak FLOP/s across local devices, or None if unknown."""
+    try:
+        devices = jax.devices()
+        kind = getattr(devices[0], "device_kind", "")
+    except Exception:
+        return None
+    for name, tflops in PEAK_TFLOPS_BY_KIND.items():
+        if kind.startswith(name):
+            return tflops * 1e12 * len(devices)
+    return None
+
+
+def flops_per_token(cfg: ModelConfig, seq: int) -> float:
+    """Training FLOPs per token: the standard 6·N (fwd 2N + bwd 4N over
+    all parameters) plus the attention term 12·L·s·d (score+value
+    matmuls, fwd+bwd, across layers at sequence length s)."""
+    n_params = (
+        cfg.vocab * cfg.d_model * 2  # embed + untied lm_head
+        + cfg.n_layers * (
+            cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads)
+            * cfg.head_dim  # qkv
+            + cfg.n_heads * cfg.head_dim * cfg.d_model  # wo
+            + 3 * cfg.d_model * cfg.d_ff  # swiglu
+            + 2 * cfg.d_model  # norms
+        )
+        + cfg.d_model  # final norm
+    )
+    return 6.0 * n_params + 12.0 * cfg.n_layers * seq * cfg.d_model
+
+
 class TrainMetrics:
     """Live training telemetry, exposed as Prometheus text.
 
     The trainer-side half of the monitor's training panel: step progress,
-    loss, amortized step time, token throughput and goodput (productive
+    loss, amortized step time, token throughput, goodput (productive
     step time over wall time — checkpoint saves and restore stalls are
-    the non-productive remainder). Updates are plain attribute writes
-    from the train loop; the HTTP scrape thread only formats them.
+    the non-productive remainder), and MFU (achieved model FLOP/s over
+    the chips' peak — the standard TPU training health number). Updates
+    are plain attribute writes from the train loop; the HTTP scrape
+    thread only formats them.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, flops_per_token: float | None = None,
+                 peak_flops: float | None = None) -> None:
         self.started = time.time()
         self.step = -1
         self.loss: float | None = None
@@ -89,6 +137,8 @@ class TrainMetrics:
         self.tokens_total = 0
         self.ckpt_step = -1
         self.productive_s = 0.0
+        self.flops_per_token = flops_per_token
+        self.peak_flops = peak_flops
 
     def observe_step(self, step: int, dt_s: float, batch_tokens: int) -> None:
         self.step = step
@@ -96,6 +146,22 @@ class TrainMetrics:
         self.productive_s += dt_s
         ema = self.step_time_ema_s
         self.step_time_ema_s = dt_s if ema is None else 0.9 * ema + 0.1 * dt_s
+
+    @property
+    def mfu_pct(self) -> float | None:
+        """Cumulative MFU: achieved FLOP/s over peak, from totals.
+
+        Cumulative (not per-step EMA) because the train loop is
+        dispatch-only under JAX async dispatch: an individual loop dt
+        can be ~1 ms while the device step is ~100 ms (queue not yet
+        saturated), which would feed absurd per-step MFU samples into
+        an EMA. Totals amortize dispatch-time artifacts away.
+        """
+        if not (self.flops_per_token and self.peak_flops
+                and self.productive_s > 0):
+            return None
+        return 100.0 * (self.tokens_total * self.flops_per_token) / (
+            self.productive_s * self.peak_flops)
 
     def metrics_text(self) -> str:
         wall = max(1e-9, time.time() - self.started)
@@ -119,6 +185,9 @@ class TrainMetrics:
         if self.step_time_ema_s is not None:
             lines += ["# TYPE tpumon_train_step_time_seconds gauge",
                       f"tpumon_train_step_time_seconds {self.step_time_ema_s:.6f}"]
+        if self.mfu_pct is not None:
+            lines += ["# TYPE tpumon_train_mfu_pct gauge",
+                      f"tpumon_train_mfu_pct {self.mfu_pct:.2f}"]
         return "\n".join(lines) + "\n"
 
 
@@ -239,6 +308,13 @@ def main(argv: list[str] | None = None) -> int:
         help="expose tpumon_train_* Prometheus metrics on this port "
         "(0 = ephemeral); add the printed URL to tpumon's serving_targets",
     )
+    ap.add_argument(
+        "--peak-tflops",
+        type=float,
+        default=None,
+        help="per-chip peak dense bf16 TFLOP/s for MFU (default: "
+        "auto-detect from the TPU device kind; unknown kinds omit MFU)",
+    )
     args = ap.parse_args(argv)
 
     cfg = TrainConfig(
@@ -251,7 +327,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     metrics = httpd = None
     if args.metrics_port is not None:
-        metrics = TrainMetrics()
+        if args.peak_tflops is None:
+            peak = detect_peak_flops()
+        elif args.peak_tflops > 0:
+            peak = args.peak_tflops * 1e12 * len(jax.devices())
+        else:
+            peak = None  # explicit 0 disables MFU even on known TPUs
+        metrics = TrainMetrics(
+            flops_per_token=flops_per_token(cfg.model, cfg.seq),
+            peak_flops=peak)
         httpd, url = start_metrics_server(metrics, port=args.metrics_port)
         print(f"train metrics at {url}")
     out = run_train(cfg, log=print, metrics=metrics)
