@@ -102,3 +102,13 @@ def test_int8_burn_runs_off_tpu():
     out = int8_burn(seconds=0.2, size=128, iters=2, use_pallas=False)
     assert out["tflops"] > 0 and out["weight_gbps"] > 0
     assert out["pallas"] is False
+
+
+def test_paged_burn_runs_off_tpu():
+    from tpumon.loadgen.burn import paged_burn
+
+    out = paged_burn(seconds=0.2, batch=2, n_heads=4, n_kv_heads=2,
+                     head_dim=16, page_size=8, context=32,
+                     use_pallas=False)
+    assert out["decode_steps_per_sec"] > 0 and out["kv_gbps"] > 0
+    assert out["pallas"] is False

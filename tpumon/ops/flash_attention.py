@@ -28,6 +28,27 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
+def online_softmax_update(s, v, m_ref, l_ref, acc_ref):
+    """One flash-attention block update, shared by the flash and paged
+    kernels so their numerics stay provably identical.
+
+    s: [rows, cols] f32 scores (already scaled/masked); v: [cols, d]
+    values; m/l: (rows, 128) VMEM stat tiles (statistic broadcast
+    across lanes — min TPU lane width); acc: (rows, d) f32 accumulator.
+    """
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[:] = (l_ref[:, 0] * corr + jnp.sum(p, axis=1))[
+        :, None] + jnp.zeros_like(l_ref)
+    m_ref[:] = m_new[:, None] + jnp.zeros_like(m_ref)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, k_steps: int, scale: float, causal: bool,
@@ -56,16 +77,7 @@ def _flash_kernel(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        m_prev = m_ref[:, 0]  # [block_q]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
-        corr = jnp.exp(m_prev - m_new)
-        l_ref[:] = (l_ref[:, 0] * corr + jnp.sum(p, axis=1))[:, None] + jnp.zeros_like(l_ref)
-        m_ref[:] = m_new[:, None] + jnp.zeros_like(m_ref)
-        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        online_softmax_update(s, v_ref[0], m_ref, l_ref, acc_ref)
 
     if causal:
         # Skip K blocks entirely above the diagonal: with equal block
