@@ -1,0 +1,170 @@
+"""Paged KV cache mode for the serving engine.
+
+Dense mode (serving.init_cache) reserves ``slots x max_seq`` KV rows
+forever; a slot serving a 40-token request pins the same HBM as one
+serving 4k tokens. Paged mode (beyond-reference; the reference ships no
+serving code — SURVEY §5.7) allocates fixed-size pages from a shared
+pool instead: a request pins ``ceil((prompt+max_new)/page_size)`` pages
+for its lifetime and frees them on completion, so resident KV scales
+with admitted work, not with the worst case. The pool can therefore be
+sized well under ``slots x max_seq`` and admission blocks (requests
+stay queued) when no pages are free — KV memory backpressure instead
+of OOM.
+
+TPU-first design:
+- **page == prefill chunk**: each fixed-shape prefill call fills
+  exactly one fresh page, so prefill needs no partial-page bookkeeping
+  and pages never interleave requests.
+- the pool is head-major ``[layers, kv_heads, num_pages, page, hd]``
+  (the layout tpumon.ops.paged_attention established for TPU lowering);
+  per-slot page tables are host-owned ints, shipped as one small
+  ``[slots, max_pages]`` device array per step.
+- decode attention uses the fused dense-gather path: measured on v5e
+  (see tpumon/ops/paged_attention.py) XLA fuses the table gather into
+  the attention consumer at HBM roofline, so nothing is materialized;
+  appends are one batched scatter at ``(page, offset)`` per slot.
+- allocation is reservation-style (``ceil((prompt+max_new)/page_size)``
+  pages claimed at admission — the last K/V row written is index
+  ``prompt+max_new-1``; the final emitted token is never fed back, so
+  no extra page is needed for it): the hot loop never allocates, and a
+  mid-decode out-of-pages state cannot exist.
+
+Composes with int8 weights, sampling, and streaming; speculative
+decoding and prefix caching currently require dense mode (their cache
+surgery assumes contiguous rows) and are rejected at engine init.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass
+class PageAllocator:
+    """Host-side free-list allocator over the shared pool."""
+
+    num_pages: int
+    _free: list[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n pages, or None (and no change) if not enough are free."""
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def release(self, pages: list[int]) -> None:
+        self._free.extend(pages)
+
+
+def init_pool(cfg, num_pages: int) -> dict:
+    m = cfg.model
+    shape = (m.n_layers, m.n_kv_heads, num_pages, cfg.prefill_len,
+             m.head_dim)
+    dt = jnp.dtype(m.compute_dtype)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_prefill(cfg, params: dict, pool: dict, tokens: jax.Array,
+                  length: jax.Array, page_id: jax.Array,
+                  table_row: jax.Array, start: jax.Array
+                  ) -> tuple[dict, jax.Array]:
+    """One prompt chunk into fresh page ``page_id`` of one sequence.
+
+    tokens: [page_size] int32 padded chunk; length: true tokens in this
+    chunk; page_id: the fresh page this chunk fills; table_row:
+    [max_pages] int32 — the sequence's table with page_id already at
+    position start//page_size (earlier entries are its earlier pages;
+    later entries may be anything — masked); start: global row of the
+    chunk's first token. Returns (pool, logits[vocab] at local position
+    length-1). Mirrors serving.prefill's math over the paged layout.
+    """
+    m = cfg.model
+    p = cfg.prefill_len  # == page_size
+    dt = jnp.dtype(m.compute_dtype)
+    nkv, hd = m.n_kv_heads, m.head_dim
+    max_pages = table_row.shape[0]
+    s_max = max_pages * p
+
+    from tpumon.loadgen.serving import decoder_forward
+
+    pos = start + jnp.arange(p, dtype=jnp.int32)[None]  # [1, P]
+    row = jnp.arange(s_max, dtype=jnp.int32)
+    mask = (row[None, :] <= pos[0][:, None])[None, None]  # [1,1,P,S]
+
+    def kv_update(li, k, v):
+        # Write the chunk into its fresh page, then attend over the
+        # sequence's pages (this chunk's page included).
+        for name, new in (("k", k), ("v", v)):
+            block = new[0].transpose(1, 0, 2)[:, None]  # [nkv, 1, ps, hd]
+            pool[name] = pool[name].at[li].set(
+                lax.dynamic_update_slice(
+                    pool[name][li], block, (0, page_id, 0, 0)))
+        ck = pool["k"][li][:, table_row]  # [nkv, max_pages, ps, hd]
+        cv = pool["v"][li][:, table_row]
+        ck = ck.reshape(nkv, s_max, hd).transpose(1, 0, 2)[None]
+        cv = cv.reshape(nkv, s_max, hd).transpose(1, 0, 2)[None]
+        return ck, cv  # [1, S, nkv, hd]
+
+    x = decoder_forward(cfg, params, tokens[None], pos, mask, kv_update)
+    last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
+    logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return pool, logits
+
+
+def paged_decode_step(cfg, params: dict, pool: dict,
+                      last_tokens: jax.Array, positions: jax.Array,
+                      tables: jax.Array) -> tuple[dict, jax.Array]:
+    """Advance every slot one token over the paged pool.
+
+    last_tokens/positions: [B] as in serving.decode_step; tables:
+    [B, max_pages] int32 per-slot page tables. The new token's K/V is
+    scattered to (tables[b, positions[b]//ps], positions[b]%ps); the
+    page must already be reserved (reservation-style allocation).
+    Returns (pool, logits [B, vocab]).
+    """
+    m = cfg.model
+    ps = cfg.prefill_len
+    dt = jnp.dtype(m.compute_dtype)
+    nkv, hd = m.n_kv_heads, m.head_dim
+    b, max_pages = tables.shape
+    s_max = max_pages * ps
+
+    from tpumon.loadgen.serving import decoder_forward
+
+    page = jnp.take_along_axis(
+        tables, (positions // ps)[:, None], axis=1)[:, 0]  # [B]
+    off = positions % ps  # [B]
+    pos = positions[:, None]
+    row = jnp.arange(s_max, dtype=jnp.int32)
+    mask = (row[None] <= positions[:, None])[:, None, None]  # [B,1,1,S]
+
+    def kv_update(li, k, v):
+        # Batched scatter: pool[li, :, page[b], off[b]] = kv[b]. The
+        # mixed basic/advanced index puts the broadcast batch dim FIRST,
+        # so the update value is [B, nkv, hd] (no transpose — passing
+        # [nkv, B, hd] would broadcast silently whenever nkv == B).
+        for name, new in (("k", k), ("v", v)):
+            pool[name] = pool[name].at[li, :, page, off].set(new[:, 0])
+        ck = pool["k"][li][:, tables]  # [nkv, B, max_pages, ps, hd]
+        cv = pool["v"][li][:, tables]
+        ck = ck.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
+        cv = cv.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
+        return ck, cv  # [B, S, nkv, hd]
+
+    x = decoder_forward(cfg, params, last_tokens[:, None], pos, mask,
+                        kv_update)
+    logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return pool, logits

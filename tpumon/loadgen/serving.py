@@ -75,6 +75,14 @@ class ServeConfig:
     # chunk-aligned prompt-prefix K/V; 0 = off. Each entry pins HBM —
     # the deliberate trade of memory for prefill FLOPs.
     prefix_cache_entries: int = 0
+    # KV layout: "dense" reserves slots*max_seq rows forever; "paged"
+    # (tpumon.loadgen.paged_kv) allocates page_size(=prefill_len) pages
+    # from a shared pool per request and frees them on completion, so
+    # resident KV scales with admitted work. pool_pages 0 sizes the
+    # pool to the dense equivalent (the win comes from setting it
+    # lower); exhaustion blocks admission instead of OOMing.
+    kv_layout: str = "dense"
+    pool_pages: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -107,6 +115,49 @@ def _gqa_repeat(kv: jax.Array, n_heads: int) -> jax.Array:
     return kv if nkv == n_heads else jnp.repeat(kv, n_heads // nkv, axis=-2)
 
 
+def decoder_forward(cfg: ServeConfig, params: dict, tokens: jax.Array,
+                    pos: jax.Array, mask: jax.Array,
+                    kv_update) -> jax.Array:
+    """The ONE transformer body shared by every serving path — dense
+    prefill/decode, speculative verify, and paged prefill/decode differ
+    only in how K/V is stored and read back, which ``kv_update``
+    abstracts; everything else (RoPE, GQA attention, SwiGLU) lives here
+    exactly once so the modes cannot drift numerically.
+
+    tokens: [B, T] int32; pos: [B, T] int32 global row positions;
+    mask: [B, 1, T, S] over the context rows kv_update returns;
+    kv_update(li, k, v): write the block's K/V ([B, T, nkv, hd]) into
+    layer li's store and return the full context (ck, cv) as
+    [B, S, nkv, hd]. Returns final-norm hidden states [B, T, D]
+    (callers apply lm_head to the rows they need).
+    """
+    m = cfg.model
+    dt = jnp.dtype(m.compute_dtype)
+    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
+    b, t = tokens.shape
+    x = params["embed"].astype(dt)[tokens]  # [B, T, D]
+    for li, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["attn_norm"])
+        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(b, t, nh, hd),
+                     pos, m.rope_theta)
+        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd),
+                     pos, m.rope_theta)
+        v = (h @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
+        ck, cv = kv_update(li, k, v)
+        kr, vr = _gqa_repeat(ck, nh), _gqa_repeat(cv, nh)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+        scores = scores / (hd**0.5)
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
+        x = x + att @ layer["wo"].astype(dt)
+        hm = _rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
+        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
+            "w_down"].astype(dt)
+    return _rms_norm(x, params["final_norm"])
+
+
 def prefill(cfg: ServeConfig, params: dict, cache: dict, tokens: jax.Array,
             length: jax.Array, slot: jax.Array,
             start: jax.Array | int = 0) -> tuple[dict, jax.Array]:
@@ -126,43 +177,28 @@ def prefill(cfg: ServeConfig, params: dict, cache: dict, tokens: jax.Array,
     m = cfg.model
     p = cfg.prefill_len
     dt = jnp.dtype(m.compute_dtype)
-    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
-    x = params["embed"].astype(dt)[tokens][None]  # [1, P, D]
+    nkv, hd = m.n_kv_heads, m.head_dim
     pos = start + jnp.arange(p, dtype=jnp.int32)[None]  # [1, P] global rows
     row = jnp.arange(m.max_seq, dtype=jnp.int32)
     # mask[i, row]: row <= start + i — prior chunks + causal within chunk.
     mask = (row[None, :] <= pos[0][:, None])[None, None]  # [1,1,P,S]
-    for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["attn_norm"])
-        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(1, p, nh, hd),
-                     pos, m.rope_theta)
-        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(1, p, nkv, hd),
-                     pos, m.rope_theta)
-        v = (h @ layer["wv"].astype(dt)).reshape(1, p, nkv, hd)
+
+    def kv_update(li, k, v):
+        # Write the chunk, then attend over the slot's whole cache
+        # (earlier chunks are already there).
         cache["k"] = lax.dynamic_update_slice(
             cache["k"], k[None], (li, slot, start, 0, 0))
         cache["v"] = lax.dynamic_update_slice(
             cache["v"], v[None], (li, slot, start, 0, 0))
-        # Attend over the slot's whole cache (like decode): earlier chunks
-        # are already there, this chunk was just written.
         ck = lax.dynamic_slice(
             cache["k"], (li, slot, 0, 0, 0), (1, 1, m.max_seq, nkv, hd)
-        )[0, 0]
+        )[0]
         cv = lax.dynamic_slice(
             cache["v"], (li, slot, 0, 0, 0), (1, 1, m.max_seq, nkv, hd)
-        )[0, 0]
-        kr, vr = _gqa_repeat(ck, nh), _gqa_repeat(cv, nh)
-        scores = jnp.einsum("bqhd,khd->bhqk", q, kr).astype(jnp.float32)
-        scores = scores / (hd**0.5)
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        att = jnp.einsum("bhqk,khd->bqhd", probs, vr).reshape(1, p, nh * hd)
-        x = x + att @ layer["wo"].astype(dt)
-        hm = _rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
-        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
-            "w_down"].astype(dt)
-    x = _rms_norm(x, params["final_norm"])
+        )[0]
+        return ck, cv  # [1, S, nkv, hd]
+
+    x = decoder_forward(cfg, params, tokens[None], pos, mask, kv_update)
     last = lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
     logits = (last @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return cache, logits
@@ -418,7 +454,48 @@ class ServingEngine:
             self.prefix_cache = PrefixCache(
                 chunk=self.cfg.prefill_len,
                 max_entries=self.cfg.prefix_cache_entries)
-        self.cache = init_cache(self.cfg)
+        # Paged KV mode (tpumon.loadgen.paged_kv).
+        self.paged = self.cfg.kv_layout == "paged"
+        if self.cfg.kv_layout not in ("dense", "paged"):
+            raise ValueError(f"unknown kv_layout {self.cfg.kv_layout!r}")
+        if self.paged:
+            if self.spec_len or self.prefix_cache is not None:
+                raise ValueError(
+                    "paged KV mode does not compose with speculative "
+                    "decoding or prefix caching yet (their cache surgery "
+                    "assumes contiguous dense rows)")
+            from tpumon.loadgen.paged_kv import (
+                PageAllocator,
+                init_pool,
+                paged_decode_step,
+                paged_prefill,
+            )
+
+            p = self.cfg.prefill_len
+            self._max_pages = -(-m.max_seq // p)  # per-slot table width
+            pool_pages = self.cfg.pool_pages or (
+                self.cfg.slots * self._max_pages + 1)
+            if pool_pages < 2:
+                raise ValueError("pool_pages must be >= 2")
+            self.pool = init_pool(self.cfg, pool_pages)
+            self.allocator = PageAllocator(pool_pages)
+            # Page 0 is the permanent trash page: freed slots' tables
+            # point at it so their garbage batched-decode writes can
+            # never corrupt pages reallocated to live requests.
+            trash = self.allocator.alloc(1)
+            assert trash == [0]
+            self._slot_pages: list[list[int]] = [
+                [] for _ in range(self.cfg.slots)]
+            self._tables_host = [
+                [0] * self._max_pages for _ in range(self.cfg.slots)]
+            self._tables_dev = jnp.zeros(
+                (self.cfg.slots, self._max_pages), jnp.int32)
+            self._tables_dirty = False
+            self._paged_prefill = jax.jit(
+                partial(paged_prefill, self.cfg), donate_argnums=(1,))
+            self._paged_decode = jax.jit(
+                partial(paged_decode_step, self.cfg), donate_argnums=(1,))
+        self.cache = init_cache(self.cfg) if not self.paged else None
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
         self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
@@ -457,13 +534,20 @@ class ServingEngine:
         stream=True attaches a queue (req.stream) that receives each
         token as it is emitted, None at end of stream."""
         m = self.cfg.model
+        max_new = max(0, int(max_new))  # negatives would corrupt paged
+        # reservation math and mean nothing in any mode
         prompt = [t % m.vocab for t in prompt][: m.max_seq - 1]
         req = Request(rid=next(self._rid), prompt=prompt or [0],
                       max_new=max_new, enqueued=time.monotonic(),
                       temperature=float(temperature), top_k=int(top_k),
                       stream=queue.Queue() if stream else None)
+        infeasible = self.paged and self._pages_needed(
+            req) > self.allocator.num_pages - 1
         with self._lock:
-            if len(self._queue) >= self.max_queue:
+            if len(self._queue) >= self.max_queue or infeasible:
+                # Queue full, or (paged) the reservation can never be
+                # satisfied by the whole pool — rejecting beats wedging
+                # the queue head forever.
                 self.rejected_total += 1
                 req.finish_stream()
                 req.done.set()
@@ -483,16 +567,48 @@ class ServingEngine:
             self._ttft_inf += 1
         self._ttft_sum += dt_s
 
+    def _pages_needed(self, req: Request) -> int:
+        """Worst-case page reservation: KV rows 0..prompt+max_new-1,
+        capped by the max_seq-1 position clamp."""
+        rows = len(req.prompt) + req.max_new
+        return max(1, min(-(-rows // self.cfg.prefill_len),
+                          self._max_pages))
+
     def _admit(self) -> None:
         for slot in range(self.cfg.slots):
             if self._slots[slot] is not None:
                 continue
+            pages: list[int] | None = None
             with self._lock:
                 if not self._queue:
                     return
+                if self.paged:
+                    # Reserve the request's worst-case pages before
+                    # admission; exhaustion blocks the queue head (KV
+                    # memory backpressure, head-of-line to stay FIFO).
+                    pages = self.allocator.alloc(
+                        self._pages_needed(self._queue[0]))
+                    if pages is None:
+                        return
                 req = self._queue.popleft()
             n = len(req.prompt)
             p = self.cfg.prefill_len
+            if self.paged:
+                self._slot_pages[slot] = pages
+                trow = self._tables_host[slot]
+                for i in range(self._max_pages):
+                    trow[i] = pages[i] if i < len(pages) else 0
+                self._tables_dirty = True
+                table_row = jnp.asarray(trow, jnp.int32)
+                for ci, c0 in enumerate(range(0, n, p)):
+                    chunk = req.prompt[c0:c0 + p]
+                    ln = len(chunk)
+                    toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
+                    self.pool, logits = self._paged_prefill(
+                        self.params, self.pool, toks, jnp.int32(ln),
+                        jnp.int32(pages[ci]), table_row, jnp.int32(c0))
+                self._after_prefill(slot, req, n, logits)
+                continue
             # Prefix cache: restore a previously-computed chunk-aligned
             # prefix's K/V (one HBM copy) and prefill only the tail. The
             # restored prefix is strictly shorter than the prompt, so
@@ -524,30 +640,44 @@ class ServingEngine:
                         self.draft_params, self.draft_cache, toks,
                         jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
                 self._draft_pos[slot] = n
-            self._sample_ctr += 1
-            first = int(sample_tokens(
-                logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
-                jnp.full((1,), req.temperature, jnp.float32),
-                jnp.full((1,), req.top_k, jnp.int32))[0])
-            with self._lock:
-                req.ttft_s = time.monotonic() - req.enqueued
-                self._observe_ttft(req.ttft_s)
-                req.emit([first])
-                self.tokens_total += 1
-            self._slots[slot] = req
-            self.positions = self.positions.at[slot].set(n)
-            self._host_positions[slot] = n
-            self.last_tokens = self.last_tokens.at[slot].set(first)
-            self._host_last[slot] = first
-            self.temps = self.temps.at[slot].set(req.temperature)
-            self.topks = self.topks.at[slot].set(req.top_k)
-            if len(req.output) >= req.max_new + 1:  # max_new == 0
-                self._complete(slot)
+            self._after_prefill(slot, req, n, logits)
+
+    def _after_prefill(self, slot: int, req: Request, n: int,
+                       logits: jax.Array) -> None:
+        """Shared admission tail: sample the first token, install the
+        request into its slot."""
+        self._sample_ctr += 1
+        first = int(sample_tokens(
+            logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
+            jnp.full((1,), req.temperature, jnp.float32),
+            jnp.full((1,), req.top_k, jnp.int32))[0])
+        with self._lock:
+            req.ttft_s = time.monotonic() - req.enqueued
+            self._observe_ttft(req.ttft_s)
+            req.emit([first])
+            self.tokens_total += 1
+        self._slots[slot] = req
+        self.positions = self.positions.at[slot].set(n)
+        self._host_positions[slot] = n
+        self.last_tokens = self.last_tokens.at[slot].set(first)
+        self._host_last[slot] = first
+        self.temps = self.temps.at[slot].set(req.temperature)
+        self.topks = self.topks.at[slot].set(req.top_k)
+        if len(req.output) >= req.max_new + 1:  # max_new == 0
+            self._complete(slot)
 
     def _complete(self, slot: int) -> None:
         req = self._slots[slot]
         assert req is not None
         self._slots[slot] = None
+        if self.paged:
+            # Free the pages and park the slot's table on the trash
+            # page so its garbage batched-decode writes can't corrupt
+            # pages reallocated to live requests.
+            self.allocator.release(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self._tables_host[slot] = [0] * self._max_pages
+            self._tables_dirty = True
         with self._lock:
             self.completed_total += 1
         req.finish_stream()
@@ -580,8 +710,16 @@ class ServingEngine:
         return pending or any(s is not None for s in self._slots)
 
     def _plain_step(self, active: list[int]) -> None:
-        self.cache, logits = self._decode(
-            self.params, self.cache, self.last_tokens, self.positions)
+        if self.paged:
+            if self._tables_dirty:
+                self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
+                self._tables_dirty = False
+            self.pool, logits = self._paged_decode(
+                self.params, self.pool, self.last_tokens, self.positions,
+                self._tables_dev)
+        else:
+            self.cache, logits = self._decode(
+                self.params, self.cache, self.last_tokens, self.positions)
         self._sample_ctr += 1
         nxt = sample_tokens(logits, self._sample_key,
                             jnp.uint32(self._sample_ctr),
@@ -771,6 +909,13 @@ class ServingEngine:
         w.counter("tpumon_serving_spec_accepted",
                   "draft tokens the target verify accepted"
                   ).add(value=spec_accepted)
+        if self.paged:
+            w.gauge("tpumon_serving_kv_pages_total",
+                    "shared KV pool pages (excl. the trash page)"
+                    ).add(value=self.allocator.num_pages - 1)
+            w.gauge("tpumon_serving_kv_pages_free",
+                    "KV pool pages not reserved by admitted requests"
+                    ).add(value=self.allocator.free_pages)
         if self.prefix_cache is not None:
             pc = self.prefix_cache
             w.counter("tpumon_serving_prefix_hits",
@@ -989,6 +1134,14 @@ def main(argv: list[str] | None = None) -> int:
                          "draft shares the target weights)")
     ap.add_argument("--prefix-cache", type=int, default=0,
                     help="prompt-prefix KV cache LRU entries (0 = off)")
+    ap.add_argument("--kv-layout", choices=["dense", "paged"],
+                    default="dense",
+                    help="paged: per-request page reservation from a "
+                         "shared pool instead of slots*max_seq rows")
+    ap.add_argument("--pool-pages", type=int, default=0,
+                    help="paged pool size in pages (0 = dense "
+                         "equivalent; smaller = real memory savings "
+                         "with admission backpressure)")
     args = ap.parse_args(argv)
     if args.spec_draft_layers and not args.spec_len:
         ap.error("--spec-draft-layers requires --spec-len > 0")
@@ -1005,6 +1158,7 @@ def main(argv: list[str] | None = None) -> int:
         model=model, slots=args.slots, prefill_len=32, quantize=args.quant,
         spec_len=args.spec_len, draft_model=draft,
         prefix_cache_entries=args.prefix_cache,
+        kv_layout=args.kv_layout, pool_pages=args.pool_pages,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "

@@ -36,8 +36,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpumon.loadgen.model import _rms_norm
-
 
 def decode_block(cfg, params: dict, cache: dict, tokens: jax.Array,
                  positions: jax.Array) -> tuple[dict, jax.Array]:
@@ -52,44 +50,27 @@ def decode_block(cfg, params: dict, cache: dict, tokens: jax.Array,
     """
     m = cfg.model
     dt = jnp.dtype(m.compute_dtype)
-    nh, nkv, hd = m.n_heads, m.n_kv_heads, m.head_dim
     b, t = tokens.shape
-    x = params["embed"].astype(dt)[tokens]  # [B, T, D]
     pos = positions[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B, T]
     row = jnp.arange(m.max_seq, dtype=jnp.int32)
     # mask[b, 1, t, row]: row <= positions[b] + t — prior context plus
     # causal order within the block (same frontier rule as decode_step).
     mask = (row[None, None] <= pos[:, :, None])[:, None]  # [B, 1, T, S]
 
-    from tpumon.loadgen.serving import _gqa_repeat, _rope_at
+    from tpumon.loadgen.serving import decoder_forward
 
     def append(cache_l: jax.Array, kv: jax.Array, p: jax.Array) -> jax.Array:
         # cache_l: [S, nkv, hd]; kv: [T, nkv, hd] — contiguous T-row write.
         return lax.dynamic_update_slice(cache_l, kv, (p, 0, 0))
 
-    for li, layer in enumerate(params["layers"]):
-        h = _rms_norm(x, layer["attn_norm"])
-        q = _rope_at((h @ layer["wq"].astype(dt)).reshape(b, t, nh, hd),
-                     pos, m.rope_theta)
-        k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd),
-                     pos, m.rope_theta)
-        v = (h @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
+    def kv_update(li, k, v):
         new_k = jax.vmap(append)(cache["k"][li], k, positions)
         new_v = jax.vmap(append)(cache["v"][li], v, positions)
         cache["k"] = cache["k"].at[li].set(new_k)
         cache["v"] = cache["v"].at[li].set(new_v)
-        kr, vr = _gqa_repeat(new_k, nh), _gqa_repeat(new_v, nh)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
-        scores = scores / (hd**0.5)
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
-        x = x + att @ layer["wo"].astype(dt)
-        hm = _rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
-        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
-            "w_down"].astype(dt)
-    x = _rms_norm(x, params["final_norm"])
+        return new_k, new_v  # [B, S, nkv, hd]
+
+    x = decoder_forward(cfg, params, tokens, pos, mask, kv_update)
     logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return cache, logits
 
