@@ -1,0 +1,255 @@
+"""Watch-based pod source: live event stream vs the poll-boundary
+blindness of list-based collection (SURVEY §2.2's missed transitions).
+Driven against a fake API server that speaks the K8s watch protocol
+(chunked JSON event lines)."""
+
+import asyncio
+import http.server
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from tpumon.alerts import AlertEngine
+from tpumon.collectors.k8s import K8sCollector, PodWatcher
+
+
+def pod_item(name, phase="Running", ns="default", rv="1"):
+    return {
+        "metadata": {"name": name, "namespace": ns, "resourceVersion": rv},
+        "status": {"phase": phase,
+                   "startTime": "2026-07-30T00:00:00Z",
+                   "containerStatuses": []},
+        "spec": {},
+    }
+
+
+class FakeWatchApi:
+    """Minimal K8s API: GET /api/v1/pods lists; ?watch=1 streams events
+    pushed via send_event() until close_stream() or shutdown."""
+
+    def __init__(self, pods):
+        self.pods = {p["metadata"]["name"]: p for p in pods}
+        self.events: "queue.Queue[dict | None]" = queue.Queue()
+        self.watch_connects = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                path, _, q = self.path.partition("?")
+                if path != "/api/v1/pods":
+                    self.send_error(404)
+                    return
+                if "watch=1" in q:
+                    outer.watch_connects += 1
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while True:
+                        ev = outer.events.get()
+                        if ev is None:  # close this stream
+                            self.wfile.write(b"0\r\n\r\n")
+                            return
+                        body = (json.dumps(ev) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(body):x}\r\n".encode() + body + b"\r\n")
+                        self.wfile.flush()
+                else:
+                    body = json.dumps({
+                        "kind": "PodList",
+                        "metadata": {"resourceVersion": "10"},
+                        "items": list(outer.pods.values()),
+                    }).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def send_event(self, kind, item):
+        self.events.put({"type": kind, "object": item})
+
+    def close_stream(self):
+        self.events.put(None)
+
+    def shutdown(self):
+        self.events.put(None)
+        self.server.shutdown()
+        self.server.server_close()  # refuse new connections immediately
+
+
+def wait_for(cond, timeout=5.0, interval=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def api():
+    srv = FakeWatchApi([pod_item("web"), pod_item("db")])
+    yield srv
+    srv.shutdown()
+
+
+def test_watcher_syncs_and_applies_events(api):
+    w = PodWatcher(api_url=api.url)
+    w.start()
+    try:
+        assert wait_for(lambda: w.synced)
+        doc, interim = w.snapshot()
+        assert {i["metadata"]["name"] for i in doc["items"]} == {"web", "db"}
+        assert interim == {}
+
+        api.send_event("MODIFIED", pod_item("web", phase="Failed"))
+        api.send_event("ADDED", pod_item("job"))
+        assert wait_for(lambda: len(w._pods) == 3)
+        doc, interim = w.snapshot()
+        names = {i["metadata"]["name"] for i in doc["items"]}
+        assert names == {"web", "db", "job"}
+        assert interim["default/web"] == ["Failed"]
+        assert interim["default/job"] == ["Running"]
+    finally:
+        w.stop()
+
+
+def test_flap_between_snapshots_recorded_then_drained(api):
+    w = PodWatcher(api_url=api.url)
+    w.start()
+    try:
+        assert wait_for(lambda: w.synced)
+        w.snapshot()
+        api.send_event("MODIFIED", pod_item("web", phase="Failed"))
+        api.send_event("MODIFIED", pod_item("web", phase="Running"))
+        assert wait_for(
+            lambda: w._interim.get("default/web") == ["Failed", "Running"])
+        doc, interim = w.snapshot()
+        # Current state looks healthy; only interim reveals the flap.
+        web = next(i for i in doc["items"]
+                   if i["metadata"]["name"] == "web")
+        assert web["status"]["phase"] == "Running"
+        assert interim["default/web"] == ["Failed", "Running"]
+        assert w.snapshot()[1] == {}  # drained
+    finally:
+        w.stop()
+
+
+def test_watcher_reconnects_after_stream_drop(api):
+    w = PodWatcher(api_url=api.url, reconnect_delay_s=0.05)
+    w.start()
+    try:
+        assert wait_for(lambda: w.synced)
+        api.close_stream()
+        assert wait_for(lambda: api.watch_connects >= 2)
+        api.send_event("ADDED", pod_item("late"))
+        assert wait_for(
+            lambda: "default/late" in w._pods)
+    finally:
+        w.stop()
+
+
+def test_error_event_forces_resync_without_ghost_pod(api):
+    w = PodWatcher(api_url=api.url, reconnect_delay_s=0.05)
+    w.start()
+    try:
+        assert wait_for(lambda: w.synced)
+        api.events.put({"type": "ERROR", "object": {
+            "kind": "Status", "code": 410, "reason": "Expired"}})
+        assert wait_for(lambda: api.watch_connects >= 2)
+        doc, _ = w.snapshot()
+        names = {i["metadata"]["name"] for i in doc["items"]}
+        assert names == {"web", "db"}  # no 'default/?' ghost entry
+    finally:
+        w.stop()
+
+
+def test_deleted_pod_excursion_still_alerts(api):
+    """A pod that fails and is deleted inside one sample interval must
+    surface — the exact sub-sample gap watch mode exists to close."""
+    c = K8sCollector(mode="watch", api_url=api.url)
+    try:
+        asyncio.run(c.collect())
+        assert wait_for(lambda: c._watcher.synced)
+        c._watcher.snapshot()  # settle initial interim
+        api.send_event("MODIFIED", pod_item("db", phase="Failed"))
+        api.send_event("DELETED", pod_item("db", phase="Failed"))
+        assert wait_for(
+            lambda: "default/db" not in c._watcher._pods)
+        s = asyncio.run(c.collect())
+        ghost = next(p for p in s.data if p["name"] == "db")
+        assert ghost["status"] == "Deleted"
+        assert "Failed" in ghost["interim_phases"]
+        out = AlertEngine().evaluate(pods=s.data)
+        keys = [a["key"] for a in out["serious"]]
+        assert "pod.default/db.flapped" in keys
+    finally:
+        c._watcher.stop()
+
+
+def test_broken_stream_degrades_but_serves_last_state(api):
+    c = K8sCollector(mode="watch", api_url=api.url)
+    try:
+        asyncio.run(c.collect())
+        assert wait_for(lambda: c._watcher.synced)
+        api.shutdown()  # API server gone
+        assert wait_for(lambda: c._watcher.last_error is not None)
+        s = asyncio.run(c.collect())
+        assert not s.ok and "degraded" in s.error
+        assert {p["name"] for p in s.data} == {"web", "db"}  # last state
+    finally:
+        c._watcher.stop()
+
+
+def test_collector_watch_mode_annotates_interim(api):
+    c = K8sCollector(mode="watch", api_url=api.url)
+    try:
+        # First sample may race the initial sync.
+        s = asyncio.run(c.collect())
+        assert wait_for(lambda: c._watcher.synced)
+        api.send_event("MODIFIED", pod_item("db", phase="Failed"))
+        api.send_event("MODIFIED", pod_item("db", phase="Running"))
+        assert wait_for(
+            lambda: c._watcher._interim.get("default/db")
+            == ["Failed", "Running"])
+        s = asyncio.run(c.collect())
+        assert s.ok
+        db = next(p for p in s.data if p["name"] == "db")
+        assert db["interim_phases"] == ["Failed", "Running"]
+        assert db["status"] == "Running"
+    finally:
+        c._watcher.stop()
+
+
+def test_engine_raises_flap_alert():
+    eng = AlertEngine()
+    pods = [{"namespace": "default", "name": "db", "status": "Running",
+             "restarts": 0, "age": "1h",
+             "interim_phases": ["Failed", "Running"]}]
+    out = eng.evaluate(pods=pods)
+    keys = [a["key"] for sev in ("critical", "serious", "minor")
+            for a in out[sev]]
+    assert "pod.default/db.flapped" in keys
+    sev = next(a for a in out["serious"]
+               if a["key"] == "pod.default/db.flapped")
+    assert "Failed" in sev["desc"] and sev["fix"]
+    # Healthy pod without excursions raises nothing.
+    out2 = AlertEngine().evaluate(pods=[
+        {"namespace": "default", "name": "db", "status": "Running",
+         "restarts": 0, "age": "1h"}])
+    keys2 = [a["key"] for sev in ("critical", "serious", "minor")
+             for a in out2[sev]]
+    assert "pod.default/db.flapped" not in keys2

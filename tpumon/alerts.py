@@ -294,6 +294,28 @@ class AlertEngine:
                         key=f"pod.{full_name}.pending",
                     )
                 )
+            # Sub-sample flap (watch-mode collector only): the pod
+            # passed through a failed phase between samples but looks
+            # healthy now — a poll-based diff would never see it
+            # (SURVEY §2.2's missed-transition gap).
+            bad_interim = [
+                ph for ph in p.get("interim_phases") or []
+                if ph in ("Failed", "Error", "Unknown")
+            ]
+            if bad_interim and status not in ("Failed", "Error"):
+                alerts.append(
+                    Alert(
+                        severity="serious",
+                        title=f"Pod {full_name} flapped",
+                        desc=f"Passed through {'/'.join(bad_interim)} "
+                        "between samples (now "
+                        f"{status})",
+                        fix="Transient failure healed by the controller — "
+                        "check logs --previous for the cause before it "
+                        "recurs under load.",
+                        key=f"pod.{full_name}.flapped",
+                    )
+                )
             if prev is not None:
                 was = prev.get(full_name)
                 if was is not None:

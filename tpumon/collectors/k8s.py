@@ -164,6 +164,146 @@ class ApiPodSource:
         return await asyncio.to_thread(self._fetch)
 
 
+class PodWatcher:
+    """Live pod map via the Kubernetes watch API (chunked event stream).
+
+    Poll-based collection — the reference's model and our ApiPodSource —
+    sees only poll-boundary states: a pod that fails and recovers inside
+    one sample interval is invisible (SURVEY §2.2 calls this out). The
+    watcher holds one long-lived ``?watch=1`` stream, applies
+    ADDED/MODIFIED/DELETED events to an in-memory pod map, and records
+    every phase a pod passes through between collector samples; the
+    collector surfaces those as ``interim_phases`` so the alert engine
+    can flag a pod that flapped through Failed even though it is Running
+    again by sample time. Reconnects with backoff on stream drop,
+    re-listing to resync (last_error says why the previous stream died).
+    """
+
+    def __init__(self, api_url: str | None = None,
+                 reconnect_delay_s: float = 1.0):
+        import threading
+
+        self.api_url = api_url
+        self.reconnect_delay_s = reconnect_delay_s
+        self._lock = threading.Lock()
+        self._pods: dict[str, dict] = {}
+        self._interim: dict[str, list[str]] = {}
+        self._synced = False
+        self.last_error: str | None = None
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        self.reconnects = 0
+
+    # -- stream plumbing ---------------------------------------------------
+
+    def _resolve(self):
+        return ApiPodSource(api_url=self.api_url)._resolve()
+
+    def _list_once(self) -> str:
+        # Delegates to the poll source so the /api/v1/pods request path
+        # (auth, TLS, timeouts) exists exactly once.
+        doc = ApiPodSource(api_url=self.api_url)._fetch()
+        with self._lock:
+            self._pods = {self._key(p): p for p in doc.get("items", [])}
+            self._synced = True
+        return doc.get("metadata", {}).get("resourceVersion", "0")
+
+    @staticmethod
+    def _key(item: dict) -> str:
+        md = item.get("metadata", {})
+        return f"{md.get('namespace', 'default')}/{md.get('name', '?')}"
+
+    def _apply(self, event: dict) -> str | None:
+        """Apply one watch event; returns its resourceVersion (for
+        resume), or raises on ERROR (forces a re-list — the standard
+        410 Gone / expired-resourceVersion protocol)."""
+        kind = event.get("type")
+        item = event.get("object") or {}
+        if kind == "ERROR":
+            raise RuntimeError(
+                f"watch ERROR event: {json.dumps(item)[:120]}")
+        if kind not in ("ADDED", "MODIFIED", "DELETED"):
+            return None  # BOOKMARK etc.: nothing to apply
+        key = self._key(item)
+        with self._lock:
+            if kind == "DELETED":
+                self._pods.pop(key, None)
+                self._interim.setdefault(key, []).append("Deleted")
+            else:
+                prev_phase = (self._pods.get(key) or {}).get(
+                    "status", {}).get("phase")
+                self._pods[key] = item
+                phase = item.get("status", {}).get("phase")
+                if phase and phase != prev_phase:
+                    self._interim.setdefault(key, []).append(phase)
+        return item.get("metadata", {}).get("resourceVersion")
+
+    def _watch_stream(self, rv: str) -> str:
+        """One watch connection; returns the last event's rv (resume
+        point) on clean server-side timeout."""
+        base, headers, ctx = self._resolve()
+        # Server-side timeoutSeconds ends quiet streams cleanly so an
+        # idle cluster doesn't register as an error; the client timeout
+        # is just the backstop for a hung connection.
+        url = (f"{base}/api/v1/pods?watch=1&resourceVersion={rv}"
+               "&timeoutSeconds=300")
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=330, context=ctx) as r:
+            for line in r:
+                if self._stop.is_set():
+                    return rv
+                line = line.strip()
+                if line:
+                    rv = self._apply(json.loads(line)) or rv
+        return rv
+
+    def _run(self) -> None:
+        rv: str | None = None
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    rv = self._list_once()
+                self.last_error = None
+                rv = self._watch_stream(rv)
+                # Clean stream end: resume from the last seen rv with
+                # no re-list and no error/backoff.
+                continue
+            except Exception as e:
+                self.last_error = f"{type(e).__name__}: {e}"
+                rv = None  # full resync on reconnect
+            if self._stop.is_set():
+                return
+            self.reconnects += 1
+            self._stop.wait(self.reconnect_delay_s)
+
+    # -- public API --------------------------------------------------------
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tpumon-pod-watch")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    @property
+    def synced(self) -> bool:
+        return self._synced
+
+    def snapshot(self) -> tuple[dict, dict[str, list[str]]]:
+        """Current PodList document + drained interim phase excursions
+        (phases each pod passed through since the previous snapshot)."""
+        with self._lock:
+            doc = {"kind": "PodList",
+                   "items": [dict(p) for p in self._pods.values()]}
+            interim, self._interim = self._interim, {}
+        return doc, interim
+
+
 @dataclass
 class KubectlPodSource:
     """Async-subprocess kubectl fallback (never blocks the event loop,
@@ -286,8 +426,12 @@ class FakePodSource:
 @dataclass
 class K8sCollector:
     name: str = "k8s"
-    mode: str = "auto"  # "auto" | "api" | "kubectl" | "fake" | "none"
+    # "auto" | "api" | "watch" | "kubectl" | "fake" | "none"
+    mode: str = "auto"
     api_url: str | None = None
+
+    def __post_init__(self):
+        self._watcher: PodWatcher | None = None
 
     def _sources(self):
         if self.mode == "api":
@@ -300,7 +444,53 @@ class K8sCollector:
             return []
         return [ApiPodSource(api_url=self.api_url), KubectlPodSource()]
 
+    def _watch_sample(self) -> Sample | None:
+        """Watch mode: serve from the live watcher map, annotating each
+        pod with the phases it passed through since the last sample."""
+        if self._watcher is None:
+            self._watcher = PodWatcher(api_url=self.api_url)
+            self._watcher.start()
+        w = self._watcher
+        if not w.synced:
+            return Sample(
+                source=self.name, ok=False, data=[],
+                error="pod watch not synced yet"
+                + (f" ({w.last_error})" if w.last_error else ""),
+            )
+        doc, interim = w.snapshot()
+        pods = parse_pod_list(doc)
+        seen = set()
+        for p in pods:
+            key = f"{p['namespace']}/{p['name']}"
+            seen.add(key)
+            phases = interim.get(key)
+            if phases:
+                p["interim_phases"] = phases
+        # Pods that vanished between samples still report their final
+        # excursions (a Job pod that fails and is deleted inside one
+        # interval is exactly the event this mode exists to catch).
+        for key, phases in interim.items():
+            if key in seen:
+                continue
+            ns, _, name = key.partition("/")
+            pods.append({
+                "namespace": ns, "name": name, "status": "Deleted",
+                "reason": None, "restarts": 0, "age": "-",
+                "interim_phases": phases,
+            })
+        if w.last_error:
+            # The stream is broken: serve the last-synced state but say
+            # so — a frozen map must not masquerade as healthy.
+            return Sample(
+                source=self.name, ok=False, data=pods,
+                error=f"pod watch degraded, serving last-synced state "
+                f"({w.last_error})",
+            )
+        return Sample(source=self.name, ok=True, data=pods)
+
     async def collect(self) -> Sample:
+        if self.mode == "watch":
+            return await asyncio.to_thread(self._watch_sample)
         errors: list[str] = []
         for source in self._sources():
             try:

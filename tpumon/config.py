@@ -126,7 +126,9 @@ class Config:
     # host cpu count: 0 => auto-detect (reference hardcoded 8, monitor_server.js:76)
     cpu_count: int = 0
     disk_mounts: tuple[str, ...] = ("/",)
-    # k8s: "auto" tries in-cluster API then kubectl; "api" | "kubectl" | "none"
+    # k8s: "auto" tries in-cluster API then kubectl; "api" | "watch"
+    # (live watch stream — catches sub-sample pod flaps) | "kubectl" |
+    # "fake" | "none"
     k8s_mode: str = "auto"
     k8s_api_url: str | None = None
     # JetStream / MaxText /metrics scrape targets (SURVEY §5.7)
