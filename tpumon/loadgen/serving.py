@@ -351,7 +351,8 @@ class ServingEngine:
                  params: dict | None = None, seed: int = 0,
                  max_queue: int = 64, ckpt_dir: str | None = None,
                  quantize: str | None = None,
-                 draft_params: dict | None = None):
+                 draft_params: dict | None = None,
+                 mesh=None):
         if cfg is None and ckpt_dir:
             # No explicit config: adopt the checkpoint's own architecture
             # so --loadgen-ckpt serves the trained weights instead of
@@ -371,6 +372,20 @@ class ServingEngine:
             import dataclasses
 
             self.cfg = dataclasses.replace(self.cfg, quantize=quantize)
+        # Validate configuration before any expensive work (param init,
+        # device placement, cache allocation).
+        if self.cfg.kv_layout not in ("dense", "paged"):
+            raise ValueError(f"unknown kv_layout {self.cfg.kv_layout!r}")
+        if self.cfg.spec_len < 0:
+            raise ValueError(
+                f"spec_len must be >= 0, got {self.cfg.spec_len}")
+        if mesh is not None and (
+                self.cfg.spec_len or self.cfg.prefix_cache_entries
+                or self.cfg.kv_layout == "paged"):
+            raise ValueError(
+                "a tensor-parallel mesh currently composes with the "
+                "dense KV layout only (no speculative decoding, prefix "
+                "caching, or paged KV)")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -402,16 +417,33 @@ class ServingEngine:
         # params stay a traced argument (closure capture would bake the
         # weights into the executable as constants, duplicating them in
         # HBM); only the cache is donated for in-place updates.
-        self._prefill = jax.jit(partial(prefill, self.cfg),
-                                donate_argnums=(1,))
-        self._decode = jax.jit(partial(decode_step, self.cfg),
-                               donate_argnums=(1,))
+        self.mesh = mesh
+        if mesh is not None:
+            # Tensor-parallel engine: the whole continuous-batching loop
+            # runs over the mesh — Megatron-split projections, KV cache
+            # sharded on its head axis, XLA inserting the psums over ICI
+            # (make_sharded_serving). Same call signatures as the
+            # single-chip jits (params are pre-placed, so the params
+            # argument the engine passes is ignored via the adapters).
+            pre_fn, dec_fn, placed, placed_cache = make_sharded_serving(
+                self.cfg, mesh, self.params)
+            self.params = placed
+            self.cache = placed_cache  # sharded on the KV-head axis
+            self._prefill = (
+                lambda _params, cache, toks, ln, slot, start:
+                pre_fn(cache, toks, ln, slot, start))
+            self._decode = (
+                lambda _params, cache, last, positions:
+                dec_fn(cache, last, positions))
+        else:
+            self._prefill = jax.jit(partial(prefill, self.cfg),
+                                    donate_argnums=(1,))
+            self._decode = jax.jit(partial(decode_step, self.cfg),
+                                   donate_argnums=(1,))
         # Speculative decoding state (after quantization so a self-
         # speculating draft shares the quantized weights, not a second
         # f32 copy).
         self.spec_len = self.cfg.spec_len
-        if self.spec_len < 0:
-            raise ValueError(f"spec_len must be >= 0, got {self.spec_len}")
         if self.spec_len:
             from tpumon.loadgen.speculative import decode_block
 
@@ -456,8 +488,6 @@ class ServingEngine:
                 max_entries=self.cfg.prefix_cache_entries)
         # Paged KV mode (tpumon.loadgen.paged_kv).
         self.paged = self.cfg.kv_layout == "paged"
-        if self.cfg.kv_layout not in ("dense", "paged"):
-            raise ValueError(f"unknown kv_layout {self.cfg.kv_layout!r}")
         if self.paged:
             if self.spec_len or self.prefix_cache is not None:
                 raise ValueError(
@@ -495,7 +525,11 @@ class ServingEngine:
                 partial(paged_prefill, self.cfg), donate_argnums=(1,))
             self._paged_decode = jax.jit(
                 partial(paged_decode_step, self.cfg), donate_argnums=(1,))
-        self.cache = init_cache(self.cfg) if not self.paged else None
+        if self.paged:
+            self.cache = None
+        elif mesh is None:
+            self.cache = init_cache(self.cfg)
+        # (mesh mode set self.cache when the sharded jits were built)
         self.positions = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._host_positions = [0] * self.cfg.slots  # mirror, avoids syncs
         self.last_tokens = jnp.zeros((self.cfg.slots,), jnp.int32)
