@@ -54,7 +54,8 @@ def test_render_status_lines_alerts_and_targets():
     serving = {
         "targets": [
             {"target": "js:9100", "ok": True, "tokens_per_sec": 1234.5,
-             "ttft_p50_ms": 42.0, "spec_accept_pct": 94.2},
+             "ttft_p50_ms": 42.0, "spec_accept_pct": 94.2,
+             "kv_pages_used_pct": 62.5},
             {"target": "trainer:9200", "ok": True, "train_step": 310.0,
              "train_loss": 2.345, "train_goodput_pct": 91.0},
             {"target": "dead:9300", "ok": False, "error": "connection refused"},
@@ -64,7 +65,8 @@ def test_render_status_lines_alerts_and_targets():
     text = "\n".join(lines)
     assert "1🔴 0🟠 1🟡" in text and "(1 silenced)" in text
     assert "[critical] HBM full: chip-0 at 97%" in text
-    assert "serve js:9100: 1234 tok/s · TTFT p50 42ms · spec 94%" in text
+    assert ("serve js:9100: 1234 tok/s · TTFT p50 42ms · spec 94% "
+            "· KV pool 62%") in text
     assert "train trainer:9200: step 310 · loss 2.345 · goodput 91%" in text
     assert "target dead:9300: DOWN (connection refused)" in text
 

@@ -35,6 +35,20 @@ def test_distill_jetstream():
     assert "tokens_per_sec" not in d  # no previous sample yet
 
 
+def test_distill_kv_pool_occupancy():
+    text = JETSTREAM_TEXT + (
+        "# TYPE tpumon_serving_kv_pages_total gauge\n"
+        "tpumon_serving_kv_pages_total 48\n"
+        "# TYPE tpumon_serving_kv_pages_free gauge\n"
+        "tpumon_serving_kv_pages_free 12\n"
+    )
+    d = distill_serving_metrics(text, now=1000.0)
+    assert d["kv_pages_total"] == 48
+    assert d["kv_pages_used_pct"] == 75.0
+    assert "kv_pages_used_pct" not in distill_serving_metrics(
+        JETSTREAM_TEXT, now=1000.0)
+
+
 def test_distill_spec_acceptance():
     text = JETSTREAM_TEXT + (
         "# TYPE tpumon_serving_spec_proposed counter\n"

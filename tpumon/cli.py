@@ -96,11 +96,13 @@ def render_status_lines(alerts: dict | None, serving: dict | None) -> list[str]:
             tps = t.get("tokens_per_sec")
             ttft = t.get("ttft_p50_ms")
             spec = t.get("spec_accept_pct")
+            kv = t.get("kv_pages_used_pct")
             lines.append(
                 f"serve {t.get('target')}:"
                 + (f" {tps:.0f} tok/s" if tps is not None else "")
                 + (f" · TTFT p50 {ttft:.0f}ms" if ttft is not None else "")
                 + (f" · spec {spec:.0f}%" if spec is not None else "")
+                + (f" · KV pool {kv:.0f}%" if kv is not None else "")
             )
         else:
             # a down target carries no train_* fields, so we can't tell

@@ -146,6 +146,14 @@ def distill_serving_metrics(
     spec_acc = _sum_samples(by_name, ("tpumon_serving_spec_accepted",))
     if spec_prop and spec_prop[1] > 0 and spec_acc:
         out["spec_accept_pct"] = 100.0 * spec_acc[1] / spec_prop[1]
+    # Paged KV pool occupancy (tpumon.loadgen.paged_kv): reserved pages
+    # over the pool — the engine's KV-memory pressure signal.
+    pg_total = _sum_samples(by_name, ("tpumon_serving_kv_pages_total",))
+    pg_free = _sum_samples(by_name, ("tpumon_serving_kv_pages_free",))
+    if pg_total and pg_total[1] > 0 and pg_free:
+        out["kv_pages_total"] = pg_total[1]
+        out["kv_pages_used_pct"] = (
+            100.0 * (pg_total[1] - pg_free[1]) / pg_total[1])
 
     # Training targets (tpumon_train_* families).
     for field_name, metric in TRAIN_GAUGES.items():
