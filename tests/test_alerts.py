@@ -35,6 +35,25 @@ def keys(result):
     return {a["key"] for sev in result.values() for a in sev if isinstance(sev, list)}
 
 
+def test_kv_pool_pressure_alert():
+    base = {"target": "eng:9105", "ok": True}
+    for pct, sev in ((50.0, None), (86.0, "serious"), (96.0, "critical")):
+        r = AlertEngine().evaluate(
+            serving=[dict(base, kv_pages_used_pct=pct)])
+        keys = [a["key"] for s in ("serious", "critical") for a in r[s]]
+        if sev:
+            assert "serving.eng:9105.kv_pool" in keys
+            a = next(a for a in r[sev]
+                     if a["key"] == "serving.eng:9105.kv_pool")
+            assert "--pool-pages" in a["fix"]
+        else:
+            assert "serving.eng:9105.kv_pool" not in keys
+    # Dense-mode targets (no kv field) never raise it.
+    r = AlertEngine().evaluate(serving=[base])
+    assert all("kv_pool" not in a["key"]
+               for s in ("serious", "critical") for a in r[s])
+
+
 def test_host_threshold_table():
     e = AlertEngine()
     # Reference thresholds 70/85/95 (monitor_server.js:163-175).

@@ -402,6 +402,23 @@ class AlertEngine:
                         key=f"serving.{s.get('target')}.down",
                     )
                 )
+            kv = s.get("kv_pages_used_pct")
+            if s.get("ok") and kv is not None:
+                sev = self.t.kv_pool_pct.severity(kv)
+                if sev:
+                    alerts.append(
+                        Alert(
+                            severity=sev,
+                            title=f"KV pool pressure on {target}",
+                            desc=f"Paged KV pool {kv:.0f}% reserved "
+                            f"(threshold "
+                            f"{getattr(self.t.kv_pool_pct, sev):.0f}%)",
+                            fix="Admissions are about to queue on KV "
+                            "memory: grow --pool-pages, lower max_new, "
+                            "or add serving replicas.",
+                            key=f"serving.{target}.kv_pool",
+                        )
+                    )
         return alerts
 
     # ----------------------------------------------------------------------

@@ -90,6 +90,9 @@ class Thresholds:
     # is stalled (serious) — wedged collective, input starvation, or a
     # checkpoint write that never returns. 0 disables.
     train_stall_s: float = 120.0
+    # Paged-serving KV pool occupancy (reserved pages / pool): high
+    # occupancy means admissions are about to queue on KV memory.
+    kv_pool_pct: TriLevel = TriLevel(None, 85, 95)
     # Anti-flap holds (Prometheus "for" / "keep_firing_for" semantics):
     # a condition must hold fire_hold_s before the alert fires, and must
     # stay clear resolve_hold_s before it resolves. 0/0 = the reference's
