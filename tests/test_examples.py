@@ -40,12 +40,21 @@ def exported_families() -> set[str]:
         "tpumon_monitor_train_step", "tpumon_monitor_train_loss",
         "tpumon_monitor_train_tokens_total",
         "tpumon_monitor_train_goodput_pct",
+        "tpumon_monitor_train_mfu_pct",
     }
     src = open(os.path.join(EXAMPLES, "..", "tpumon", "exporter.py")).read()
     for extra in names:
         if extra.startswith("tpumon_serving") or extra.startswith(
                 "tpumon_monitor") or extra == "tpumon_pods_by_phase":
             assert extra in src, f"{extra} not found in exporter.py"
+    # Families the serving ENGINE exports on its own /metrics (scraped
+    # directly by Prometheus alongside the monitor).
+    engine_src = open(os.path.join(
+        EXAMPLES, "..", "tpumon", "loadgen", "serving.py")).read()
+    for fam in ("tpumon_serving_kv_pages_total",
+                "tpumon_serving_kv_pages_free"):
+        assert fam in engine_src, f"{fam} not found in loadgen/serving.py"
+        names.add(fam)
     return names
 
 

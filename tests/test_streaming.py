@@ -115,6 +115,105 @@ def test_generate_endpoint_json_and_sse():
         server.shutdown()
 
 
+def test_stop_tokens_end_generation_early():
+    """The EOS contract: generation ends at the first stop token, which
+    is included in the output; spec and plain paths agree."""
+    plain = make_engine()
+    ref = plain.submit([9, 2, 6, 5], max_new=12)
+    plain.drain()
+    stop = ref.output[3]  # a token the run actually emits
+
+    for kw in ({}, {"spec_len": 3}, {"kv_layout": "paged"}):
+        eng = make_engine(**kw)
+        r = eng.submit([9, 2, 6, 5], max_new=12, stop_tokens=(stop,))
+        eng.drain()
+        first_stop = ref.output.index(stop)
+        assert r.output == ref.output[:first_stop + 1], kw
+        assert r.output[-1] == stop
+
+
+def test_cancel_mid_decode_frees_slot_and_pages():
+    eng = ServingEngine(cfg=ServeConfig(
+        model=SMALL, slots=2, prefill_len=8, kv_layout="paged",
+        pool_pages=9))
+    free0 = eng.allocator.free_pages
+    req = eng.submit([3, 1, 4], max_new=50)
+    other = eng.submit([9, 2], max_new=4)
+    for _ in range(3):
+        eng.step()
+    assert not req.done.is_set()
+    partial = len(req.output)
+    req.cancel()
+    eng.drain()
+    assert req.done.is_set()
+    assert len(req.output) >= partial  # partial output preserved
+    assert len(req.output) < 51  # but generation stopped early
+    assert other.done.is_set() and len(other.output) == 5
+    assert eng.allocator.free_pages == free0  # pages reclaimed
+
+
+def test_cancel_while_queued_never_runs():
+    eng = make_engine()
+    blockers = [eng.submit([1, 2], max_new=30) for _ in range(2)]
+    queued = eng.submit([5, 5], max_new=4)
+    queued.cancel()
+    eng.drain()
+    assert queued.done.is_set() and queued.output == []
+    assert queued.stream is None
+    assert all(b.done.is_set() for b in blockers)
+    # Counted as a cancellation, not a completion.
+    assert eng.cancelled_total == 1
+    assert eng.completed_total == len(blockers)
+    assert "tpumon_serving_requests_cancelled 1" in eng.metrics_text()
+
+
+def test_cancelled_queue_entries_free_capacity():
+    """Dead queued requests must not hold queue slots: with all decode
+    slots busy, cancelling queued requests makes room for fresh submits
+    instead of spurious 429-style rejections."""
+    eng = make_engine()
+    eng.max_queue = 2
+    running = [eng.submit([1, 2], max_new=40) for _ in range(2)]
+    eng.step()  # admit into both slots; queue now empty
+    stuck = [eng.submit([3], max_new=4) for _ in range(2)]  # fills queue
+    assert eng.submit([4], max_new=4).output == []  # full -> rejected
+    for r in stuck:
+        r.cancel()
+    fresh = eng.submit([5, 6], max_new=4)  # purge makes room
+    assert not fresh.done.is_set()
+    eng.drain()
+    assert fresh.done.is_set() and len(fresh.output) == 5
+    assert all(r.done.is_set() for r in running + stuck)
+
+
+def test_generate_stop_param():
+    eng = make_engine()
+    server, port = start_metrics_server(eng)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            if not eng.step():
+                stop.wait(0.005)
+
+    threading.Thread(target=loop, daemon=True).start()
+    try:
+        import json
+
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(
+                f"{base}/generate?prompt=9,2,6,5&max_new=12") as r:
+            ref = json.load(r)["tokens"]
+        s = ref[2]
+        with urllib.request.urlopen(
+                f"{base}/generate?prompt=9,2,6,5&max_new=12&stop={s}") as r:
+            out = json.load(r)["tokens"]
+        assert out == ref[:ref.index(s) + 1]
+    finally:
+        stop.set()
+        server.shutdown()
+
+
 def test_generate_queue_full_returns_429():
     eng = make_engine()
     eng.max_queue = 0

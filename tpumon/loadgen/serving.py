@@ -308,12 +308,26 @@ class Request:
     # of stream), so a consumer sees the first token at TTFT instead of
     # waiting for completion. Created by submit(stream=True).
     stream: "object | None" = None
+    # Generation ends early when an emitted token is in stop_tokens
+    # (the EOS contract; the stop token is included in output).
+    stop_tokens: tuple = ()
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self) -> None:
+        """Ask the engine to drop this request at its next step — frees
+        the slot (and paged KV pages) instead of generating for a
+        client that went away."""
+        self.cancelled.set()
 
     def emit(self, tokens: list[int]) -> None:
         self.output.extend(tokens)
         if self.stream is not None:
             for t in tokens:
                 self.stream.put(t)
+
+    def hit_stop(self) -> bool:
+        return bool(self.stop_tokens) and bool(self.output) and (
+            self.output[-1] in self.stop_tokens)
 
     def finish_stream(self) -> None:
         if self.stream is not None:
@@ -548,6 +562,7 @@ class ServingEngine:
         self.tokens_total = 0
         self.requests_total = 0
         self.rejected_total = 0
+        self.cancelled_total = 0
         self.completed_total = 0
         self.decode_steps_total = 0
         self._ttft_counts = [0] * len(TTFT_BUCKETS_S)
@@ -558,7 +573,8 @@ class ServingEngine:
 
     def submit(self, prompt: list[int], max_new: int = 16,
                temperature: float = 0.0, top_k: int = 0,
-               stream: bool = False) -> Request:
+               stream: bool = False,
+               stop_tokens: tuple = ()) -> Request:
         """Enqueue a request. When the queue is full the request is
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
@@ -574,10 +590,13 @@ class ServingEngine:
         req = Request(rid=next(self._rid), prompt=prompt or [0],
                       max_new=max_new, enqueued=time.monotonic(),
                       temperature=float(temperature), top_k=int(top_k),
-                      stream=queue.Queue() if stream else None)
+                      stream=queue.Queue() if stream else None,
+                      stop_tokens=tuple(int(t) for t in stop_tokens))
         infeasible = self.paged and self._pages_needed(
             req) > self.allocator.num_pages - 1
         with self._lock:
+            # Cancelled entries must not consume queue capacity.
+            self._purge_cancelled_locked()
             if len(self._queue) >= self.max_queue or infeasible:
                 # Queue full, or (paged) the reservation can never be
                 # satisfied by the whole pool — rejecting beats wedging
@@ -608,7 +627,25 @@ class ServingEngine:
         return max(1, min(-(-rows // self.cfg.prefill_len),
                           self._max_pages))
 
+    def _purge_cancelled_locked(self) -> None:
+        """Drop cancelled requests anywhere in the queue (caller holds
+        the lock): they must not consume capacity or ever run. Counted
+        as cancellations, not completions."""
+        if not any(r.cancelled.is_set() for r in self._queue):
+            return
+        kept: deque[Request] = deque()
+        for r in self._queue:
+            if r.cancelled.is_set():
+                self.cancelled_total += 1
+                r.finish_stream()
+                r.done.set()
+            else:
+                kept.append(r)
+        self._queue = kept
+
     def _admit(self) -> None:
+        with self._lock:
+            self._purge_cancelled_locked()
         for slot in range(self.cfg.slots):
             if self._slots[slot] is not None:
                 continue
@@ -697,7 +734,7 @@ class ServingEngine:
         self._host_last[slot] = first
         self.temps = self.temps.at[slot].set(req.temperature)
         self.topks = self.topks.at[slot].set(req.top_k)
-        if len(req.output) >= req.max_new + 1:  # max_new == 0
+        if len(req.output) >= req.max_new + 1 or req.hit_stop():
             self._complete(slot)
 
     def _complete(self, slot: int) -> None:
@@ -721,6 +758,12 @@ class ServingEngine:
         """Admit + one decode step (plain or speculative round);
         returns True if any work remains."""
         self._admit()
+        # Cancelled mid-flight requests free their slot (and paged
+        # pages) instead of decoding for a client that went away.
+        for slot in range(self.cfg.slots):
+            req = self._slots[slot]
+            if req is not None and req.cancelled.is_set():
+                self._complete(slot)
         active = [s for s in range(self.cfg.slots) if self._slots[s]]
         if active:
             # Speculative round needs room for spec_len+1 cache rows in
@@ -774,6 +817,7 @@ class ServingEngine:
                 self._host_positions[slot] + 1,
                 self.cfg.model.max_seq - 1)
             if (len(req.output) >= req.max_new + 1
+                    or req.hit_stop()
                     or self._host_positions[slot]
                     >= self.cfg.model.max_seq - 1):
                 self._complete(slot)
@@ -868,12 +912,18 @@ class ServingEngine:
             accepted_n += a
             room = req.max_new + 1 - len(req.output)
             emitted = emitted[:room]  # room >= 1: full slots completed
+            if req.stop_tokens:
+                for si, tok in enumerate(emitted):
+                    if tok in req.stop_tokens:
+                        emitted = emitted[:si + 1]
+                        break
             req.emit(emitted)
             self._host_positions[slot] += len(emitted)
             self._host_last[slot] = emitted[-1]
             self._draft_pos[slot] = self._host_positions[slot]
             emitted_n += len(emitted)
             if (len(req.output) >= req.max_new + 1
+                    or req.hit_stop()
                     or self._host_positions[slot]
                     >= self.cfg.model.max_seq - 1):
                 self._complete(slot)
@@ -901,6 +951,7 @@ class ServingEngine:
             steps = self.decode_steps_total
             queue = len(self._queue)
             rejected = self.rejected_total
+            cancelled = self.cancelled_total
             counts = list(self._ttft_counts)
             inf = self._ttft_inf
             ttft_sum = self._ttft_sum
@@ -919,6 +970,9 @@ class ServingEngine:
         w.counter("tpumon_serving_requests_rejected",
                   "requests dropped by queue backpressure"
                   ).add(value=rejected)
+        w.counter("tpumon_serving_requests_cancelled",
+                  "requests cancelled before admission"
+                  ).add(value=cancelled)
         w.counter("tpumon_serving_decode_steps", "fused decode steps"
                   ).add(value=steps)
         w.gauge("jetstream_queue_size", "requests waiting for a slot"
@@ -1022,13 +1076,16 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
                 max_new = int(q.get("max_new", ["16"])[0])
                 temp = float(q.get("temperature", ["0"])[0])
                 top_k = int(q.get("top_k", ["0"])[0])
+                stops = tuple(
+                    int(t) for t in q.get("stop", [""])[0].split(",") if t)
             except (KeyError, ValueError):
                 self._send(400, b'{"error": "bad prompt/max_new"}',
                            "application/json")
                 return
             streaming = q.get("stream", ["0"])[0] not in ("0", "")
             req = engine.submit(prompt, max_new=max_new, temperature=temp,
-                                top_k=top_k, stream=streaming)
+                                top_k=top_k, stream=streaming,
+                                stop_tokens=stops)
             if req.done.is_set() and not req.output:
                 # Queue-full backpressure must be visible to clients
                 # (retry logic keys off the status code, not the body).
@@ -1037,6 +1094,7 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
                 return
             if not streaming:
                 if not req.done.wait(timeout=60):
+                    req.cancel()  # stop generating for a timed-out call
                     self._send(504, b'{"error": "timeout"}',
                                "application/json")
                     return
@@ -1064,6 +1122,7 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
                             b'event: error\ndata: {"error": "stalled"}'
                             b"\n\n")
                         self.wfile.flush()
+                        req.cancel()  # connection is being abandoned
                         return
                     if tok is None:
                         self.wfile.write(b"event: done\ndata: {}\n\n")
@@ -1072,7 +1131,10 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
                     self.wfile.write(f"data: {tok}\n\n".encode())
                     self.wfile.flush()
             except Exception:
-                return  # client went away; just stop
+                # Client went away: cancel so the engine frees the slot
+                # instead of generating into a dead socket.
+                req.cancel()
+                return
 
         def log_message(self, *a):  # quiet
             pass
