@@ -41,6 +41,22 @@ def test_fake_serving_exposition_distills():
     assert d0["ttft_p50_ms"] > 0
     assert 500 < d1["tokens_per_sec"] < 1500  # ~900 tok/s nominal
     assert d1["queue_depth"] >= 0
+    # Demo mode exercises every serving tile, new ones included.
+    assert 80 < d1["spec_accept_pct"] < 100
+    assert 0 <= d1["kv_pages_used_pct"] <= 100
+    # Across the whole sine cycle: occupancy stays below the 85%
+    # pressure threshold (the demo must not flap alerts) and the
+    # accepted "counter" is genuinely monotonic (rate()-safe).
+    prev_acc = None
+    for t in range(0, 400, 7):
+        d = distill_serving_metrics(_fake_exposition(now=1e9 + t),
+                                    now=1e9 + t)
+        assert d["kv_pages_used_pct"] < 85
+        from tpumon.metrics_text import parse_metrics_text, samples_by_name
+        by = samples_by_name(parse_metrics_text(_fake_exposition(now=1e9 + t)))
+        acc = by["tpumon_serving_spec_accepted"][0].value
+        assert prev_acc is None or acc >= prev_acc
+        prev_acc = acc
 
 
 def test_serving_collector_fake_target():

@@ -201,6 +201,17 @@ jetstream_generate_tokens {tokens}
 jetstream_request_count {requests}
 # TYPE jetstream_queue_size gauge
 jetstream_queue_size {queue}
+# accepted integrates its wobbling rate so the counter stays monotonic
+# (rate()-safe); kv_pages_free floors at 15/96 so demo occupancy never
+# crosses the 85% pressure alert threshold (no demo alert flapping).
+# TYPE tpumon_serving_spec_proposed counter
+tpumon_serving_spec_proposed {int(t * 40)}
+# TYPE tpumon_serving_spec_accepted counter
+tpumon_serving_spec_accepted {int(35.2 * t - 180 * math.cos(t / 75))}
+# TYPE tpumon_serving_kv_pages_total gauge
+tpumon_serving_kv_pages_total 96
+# TYPE tpumon_serving_kv_pages_free gauge
+tpumon_serving_kv_pages_free {max(15, int(45 + 28 * math.sin(t / 50)))}
 """
 
 
