@@ -50,14 +50,25 @@ def test_distill_kv_pool_occupancy():
 
 
 def test_distill_spec_acceptance():
-    text = JETSTREAM_TEXT + (
-        "# TYPE tpumon_serving_spec_proposed counter\n"
-        "tpumon_serving_spec_proposed 200\n"
-        "# TYPE tpumon_serving_spec_accepted counter\n"
-        "tpumon_serving_spec_accepted 150\n"
-    )
-    d = distill_serving_metrics(text, now=1000.0)
+    def spec_text(prop, acc):
+        return JETSTREAM_TEXT + (
+            "# TYPE tpumon_serving_spec_proposed counter\n"
+            f"tpumon_serving_spec_proposed {prop}\n"
+            "# TYPE tpumon_serving_spec_accepted counter\n"
+            f"tpumon_serving_spec_accepted {acc}\n"
+        )
+
+    # First scrape: lifetime ratio.
+    d = distill_serving_metrics(spec_text(200, 150), now=1000.0)
     assert d["spec_accept_pct"] == 75.0
+    # Later scrapes: windowed delta ratio (tracks CURRENT acceptance —
+    # +100 proposed, +20 accepted since last scrape -> 20%, not the
+    # lifetime ~57%).
+    d2 = distill_serving_metrics(spec_text(300, 170), prev=d, now=1010.0)
+    assert d2["spec_accept_pct"] == 20.0
+    # Idle window (no new proposals): field omitted, not stale-repeated.
+    d3 = distill_serving_metrics(spec_text(300, 170), prev=d2, now=1020.0)
+    assert "spec_accept_pct" not in d3
     # Absent (or zero-proposal) spec counters must not emit the field.
     assert "spec_accept_pct" not in distill_serving_metrics(
         JETSTREAM_TEXT, now=1000.0)

@@ -92,9 +92,15 @@ class PrometheusClient:
             values: list[float] = []
             for t, v in series.get("values", []):
                 try:
-                    values.append(float(v))
+                    fv = float(v)
                 except ValueError:
                     continue
+                # Prometheus emits "NaN"/"Inf" strings for 0/0-style
+                # expressions; float() accepts them, but they would
+                # serialize as invalid JSON downstream — drop to a gap.
+                if fv != fv or fv in (float("inf"), float("-inf")):
+                    continue
+                values.append(fv)
                 times.append(float(t))
             out.append(Series(labels=series.get("metric", {}), times=times, values=values))
         return out

@@ -141,11 +141,23 @@ def distill_serving_metrics(
     if weights:
         out["weight_bytes"] = weights[1]  # drops ~4x when served int8
     # Speculative decoding acceptance (tpumon.loadgen.speculative):
-    # lifetime ratio of draft tokens the target verify accepted.
+    # windowed between scrapes via counter deltas (so the value tracks
+    # CURRENT acceptance, matching the PromQL rate-ratio semantics of
+    # the history series); lifetime ratio on the first scrape. Idle
+    # windows (no new proposals) omit the field rather than repeat a
+    # stale number.
     spec_prop = _sum_samples(by_name, ("tpumon_serving_spec_proposed",))
     spec_acc = _sum_samples(by_name, ("tpumon_serving_spec_accepted",))
-    if spec_prop and spec_prop[1] > 0 and spec_acc:
-        out["spec_accept_pct"] = 100.0 * spec_acc[1] / spec_prop[1]
+    if spec_prop and spec_acc:
+        out["spec_proposed_total"] = spec_prop[1]
+        out["spec_accepted_total"] = spec_acc[1]
+        if prev and "spec_proposed_total" in prev:
+            dp = spec_prop[1] - prev["spec_proposed_total"]
+            da = spec_acc[1] - prev["spec_accepted_total"]
+            if dp > 0 and 0 <= da <= dp:
+                out["spec_accept_pct"] = 100.0 * da / dp
+        elif spec_prop[1] > 0:
+            out["spec_accept_pct"] = 100.0 * spec_acc[1] / spec_prop[1]
     # Paged KV pool occupancy (tpumon.loadgen.paged_kv): reserved pages
     # over the pool — the engine's KV-memory pressure signal.
     pg_total = _sum_samples(by_name, ("tpumon_serving_kv_pages_total",))

@@ -39,6 +39,16 @@ PROM_QUERIES: dict[str, str] = {
     "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
     "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
+    # The `> 0` clause drops idle samples instead of producing 0/0
+    # NaN points (which would serialize as invalid JSON).
+    "spec_accept_pct": (
+        "100 * sum(rate(tpumon_serving_spec_accepted[5m])) "
+        "/ (sum(rate(tpumon_serving_spec_proposed[5m])) > 0)"
+    ),
+    "kv_pool_pct": (
+        "max(100 * (tpumon_serving_kv_pages_total "
+        "- tpumon_serving_kv_pages_free) / tpumon_serving_kv_pages_total)"
+    ),
     # Direct trainer series preferred; tpumon's re-export (distinct name,
     # tpumon/exporter.py) is the fallback when Prometheus only scrapes us.
     # Limitation: PromQL `or` is all-or-nothing — in a mixed deployment

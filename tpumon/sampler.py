@@ -189,28 +189,22 @@ class Sampler:
                 rec(f"chip.{c.chip_id}.mxu", c.mxu_duty_pct, ts)
                 rec(f"chip.{c.chip_id}.hbm", c.hbm_pct, ts)
         serving = self.serving_data()
-        tokens = [
-            s["tokens_per_sec"] for s in serving if s.get("tokens_per_sec") is not None
-        ]
-        if tokens:
-            rec("tokens_per_sec", sum(tokens), ts)
-        ttfts = [
-            s["ttft_p50_ms"] for s in serving if s.get("ttft_p50_ms") is not None
-        ]
-        if ttfts:
-            rec("ttft_p50_ms", sum(ttfts) / len(ttfts), ts)
-        losses = [
-            s["train_loss"] for s in serving if s.get("train_loss") is not None
-        ]
-        if losses:
-            rec("train_loss", sum(losses) / len(losses), ts)
-        train_tps = [
-            s["train_tokens_per_sec"]
-            for s in serving
-            if s.get("train_tokens_per_sec") is not None
-        ]
-        if train_tps:
-            rec("train_tokens_per_sec", sum(train_tps), ts)
+
+        def mean(vals):
+            return sum(vals) / len(vals)
+
+        # (target field, history series, cross-target reducer)
+        for key, name, agg in (
+            ("tokens_per_sec", "tokens_per_sec", sum),
+            ("ttft_p50_ms", "ttft_p50_ms", mean),
+            ("train_loss", "train_loss", mean),
+            ("train_tokens_per_sec", "train_tokens_per_sec", sum),
+            ("spec_accept_pct", "spec_accept_pct", mean),
+            ("kv_pages_used_pct", "kv_pool_pct", max),  # tightest pool
+        ):
+            vals = [s[key] for s in serving if s.get(key) is not None]
+            if vals:
+                rec(name, agg(vals), ts)
 
     def _evaluate_alerts(self) -> None:
         # Pod rules only run on a healthy scrape: a failed scrape must not
