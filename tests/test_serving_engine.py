@@ -224,3 +224,66 @@ def test_engine_lifecycle_fuzz():
     for r in served:
         assert all(0 <= t < CFG.model.vocab for t in r.output)
         assert len(r.output) <= r.max_new + 1
+
+
+def test_start_background_engine_option_passthrough():
+    """--serve-loadgen's engine options reach the engine: spec/paged
+    configs built from the default model; bad combos raise (app surfaces
+    them as usage errors)."""
+    import pytest
+
+    from tpumon.loadgen.serving import start_background
+
+    engine, url, stop = start_background(
+        rps=0.0, spec_len=2, prefix_cache=4)
+    try:
+        assert engine.spec_len == 2
+        assert engine.prefix_cache is not None
+        assert url.endswith("/metrics")
+    finally:
+        stop.set()
+
+    engine2, _, stop2 = start_background(
+        rps=0.0, kv_layout="paged", pool_pages=9)
+    try:
+        assert engine2.paged and engine2.allocator.num_pages == 9
+    finally:
+        stop2.set()
+
+    with pytest.raises(ValueError):
+        start_background(rps=0.0, kv_layout="paged", spec_len=2)
+
+
+def test_pool_pages_requires_paged_layout():
+    import pytest
+
+    from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+    with pytest.raises(ValueError, match="pool_pages"):
+        ServingEngine(cfg=ServeConfig(pool_pages=9))
+
+
+def test_start_background_ckpt_adopts_saved_architecture(tmp_path):
+    """Engine options combined with a checkpoint must serve the
+    checkpoint's architecture, not silently fall back to the demo
+    default."""
+    from tpumon.loadgen.checkpoint import saved_model_config
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.serving import start_background
+    from tpumon.loadgen.train import TrainConfig, run_train
+
+    cfg = TrainConfig(
+        model=ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2,
+                          n_kv_heads=1, d_ff=64, max_seq=32),
+        steps=2, batch=2, seq=8, ckpt_dir=str(tmp_path), ckpt_every=1)
+    run_train(cfg, log=lambda *a: None)
+    assert saved_model_config(str(tmp_path)) is not None
+
+    engine, _, stop = start_background(
+        rps=0.0, ckpt_dir=str(tmp_path), spec_len=2)
+    try:
+        assert engine.cfg.model.vocab == 64  # saved arch, not demo 512
+        assert engine.spec_len == 2
+        assert engine.ckpt_step is not None  # weights actually restored
+    finally:
+        stop.set()

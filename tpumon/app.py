@@ -117,6 +117,10 @@ def main(argv: list[str] | None = None) -> int:
     serve_loadgen = False
     loadgen_ckpt = None
     loadgen_quant = None
+    loadgen_spec = 0
+    loadgen_prefix = 0
+    loadgen_kv = "dense"
+    loadgen_pool = 0
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -125,6 +129,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{flag} requires a value", file=sys.stderr)
             raise SystemExit(2)
         return v
+
+    def take_int(flag: str) -> int:
+        v = take(flag)
+        if not v.isdigit():
+            print(f"{flag} wants an integer, got {v!r}", file=sys.stderr)
+            raise SystemExit(2)
+        return int(v)
 
     for arg in it:
         if arg in ("-c", "--config"):
@@ -164,6 +175,21 @@ def main(argv: list[str] | None = None) -> int:
             # implies --serve-loadgen.
             loadgen_quant = take(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-spec-len":
+            # Speculative decoding for the loadgen engine (implies
+            # --serve-loadgen; self-speculating draft).
+            loadgen_spec = take_int(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-prefix-cache":
+            loadgen_prefix = take_int(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-kv-layout":
+            # "dense" | "paged" KV layout for the loadgen engine.
+            loadgen_kv = take(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-pool-pages":
+            loadgen_pool = take_int(arg)
+            serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
         elif arg in ("-h", "--help"):
@@ -171,7 +197,9 @@ def main(argv: list[str] | None = None) -> int:
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
                 "[--accel-backend auto|jax|fake:v5e-8|none] [--demo] "
                 "[--serve-loadgen] [--loadgen-ckpt DIR] "
-                "[--loadgen-quant int8] [--state FILE]\n"
+                "[--loadgen-quant int8] [--loadgen-spec-len N] "
+                "[--loadgen-prefix-cache N] [--loadgen-kv-layout dense|paged] "
+                "[--loadgen-pool-pages N] [--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0
@@ -194,9 +222,15 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
-        _, url, loadgen_stop = start_background(
-            ckpt_dir=loadgen_ckpt, quantize=loadgen_quant
-        )
+        try:
+            _, url, loadgen_stop = start_background(
+                ckpt_dir=loadgen_ckpt, quantize=loadgen_quant,
+                spec_len=loadgen_spec, prefix_cache=loadgen_prefix,
+                kv_layout=loadgen_kv, pool_pages=loadgen_pool,
+            )
+        except ValueError as e:  # uncomposable/unknown engine options
+            print(f"--serve-loadgen: {e}", file=sys.stderr)
+            return 2
         collectors = tuple(cfg.collectors)
         if "serving" not in collectors:
             collectors = collectors + ("serving",)

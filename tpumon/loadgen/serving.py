@@ -356,6 +356,15 @@ def sample_tokens(logits: jax.Array, base_key: jax.Array, ctr: jax.Array,
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
+def default_engine_config() -> ServeConfig:
+    """The small demo model an engine runs when no config is given."""
+    return ServeConfig(
+        model=ModelConfig(vocab=512, d_model=128, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=256, max_seq=128),
+        slots=4, prefill_len=16,
+    )
+
+
 class ServingEngine:
     """Continuous-batching engine: submit() from any thread, step() (or
     the run loop) drives prefill/decode; /metrics-ready exposition from
@@ -377,11 +386,7 @@ class ServingEngine:
             if saved is not None:
                 cfg = ServeConfig(model=saved, slots=4,
                                   prefill_len=min(16, saved.max_seq // 2))
-        self.cfg = cfg or ServeConfig(
-            model=ModelConfig(vocab=512, d_model=128, n_layers=2, n_heads=4,
-                              n_kv_heads=2, d_ff=256, max_seq=128),
-            slots=4, prefill_len=16,
-        )
+        self.cfg = cfg or default_engine_config()
         if quantize is not None:
             import dataclasses
 
@@ -393,6 +398,10 @@ class ServingEngine:
         if self.cfg.spec_len < 0:
             raise ValueError(
                 f"spec_len must be >= 0, got {self.cfg.spec_len}")
+        if self.cfg.pool_pages and self.cfg.kv_layout != "paged":
+            raise ValueError(
+                "pool_pages requires kv_layout='paged' (a dense cache "
+                "has no page pool to size)")
         if mesh is not None and (
                 self.cfg.spec_len or self.cfg.prefix_cache_entries
                 or self.cfg.kv_layout == "paged"):
@@ -1169,7 +1178,7 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
         now = time.monotonic()
         if duration and now - t0 >= duration:
             return
-        while now >= next_arrival:
+        while rps > 0 and now >= next_arrival:
             n = rng.randint(2, engine.cfg.prefill_len)
             tail = [rng.randrange(engine.cfg.model.vocab)
                     for _ in range(n)]
@@ -1177,18 +1186,40 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
                           temperature=temperature, top_k=top_k)
             next_arrival += rng.expovariate(rps)
         if not engine.step():
-            time.sleep(min(0.05, max(0.0, next_arrival - now)))
+            time.sleep(0.05 if rps <= 0 else
+                       min(0.05, max(0.0, next_arrival - now)))
 
 
 def start_background(rps: float = 0.5, max_new: int = 16,
                      cfg: ServeConfig | None = None, port: int = 0,
                      seed: int = 0, ckpt_dir: str | None = None,
-                     quantize: str | None = None):
+                     quantize: str | None = None,
+                     spec_len: int = 0, prefix_cache: int = 0,
+                     kv_layout: str = "dense", pool_pages: int = 0):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
     whole north-star loop: a live TPU serving job AND the monitor
     scraping it."""
+    if cfg is None and (spec_len or prefix_cache or pool_pages
+                        or kv_layout != "dense"):
+        import dataclasses
+
+        # Keep the checkpoint-architecture adoption the engine would do
+        # for a bare ckpt_dir: engine options must not silently swap the
+        # served model back to the demo default.
+        base = None
+        if ckpt_dir:
+            from tpumon.loadgen.checkpoint import saved_model_config
+
+            saved = saved_model_config(ckpt_dir)
+            if saved is not None:
+                base = ServeConfig(model=saved, slots=4,
+                                   prefill_len=min(16, saved.max_seq // 2))
+        cfg = dataclasses.replace(
+            base or default_engine_config(), spec_len=spec_len,
+            prefix_cache_entries=prefix_cache,
+            kv_layout=kv_layout, pool_pages=pool_pages)
     engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
@@ -1243,6 +1274,8 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--spec-draft-layers requires --spec-len > 0")
     if args.spec_len < 0:
         ap.error("--spec-len must be >= 0")
+    if args.pool_pages and args.kv_layout != "paged":
+        ap.error("--pool-pages requires --kv-layout paged")
 
     import dataclasses
 
