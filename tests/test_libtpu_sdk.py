@@ -1,0 +1,394 @@
+"""Golden tests for the libtpu SDK metric parsers + collector merge.
+
+Every golden string below is taken verbatim from the official metric
+``description()`` examples captured on real hardware (PROBE_libtpu.md),
+so a libtpu grammar change shows up as a failing golden here rather than
+as silently-empty panels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from tpumon.collectors import run_collector
+from tpumon.collectors.accel_jax import TEMP_UNAVAILABLE_NOTE, JaxTpuCollector
+from tpumon.collectors.libtpu_sdk import (
+    IciLink,
+    LibtpuSdkSource,
+    SdkSnapshot,
+    ici_health_by_chip,
+    parse_float_list,
+    parse_ici_link_health,
+    parse_int_list,
+    parse_labeled_percentiles,
+    parse_queue_sizes,
+    parse_throttle_scores,
+)
+
+
+# ---------------------------------------------------------------- parsers
+
+def test_parse_float_list_duty_cycle_golden():
+    # duty_cycle_pct description example: [0.00, 20.00, 0.00, 0.00]
+    assert parse_float_list(["0.00", "20.00", "0.00", "0.00"]) == {
+        0: 0.0,
+        1: 20.0,
+        2: 0.0,
+        3: 0.0,
+    }
+
+
+def test_parse_float_list_skips_junk():
+    assert parse_float_list(["1.5", "garbage", "3"]) == {0: 1.5, 2: 3.0}
+    assert parse_float_list([]) == {}
+
+
+def test_parse_int_list_hbm_golden():
+    # hbm_capacity_total example: [33550229504, ...] (31.24 GiB chips)
+    data = ["33550229504", "33550229504", "33550229504", "33550229504"]
+    assert parse_int_list(data) == {i: 33550229504 for i in range(4)}
+
+
+def test_parse_int_list_hbm_usage_golden():
+    # hbm_capacity_usage example: [1073741824, 0, 0, 0]
+    assert parse_int_list(["1073741824", "0", "0", "0"]) == {
+        0: 1073741824,
+        1: 0,
+        2: 0,
+        3: 0,
+    }
+
+
+def test_parse_ici_link_health_golden():
+    # ici_link_health example: ['tray1.chip3.ici0.int: 0',
+    #                           'tray1.chip3.ici1.int: 10']
+    links = parse_ici_link_health(
+        ["tray1.chip3.ici0.int: 0", "tray1.chip3.ici1.int: 10"]
+    )
+    assert links == [
+        IciLink(location="tray1.chip3.ici0.int", chip=3, port=0, score=0),
+        IciLink(location="tray1.chip3.ici1.int", chip=3, port=1, score=10),
+    ]
+    # Worst-per-chip rollup: chip 3 carries the unusable link's score.
+    assert ici_health_by_chip(links) == {3: 10}
+
+
+def test_parse_ici_link_health_unknown_location():
+    links = parse_ici_link_health(["weird-location: 4", "nonsense", "x: bad"])
+    assert len(links) == 1
+    assert links[0].score == 4 and links[0].chip is None
+    assert ici_health_by_chip(links) == {-1: 4}
+
+
+def test_parse_throttle_scores_golden():
+    # tpu_throttle_score example: ['0-0', '1-1', '2-0', '3-0']
+    assert parse_throttle_scores(["0-0", "1-1", "2-0", "3-0"]) == {
+        0: 0,
+        1: 1,
+        2: 0,
+        3: 0,
+    }
+
+
+def test_parse_labeled_percentiles_buffer_golden():
+    # buffer_transfer_latency example:
+    # [8MB+, 100.00, 200.00, 300.00, 400.00, 500.00]
+    out = parse_labeled_percentiles(["8MB+, 100.00, 200.00, 300.00, 400.00, 500.00"])
+    assert out == {
+        "8MB+": {
+            "mean": 100.0,
+            "p50": 200.0,
+            "p90": 300.0,
+            "p95": 400.0,
+            "p999": 500.0,
+        }
+    }
+
+
+def test_parse_labeled_percentiles_collective_golden():
+    # collective_e2e_latency example label: 2MB+-ALL_REDUCE
+    out = parse_labeled_percentiles(
+        ["2MB+-ALL_REDUCE, 100.00, 200.00, 300.00, 400.00, 500.00"]
+    )
+    assert list(out) == ["2MB+-ALL_REDUCE"]
+    assert out["2MB+-ALL_REDUCE"]["p999"] == 500.0
+
+
+def test_parse_labeled_percentiles_hlo_timing_golden():
+    # hlo_execution_timing example label: tensorcore_0
+    out = parse_labeled_percentiles(
+        ["tensorcore_0, 100.00, 200.00, 300.00, 400.00, 500.00"]
+    )
+    assert out["tensorcore_0"]["mean"] == 100.0
+
+
+def test_parse_queue_sizes_golden():
+    # hlo_queue_size example: [tensorcore_0: 0, tensorcore_1: 10, ...]
+    out = parse_queue_sizes(
+        ["tensorcore_0: 0", "tensorcore_1: 10", "tensorcore_2: 20", "tensorcore_3: 30"]
+    )
+    assert out == {
+        "tensorcore_0": 0,
+        "tensorcore_1": 10,
+        "tensorcore_2": 20,
+        "tensorcore_3": 30,
+    }
+
+
+# ------------------------------------------------------------- source
+
+class _FakeMetric:
+    def __init__(self, data):
+        self._data = data
+
+    def data(self):
+        return self._data
+
+
+class _FakeTpuMonitoring:
+    """Stands in for libtpu.sdk.tpumonitoring."""
+
+    def __init__(self, payloads: dict[str, list[str]]):
+        self.payloads = payloads
+
+    def list_supported_metrics(self):
+        return list(self.payloads)
+
+    def get_metric(self, name):
+        return _FakeMetric(self.payloads[name])
+
+
+def _source_with(payloads: dict[str, list[str]]) -> LibtpuSdkSource:
+    src = LibtpuSdkSource()
+    src._mod = _FakeTpuMonitoring(payloads)
+    src._supported = list(payloads)
+    return src
+
+
+def test_sdk_source_snapshot_merges_all_metrics():
+    src = _source_with(
+        {
+            "duty_cycle_pct": ["12.50", "99.00"],
+            "hbm_capacity_usage": ["1073741824", "0"],
+            "hbm_capacity_total": ["17179869184", "17179869184"],
+            "ici_link_health": ["tray0.chip0.ici0.int: 0", "tray0.chip1.ici0.int: 7"],
+            "tpu_throttle_score": ["0-0", "1-3"],
+            "hlo_queue_size": ["tensorcore_0: 2"],
+            "buffer_transfer_latency": ["8MB+, 1.0, 2.0, 3.0, 4.0, 5.0"],
+        }
+    )
+    snap = asyncio.run(src.snapshot())
+    assert snap is not None
+    assert snap.duty_pct == {0: 12.5, 1: 99.0}
+    assert snap.hbm_used == {0: 1073741824, 1: 0}
+    assert snap.hbm_total[0] == 17179869184
+    assert snap.ici_health == {0: 0, 1: 7}
+    assert snap.throttle == {0: 0, 1: 3}
+    assert snap.extras["hlo_queue_size"] == {"tensorcore_0": 2}
+    assert "8MB+" in snap.extras["buffer_transfer_latency"]
+
+
+def test_sdk_source_all_empty_is_unavailable():
+    """The axon-tunnel case from PROBE_libtpu.md: SDK importable, every
+    metric answers [] — must read as 'source absent', not zeros."""
+    src = _source_with({name: [] for name in ("duty_cycle_pct", "ici_link_health")})
+    assert asyncio.run(src.snapshot()) is None
+
+
+def test_sdk_source_missing_module_is_unavailable():
+    src = LibtpuSdkSource()
+    src._import_failed = True
+    assert asyncio.run(src.snapshot()) is None
+
+
+def test_sdk_source_tensorcore_util_fallback():
+    src = _source_with(
+        {"duty_cycle_pct": [], "tensorcore_util": ["0.00", "20.00"]}
+    )
+    snap = asyncio.run(src.snapshot())
+    assert snap.duty_pct == {0: 0.0, 1: 20.0}
+
+
+# --------------------------------------------------- collector merge
+
+class _FakeDevice:
+    platform = "tpu"
+    device_kind = "TPU v5 lite"
+
+    def __init__(self, idx):
+        self.id = idx
+        self.local_hardware_id = idx
+        self.coords = (idx, 0, 0)
+
+    def memory_stats(self):
+        return {}
+
+
+def _collector_with_sdk(snap: SdkSnapshot | None) -> JaxTpuCollector:
+    c = JaxTpuCollector(hostname="testhost", slice_id="s0")
+    c._devices = [_FakeDevice(0), _FakeDevice(1)]
+
+    class _Sdk:
+        async def snapshot(self):
+            return snap
+
+    class _Grpc:
+        async def snapshot(self):
+            return None
+
+    c._sdk = _Sdk()
+    c._client = _Grpc()
+    return c
+
+
+def test_accel_jax_merges_sdk_snapshot():
+    snap = SdkSnapshot(
+        duty_pct={0: 42.0, 1: 7.0},
+        hbm_used={0: 2**30, 1: 0},
+        hbm_total={0: 16 * 2**30, 1: 16 * 2**30},
+        ici_health={0: 0, 1: 10},
+        throttle={0: 0, 1: 5},
+        extras={"hlo_queue_size": {"tensorcore_0": 1}},
+    )
+    c = _collector_with_sdk(snap)
+    s = asyncio.run(run_collector(c))
+    assert s.ok
+    by_idx = {ch.index: ch for ch in s.data}
+    assert by_idx[0].mxu_duty_pct == 42.0
+    assert by_idx[0].hbm_used == 2**30
+    assert by_idx[0].ici_link_health == 0
+    assert by_idx[0].ici_link_up is True
+    # Chip 1: unusable link (score 10) -> link down; throttled 50%.
+    assert by_idx[1].ici_link_health == 10
+    assert by_idx[1].ici_link_up is False
+    assert by_idx[1].throttle_score == 5
+    # temp is platform-unavailable and declared, not silently None.
+    assert by_idx[0].temp_c is None
+    assert TEMP_UNAVAILABLE_NOTE in s.notes
+    assert c.last_extras == {"hlo_queue_size": {"tensorcore_0": 1}}
+
+
+def test_accel_jax_clears_extras_when_sdk_disappears():
+    """A dead workload's HLO queue/latency extras must not be served as
+    current once the SDK stops reporting."""
+    snap = SdkSnapshot(
+        duty_pct={0: 1.0}, extras={"hlo_queue_size": {"tensorcore_0": 9}}
+    )
+    c = _collector_with_sdk(snap)
+    asyncio.run(run_collector(c))
+    assert c.last_extras
+    # Workload exits: SDK answers all-empty -> snapshot None.
+    async def gone():
+        return None
+
+    c._sdk.snapshot = gone
+    asyncio.run(run_collector(c))
+    assert c.last_extras == {}
+
+
+def test_accel_jax_unattributed_ici_links_hit_every_chip():
+    """A bad link whose location lacks a chipN token (rolled up under -1)
+    must surface on the host's chips, not vanish."""
+    snap = SdkSnapshot(duty_pct={0: 1.0, 1: 1.0}, ici_health={-1: 7})
+    c = _collector_with_sdk(snap)
+    s = asyncio.run(run_collector(c))
+    assert all(ch.ici_link_health == 7 for ch in s.data)
+
+
+def test_alert_engine_owns_link_down_from_health_score():
+    """health==10 alone (e.g. a fake-backend override that doesn't also
+    flip ici_link_up) must still raise the critical link-down alert."""
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+    from tpumon.topology import ChipSample
+
+    chip = ChipSample(
+        chip_id="h0/chip-0", host="h0", slice_id="s0", index=0, kind="v5e",
+        ici_link_health=10,  # ici_link_up left at None
+    )
+    alerts = AlertEngine(Thresholds())._chip_alerts([chip])
+    keys = {a.key for a in alerts}
+    assert "chip.h0/chip-0.ici_down" in keys
+    assert not any("ici_health" in k for k in keys)
+
+
+def test_accel_jax_no_sdk_degrades_with_note():
+    c = _collector_with_sdk(None)
+    s = asyncio.run(run_collector(c))
+    # No counter source at all: fields None, sample degraded but present.
+    assert not s.ok
+    assert all(ch.mxu_duty_pct is None for ch in s.data)
+    assert all(ch.ici_link_health is None for ch in s.data)
+    assert TEMP_UNAVAILABLE_NOTE in s.notes
+
+
+# ------------------------------------------------------- alert rules
+
+def test_ici_health_and_throttle_alerts():
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+    from tpumon.topology import ChipSample
+
+    def chip(idx, **kw):
+        return ChipSample(
+            chip_id=f"h0/chip-{idx}",
+            host="h0",
+            slice_id="s0",
+            index=idx,
+            kind="v5e",
+            **kw,
+        )
+
+    engine = AlertEngine(Thresholds())
+    chips = [
+        chip(0, ici_link_health=0, throttle_score=0),  # healthy
+        chip(1, ici_link_health=3),  # transient -> minor
+        chip(2, ici_link_health=7),  # persistent -> serious
+        chip(3, ici_link_health=10, ici_link_up=False),  # -> critical ici_down
+        chip(4, throttle_score=3),  # ~30% -> minor
+        chip(5, throttle_score=6),  # ~60% -> serious
+        chip(6, throttle_score=9),  # ~90% -> critical
+    ]
+    alerts = engine._chip_alerts(chips)
+    keys = {a.key: a.severity for a in alerts}
+    assert keys.get("chip.h0/chip-1.ici_health.minor") == "minor"
+    assert keys.get("chip.h0/chip-2.ici_health.serious") == "serious"
+    assert keys.get("chip.h0/chip-3.ici_down") == "critical"
+    # Score 10 must NOT also fire the degradation rule.
+    assert not any("chip-3.ici_health" in k for k in keys)
+    assert keys.get("chip.h0/chip-4.throttle.minor") == "minor"
+    assert keys.get("chip.h0/chip-5.throttle.serious") == "serious"
+    assert keys.get("chip.h0/chip-6.throttle.critical") == "critical"
+    assert not any("chip-0." in k for k in keys)
+
+
+def test_exporter_emits_new_gauges():
+    from tpumon.config import Config
+    from tpumon.exporter import render_exporter
+    from tpumon.sampler import Sampler
+    from tpumon.collectors import Sample
+    from tpumon.topology import ChipSample
+
+    cfg = Config()
+    sampler = Sampler(cfg)
+    sampler.latest["accel"] = Sample(
+        source="accel",
+        ok=True,
+        data=[
+            ChipSample(
+                chip_id="h0/chip-0",
+                host="h0",
+                slice_id="s0",
+                index=0,
+                kind="v5e",
+                ici_link_health=7,
+                throttle_score=2,
+            )
+        ],
+    )
+    text = render_exporter(sampler)
+    assert 'tpu_ici_link_health_score{chip="h0/chip-0"' in text
+    assert "tpu_ici_link_health_score" in text and " 7" in text
+    assert 'tpu_throttle_score{chip="h0/chip-0"' in text

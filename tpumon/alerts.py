@@ -209,7 +209,12 @@ class AlertEngine:
                         key=f"chip.{c.chip_id}.stalled",
                     )
                 )
-            if c.ici_link_up is False:
+            # Link down: either the producer says so directly, or the SDK
+            # health score hits 10 ("link is not usable"). The engine owns
+            # this derivation so a producer that sets only the score (e.g.
+            # a fake-backend override) still raises the critical alert.
+            link_down = c.ici_link_up is False or c.ici_link_health == 10
+            if link_down:
                 alerts.append(
                     Alert(
                         severity="critical",
@@ -221,6 +226,44 @@ class AlertEngine:
                         key=f"chip.{c.chip_id}.ici_down",
                     )
                 )
+            # ICI link degradation (libtpu SDK 0-10 score, PROBE_libtpu.md):
+            # 1-5 transient -> minor, 6-9 persistent -> serious. Score 10
+            # ("unusable") is the critical link-down rule above.
+            if c.ici_link_health is not None and 0 < c.ici_link_health < 10:
+                sev = self.t.ici_health_score.severity(c.ici_link_health)
+                if sev:
+                    alerts.append(
+                        Alert(
+                            severity=sev,
+                            title=f"ICI link degraded on {c.chip_id}",
+                            desc=f"Worst ICI link health score "
+                            f"{c.ici_link_health}/10 "
+                            f"({'persistent' if c.ici_link_health > 5 else 'transient'} "
+                            f"problem){pod_note}",
+                            fix="Watch collective latency on this slice; if the "
+                            "score persists above 5, drain the slice and file "
+                            "a hardware case before the link fails outright.",
+                            key=f"chip.{c.chip_id}.ici_health.{sev}",
+                        )
+                    )
+            # Throttling (libtpu SDK score 0-10 = throttled by 0-100%) —
+            # the platform's thermal/power proxy; TPUs expose no direct
+            # temperature metric (PROBE_libtpu.md finding #4).
+            if c.throttle_score is not None and c.throttle_score > 0:
+                sev = self.t.throttle_score.severity(c.throttle_score)
+                if sev:
+                    alerts.append(
+                        Alert(
+                            severity=sev,
+                            title=f"TPU throttled on {c.chip_id}",
+                            desc=f"Throttle score {c.throttle_score}/10 "
+                            f"(~{c.throttle_score * 10}% throttled){pod_note}",
+                            fix="Check node cooling/power; sustained throttling "
+                            "stretches step time. If cluster-wide, suspect "
+                            "datacenter thermals rather than one node.",
+                            key=f"chip.{c.chip_id}.throttle.{sev}",
+                        )
+                    )
         return alerts
 
     # ------------- slice rules (SURVEY §2.2 TPU re-keying) ----------------

@@ -30,6 +30,10 @@ class Sample:
     error: str | None = None
     ts: float = field(default_factory=time.time)
     latency_ms: float = 0.0
+    # Non-error caveats about the source (e.g. "temp_c unavailable on
+    # this platform") — shown in /api/health and the dashboard health
+    # strip without flipping ok to False.
+    notes: list[str] = field(default_factory=list)
 
     def health_json(self) -> dict:
         return {
@@ -38,6 +42,7 @@ class Sample:
             "error": self.error,
             "ts": self.ts,
             "latency_ms": round(self.latency_ms, 3),
+            "notes": self.notes,
         }
 
 

@@ -94,6 +94,10 @@ class FakeTpuCollector:
                     ici_tx_bytes=cumulative,
                     ici_rx_bytes=int(cumulative * 0.97),
                     ici_link_up=True,
+                    # Healthy by default; tests/demos inject degradation
+                    # via set_override (scores per PROBE_libtpu.md).
+                    ici_link_health=0,
+                    throttle_score=0,
                 )
                 ov = self.overrides.get(sample.chip_id)
                 if ov:

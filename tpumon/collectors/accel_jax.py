@@ -8,10 +8,19 @@ per chip:
    coords, process/slice membership. Always available when JAX can see
    the chip.
 2. **Counters** — in preference order:
-   a. libtpu runtime-metrics gRPC (tpumon.collectors.libtpu_grpc): HBM
+   a. in-process libtpu SDK (tpumon.collectors.libtpu_sdk): duty cycle,
+      HBM, **ICI link health**, throttle score, HLO-queue/latency extras
+      — richest source, verified available on real hardware
+      (PROBE_libtpu.md).
+   b. libtpu runtime-metrics gRPC (tpumon.collectors.libtpu_grpc): HBM
       used/total + TensorCore duty cycle — the tpu-info data path.
-   b. ``device.memory_stats()`` (PJRT): HBM bytes_in_use / bytes_limit.
-   c. nothing — fields stay None and the sample is marked degraded.
+   c. ``device.memory_stats()`` (PJRT): HBM bytes_in_use / bytes_limit.
+   d. nothing — fields stay None and the sample is marked degraded.
+
+Temperature: no TPU platform surface exposes it (no SDK metric, no
+hwmon — PROBE_libtpu.md finding #4), so ``temp_c`` is None here and the
+absence is declared via the sample's note (surfaced in /api/health and
+the dashboard health strip). Throttle score is the thermal proxy.
 
 JAX import and device enumeration happen lazily on first collect (in a
 thread, since backend init can take seconds) and are cached; per-sample
@@ -27,7 +36,15 @@ from dataclasses import dataclass, field
 
 from tpumon.collectors import Sample
 from tpumon.collectors.libtpu_grpc import LibtpuMetricsClient
+from tpumon.collectors.libtpu_sdk import LibtpuSdkSource, SdkSnapshot
 from tpumon.topology import HBM_BYTES_BY_KIND, ChipSample, normalize_chip_kind
+
+#: Health-strip note attached to every real-hardware accel sample: the
+#: platform exposes no temperature metric (PROBE_libtpu.md finding #4).
+TEMP_UNAVAILABLE_NOTE = (
+    "temp_c unavailable: no TPU platform temperature source "
+    "(PROBE_libtpu.md); throttle_score is the thermal proxy"
+)
 
 
 @dataclass
@@ -43,9 +60,15 @@ class JaxTpuCollector:
 
     _devices: list | None = field(default=None, repr=False)
     _client: LibtpuMetricsClient | None = field(default=None, repr=False)
+    _sdk: LibtpuSdkSource | None = field(default=None, repr=False)
     _libtpu_ok: bool | None = field(default=None, repr=False)
+    _sdk_ok: bool | None = field(default=None, repr=False)
     _init_error: str | None = field(default=None, repr=False)
     _collects: int = field(default=0, repr=False)
+    #: Slice-level SDK extras (HLO queue sizes, transfer/collective
+    #: latency percentiles) from the last successful SDK snapshot;
+    #: the server surfaces these under /api/accel/metrics -> "runtime".
+    last_extras: dict = field(default_factory=dict, repr=False)
 
     # Re-probe a missing libtpu metrics service every N collects: the
     # service only exists once a workload initializes libtpu, which may
@@ -62,6 +85,7 @@ class JaxTpuCollector:
                 or "slice-0"
             )
         self._client = LibtpuMetricsClient(addr=self.libtpu_addr)
+        self._sdk = LibtpuSdkSource()
 
     def _init_devices(self) -> list:
         """Blocking JAX init; run in a thread."""
@@ -99,14 +123,21 @@ class JaxTpuCollector:
                 error=self._init_error or "no local TPU devices visible to JAX",
             )
 
-        # Counter source (a): libtpu gRPC. On a miss, skip for a while but
-        # keep re-probing — the service appears when a workload starts.
+        # Counter sources, preference order (a) SDK, (b) gRPC. On a miss,
+        # skip for a while but keep re-probing — either service appears
+        # when a workload initializes libtpu in-process / on-host.
         self._collects += 1
+        reprobe = self._collects % self.LIBTPU_REPROBE_EVERY == 0
+        sdk_snap: SdkSnapshot | None = None
+        if self._sdk_ok is not False or reprobe:
+            sdk_snap = await self._sdk.snapshot()
+            self._sdk_ok = sdk_snap is not None
+            # Extras mirror the *probed* state: cleared when the SDK stops
+            # reporting so /api/accel "runtime" never serves a dead
+            # workload's queue depths as current.
+            self.last_extras = sdk_snap.extras if sdk_snap is not None else {}
         libtpu_snap = None
-        if (
-            self._libtpu_ok is not False
-            or self._collects % self.LIBTPU_REPROBE_EVERY == 0
-        ):
+        if sdk_snap is None and (self._libtpu_ok is not False or reprobe):
             libtpu_snap = await self._client.snapshot()
             self._libtpu_ok = libtpu_snap is not None
 
@@ -119,12 +150,27 @@ class JaxTpuCollector:
                 local_idx = d.id
             hbm_used = hbm_total = None
             duty = None
-            if libtpu_snap is not None:
+            ici_health = throttle = None
+            if sdk_snap is not None:
+                duty = sdk_snap.duty_pct.get(local_idx)
+                hbm_used = sdk_snap.hbm_used.get(local_idx)
+                hbm_total = sdk_snap.hbm_total.get(local_idx)
+                ici_health = sdk_snap.ici_health.get(local_idx)
+                # Links whose location string didn't carry a chipN token
+                # roll up under -1; attribute that worst score to every
+                # chip on this host (a bad link *somewhere* in the host's
+                # ICI fabric degrades the whole slice's collectives) so
+                # it can never be silently dropped.
+                unattributed = sdk_snap.ici_health.get(-1)
+                if unattributed is not None:
+                    ici_health = max(ici_health or 0, unattributed)
+                throttle = sdk_snap.throttle.get(local_idx)
+            elif libtpu_snap is not None:
                 hbm_used = libtpu_snap["hbm_used"].get(local_idx)
                 hbm_total = libtpu_snap["hbm_total"].get(local_idx)
                 duty = libtpu_snap["duty_pct"].get(local_idx)
             if hbm_used is None:
-                # Counter source (b): PJRT memory stats (process-local view).
+                # Counter source (c): PJRT memory stats (process-local view).
                 try:
                     ms = d.memory_stats()
                 except Exception:
@@ -147,7 +193,12 @@ class JaxTpuCollector:
                     mxu_duty_pct=duty,
                     hbm_used=int(hbm_used) if hbm_used is not None else None,
                     hbm_total=int(hbm_total) if hbm_total is not None else None,
-                    temp_c=None,  # not exposed by libtpu metrics today
+                    temp_c=None,  # no platform source (PROBE_libtpu.md #4)
+                    ici_link_health=ici_health,
+                    throttle_score=throttle,
+                    # A chip's ICI is down iff any of its links scores 10
+                    # ("link is not usable" per the SDK metric description).
+                    ici_link_up=(ici_health < 10) if ici_health is not None else None,
                 )
             )
         return Sample(
@@ -155,6 +206,7 @@ class JaxTpuCollector:
             ok=not degraded,
             data=chips,
             error=("; ".join(degraded) or None),
+            notes=[TEMP_UNAVAILABLE_NOTE],
         )
 
     async def close(self) -> None:

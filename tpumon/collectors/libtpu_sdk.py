@@ -1,0 +1,274 @@
+"""libtpu in-process monitoring SDK source (``libtpu.sdk.tpumonitoring``).
+
+Second real counter path next to the gRPC metrics service
+(tpumon.collectors.libtpu_grpc). Newer libtpu builds ship an in-process
+SDK that exposes strictly more than the gRPC service's three gauges —
+probed on real hardware 2026-07-31 (see PROBE_libtpu.md at the repo
+root for the committed probe log):
+
+    tensorcore_util, ici_link_health, tpu_throttle_score, duty_cycle_pct,
+    buffer_transfer_latency, collective_e2e_latency, hbm_capacity_total,
+    hbm_capacity_usage, hlo_execution_timing, hlo_queue_size, tcp_min_rtt,
+    tcp_delivery_rate, host_to_device_transfer_latency,
+    device_to_host_transfer_latency
+
+``ici_link_health`` is the TPU-native communication-observability signal
+SURVEY §5.8 keys the north star on (the analogue of the reference's DCGM
+series, monitor_server.js:128-134): per-ICI-link health scored 0-10
+(0 healthy, 1-5 transient, 6-9 persistent minor, 10 unusable).
+``tpu_throttle_score`` (0-10 = throttled by 0-100%) stands in for the
+thermal signal the platform does not export directly (no temperature
+metric exists in the SDK list, no hwmon node on TPU VMs — PROBE_libtpu.md).
+
+The SDK returns every metric as a list of *strings* whose grammar is
+only specified by each metric's description. All parsing lives in pure
+module-level functions so golden tests can pin the documented formats
+without a TPU (tests/test_libtpu_sdk.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+from dataclasses import dataclass, field
+
+# Metric names as listed by list_supported_metrics() on real hardware.
+METRIC_DUTY = "duty_cycle_pct"
+METRIC_TC_UTIL = "tensorcore_util"
+METRIC_HBM_USAGE = "hbm_capacity_usage"
+METRIC_HBM_TOTAL = "hbm_capacity_total"
+METRIC_ICI_HEALTH = "ici_link_health"
+METRIC_THROTTLE = "tpu_throttle_score"
+METRIC_HLO_QUEUE = "hlo_queue_size"
+METRIC_HLO_TIMING = "hlo_execution_timing"
+METRIC_BUFFER_LATENCY = "buffer_transfer_latency"
+METRIC_COLLECTIVE_LATENCY = "collective_e2e_latency"
+
+# Slice-level percentile metrics surfaced verbatim under /api/accel/metrics
+# "runtime" -> each parses as {label: {mean,p50,p90,p95,p999}}.
+PERCENTILE_METRICS = (
+    METRIC_BUFFER_LATENCY,
+    METRIC_COLLECTIVE_LATENCY,
+    METRIC_HLO_TIMING,
+    "host_to_device_transfer_latency",
+    "device_to_host_transfer_latency",
+)
+
+
+# ---------------------------------------------------------------------------
+# Pure parsers for the SDK's stringly-typed payloads. Each grammar comes
+# from the metric's own description() (captured in PROBE_libtpu.md).
+# All tolerate junk entries by skipping them — a monitor must not crash on
+# a runtime that evolves its exposition.
+# ---------------------------------------------------------------------------
+
+
+def parse_float_list(data: list[str]) -> dict[int, float]:
+    """``["0.00", "20.00", ...]`` -> {index: value}.
+
+    Grammar of duty_cycle_pct / tensorcore_util / tcp_* metrics: one
+    bare decimal per device, index-ordered.
+    """
+    out: dict[int, float] = {}
+    for i, s in enumerate(data):
+        try:
+            out[i] = float(str(s).strip().rstrip("%"))
+        except ValueError:
+            continue
+    return out
+
+
+def parse_int_list(data: list[str]) -> dict[int, int]:
+    """``["33550229504", ...]`` -> {index: value} (hbm_capacity_*)."""
+    out: dict[int, int] = {}
+    for i, s in enumerate(data):
+        try:
+            out[i] = int(float(str(s).strip()))
+        except ValueError:
+            continue
+    return out
+
+
+@dataclass(frozen=True)
+class IciLink:
+    """One ICI link's health reading.
+
+    Location grammar (from the metric description):
+    ``tray1.chip3.ici0.int: 0`` -> tray 1, chip 3, port 0, scope "int",
+    score 0. Score scale: 0 healthy / 1-5 transient / 6-9 persistent
+    minor / 10 unusable.
+    """
+
+    location: str
+    chip: int | None
+    port: int | None
+    score: int
+
+
+def parse_ici_link_health(data: list[str]) -> list[IciLink]:
+    links: list[IciLink] = []
+    for entry in data:
+        loc, sep, score_s = str(entry).rpartition(":")
+        if not sep:
+            continue
+        try:
+            score = int(float(score_s.strip()))
+        except ValueError:
+            continue
+        loc = loc.strip().strip("'\"")
+        chip_m = re.search(r"chip(\d+)", loc)
+        port_m = re.search(r"ici(\d+)", loc)
+        links.append(
+            IciLink(
+                location=loc,
+                chip=int(chip_m.group(1)) if chip_m else None,
+                port=int(port_m.group(1)) if port_m else None,
+                score=score,
+            )
+        )
+    return links
+
+
+def ici_health_by_chip(links: list[IciLink]) -> dict[int, int]:
+    """Worst (max) link score per chip; links with unknown chip -> key -1."""
+    out: dict[int, int] = {}
+    for ln in links:
+        key = ln.chip if ln.chip is not None else -1
+        out[key] = max(out.get(key, 0), ln.score)
+    return out
+
+
+def parse_throttle_scores(data: list[str]) -> dict[int, int]:
+    """``["0-0", "1-1", ...]`` -> {chip_id: score} (0=none .. 10=100%)."""
+    out: dict[int, int] = {}
+    for entry in data:
+        left, sep, right = str(entry).strip().strip("'\"").partition("-")
+        if not sep:
+            continue
+        try:
+            out[int(left)] = int(right)
+        except ValueError:
+            continue
+    return out
+
+
+def parse_labeled_percentiles(data: list[str]) -> dict[str, dict[str, float]]:
+    """``["8MB+, 100.00, 200.00, 300.00, 400.00, 500.00", ...]`` ->
+    {label: {mean,p50,p90,p95,p999}}. Shared by the buffer/collective/HLO
+    latency metrics; the label is everything before the first comma
+    (e.g. "2MB+-ALL_REDUCE", "tensorcore_0")."""
+    keys = ("mean", "p50", "p90", "p95", "p999")
+    out: dict[str, dict[str, float]] = {}
+    for entry in data:
+        parts = [p.strip() for p in str(entry).strip().strip("[]'\"").split(",")]
+        if len(parts) < 2:
+            continue
+        label, vals = parts[0], parts[1:]
+        try:
+            floats = [float(v) for v in vals]
+        except ValueError:
+            continue
+        out[label] = dict(zip(keys, floats))
+    return out
+
+
+def parse_queue_sizes(data: list[str]) -> dict[str, int]:
+    """``["tensorcore_0: 0", "tensorcore_1: 10", ...]`` -> {core: size}."""
+    out: dict[str, int] = {}
+    for entry in data:
+        left, sep, right = str(entry).strip().strip("'\"").partition(":")
+        if not sep:
+            continue
+        try:
+            out[left.strip()] = int(float(right))
+        except ValueError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Snapshot source
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SdkSnapshot:
+    """Per-chip maps (index-keyed, merged into ChipSample) + slice extras."""
+
+    duty_pct: dict[int, float] = field(default_factory=dict)
+    hbm_used: dict[int, int] = field(default_factory=dict)
+    hbm_total: dict[int, int] = field(default_factory=dict)
+    ici_health: dict[int, int] = field(default_factory=dict)  # worst per chip
+    ici_links: list[IciLink] = field(default_factory=list)
+    throttle: dict[int, int] = field(default_factory=dict)
+    extras: dict[str, object] = field(default_factory=dict)  # slice-level
+
+    def empty(self) -> bool:
+        return not (
+            self.duty_pct
+            or self.hbm_used
+            or self.hbm_total
+            or self.ici_health
+            or self.throttle
+        )
+
+
+class LibtpuSdkSource:
+    """Reads ``libtpu.sdk.tpumonitoring`` off-thread.
+
+    ``snapshot()`` returns None when the SDK is missing or (as on
+    axon-tunneled dev chips, PROBE_libtpu.md) present but answering every
+    metric with ``[]`` — callers treat None exactly like an absent gRPC
+    service and fall through to the next counter source.
+    """
+
+    def __init__(self) -> None:
+        self._mod = None
+        self._import_failed = False
+        self._supported: list[str] | None = None
+
+    def _api(self):
+        if self._mod is None and not self._import_failed:
+            try:
+                from libtpu.sdk import tpumonitoring  # type: ignore
+
+                self._mod = tpumonitoring
+                self._supported = list(tpumonitoring.list_supported_metrics())
+            except Exception:
+                self._import_failed = True
+        return self._mod
+
+    def _get(self, name: str) -> list[str]:
+        mod = self._api()
+        if mod is None or (self._supported and name not in self._supported):
+            return []
+        try:
+            return list(mod.get_metric(name).data())
+        except Exception:
+            return []
+
+    def _snapshot_blocking(self) -> SdkSnapshot | None:
+        if self._api() is None:
+            return None
+        snap = SdkSnapshot()
+        snap.duty_pct = parse_float_list(self._get(METRIC_DUTY))
+        if not snap.duty_pct:
+            # Per-core fallback; on single-core-per-chip parts (v5e/v6e)
+            # the index mapping is 1:1.
+            snap.duty_pct = parse_float_list(self._get(METRIC_TC_UTIL))
+        snap.hbm_used = parse_int_list(self._get(METRIC_HBM_USAGE))
+        snap.hbm_total = parse_int_list(self._get(METRIC_HBM_TOTAL))
+        snap.ici_links = parse_ici_link_health(self._get(METRIC_ICI_HEALTH))
+        snap.ici_health = ici_health_by_chip(snap.ici_links)
+        snap.throttle = parse_throttle_scores(self._get(METRIC_THROTTLE))
+        queue = parse_queue_sizes(self._get(METRIC_HLO_QUEUE))
+        if queue:
+            snap.extras["hlo_queue_size"] = queue
+        for name in PERCENTILE_METRICS:
+            pct = parse_labeled_percentiles(self._get(name))
+            if pct:
+                snap.extras[name] = pct
+        return None if snap.empty() else snap
+
+    async def snapshot(self) -> SdkSnapshot | None:
+        return await asyncio.to_thread(self._snapshot_blocking)

@@ -93,6 +93,12 @@ class Thresholds:
     # Paged-serving KV pool occupancy (reserved pages / pool): high
     # occupancy means admissions are about to queue on KV memory.
     kv_pool_pct: TriLevel = TriLevel(None, 85, 95)
+    # libtpu SDK per-chip scores, both scaled 0-10 (PROBE_libtpu.md).
+    # ICI link health: 1-5 transient problem (minor), 6-9 persistent
+    # minor problem (serious); 10 = unusable, covered by the critical
+    # link-down rule. Throttle: score N means throttled by N*10%.
+    ici_health_score: TriLevel = TriLevel(0, 5, 9)
+    throttle_score: TriLevel = TriLevel(0, 4, 7)
     # Anti-flap holds (Prometheus "for" / "keep_firing_for" semantics):
     # a condition must hold fire_hold_s before the alert fires, and must
     # stay clear resolve_hold_s before it resolves. 0/0 = the reference's

@@ -59,6 +59,13 @@ def render_exporter(sampler: Sampler) -> str:
         tx = w.counter("tpu_ici_tx_bytes_total", "Cumulative ICI bytes transmitted")
         rx = w.counter("tpu_ici_rx_bytes_total", "Cumulative ICI bytes received")
         link = w.gauge("tpu_ici_link_up", "ICI link state (1=up)")
+        ici_health = w.gauge(
+            "tpu_ici_link_health_score",
+            "Worst ICI link health per chip (0 healthy .. 10 unusable)",
+        )
+        throttle = w.gauge(
+            "tpu_throttle_score", "TPU throttle score (0 .. 10 = 100% throttled)"
+        )
         for c in chips:
             labels = {
                 "chip": c.chip_id,
@@ -82,6 +89,10 @@ def render_exporter(sampler: Sampler) -> str:
                 rx.add(labels, c.ici_rx_bytes)
             if c.ici_link_up is not None:
                 link.add(labels, 1.0 if c.ici_link_up else 0.0)
+            if c.ici_link_health is not None:
+                ici_health.add(labels, c.ici_link_health)
+            if c.throttle_score is not None:
+                throttle.add(labels, c.throttle_score)
 
     # ---- slices ----
     slices = sampler.slices()

@@ -137,6 +137,9 @@ class MonitorServer:
         return {
             "chips": chip_json,
             "slices": [v.to_json() for v in self.sampler.slices()],
+            # Slice-level libtpu SDK extras (HLO queue depth, DCN/collective
+            # latency percentiles) when the real collector exposes them.
+            "runtime": getattr(self.sampler.accel, "last_extras", None) or {},
             "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
         }
 

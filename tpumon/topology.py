@@ -63,6 +63,10 @@ class ChipSample:
     ici_tx_bytes: int | None = None  # cumulative counters
     ici_rx_bytes: int | None = None
     ici_link_up: bool | None = None
+    # libtpu SDK signals (PROBE_libtpu.md): worst ICI link score for this
+    # chip (0 healthy .. 10 unusable) and throttle score (0 .. 10 = 100%).
+    ici_link_health: int | None = None
+    throttle_score: int | None = None
 
     @property
     def hbm_pct(self) -> float | None:
@@ -86,6 +90,8 @@ class ChipSample:
             "ici_tx_bytes": self.ici_tx_bytes,
             "ici_rx_bytes": self.ici_rx_bytes,
             "ici_link_up": self.ici_link_up,
+            "ici_link_health": self.ici_link_health,
+            "throttle_score": self.throttle_score,
         }
         return d
 
