@@ -1,0 +1,126 @@
+"""Bearer-token auth for the mutating/expensive routes (VERDICT r1 #6).
+
+Default stays open (reference parity: monitor_server.js:244-248 has no
+auth — but also no mutating routes). With TPUMON_AUTH_TOKEN set, POST
+/api/silence, /api/unsilence and GET /api/profile demand
+`Authorization: Bearer <token>`; read-only routes stay open so
+dashboards and Prometheus scrapes keep working without credentials.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpumon.app import build
+from tpumon.config import load_config
+
+
+def serve(env=None):
+    base = {
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+    }
+    base.update(env or {})
+    return build(load_config(env=base))
+
+
+def request(port, path, method="GET", body=None, token=None):
+    """Returns (status, parsed-json)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+    )
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture()
+def app_with_token():
+    sampler, server = serve({"TPUMON_AUTH_TOKEN": "s3cret"})
+    loop = asyncio.new_event_loop()
+
+    async def up():
+        await sampler.tick_fast()
+        await server.start()
+        return server.port
+
+    port = loop.run_until_complete(up())
+    yield loop, port
+    loop.run_until_complete(server.stop())
+    loop.close()
+
+
+def _req(loop, port, *a, **kw):
+    return loop.run_until_complete(asyncio.to_thread(request, port, *a, **kw))
+
+
+def test_silence_requires_token(app_with_token):
+    loop, port = app_with_token
+    body = {"key": "host.cpu", "duration": "10m"}
+    status, payload = _req(loop, port, "/api/silence", "POST", body)
+    assert status == 401
+    assert "authorization" in payload["error"].lower()
+    # Wrong token, wrong scheme: still 401.
+    assert _req(loop, port, "/api/silence", "POST", body, token="nope")[0] == 401
+    status, payload = _req(loop, port, "/api/silence", "POST", body, token="s3cret")
+    assert status == 200
+    assert payload["silenced"] == "host.cpu"
+    status, payload = _req(
+        loop, port, "/api/unsilence", "POST", {"key": "host.cpu"}, token="s3cret"
+    )
+    assert status == 200 and payload["existed"] is True
+    assert _req(loop, port, "/api/unsilence", "POST", {"key": "x"})[0] == 401
+
+
+def test_profile_requires_token(app_with_token):
+    loop, port = app_with_token
+    status, _ = _req(loop, port, "/api/profile")
+    assert status == 401
+    # Status query (no capture) with the right token passes auth.
+    status, payload = _req(loop, port, "/api/profile", token="s3cret")
+    assert status in (200, 503)  # 503 only if jax were absent
+
+
+def test_readonly_routes_stay_open(app_with_token):
+    loop, port = app_with_token
+    for path in ("/api/accel/metrics", "/api/alerts", "/api/health", "/metrics"):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+
+        def fetch(r=req):
+            with urllib.request.urlopen(r) as resp:
+                return resp.status
+
+        assert loop.run_until_complete(asyncio.to_thread(fetch)) == 200
+
+
+def test_default_remains_open():
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+
+    async def up():
+        await sampler.tick_fast()
+        await server.start()
+        return server.port
+
+    port = loop.run_until_complete(up())
+    try:
+        status, payload = _req(
+            loop, port, "/api/silence", "POST", {"key": "k", "duration": "1m"}
+        )
+        assert status == 200 and payload["silenced"] == "k"
+    finally:
+        loop.run_until_complete(server.stop())
+        loop.close()

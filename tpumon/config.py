@@ -168,6 +168,15 @@ class Config:
     # Per-request access logging (method path status ms) — SURVEY §5.1.
     access_log: bool = False
 
+    # Bearer token gating the mutating/expensive routes (POST
+    # /api/silence, /api/unsilence; GET /api/profile). None (default)
+    # keeps those routes open — reference parity (monitor_server.js:
+    # 244-248 serves everything unauthenticated) — but the reference has
+    # no mutating routes, so deployments that page off tpumon alerts
+    # should set a token (TPUMON_AUTH_TOKEN) so network reach doesn't
+    # equal silence-my-pager.
+    auth_token: str | None = None
+
     thresholds: Thresholds = field(default_factory=Thresholds)
 
     def effective_cpu_count(self) -> int:
@@ -191,6 +200,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "webhook_min_severity": str,
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "auth_token": str,
 }
 # Config-file/env key -> Config field for duration-valued settings
 # ("30m"-style strings accepted via parse_duration).

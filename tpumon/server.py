@@ -46,6 +46,7 @@ metric beat a collect-on-request design.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import json
 import os
 import statistics
@@ -112,6 +113,10 @@ class MonitorServer:
             os.path.join(WEB_DIR, "dashboard.html"), "text/html; charset=utf-8"
         )
         self._logo = StaticFile(os.path.join(WEB_DIR, "logo.svg"), "image/svg+xml")
+        self._chartcore = StaticFile(
+            os.path.join(WEB_DIR, "chartcore.js"),
+            "application/javascript; charset=utf-8",
+        )
         self._profiler = None  # built lazily; jax may be absent
 
     # ------------------------------ handlers ------------------------------
@@ -292,16 +297,41 @@ class MonitorServer:
             payload = {"silenced": key, "until": until}
         return 200, "application/json", json.dumps(payload).encode()
 
+    def _check_auth(self, auth: str | None) -> None:
+        """Bearer-token gate for mutating/expensive routes. No token
+        configured => open (reference parity); configured => constant-time
+        comparison against `Authorization: Bearer <token>`."""
+        token = self.cfg.auth_token
+        if not token:
+            return
+        scheme, _, presented = (auth or "").partition(" ")
+        # Bytes comparison: compare_digest on str raises TypeError for
+        # non-ASCII input (the header arrives latin-1-decoded), which
+        # would turn a bad credential into a 500 instead of a 401.
+        if scheme.lower() != "bearer" or not hmac.compare_digest(
+            presented.strip().encode("utf-8", "surrogateescape"),
+            token.encode("utf-8"),
+        ):
+            raise HttpError(401, "authorization required (Bearer token)")
+
     async def handle(
-        self, method: str, path: str, query: str = "", body: bytes = b""
+        self,
+        method: str,
+        path: str,
+        query: str = "",
+        body: bytes = b"",
+        auth: str | None = None,
     ) -> tuple[int, str, bytes]:
         """Route a request; returns (status, content_type, body)."""
         if method == "POST":
+            self._check_auth(auth)
             return self._handle_post(path, body)
         if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
             return 200, self._dashboard.content_type, self._dashboard.read()
         if path == "/logo.svg":
             return 200, self._logo.content_type, self._logo.read()
+        if path == "/chartcore.js":
+            return 200, self._chartcore.content_type, self._chartcore.read()
         if path == "/metrics":
             return 200, "text/plain; version=0.0.4; charset=utf-8", render_exporter(
                 self.sampler
@@ -333,6 +363,7 @@ class MonitorServer:
         elif path == "/api/health":
             payload = self._api_health()
         elif path == "/api/profile":
+            self._check_auth(auth)  # capture burns device time; gate it
             payload = await self._api_profile(query)
         if payload is None:
             raise HttpError(404, "Not Found")
@@ -353,7 +384,7 @@ class MonitorServer:
             # Drain headers; Content-Length is the only one routing needs
             # (POST bodies for the silence routes).
             content_length = 0
-            origin = host_hdr = None
+            origin = host_hdr = auth_hdr = None
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
@@ -368,6 +399,8 @@ class MonitorServer:
                     origin = line.split(b":", 1)[1].strip().decode("latin-1")
                 elif lower.startswith(b"host:"):
                     host_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+                elif lower.startswith(b"authorization:"):
+                    auth_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
             # Query stripped from routing (monitor_server.js:250) but kept
             # for the routes that take parameters (/api/profile).
             path, _, query = target.partition("?")
@@ -414,7 +447,9 @@ class MonitorServer:
                     reader.readexactly(content_length), timeout=10
                 )
             try:
-                status, ctype, body = await self.handle(method, path, query, req_body)
+                status, ctype, body = await self.handle(
+                    method, path, query, req_body, auth=auth_hdr
+                )
             except HttpError as e:
                 status, ctype = e.status, "application/json"
                 body = json.dumps({"error": e.message}).encode()
