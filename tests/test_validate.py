@@ -1,0 +1,148 @@
+"""Verdict logic of the hardware validation harness (VERDICT r1 #3).
+
+The classifiers are pure functions over sampled counter values, so the
+rise/fall/skip/fail paths are all pinned here without a chip; the
+end-to-end path runs against the fake collector (synthetic counters =>
+counter checks SKIP, serving check executes for real on CPU).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from tpumon.topology import ChipSample
+from tpumon.validate import (
+    CheckResult,
+    classify_chips_visible,
+    classify_hbm_response,
+    classify_mxu_response,
+    classify_serving,
+    results_json,
+    summarize,
+    validate,
+)
+
+GIB = 2**30
+
+
+def chip(idx=0, **kw):
+    return ChipSample(
+        chip_id=f"h0/chip-{idx}", host="h0", slice_id="s0", index=idx,
+        kind="v5e", **kw,
+    )
+
+
+# ------------------------------------------------------------ chips
+
+def test_chips_visible_pass_and_fail():
+    assert classify_chips_visible([chip()]).verdict == "PASS"
+    r = classify_chips_visible([])
+    assert r.verdict == "FAIL" and "no chips" in r.detail
+
+
+# ------------------------------------------------------------ hbm
+
+def test_hbm_rise_and_fall_passes():
+    r = classify_hbm_response(2 * GIB, 7 * GIB, 3 * GIB, synthetic=False)
+    assert r.verdict == "PASS"
+    assert "during fill" in r.detail and "after release" in r.detail
+
+
+def test_hbm_no_rise_fails():
+    r = classify_hbm_response(2 * GIB, 2.1 * GIB, None, synthetic=False)
+    assert r.verdict == "FAIL" and "did not track" in r.detail
+
+
+def test_hbm_counter_vanishes_during_fill_fails():
+    assert (
+        classify_hbm_response(2 * GIB, None, None, synthetic=False).verdict
+        == "FAIL"
+    )
+
+
+def test_hbm_no_fall_is_noted_not_failed():
+    # Allocator retention / coarse counters can hold the peak briefly;
+    # the rise is the gate, the missing fall is recorded for the artifact.
+    r = classify_hbm_response(2 * GIB, 7 * GIB, 7 * GIB, synthetic=False)
+    assert r.verdict == "PASS" and "release not yet visible" in r.detail
+
+
+def test_hbm_release_measurement_missing_still_passes_rise():
+    # hbm_after None (collector raced the release): rise evidence stands.
+    assert (
+        classify_hbm_response(2 * GIB, 7 * GIB, None, synthetic=False).verdict
+        == "PASS"
+    )
+
+
+def test_hbm_skip_paths():
+    assert classify_hbm_response(None, None, None, False).verdict == "SKIP"
+    r = classify_hbm_response(2 * GIB, 7 * GIB, 3 * GIB, synthetic=True)
+    assert r.verdict == "SKIP" and "synthetic" in r.detail
+
+
+# ------------------------------------------------------------ mxu
+
+def test_mxu_rise_passes():
+    r = classify_mxu_response(1.0, [2.0, 40.0, 80.0], synthetic=False)
+    assert r.verdict == "PASS" and "peak 80.0%" in r.detail
+
+
+def test_mxu_flat_fails():
+    assert classify_mxu_response(1.0, [1.0, 1.2, None], False).verdict == "FAIL"
+
+
+def test_mxu_absolute_floor():
+    # A constant tiny counter (0.1 -> 0.4) must not pass just because it
+    # moved: the peak must clear 5% absolute.
+    assert classify_mxu_response(0.1, [0.4], False).verdict == "FAIL"
+    assert classify_mxu_response(0.1, [6.0], False).verdict == "PASS"
+
+
+def test_mxu_skip_paths():
+    assert classify_mxu_response(None, [], False).verdict == "SKIP"
+    assert classify_mxu_response(50.0, [90.0], True).verdict == "SKIP"
+
+
+# ------------------------------------------------------------ serving
+
+def test_serving_classification():
+    assert classify_serving("all good", None).verdict == "PASS"
+    assert classify_serving(None, ImportError("no jax")).verdict == "SKIP"
+    r = classify_serving(None, AssertionError("no tokens counted"))
+    assert r.verdict == "FAIL" and "no tokens" in r.detail
+
+
+# ------------------------------------------------------------ summary
+
+def test_summarize_exit_codes():
+    ok = [CheckResult("a", "PASS", ""), CheckResult("b", "SKIP", "x")]
+    assert summarize(ok)[1] == 0
+    assert summarize(ok + [CheckResult("c", "FAIL", "y")])[1] == 1
+
+
+def test_results_json_roundtrip():
+    rs = [CheckResult("a", "PASS", "fine")]
+    d = results_json(rs, backend="fake:v5e-8", seconds=1.23)
+    # The artifact the driver reads must be plain JSON with verdicts.
+    parsed = json.loads(json.dumps(d))
+    assert parsed["exit"] == 0 and parsed["backend"] == "fake:v5e-8"
+    assert parsed["checks"][0] == {
+        "check": "a", "verdict": "PASS", "detail": "fine",
+    }
+
+
+# ------------------------------------------------------------ end-to-end
+
+def test_validate_end_to_end_fake_backend():
+    """Full harness against the fake collector: chips PASS, counter
+    checks SKIP (synthetic), serving runs for real on this device."""
+    results = asyncio.run(validate("fake:v5e-8"))
+    by = {r.check: r for r in results}
+    assert by["chips-visible"].verdict == "PASS"
+    assert by["hbm-response"].verdict == "SKIP"
+    assert by["mxu-response"].verdict == "SKIP"
+    assert by["serving-engine"].verdict in ("PASS", "SKIP")
+    if by["serving-engine"].verdict == "PASS":
+        assert "outputs agree" in by["serving-engine"].detail

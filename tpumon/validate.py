@@ -14,19 +14,136 @@ On hosts where a counter source is unavailable (no libtpu metrics
 service, memory_stats unsupported) each check reports SKIP with the
 reason rather than pretending success — the same honest-degradation
 stance as the rest of the framework. Exit code: 0 if no check FAILED.
+
+The verdict logic (counter-delta assertions, skip/fail classification)
+is pure functions over sampled values — unit-tested against fake
+collectors in tests/test_validate.py — while the hardware entry point
+below stays a thin orchestrator. ``--json PATH`` writes the results as
+an artifact (VALIDATE_r{N}.json in this repo) so a run's evidence is
+committable, not just scrollback.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import sys
 import threading
 import time
+from dataclasses import asdict, dataclass
+
+
+@dataclass(frozen=True)
+class CheckResult:
+    check: str
+    verdict: str  # PASS | FAIL | SKIP
+    detail: str
 
 
 def _mean(vals: list[float | None]) -> float | None:
     xs = [v for v in vals if v is not None]
     return sum(xs) / len(xs) if xs else None
+
+
+# ---------------------------------------------------------------------------
+# Pure verdict logic (unit-tested without hardware).
+# ---------------------------------------------------------------------------
+
+
+def classify_chips_visible(chips: list) -> CheckResult:
+    if not chips:
+        return CheckResult("chips-visible", "FAIL", "no chips reported")
+    return CheckResult(
+        "chips-visible", "PASS", f"{len(chips)} chip(s), kind {chips[0].kind}"
+    )
+
+
+def classify_hbm_response(
+    hbm0: float | None,
+    hbm_during: float | None,
+    hbm_after: float | None,
+    synthetic: bool,
+) -> CheckResult:
+    """A ~30% HBM fill must register as a >=1.1x rise while held — that
+    is the hard gate. The post-release reading is recorded but does not
+    gate: allocator reservation semantics and coarse counter cadences
+    legitimately hold the peak briefly, so "didn't fall within a second"
+    must not flunk a healthy chip (it is noted for the artifact)."""
+    if synthetic:
+        return CheckResult("hbm-response", "SKIP", "synthetic backend")
+    if hbm0 is None:
+        return CheckResult("hbm-response", "SKIP", "no HBM counter source")
+    if hbm_during is None or hbm_during <= hbm0 * 1.1:
+        return CheckResult(
+            "hbm-response",
+            "FAIL",
+            f"hbm_used {hbm0} -> {hbm_during} did not track a 30% fill",
+        )
+    detail = f"{hbm0 / 2**30:.1f} -> {hbm_during / 2**30:.1f} GiB during fill"
+    if hbm_after is None:
+        pass
+    elif hbm_after < hbm_during * 0.98:
+        detail += f" -> {hbm_after / 2**30:.1f} GiB after release"
+    else:
+        detail += (
+            f"; release not yet visible ({hbm_after / 2**30:.1f} GiB — "
+            "allocator retention or coarse counter)"
+        )
+    return CheckResult("hbm-response", "PASS", detail)
+
+
+def classify_mxu_response(
+    duty0: float | None, duty_during: list[float | None], synthetic: bool
+) -> CheckResult:
+    """An MXU burn must push the duty cycle above both the idle baseline
+    and an absolute 5% floor (guards against a counter that reads a
+    constant small value)."""
+    if synthetic:
+        return CheckResult("mxu-response", "SKIP", "synthetic backend")
+    if duty0 is None:
+        return CheckResult("mxu-response", "SKIP", "no duty-cycle counter source")
+    peak = max((d for d in duty_during if d is not None), default=None)
+    if peak is not None and peak > max(duty0, 5.0):
+        return CheckResult(
+            "mxu-response",
+            "PASS",
+            f"duty {duty0:.1f}% -> peak {peak:.1f}% under burn",
+        )
+    return CheckResult(
+        "mxu-response", "FAIL", f"duty {duty0} -> {duty_during} under burn"
+    )
+
+
+def classify_serving(outcome: str | None, error: Exception | None) -> CheckResult:
+    if error is None:
+        return CheckResult("serving-engine", "PASS", outcome or "")
+    if isinstance(error, ImportError):
+        return CheckResult("serving-engine", "SKIP", f"unavailable: {error}")
+    return CheckResult(
+        "serving-engine", "FAIL", f"{type(error).__name__}: {error}"
+    )
+
+
+def summarize(results: list[CheckResult]) -> tuple[str, int]:
+    """Render the report table; exit code 1 iff any check FAILED."""
+    width = max(len(r.check) for r in results)
+    lines = [f"{r.check:<{width}}  {r.verdict:<5} {r.detail}" for r in results]
+    failed = any(r.verdict == "FAIL" for r in results)
+    return "\n".join(lines), 1 if failed else 0
+
+
+def results_json(results: list[CheckResult], backend: str, seconds: float) -> dict:
+    return {
+        "backend": backend,
+        "seconds": round(seconds, 1),
+        "exit": summarize(results)[1],
+        "checks": [asdict(r) for r in results],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Hardware orchestration (thin; no verdict logic).
+# ---------------------------------------------------------------------------
 
 
 async def _sample_chips(collector):
@@ -73,56 +190,42 @@ def _validate_serving() -> str:
             f"spec accept {d['spec_accept_pct']:.0f}%")
 
 
-async def validate(backend: str = "jax") -> int:
+async def validate(backend: str = "jax") -> list[CheckResult]:
     from tpumon.collectors.accel import make_accel_collector
     from tpumon.config import load_config
 
     cfg = load_config(env={"TPUMON_ACCEL_BACKEND": backend})
     collector = make_accel_collector(cfg)
-    results: list[tuple[str, str, str]] = []  # (check, verdict, detail)
+    results: list[CheckResult] = []
 
     chips0 = await _sample_chips(collector)
+    results.append(classify_chips_visible(chips0))
     if not chips0:
         print("validate: no chips visible — nothing to validate", file=sys.stderr)
-        results.append(("chips-visible", "FAIL", "no chips reported"))
-    else:
-        results.append(
-            ("chips-visible", "PASS", f"{len(chips0)} chip(s), kind {chips0[0].kind}")
-        )
 
     synthetic = backend.startswith("fake:")
     hbm0 = _mean([c.hbm_used for c in chips0]) if chips0 else None
 
     # ---- HBM response ----
-    if synthetic:
-        results.append(("hbm-response", "SKIP", "synthetic backend"))
-    elif hbm0 is None:
-        results.append(("hbm-response", "SKIP", "no HBM counter source"))
+    if synthetic or hbm0 is None:
+        results.append(classify_hbm_response(hbm0, None, None, synthetic))
     else:
         from tpumon.loadgen.burn import hbm_fill
 
         arrays = await asyncio.to_thread(hbm_fill, 0.3)
         await asyncio.sleep(1.0)
-        chips1 = await _sample_chips(collector)
-        hbm1 = _mean([c.hbm_used for c in chips1])
+        hbm_during = _mean([c.hbm_used for c in await _sample_chips(collector)])
         del arrays
-        if hbm1 is not None and hbm1 > hbm0 * 1.1:
-            results.append(
-                ("hbm-response", "PASS",
-                 f"{hbm0 / 2**30:.1f} -> {hbm1 / 2**30:.1f} GiB during fill")
-            )
-        else:
-            results.append(
-                ("hbm-response", "FAIL",
-                 f"hbm_used {hbm0} -> {hbm1} did not track a 30% fill")
-            )
+        await asyncio.sleep(1.0)
+        hbm_after = _mean([c.hbm_used for c in await _sample_chips(collector)])
+        results.append(
+            classify_hbm_response(hbm0, hbm_during, hbm_after, synthetic)
+        )
 
     # ---- MXU duty response ----
     duty0 = _mean([c.mxu_duty_pct for c in chips0]) if chips0 else None
-    if synthetic:
-        results.append(("mxu-response", "SKIP", "synthetic backend"))
-    elif duty0 is None:
-        results.append(("mxu-response", "SKIP", "no duty-cycle counter source"))
+    if synthetic or duty0 is None:
+        results.append(classify_mxu_response(duty0, [], synthetic))
     else:
         from tpumon.loadgen.burn import mxu_burn
 
@@ -143,15 +246,7 @@ async def validate(backend: str = "jax") -> int:
                 await asyncio.sleep(1.0)
         finally:
             stop.set()
-        peak = max((d for d in duty_during if d is not None), default=None)
-        if peak is not None and peak > max(duty0, 5.0):
-            results.append(
-                ("mxu-response", "PASS", f"duty {duty0:.1f}% -> peak {peak:.1f}% under burn")
-            )
-        else:
-            results.append(
-                ("mxu-response", "FAIL", f"duty {duty0} -> {duty_during} under burn")
-            )
+        results.append(classify_mxu_response(duty0, duty_during, synthetic))
 
     # ---- serving engine on this device ----
     # Independent of the accel backend (the engine runs on whatever jax
@@ -159,32 +254,39 @@ async def validate(backend: str = "jax") -> int:
     # SKIP rather than FAIL, like the counter checks above.
     try:
         detail = await asyncio.to_thread(_validate_serving)
-        results.append(("serving-engine", "PASS", detail))
-    except ImportError as e:
-        results.append(("serving-engine", "SKIP", f"unavailable: {e}"))
+        results.append(classify_serving(detail, None))
     except Exception as e:
-        results.append(("serving-engine", "FAIL", f"{type(e).__name__}: {e}"))
+        results.append(classify_serving(None, e))
 
-    width = max(len(r[0]) for r in results)
-    failed = False
-    for check, verdict, detail in results:
-        print(f"{check:<{width}}  {verdict:<5} {detail}")
-        failed |= verdict == "FAIL"
-    return 1 if failed else 0
+    return results
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     backend = "jax"
+    json_path = None
     if "--backend" in argv:
         i = argv.index("--backend")
         if i + 1 >= len(argv):
             print("--backend requires a value", file=sys.stderr)
             return 2
         backend = argv[i + 1]
+    if "--json" in argv:
+        i = argv.index("--json")
+        if i + 1 >= len(argv):
+            print("--json requires a path", file=sys.stderr)
+            return 2
+        json_path = argv[i + 1]
     start = time.time()
-    code = asyncio.run(validate(backend))
-    print(f"validate: done in {time.time() - start:.1f}s, exit {code}")
+    results = asyncio.run(validate(backend))
+    report, code = summarize(results)
+    print(report)
+    elapsed = time.time() - start
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(results_json(results, backend, elapsed), f, indent=1)
+        print(f"validate: wrote {json_path}")
+    print(f"validate: done in {elapsed:.1f}s, exit {code}")
     return code
 
 
