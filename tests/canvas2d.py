@@ -1,0 +1,142 @@
+"""Recording 2D-canvas stub + SVG emitter (test infrastructure).
+
+A dict-shaped stand-in for CanvasRenderingContext2D that chartcore.js
+draws against under jsmini: every method call and style assignment is
+recorded as an op, so tests can assert on the real draw sequence and
+tools/render_dashboard.py can replay the ops as an SVG — the committed
+rendered-dashboard artifact (the reference ships screenshot.png of a
+live deployment; no browser exists in this environment, so the SVG is
+produced by executing the actual shipped chart code instead).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+
+class RecordingCtx:
+    """Build with .js() -> the dict object handed to interpreted JS."""
+
+    STYLE_PROPS = (
+        "strokeStyle", "fillStyle", "lineWidth", "globalAlpha", "font",
+        "textAlign", "textBaseline",
+    )
+
+    def __init__(self) -> None:
+        self.ops: list[tuple] = []
+        self._style: dict[str, Any] = {
+            "strokeStyle": "#000", "fillStyle": "#000", "lineWidth": 1.0,
+            "globalAlpha": 1.0, "font": "10px system-ui",
+            "textAlign": "left", "textBaseline": "alphabetic",
+        }
+        self._obj: dict[str, Any] = {}
+        for name in (
+            "clearRect", "beginPath", "closePath", "moveTo", "lineTo",
+            "stroke", "fill", "fillText", "arc", "setTransform", "rect",
+        ):
+            self._obj[name] = self._recorder(name)
+        self._obj.update(self._style)
+
+    def _recorder(self, name: str):
+        def record(*args):
+            # Style properties are plain dict entries mutated by JS
+            # assignment; snapshot the current values with each op.
+            style = {k: self._obj.get(k, v) for k, v in self._style.items()}
+            self.ops.append((name, args, style))
+
+        return record
+
+    def js(self) -> dict:
+        return self._obj
+
+    # -- assertions helpers --
+    def calls(self, name: str) -> list[tuple]:
+        return [op for op in self.ops if op[0] == name]
+
+
+def _esc(s: str) -> str:
+    return (
+        str(s).replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+    )
+
+
+def ops_to_svg(ops: list[tuple], width: float, height: float,
+               background: str = "#121a33") -> str:
+    """Replay recorded canvas ops as an SVG document (paths, text, arcs)."""
+    out = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{height}" viewBox="0 0 {width} {height}" '
+        f'font-family="system-ui, sans-serif">',
+        f'<rect width="{width}" height="{height}" fill="{background}"/>',
+    ]
+    path: list[str] = []
+
+    def flush(kind: str, style: dict) -> None:
+        if not path:
+            return
+        d = " ".join(path)
+        alpha = style["globalAlpha"]
+        if kind == "stroke":
+            out.append(
+                f'<path d="{d}" fill="none" stroke="{_css(style["strokeStyle"])}" '
+                f'stroke-width="{style["lineWidth"]}" opacity="{alpha}"/>'
+            )
+        else:
+            out.append(
+                f'<path d="{d}" fill="{_css(style["fillStyle"])}" '
+                f'stroke="none" opacity="{alpha}"/>'
+            )
+
+    for name, args, style in ops:
+        if name == "beginPath":
+            path.clear()
+        elif name == "moveTo":
+            path.append(f"M {args[0]:.1f} {args[1]:.1f}")
+        elif name == "lineTo":
+            path.append(f"L {args[0]:.1f} {args[1]:.1f}")
+        elif name == "closePath":
+            path.append("Z")
+        elif name == "arc":
+            x, y, r, a0, a1 = (float(a) for a in args[:5])
+            if abs(a1 - a0) >= 2 * math.pi - 1e-6:
+                path.append(
+                    f"M {x + r:.1f} {y:.1f} "
+                    f"A {r:.1f} {r:.1f} 0 1 1 {x - r:.1f} {y:.1f} "
+                    f"A {r:.1f} {r:.1f} 0 1 1 {x + r:.1f} {y:.1f}"
+                )
+            else:
+                x0, y0 = x + r * math.cos(a0), y + r * math.sin(a0)
+                x1, y1 = x + r * math.cos(a1), y + r * math.sin(a1)
+                large = 1 if (a1 - a0) % (2 * math.pi) > math.pi else 0
+                path.append(
+                    f"M {x0:.1f} {y0:.1f} "
+                    f"A {r:.1f} {r:.1f} 0 {large} 1 {x1:.1f} {y1:.1f}"
+                )
+        elif name == "stroke":
+            flush("stroke", style)
+        elif name == "fill":
+            flush("fill", style)
+        elif name == "fillText":
+            text, x, y = args[0], float(args[1]), float(args[2])
+            anchor = {"left": "start", "center": "middle", "right": "end"}[
+                style["textAlign"] if style["textAlign"] in
+                ("left", "center", "right") else "left"
+            ]
+            size = style["font"].split("px")[0]
+            dy = {"top": "0.9em", "middle": "0.35em"}.get(
+                style["textBaseline"], "0"
+            )
+            out.append(
+                f'<text x="{x:.1f}" y="{y:.1f}" fill="{_css(style["fillStyle"])}" '
+                f'font-size="{size}" text-anchor="{anchor}" dy="{dy}" '
+                f'opacity="{style["globalAlpha"]}">{_esc(text)}</text>'
+            )
+        # clearRect/setTransform/rect: no-ops for the SVG replay
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def _css(color) -> str:
+    """Canvas colors pass through; jsmini hands us plain strings."""
+    return str(color)

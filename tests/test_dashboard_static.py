@@ -1,10 +1,12 @@
 """UI smoke via static consistency (SURVEY §4.5).
 
-No browser/JS engine exists in the test environment, so instead of driving
-the page headless we pin the contract between the dashboard script and the
-rest of the system: every endpoint the script fetches must be served, every
-DOM id the script touches must exist in the markup, and the polling
-cadences must match the reference's (monitor.html:605-609)."""
+The page's *pure* logic (chart engine, topology layout, formatters)
+lives in tpumon/web/chartcore.js and IS executed by tests —
+tests/test_chartcore.py runs it under the in-repo jsmini interpreter.
+This module covers the DOM-bound remainder statically: every endpoint
+the script fetches must be served, every DOM id the script touches must
+exist in the markup, and the polling cadences must match the
+reference's (monitor.html:605-609)."""
 
 import asyncio
 import os
@@ -28,7 +30,11 @@ def html():
 
 @pytest.fixture(scope="module")
 def script(html):
-    return html.split("<script>")[1].split("</script>")[0]
+    """The inline script PLUS chartcore.js — together they are what the
+    browser executes (dashboard.html includes /chartcore.js first)."""
+    inline = html.split("<script>")[1].split("</script>")[0]
+    with open(os.path.join(os.path.dirname(HTML_PATH), "chartcore.js")) as f:
+        return f.read() + "\n" + inline
 
 
 def test_fetched_endpoints_are_served(script):

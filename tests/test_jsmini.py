@@ -1,0 +1,252 @@
+"""Semantics tests for the jsmini ES-subset interpreter (test infra).
+
+jsmini executes tpumon/web/chartcore.js in CI; these tests pin the JS
+semantics the chart core depends on, so an interpreter bug can't
+silently green-light broken frontend logic.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from tests.jsmini import UNDEF, Interp, JsError, JsSyntaxError, load
+
+
+def run(src, call=None, *args):
+    interp = load(src)
+    if call:
+        return interp.call(call, *args)
+    return None
+
+
+def ev(expr):
+    return Interp().run(f"const __r = {expr};") or Interp().run(f"__x({expr})") \
+        if False else _ev(expr)
+
+
+def _ev(expr):
+    interp = Interp()
+    interp.run(f"function __f() {{ return {expr}; }}")
+    return interp.call("__f")
+
+
+# ------------------------------------------------------------ basics
+
+def test_arithmetic_and_precedence():
+    assert _ev("2 + 3 * 4") == 14
+    assert _ev("(2 + 3) * 4") == 20
+    assert _ev("2 ** 3 ** 2") == 512  # right-assoc
+    assert _ev("7 % 3") == 1
+    assert _ev("-7 % 3") == -1  # JS truncating modulo
+    assert _ev("1 / 0") == math.inf
+    assert math.isnan(_ev("0 / 0"))
+
+
+def test_string_concat_js_semantics():
+    assert _ev("'a' + 1") == "a1"
+    assert _ev("1.5 + 'x'") == "1.5x"
+    assert _ev("1 + 2 + 'x'") == "3x"
+    # Integral floats render without a decimal point, like JS.
+    assert _ev("(10 * 10) + '%'") == "100%"
+    assert _ev("null + ''") == "null"
+    assert _ev("undefined + ''") == "undefined"
+
+
+def test_equality():
+    assert _ev("null == undefined") is True
+    assert _ev("null === undefined") is False
+    assert _ev("0 == null") is False
+    assert _ev("'1' == 1") is True
+    assert _ev("'1' === 1") is False
+    assert _ev("NaN === NaN") is False
+
+
+def test_truthiness_and_logic():
+    assert _ev("0 || 'fallback'") == "fallback"
+    assert _ev("'' || 'x'") == "x"
+    assert _ev("0 ?? 'x'") == 0  # ?? only replaces null/undefined
+    assert _ev("null ?? 'x'") == "x"
+    assert _ev("1 && 2") == 2
+    assert _ev("!0") is True
+
+
+def test_ternary_and_comparison_nan():
+    assert _ev("5 > 3 ? 'a' : 'b'") == "a"
+    assert _ev("NaN > 1") is False
+    assert _ev("NaN <= 1") is False
+
+
+# ------------------------------------------------------------ control flow
+
+def test_functions_closures_recursion():
+    assert run("""
+function fib(n) { return n < 2 ? n : fib(n-1) + fib(n-2); }
+""", "fib", 10.0) == 55
+
+
+def test_loops():
+    assert run("""
+function sum(n) {
+  let t = 0;
+  for (let i = 1; i <= n; i++) t += i;
+  return t;
+}
+""", "sum", 100.0) == 5050
+    assert run("""
+function sumOf(xs) {
+  let t = 0;
+  for (const x of xs) { if (x == null) continue; t += x; }
+  return t;
+}
+""", "sumOf", [1.0, None, 2.0, UNDEF, 3.0]) == 6
+    assert run("""
+function firstBig(xs) {
+  let out = -1;
+  for (const x of xs) { if (x > 10) { out = x; break; } }
+  return out;
+}
+""", "firstBig", [1.0, 50.0, 99.0]) == 50
+
+
+def test_while_and_compound_assign():
+    assert run("""
+function f(v) {
+  let i = 0;
+  while (v >= 1000 && i < 4) { v /= 1000; i++; }
+  return [v, i];
+}
+""", "f", 2.5e9) == [2.5, 3]
+
+
+# ------------------------------------------------------------ data
+
+def test_arrays_and_methods():
+    assert _ev("[1,2,3].map(x => x * 2)") == [2, 4, 6]
+    assert _ev("[1,2,3,4].filter(x => x % 2 === 0)") == [2, 4]
+    assert _ev("[3,1,2].sort((a,b) => a-b)") == [1, 2, 3]
+    assert _ev("[1,2,3].reduce((a,b) => a+b, 0)") == 6
+    assert _ev("['a','b'].join('-')") == "a-b"
+    assert _ev("[1,2,3].slice(1)") == [2, 3]
+    assert _ev("[1,2,3].slice(0, -1)") == [1, 2]
+    assert _ev("[1,2].concat([3], 4)") == [1, 2, 3, 4]
+    assert _ev("[1,2,3].includes(2)") is True
+    assert _ev("[[1,2],[3]].flat()") == [1, 2, 3]
+    assert _ev("Math.max(...[3, 1, 4])") == 4
+    assert _ev("[...([1,2]), 3]") == [1, 2, 3]
+
+
+def test_array_length_and_index():
+    assert _ev("[1,2,3].length") == 3
+    assert _ev("[1,2,3][0]") == 1
+    assert _ev("[1,2,3][9]") is UNDEF
+
+
+def test_objects():
+    assert _ev("({a: 1, b: 2}).a") == 1
+    assert _ev("({a: 1}).missing") is UNDEF
+    assert _ev("Object.keys({x: 1, y: 2})") == ["x", "y"]
+    interp = load("""
+function f() {
+  const o = { n: 0 };
+  o.n += 5; o['m'] = 2;
+  return o.n * 10 + o.m;
+}
+""")
+    assert interp.call("f") == 52
+
+
+def test_optional_chaining():
+    assert _ev("(null)?.x") is UNDEF
+    assert _ev("({a: {b: 3}})?.a?.b") == 3
+    assert _ev("(undefined)?.x ?? 'dash'") == "dash"
+
+
+def test_destructuring():
+    assert run("""
+function f() { const [a, b] = [10, 20]; return a + b; }
+""", "f") == 30
+
+
+def test_template_literals():
+    assert run("""
+function f(name, pct) { return `${name}: ${pct.toFixed(1)}%`; }
+""", "f", "cpu", 42.345) == "cpu: 42.3%"
+
+
+def test_number_formatting():
+    assert _ev("(5).toFixed(0)") == "5"
+    assert _ev("(1234.567).toFixed(1)") == "1234.6"
+    assert _ev("(0.5 + 0.25) + ''") == "0.75"
+
+
+def test_builtins():
+    assert _ev("Math.ceil(4.2)") == 5
+    assert _ev("Math.round(2.5)") == 3
+    assert _ev("Math.round(-2.5)") == -2  # JS rounds half toward +inf
+    assert _ev("isFinite(1/0)") is False
+    assert _ev("parseFloat('3.5px')") == 3.5
+    assert math.isnan(_ev("parseFloat('px')"))
+    assert _ev("JSON.stringify({a: [1, 'x', null]})") == '{"a":[1,"x",null]}'
+
+
+# ------------------------------------------------------------ errors
+
+def test_typeerror_on_undefined_property():
+    with pytest.raises(JsError, match="TypeError"):
+        _ev("(undefined).foo")
+    with pytest.raises(JsError, match="TypeError"):
+        _ev("(null).length")
+
+
+def test_typeerror_on_calling_nonfunction():
+    with pytest.raises(JsError, match="not a function"):
+        _ev("(5)()")
+    with pytest.raises(JsError, match="notAMethod is not a function"):
+        _ev("[1,2].notAMethod()")
+
+
+def test_referenceerror_on_unknown_name():
+    with pytest.raises(JsError, match="ReferenceError"):
+        _ev("totallyUndefinedName + 1")
+
+
+def test_out_of_dialect_is_syntax_error():
+    for src in (
+        "class Foo {}",
+        "async function f() {}",
+        "try { x() } catch (e) {}",
+        "switch (x) { }",
+        "const re = /abc/;",
+    ):
+        with pytest.raises(JsSyntaxError):
+            load(src)
+
+
+def test_undeclared_assignment_is_error():
+    with pytest.raises(JsError, match="ReferenceError"):
+        run("function f() { notDeclared = 5; return 1; }", "f")
+
+
+# ------------------------------------------------------------ scoping
+
+def test_block_scoping_and_shadowing():
+    assert run("""
+function f() {
+  const x = 1;
+  let out = 0;
+  { const x = 2; out = x; }
+  return out * 10 + x;
+}
+""", "f") == 21
+
+
+def test_closures_capture_environment():
+    assert run("""
+function mk() {
+  let n = 0;
+  return () => { n += 1; return n; };
+}
+function f() { const c = mk(); c(); c(); return c(); }
+""", "f") == 3

