@@ -54,6 +54,7 @@ QUEUE_GAUGES = (
 REQUEST_COUNTERS = (
     "jetstream_request_count",
     "jetstream_num_requests",
+    "jetstream_request_success_count",
     "vllm:request_success",
 )
 SLOTS_GAUGES = (
@@ -75,9 +76,14 @@ TRAIN_TOKEN_COUNTER = "tpumon_train_tokens_total"
 
 
 def _sum_samples(by_name: dict, names: tuple[str, ...]) -> tuple[str, float] | None:
+    """Sum a family's samples (all label sets), trying each known name
+    and its prometheus-client counter form ``<name>_total`` — real
+    JetStream/vLLM deployments expose counters with the _total suffix
+    (pinned by the golden fixtures in tests/fixtures/)."""
     for name in names:
-        if name in by_name:
-            return name, sum(s.value for s in by_name[name])
+        for candidate in (name, name + "_total"):
+            if candidate in by_name:
+                return candidate, sum(s.value for s in by_name[candidate])
     return None
 
 
