@@ -76,8 +76,12 @@ def test_jax_collector_init_hang_degrades():
 
     from tpumon.collectors.accel_jax import JaxTpuCollector
 
+    # Wedge shorter than it looks: asyncio.run's shutdown JOINS the
+    # default executor, so the test pays the full simulated hang after
+    # the timeout fires — 1.5s proves the 0.2s timeout without the
+    # 30s tail this test used to cost the suite.
     c = JaxTpuCollector(init_timeout_s=0.2)
-    c._init_devices = lambda: _time.sleep(30)  # simulated wedge
+    c._init_devices = lambda: _time.sleep(1.5)  # simulated wedge
     s = asyncio.run(c.collect())
     assert not s.ok
     assert s.data == []

@@ -17,6 +17,30 @@ def test_render_chip_table():
     assert sum(1 for line in lines if "chip-" in line) == 8
     assert "2.50GB/s" in out
     assert "█" in out  # duty bar drawn
+    # Fake chips are healthy: SDK link score shown, no throttle note.
+    assert "0/10" in out and "throttled" not in out
+
+
+def test_render_link_health_and_throttle():
+    from tpumon.topology import ChipSample
+
+    def chip(idx, **kw):
+        return ChipSample(
+            chip_id=f"h0/chip-{idx}", host="h0", slice_id="s0",
+            index=idx, kind="v5e", **kw,
+        )
+
+    out = render(
+        [
+            chip(0, ici_link_health=7, throttle_score=3),
+            chip(1, ici_link_up=False),
+            chip(2),
+        ],
+        {"cpu": {}, "memory": {}},
+    )
+    assert "7/10" in out and "throttled ~30%" in out
+    assert "DOWN" in out  # link_up fallback when no score
+    assert out.splitlines()[-1].rstrip().endswith("–")  # unknown link
 
 
 def test_render_no_chips():

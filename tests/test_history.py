@@ -67,6 +67,26 @@ def test_history_service_prometheus_unreachable_falls_back():
     assert out["mxu"]["data"] == [77.0]
 
 
+def test_tpu_health_series_worst_of_fleet():
+    """ici_health_max / throttle_max record the fleet's WORST score so a
+    single degrading link shows in the curve (sampler._record_history)."""
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    cfg = load_config(env={"TPUMON_COLLECTORS": "accel",
+                           "TPUMON_ACCEL_BACKEND": "fake:v5e-8"})
+    fake = FakeTpuCollector(topology="v5e-8")
+    fake.set_override("tpu-host-0/chip-3", ici_link_health=7, throttle_score=4)
+    sampler = Sampler(cfg, accel=fake)
+    asyncio.run(sampler.tick_fast())
+    assert sampler.history.series["ici_health_max"].points[-1][1] == 7.0
+    assert sampler.history.series["throttle_max"].points[-1][1] == 4.0
+    svc = HistoryService(sampler.history, prometheus_url=None)
+    out = asyncio.run(svc.snapshot())
+    assert out["ici_health_max"]["data"][-1] == 7.0
+
+
 def test_per_chip_series_included():
     ring = RingHistory(1800)
     ring.record("chip.h0/chip-0.mxu", 50.0, ts=1000.0)

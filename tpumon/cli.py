@@ -53,7 +53,7 @@ def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -
         )
     header = (
         f"{'chip':<24} {'kind':<5} {'MXU%':>6}  {'':20} "
-        f"{'HBM':>12} {'HBM%':>6}  {'temp':>5}  {'ICI tx':>10}"
+        f"{'HBM':>12} {'HBM%':>6}  {'temp':>5}  {'ICI tx':>10}  {'link':>5}"
     )
     lines.append(header)
     for c in chips:
@@ -62,10 +62,22 @@ def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -
         temp = f"{c.temp_c:.0f}°C" if c.temp_c is not None else "–"
         rate = (ici_rates or {}).get(c.chip_id, {}).get("tx_bps")
         rate_s = f"{rate / 1e9:.2f}GB/s" if rate is not None else "–"
+        # ICI link state: SDK health score when present (0 healthy ..
+        # 10 unusable, PROBE_libtpu.md), else up/DOWN, else unknown.
+        if c.ici_link_health is not None:
+            link = f"{c.ici_link_health}/10"
+        elif c.ici_link_up is not None:
+            link = "up" if c.ici_link_up else "DOWN"
+        else:
+            link = "–"
+        throttled = (
+            f"  throttled ~{c.throttle_score * 10}%"
+            if c.throttle_score else ""
+        )
         lines.append(
             f"{c.chip_id:<24} {c.kind:<5} {duty:>6}  {_bar(c.mxu_duty_pct)} "
             f"{_fmt_bytes(c.hbm_used):>5}/{_fmt_bytes(c.hbm_total):<6} {hbm_pct:>6}  "
-            f"{temp:>5}  {rate_s:>10}"
+            f"{temp:>5}  {rate_s:>10}  {link:>5}{throttled}"
         )
     return "\n".join(lines)
 

@@ -37,6 +37,10 @@ PROM_QUERIES: dict[str, str] = {
     "hbm": "avg(tpu_hbm_used_pct)",
     "temp": "avg(tpu_temp_celsius)",
     "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
+    # Worst-of-fleet libtpu SDK scores (0-10): max so one degrading
+    # link / throttling chip shows in the fleet curve.
+    "ici_health_max": "max(tpu_ici_link_health_score)",
+    "throttle_max": "max(tpu_throttle_score)",
     "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
     # The `> 0` clause drops idle samples instead of producing 0/0

@@ -185,6 +185,20 @@ class Sampler:
             tx_total = sum(r["tx_bps"] for r in self.ici_rates.values())
             if self.ici_rates:
                 rec("ici", tx_total, ts)
+            # Worst-of-fleet SDK scores (0-10): a single degrading link /
+            # throttling chip must show in the fleet curve, so max, not
+            # mean.
+            health = [
+                c.ici_link_health for c in chips
+                if c.ici_link_health is not None
+            ]
+            if health:
+                rec("ici_health_max", max(health), ts)
+            throttle = [
+                c.throttle_score for c in chips if c.throttle_score is not None
+            ]
+            if throttle:
+                rec("throttle_max", max(throttle), ts)
             for c in chips:
                 rec(f"chip.{c.chip_id}.mxu", c.mxu_duty_pct, ts)
                 rec(f"chip.{c.chip_id}.hbm", c.hbm_pct, ts)
