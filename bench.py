@@ -155,13 +155,22 @@ def _bench_kernels() -> dict:
     fused dequant) and paged-attention decode (Pallas vs fused gather).
     Slope-timed (loadgen.burn.measure_*) so remote-dispatch overhead
     cancels. Real-MXU-only — interpret-mode numbers would be noise."""
-    from tpumon.loadgen.burn import measure_int8_tflops, measure_paged_gbps
+    from tpumon.loadgen.burn import (
+        measure_int8_tflops,
+        measure_mxu_tflops,
+        measure_paged_gbps,
+    )
 
+    mm_pallas = measure_mxu_tflops(use_pallas=True)
+    mm_xla = measure_mxu_tflops(use_pallas=False)
     i8_pallas = measure_int8_tflops(use_pallas=True)
     i8_xla = measure_int8_tflops(use_pallas=False)
     pa_pallas = measure_paged_gbps(use_pallas=True)
     pa_xla = measure_paged_gbps(use_pallas=False)
     return {
+        "mxu_matmul_pallas_tflops": round(mm_pallas["tflops"], 2),
+        "mxu_matmul_xla_tflops": round(mm_xla["tflops"], 2),
+        "mxu_matmul_vs_xla": round(mm_pallas["tflops"] / mm_xla["tflops"], 2),
         "int8_matmul_pallas_tflops": round(i8_pallas["tflops"], 2),
         "int8_matmul_xla_tflops": round(i8_xla["tflops"], 2),
         "int8_matmul_vs_xla": round(i8_pallas["tflops"] / i8_xla["tflops"], 2),
@@ -331,7 +340,9 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
     "federation": (120, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
                          "federation_exporter_render_ms")),
-    "kernels": (420, ("int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
+    "kernels": (480, ("mxu_matmul_pallas_tflops", "mxu_matmul_xla_tflops",
+                      "mxu_matmul_vs_xla",
+                      "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
                       "int8_matmul_vs_xla", "paged_attention_pallas_kv_gbps",
                       "paged_attention_xla_kv_gbps", "paged_attention_vs_xla")),
     "train": (420, ("train_mfu_pct", "train_tokens_per_sec")),

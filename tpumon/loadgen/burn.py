@@ -62,15 +62,14 @@ def mxu_burn(
 ) -> dict:
     """Run matmul bursts for ~`seconds`; returns achieved TFLOP/s.
 
-    Uses the Pallas tiled kernel (tpumon.ops.matmul — measured faster
-    than XLA's matmul for this op on v5e) when on TPU with
-    block-divisible shapes, else plain jnp.
+    Defaults to XLA's native matmul: slope-timed r02 measurement
+    (BENCH_NOTES.md) showed it ~1.6x faster than the Pallas tiled
+    kernel on v5e — the r01 claim the Pallas default rested on was a
+    timing artifact. use_pallas=True keeps the kernel exercisable.
     """
     key = jax.random.PRNGKey(0)
     if use_pallas is None:
-        use_pallas = (
-            jax.devices()[0].platform == "tpu" and size % 512 == 0
-        )
+        use_pallas = False
     # Warm up / compile.
     _sync(_mxu_burn_program(key, size, iters, use_pallas))
     flops_per_call = 2 * size**3 * iters
@@ -274,6 +273,24 @@ def _slope_time(run, n1: int, n2: int, reps: int = 3) -> float:
             "iters): measurement invalid on this backend"
         )
     return dt
+
+
+def measure_mxu_tflops(
+    size: int = 4096, iters: int = 96, use_pallas: bool = False, reps: int = 5
+) -> dict:
+    """Slope-timed bf16 matmul throughput (Pallas tiled kernel vs XLA's
+    native matmul — pins PARITY's 'measured faster than XLA' claim)."""
+    key = jax.random.PRNGKey(0)
+
+    def run(n: int):
+        _sync(_mxu_burn_program(key, size, n, use_pallas))
+
+    n1, n2 = iters, 4 * iters
+    dt = _slope_time(run, n1, n2, reps)
+    return {
+        "tflops": 2 * size**3 * (n2 - n1) / dt / 1e12,
+        "pallas": use_pallas,
+    }
 
 
 def measure_int8_tflops(

@@ -15,12 +15,14 @@ consuming matmul — the int8 array is what lives in and streams from
 HBM. Symmetric per-output-channel scales keep the matmul error small
 without zero-points (cheap on MXU, standard for weight-only quant).
 
-For explicit control of the tiling/dequant schedule on large
-(prefill-sized) shapes there is also a hand-written Pallas kernel,
-``tpumon.ops.quant_matmul.quantized_matmul`` — int8 tiles widened in
-VMEM, scale applied once to the f32 accumulator at store — with the
-fused XLA path as its automatic fallback for decode-sized batches;
-``tpumon.loadgen.burn.int8_burn`` measures it under load.
+For explicit control of the tiling/dequant schedule there is also a
+hand-written Pallas kernel, ``tpumon.ops.quant_matmul.quantized_matmul``
+— int8 tiles widened in VMEM, scale applied once to the f32 accumulator
+at store — with the fused XLA path as its automatic fallback for
+decode-sized batches. Slope-timed measurement (BENCH_NOTES.md) puts the
+fused XLA path at or slightly above the kernel on v5e, so XLA fusion is
+the production path and the kernel is the explicitly-scheduled variant;
+``bench.py``'s kernels phase pins both every round.
 """
 
 from __future__ import annotations

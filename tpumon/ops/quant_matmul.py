@@ -97,7 +97,15 @@ def quantized_matmul(
     interpret: bool = False,
 ) -> jax.Array:
     """Pallas int8 matmul when the shapes tile, fused XLA path otherwise
-    (decode-sized M is far below a useful MXU tile)."""
+    (decode-sized M is far below a useful MXU tile).
+
+    Perf note (slope-timed r02, BENCH_NOTES.md): XLA's fused-dequant
+    matmul measures at or slightly above this kernel on v5e, so the
+    serving engine streams quantized weights through plain
+    ``x @ q.astype(dt)`` and this entry point exists for explicit
+    control of the tiling/dequant schedule (and as the tested Pallas
+    building block the paged/flash kernels share patterns with), not
+    as a speedup."""
     m, k = a.shape
     n = q.shape[1]
     if m % block_m == 0 and n % block_n == 0 and k % block_k == 0:
