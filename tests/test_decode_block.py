@@ -1,0 +1,103 @@
+"""Fused block decode (ServeConfig.decode_block / serving.decode_rounds).
+
+The plain-decode analogue of speculative verify: N (decode_step ->
+sample) pairs scanned inside one dispatch. Greedy output must be
+token-identical to the per-step path (same op sequence, same PRNG
+counter schedule), completion semantics (max_new, stop tokens, max_seq
+boundary) must match, and the invalid compositions must be rejected.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpumon.loadgen.model import ModelConfig
+from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+MODEL = ModelConfig(vocab=512, d_model=128, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=256, max_seq=128)
+PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7], [2, 7, 1, 8]]
+
+
+def run_engine(decode_block=1, max_new=12, prompts=PROMPTS, **submit_kw):
+    eng = ServingEngine(cfg=ServeConfig(
+        model=MODEL, slots=2, prefill_len=16, decode_block=decode_block))
+    reqs = [eng.submit(p, max_new=max_new, **submit_kw) for p in prompts]
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    return eng, [r.output for r in reqs]
+
+
+def test_block_greedy_matches_per_step():
+    _, per_step = run_engine(decode_block=1)
+    _, fused = run_engine(decode_block=4)
+    assert fused == per_step
+
+
+def test_block_not_dividing_max_new():
+    # max_new=5 with block 4: second block overshoots; output must stop
+    # at exactly max_new tokens, identical to per-step.
+    _, per_step = run_engine(decode_block=1, max_new=5)
+    _, fused = run_engine(decode_block=4, max_new=5)
+    assert fused == per_step
+    assert all(len(o) == 5 + 1 for o in fused)  # prefill token + max_new
+
+
+def test_block_stop_token_mid_block():
+    _, per_step = run_engine(decode_block=1, max_new=12)
+    # Use a token the greedy stream actually emits as the stop.
+    stop = per_step[0][2]
+    _, ps = run_engine(decode_block=1, max_new=12, stop_tokens=(stop,))
+    _, fu = run_engine(decode_block=4, max_new=12, stop_tokens=(stop,))
+    assert fu == ps
+
+
+def test_block_respects_max_seq_boundary():
+    # max_new large enough to hit max_seq: the fused path must fall back
+    # to single steps near the boundary and complete cleanly.
+    eng, fused = run_engine(decode_block=4, max_new=500, prompts=[[1, 2, 3]])
+    _, per_step = run_engine(decode_block=1, max_new=500, prompts=[[1, 2, 3]])
+    assert fused == per_step
+    assert len(fused[0]) <= MODEL.max_seq
+
+
+def test_block_sampled_stream_matches_per_step():
+    """Sampled (temperature) slots see the same PRNG counter schedule
+    (ctr+1 per in-block step), so WASTE-FREE blocks (max_new divisible,
+    no stop tokens) match the per-step path exactly. With mid-block
+    completions the discarded tail consumes counter values and later
+    sampled draws legitimately diverge (see decode_rounds docstring)."""
+    _, ps = run_engine(decode_block=1, max_new=8, temperature=0.8, top_k=20)
+    _, fu = run_engine(decode_block=4, max_new=8, temperature=0.8, top_k=20)
+    assert fu == ps
+
+
+def test_block_counters():
+    eng, outs = run_engine(decode_block=4, max_new=8)
+    # Emitted tokens only (discarded past-completion tokens don't count):
+    # prefill emits 1, decode 8 per request.
+    assert eng.tokens_total == sum(len(o) for o in outs)
+    assert eng.decode_steps_total >= 8
+
+
+def test_block_invalid_compositions():
+    with pytest.raises(ValueError, match="decode_block"):
+        ServingEngine(cfg=ServeConfig(model=MODEL, decode_block=0))
+    with pytest.raises(ValueError, match="dense"):
+        ServingEngine(cfg=ServeConfig(
+            model=MODEL, prefill_len=16, decode_block=2,
+            kv_layout="paged", pool_pages=9))
+
+
+def test_block_composes_with_spec_fallback():
+    """decode_block + spec_len: spec rounds run when there's room; the
+    plain fallback near max_seq uses the fused path. Greedy output still
+    matches the plain engine."""
+    eng = ServingEngine(cfg=ServeConfig(
+        model=MODEL, slots=2, prefill_len=16, decode_block=2, spec_len=2))
+    reqs = [eng.submit(p, max_new=8) for p in PROMPTS]
+    eng.drain()
+    outs = [r.output for r in reqs]
+    _, plain = run_engine(decode_block=1, max_new=8)
+    agree = sum(a == b for a, b in zip(outs, plain))
+    assert agree >= len(PROMPTS) - 1  # bf16 argmax near-ties tolerance

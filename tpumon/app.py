@@ -121,6 +121,7 @@ def main(argv: list[str] | None = None) -> int:
     loadgen_prefix = 0
     loadgen_kv = "dense"
     loadgen_pool = 0
+    loadgen_block = 1
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -190,6 +191,10 @@ def main(argv: list[str] | None = None) -> int:
         elif arg == "--loadgen-pool-pages":
             loadgen_pool = take_int(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-decode-block":
+            # Fuse N plain-decode steps per dispatch (dense KV only).
+            loadgen_block = take_int(arg)
+            serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
         elif arg in ("-h", "--help"):
@@ -199,7 +204,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--serve-loadgen] [--loadgen-ckpt DIR] "
                 "[--loadgen-quant int8] [--loadgen-spec-len N] "
                 "[--loadgen-prefix-cache N] [--loadgen-kv-layout dense|paged] "
-                "[--loadgen-pool-pages N] [--state FILE]\n"
+                "[--loadgen-pool-pages N] [--loadgen-decode-block N] "
+                "[--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0
@@ -227,6 +233,7 @@ def main(argv: list[str] | None = None) -> int:
                 ckpt_dir=loadgen_ckpt, quantize=loadgen_quant,
                 spec_len=loadgen_spec, prefix_cache=loadgen_prefix,
                 kv_layout=loadgen_kv, pool_pages=loadgen_pool,
+                decode_block=loadgen_block,
             )
         except ValueError as e:  # uncomposable/unknown engine options
             print(f"--serve-loadgen: {e}", file=sys.stderr)

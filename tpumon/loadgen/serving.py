@@ -83,6 +83,13 @@ class ServeConfig:
     # lower); exhaustion blocks admission instead of OOMing.
     kv_layout: str = "dense"
     pool_pages: int = 0
+    # Fused plain decode: run this many (decode_step -> sample) pairs
+    # inside ONE dispatch per engine step (serving.decode_rounds) — the
+    # plain-decode analogue of the speculative verify fusion. Cuts
+    # per-token dispatch overhead at the cost of up to block-1 wasted
+    # tokens past a stop/max_new and block-1 steps of added admission
+    # latency. 1 = off. Dense KV only.
+    decode_block: int = 1
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +230,37 @@ def decode_step(cfg: ServeConfig, params: dict, cache: dict,
     cache, logits = decode_block(cfg, params, cache,
                                  last_tokens[:, None], positions)
     return cache, logits[:, 0]
+
+
+def decode_rounds(cfg: ServeConfig, params: dict, cache: dict,
+                  last_tokens: jax.Array, positions: jax.Array,
+                  base_key: jax.Array, ctr0: jax.Array,
+                  temps: jax.Array, topks: jax.Array, steps: int
+                  ) -> tuple[dict, jax.Array, jax.Array, jax.Array]:
+    """``steps`` greedy/sampled decode steps fused into ONE dispatch.
+
+    A Python-level decode loop pays dispatch overhead (and on remote-
+    execution backends, cache re-shipping) per token; scanning the
+    (decode_step -> sample_tokens) pair inside jit pays it once per
+    block — the same fusion idea as speculative verify, but for plain
+    decode. Sampling matches the per-step path: the PRNG counter
+    advances by one per in-block step, and greedy (temp<=0) rows are
+    pure argmax, so a block of greedy decode emits exactly the
+    per-step tokens.
+
+    Returns (cache, last_tokens, positions, tokens [B, steps]).
+    """
+
+    def body(carry, _):
+        cache, last, pos, ctr = carry
+        cache, logits = decode_step(cfg, params, cache, last, pos)
+        nxt = sample_tokens(logits, base_key, ctr, temps, topks)
+        pos = jnp.minimum(pos + 1, cfg.model.max_seq - 1)
+        return (cache, nxt, pos, ctr + 1), nxt
+
+    (cache, last, pos, _), toks = jax.lax.scan(
+        body, (cache, last_tokens, positions, ctr0), None, length=steps)
+    return cache, last, pos, toks.T  # [B, steps] in emission order
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +447,15 @@ class ServingEngine:
                 "a tensor-parallel mesh currently composes with the "
                 "dense KV layout only (no speculative decoding, prefix "
                 "caching, or paged KV)")
+        if self.cfg.decode_block < 1:
+            raise ValueError(
+                f"decode_block must be >= 1, got {self.cfg.decode_block}")
+        if self.cfg.decode_block > 1 and (
+                self.cfg.kv_layout == "paged" or mesh is not None):
+            raise ValueError(
+                "decode_block > 1 currently composes with the dense "
+                "single-device engine only (paged page-table routing "
+                "and mesh decode each need their own fused variant)")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -463,6 +510,11 @@ class ServingEngine:
                                     donate_argnums=(1,))
             self._decode = jax.jit(partial(decode_step, self.cfg),
                                    donate_argnums=(1,))
+        self._decode_rounds = None
+        if self.cfg.decode_block > 1:
+            self._decode_rounds = jax.jit(
+                partial(decode_rounds, self.cfg),
+                static_argnames=("steps",), donate_argnums=(1,))
         # Speculative decoding state (after quantization so a self-
         # speculating draft shares the quantized weights, not a second
         # f32 copy).
@@ -796,6 +848,20 @@ class ServingEngine:
         return pending or any(s is not None for s in self._slots)
 
     def _plain_step(self, active: list[int]) -> None:
+        # Fused block decode when configured and every active slot has
+        # cache room for the whole block (else fall through to the
+        # single-step path, same boundary rule as speculative rounds).
+        n = self.cfg.decode_block
+        if (
+            self._decode_rounds is not None
+            and n > 1
+            and all(
+                self._host_positions[s] <= self.cfg.model.max_seq - 1 - n
+                for s in active
+            )
+        ):
+            self._block_step(active, n)
+            return
         if self.paged:
             if self._tables_dirty:
                 self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
@@ -830,6 +896,42 @@ class ServingEngine:
                     or self._host_positions[slot]
                     >= self.cfg.model.max_seq - 1):
                 self._complete(slot)
+
+    def _block_step(self, active: list[int], n: int) -> None:
+        """One fused decode_rounds dispatch: n tokens per active slot,
+        ONE host-device sync. Per-slot emission replays the block in
+        order and stops at each request's own completion condition —
+        tokens generated past it are discarded (bounded waste, the
+        block-decode trade)."""
+        self.cache, self.last_tokens, self.positions, toks = (
+            self._decode_rounds(
+                self.params, self.cache, self.last_tokens, self.positions,
+                self._sample_key, jnp.uint32(self._sample_ctr + 1),
+                self.temps, self.topks, steps=n,
+            )
+        )
+        self._sample_ctr += n
+        toks_host = jax.device_get(toks).tolist()  # [B, n]
+        emitted = 0
+        with self._lock:
+            self.decode_steps_total += n
+        for slot in active:
+            req = self._slots[slot]
+            for tok in toks_host[slot]:
+                req.emit([tok])
+                emitted += 1
+                self._host_positions[slot] = min(
+                    self._host_positions[slot] + 1,
+                    self.cfg.model.max_seq - 1)
+                if (len(req.output) >= req.max_new + 1
+                        or req.hit_stop()
+                        or self._host_positions[slot]
+                        >= self.cfg.model.max_seq - 1):
+                    self._complete(slot)
+                    break
+        self._host_last = [row[-1] for row in toks_host]
+        with self._lock:
+            self.tokens_total += emitted
 
     def _seq_token(self, req: Request, i: int) -> int:
         """Token at sequence index ``i``: prompt, then emitted output."""
@@ -1195,14 +1297,15 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                      seed: int = 0, ckpt_dir: str | None = None,
                      quantize: str | None = None,
                      spec_len: int = 0, prefix_cache: int = 0,
-                     kv_layout: str = "dense", pool_pages: int = 0):
+                     kv_layout: str = "dense", pool_pages: int = 0,
+                     decode_block: int = 1):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
     whole north-star loop: a live TPU serving job AND the monitor
     scraping it."""
     if cfg is None and (spec_len or prefix_cache or pool_pages
-                        or kv_layout != "dense"):
+                        or kv_layout != "dense" or decode_block != 1):
         import dataclasses
 
         # Keep the checkpoint-architecture adoption the engine would do
@@ -1219,7 +1322,8 @@ def start_background(rps: float = 0.5, max_new: int = 16,
         cfg = dataclasses.replace(
             base or default_engine_config(), spec_len=spec_len,
             prefix_cache_entries=prefix_cache,
-            kv_layout=kv_layout, pool_pages=pool_pages)
+            kv_layout=kv_layout, pool_pages=pool_pages,
+            decode_block=decode_block)
     engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
@@ -1261,6 +1365,9 @@ def main(argv: list[str] | None = None) -> int:
                          "draft shares the target weights)")
     ap.add_argument("--prefix-cache", type=int, default=0,
                     help="prompt-prefix KV cache LRU entries (0 = off)")
+    ap.add_argument("--decode-block", type=int, default=1,
+                    help="fuse N plain-decode steps into one dispatch "
+                         "(dense KV only; 1 = off)")
     ap.add_argument("--kv-layout", choices=["dense", "paged"],
                     default="dense",
                     help="paged: per-request page reservation from a "
@@ -1288,6 +1395,7 @@ def main(argv: list[str] | None = None) -> int:
         spec_len=args.spec_len, draft_model=draft,
         prefix_cache_entries=args.prefix_cache,
         kv_layout=args.kv_layout, pool_pages=args.pool_pages,
+        decode_block=args.decode_block,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
