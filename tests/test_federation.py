@@ -10,13 +10,24 @@ from tpumon.topology import ChipSample
 
 
 def test_chip_json_roundtrip():
+    # Every ChipSample field must survive the federation hop — a field
+    # dropped here silently disappears from the aggregator's view.
     c = ChipSample(
         chip_id="h1/chip-2", host="h1", slice_id="s0", index=2, kind="v5p",
         coords=(1, 0, 0), mxu_duty_pct=33.5, hbm_used=10, hbm_total=100,
         temp_c=55.0, ici_tx_bytes=999, ici_rx_bytes=900, ici_link_up=True,
+        ici_link_health=7, throttle_score=3,
     )
     back = chip_from_json(c.to_json())
     assert back == c
+    # Guard against the next added field being forgotten: every dataclass
+    # field must either round-trip or be explicitly derived (hbm_pct).
+    import dataclasses
+
+    json_keys = set(c.to_json())
+    for f in dataclasses.fields(ChipSample):
+        mapped = {"chip_id": "chip", "slice_id": "slice"}.get(f.name, f.name)
+        assert mapped in json_keys, f"ChipSample.{f.name} missing from to_json"
 
 
 def test_federation_two_live_instances():

@@ -364,6 +364,34 @@ def test_ici_health_and_throttle_alerts():
     assert not any("chip-0." in k for k in keys)
 
 
+def test_exporter_emits_runtime_extras():
+    """SDK slice-level extras (HLO queue, latency percentiles) re-export
+    as tpu_* gauges so Prometheus can record them."""
+    from tpumon.config import Config
+    from tpumon.exporter import render_exporter
+    from tpumon.sampler import Sampler
+
+    class _Accel:
+        name = "accel"
+        last_extras = {
+            "hlo_queue_size": {"tensorcore_0": 3},
+            "collective_e2e_latency": {
+                "2MB+-ALL_REDUCE": {"mean": 100.0, "p50": 200.0,
+                                    "p90": 300.0, "p95": 400.0,
+                                    "p999": 500.0},
+            },
+        }
+
+        async def collect(self):  # pragma: no cover - not sampled here
+            raise NotImplementedError
+
+    sampler = Sampler(Config(), accel=_Accel())
+    text = render_exporter(sampler)
+    assert 'tpu_hlo_queue_size{core="tensorcore_0"} 3' in text
+    assert ('tpu_collective_e2e_latency_us{bucket="2MB+-ALL_REDUCE",'
+            'quantile="p50"} 200' in text)
+
+
 def test_exporter_emits_new_gauges():
     from tpumon.config import Config
     from tpumon.exporter import render_exporter

@@ -49,6 +49,8 @@ def chip_from_json(d: dict) -> ChipSample:
         ici_tx_bytes=d.get("ici_tx_bytes"),
         ici_rx_bytes=d.get("ici_rx_bytes"),
         ici_link_up=d.get("ici_link_up"),
+        ici_link_health=d.get("ici_link_health"),
+        throttle_score=d.get("throttle_score"),
     )
 
 

@@ -94,6 +94,36 @@ def render_exporter(sampler: Sampler) -> str:
             if c.throttle_score is not None:
                 throttle.add(labels, c.throttle_score)
 
+    # ---- libtpu SDK slice-level extras (accel collector "runtime") ----
+    # HLO queue depth per core + {buffer transfer, collective e2e, HLO
+    # execution, host<->device} latency percentiles, re-exported so
+    # Prometheus can record them (the SDK only reports current values).
+    extras = getattr(sampler.accel, "last_extras", None) or {}
+    queue_sizes = extras.get("hlo_queue_size") or {}
+    if queue_sizes:
+        qg = w.gauge(
+            "tpu_hlo_queue_size", "Enqueued-not-dequeued HLOs per core"
+        )
+        for core, size in sorted(queue_sizes.items()):
+            qg.add({"core": str(core)}, float(size))
+    for family in (
+        "buffer_transfer_latency",
+        "collective_e2e_latency",
+        "hlo_execution_timing",
+        "host_to_device_transfer_latency",
+        "device_to_host_transfer_latency",
+    ):
+        table = extras.get(family) or {}
+        if not table:
+            continue
+        fg = w.gauge(
+            f"tpu_{family}_us",
+            f"libtpu {family.replace('_', ' ')} percentiles (microseconds)",
+        )
+        for label, pcts in sorted(table.items()):
+            for q, val in pcts.items():
+                fg.add({"bucket": str(label), "quantile": q}, float(val))
+
     # ---- slices ----
     slices = sampler.slices()
     if slices:
