@@ -69,6 +69,33 @@ def test_collect_sample_envelope():
     assert s.ok and s.source == "accel" and len(s.data) == 4
 
 
+def test_fault_episodes():
+    """+faults: deterministic periodic degradation episodes for demo
+    mode — chip 3's link degrades ~60s/8min, chip 5 throttles
+    ~45s/11min; outside episodes everything is healthy."""
+    from tpumon.collectors.accel import make_accel_collector
+    from tpumon.config import load_config
+
+    c = make_accel_collector(
+        load_config(env={"TPUMON_ACCEL_BACKEND": "fake:v5e-8+faults"})
+    )
+    assert c.fault_episodes
+    c.clock = lambda: 30.0  # inside both episode windows
+    by_idx = {ch.index: ch for ch in c.chips()}
+    assert by_idx[3].ici_link_health == 7
+    assert by_idx[5].throttle_score == 4
+    assert by_idx[0].ici_link_health == 0
+    c.clock = lambda: 200.0  # between episodes
+    assert all(ch.ici_link_health == 0 for ch in c.chips())
+    assert all(ch.throttle_score == 0 for ch in c.chips())
+    # Plain spec (no +faults) stays always-healthy.
+    plain = make_accel_collector(
+        load_config(env={"TPUMON_ACCEL_BACKEND": "fake:v5e-8"})
+    )
+    plain.clock = lambda: 30.0
+    assert all(ch.ici_link_health == 0 for ch in plain.chips())
+
+
 def test_jax_collector_init_hang_degrades():
     """A wedged device runtime must degrade the sample, not hang the
     monitor (regression for the lost-remote-grant scenario)."""

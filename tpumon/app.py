@@ -155,7 +155,7 @@ def main(argv: list[str] | None = None) -> int:
             # with zero external dependencies.
             overrides.update(
                 {
-                    "accel_backend": "fake:v5e-8",
+                    "accel_backend": "fake:v5e-8+faults",
                     "k8s_mode": "fake",
                     "serving_targets": ["fake:jetstream", "fake:trainer"],
                     "expected_slice_chips": {"slice-0": 8},

@@ -6,10 +6,11 @@ Backend selection (Config.accel_backend):
   reference's "nvidia-smi absent => []" mode, monitor_server.js:94, but
   with the reason recorded).
 - "jax": force the real collector.
-- "fake:<topology>[@<host_prefix>]": synthetic chips (v5e-1 / v5e-8 /
-  v5p-64 ...). The optional host prefix disambiguates chip identities
-  when several fake-backed instances federate (real deployments get
-  distinct identities from their hostnames).
+- "fake:<topology>[@<host_prefix>][+faults]": synthetic chips (v5e-1 /
+  v5e-8 / v5p-64 ...). The optional host prefix disambiguates chip
+  identities when several fake-backed instances federate (real
+  deployments get distinct identities from their hostnames); "+faults"
+  enables periodic ICI-degradation/throttle episodes (demo mode).
 - "none": disabled.
 """
 
@@ -38,8 +39,13 @@ def make_accel_collector(cfg: Config) -> Collector:
         local: Collector | None = None
     elif backend.startswith("fake:"):
         spec = backend.split(":", 1)[1]
+        kw = {}
+        if spec.endswith("+faults"):
+            spec = spec[: -len("+faults")]
+            kw["fault_episodes"] = True
         topology, _, prefix = spec.partition("@")
-        kw = {"host_prefix": prefix} if prefix else {}
+        if prefix:
+            kw["host_prefix"] = prefix
         local = FakeTpuCollector(topology=topology, **kw)
     elif backend in ("auto", "jax"):
         local = JaxTpuCollector()

@@ -42,6 +42,11 @@ class FakeTpuCollector:
     clock: object = time.time  # injectable for deterministic tests
     dead_hosts: set[str] = field(default_factory=set)
     overrides: dict[str, dict] = field(default_factory=dict)  # chip_id -> field overrides
+    # Periodic fault episodes (demo mode, `fake:<topo>+faults`): one
+    # chip's ICI link degrades for ~60s every ~8 min and another
+    # throttles for ~45s every ~11 min, so the degradation UI and the
+    # fire->resolve alert lifecycle exercise themselves continuously.
+    fault_episodes: bool = False
 
     def __post_init__(self) -> None:
         if self.topology not in FAKE_TOPOLOGIES:
@@ -80,6 +85,13 @@ class FakeTpuCollector:
                 # ~2 GB/s rate ∫2e9·(1+sin(t/41+φ))dt so deltas are consistent
                 # between successive samples.
                 cumulative = int(2e9 * (t + 41 * (1 - math.cos(t / 41 + phase))))
+                link_health = 0
+                throttle = 0
+                if self.fault_episodes:
+                    if g == 3 and (t % 480) < 60:
+                        link_health = 7  # persistent problem -> serious
+                    if g == 5 and (t % 660) < 45:
+                        throttle = 4  # ~40% throttled -> serious
                 sample = ChipSample(
                     chip_id=f"{host}/chip-{i}",
                     host=host,
@@ -94,10 +106,10 @@ class FakeTpuCollector:
                     ici_tx_bytes=cumulative,
                     ici_rx_bytes=int(cumulative * 0.97),
                     ici_link_up=True,
-                    # Healthy by default; tests/demos inject degradation
-                    # via set_override (scores per PROBE_libtpu.md).
-                    ici_link_health=0,
-                    throttle_score=0,
+                    # Healthy outside episodes; tests/demos also inject
+                    # degradation via set_override (PROBE_libtpu.md scale).
+                    ici_link_health=link_health,
+                    throttle_score=throttle,
                 )
                 ov = self.overrides.get(sample.chip_id)
                 if ov:
