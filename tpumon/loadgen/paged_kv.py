@@ -168,3 +168,29 @@ def paged_decode_step(cfg, params: dict, pool: dict,
                         kv_update)
     logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return pool, logits
+
+
+def paged_decode_rounds(cfg, params: dict, pool: dict,
+                        last_tokens: jax.Array, positions: jax.Array,
+                        tables: jax.Array, base_key: jax.Array,
+                        ctr0: jax.Array, temps: jax.Array,
+                        topks: jax.Array, steps: int):
+    """``steps`` (paged_decode_step -> sample) pairs in ONE dispatch —
+    the paged twin of serving.decode_rounds. Tables are loop-invariant:
+    pages are reserved for the whole request at admission, and trailing
+    table entries point at the permanent trash page, so a block that
+    overshoots a request's reserved rows writes harmlessly (the same
+    guard that protects freed slots). Returns
+    (pool, last_tokens, positions, tokens [B, steps])."""
+    from tpumon.loadgen.serving import sample_tokens
+
+    def body(carry, _):
+        pool, last, pos, ctr = carry
+        pool, logits = paged_decode_step(cfg, params, pool, last, pos, tables)
+        nxt = sample_tokens(logits, base_key, ctr, temps, topks)
+        pos = jnp.minimum(pos + 1, cfg.model.max_seq - 1)
+        return (pool, nxt, pos, ctr + 1), nxt
+
+    (pool, last, pos, _), toks = lax.scan(
+        body, (pool, last_tokens, positions, ctr0), None, length=steps)
+    return pool, last, pos, toks.T

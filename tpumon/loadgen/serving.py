@@ -88,7 +88,8 @@ class ServeConfig:
     # plain-decode analogue of the speculative verify fusion. Cuts
     # per-token dispatch overhead at the cost of up to block-1 wasted
     # tokens past a stop/max_new and block-1 steps of added admission
-    # latency. 1 = off. Dense KV only.
+    # latency. 1 = off. Dense and paged KV (paged_kv.paged_decode_rounds);
+    # not yet composed with a tensor-parallel mesh.
     decode_block: int = 1
 
 
@@ -450,12 +451,11 @@ class ServingEngine:
         if self.cfg.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {self.cfg.decode_block}")
-        if self.cfg.decode_block > 1 and (
-                self.cfg.kv_layout == "paged" or mesh is not None):
+        if self.cfg.decode_block > 1 and mesh is not None:
             raise ValueError(
-                "decode_block > 1 currently composes with the dense "
-                "single-device engine only (paged page-table routing "
-                "and mesh decode each need their own fused variant)")
+                "decode_block > 1 currently composes with the "
+                "single-device engine only (mesh decode needs its own "
+                "fused variant)")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -511,7 +511,7 @@ class ServingEngine:
             self._decode = jax.jit(partial(decode_step, self.cfg),
                                    donate_argnums=(1,))
         self._decode_rounds = None
-        if self.cfg.decode_block > 1:
+        if self.cfg.decode_block > 1 and self.cfg.kv_layout != "paged":
             self._decode_rounds = jax.jit(
                 partial(decode_rounds, self.cfg),
                 static_argnames=("steps",), donate_argnums=(1,))
@@ -600,6 +600,12 @@ class ServingEngine:
                 partial(paged_prefill, self.cfg), donate_argnums=(1,))
             self._paged_decode = jax.jit(
                 partial(paged_decode_step, self.cfg), donate_argnums=(1,))
+            if self.cfg.decode_block > 1:
+                from tpumon.loadgen.paged_kv import paged_decode_rounds
+
+                self._decode_rounds = jax.jit(
+                    partial(paged_decode_rounds, self.cfg),
+                    static_argnames=("steps",), donate_argnums=(1,))
         if self.paged:
             self.cache = None
         elif mesh is None:
@@ -902,14 +908,30 @@ class ServingEngine:
         ONE host-device sync. Per-slot emission replays the block in
         order and stops at each request's own completion condition —
         tokens generated past it are discarded (bounded waste, the
-        block-decode trade)."""
-        self.cache, self.last_tokens, self.positions, toks = (
-            self._decode_rounds(
-                self.params, self.cache, self.last_tokens, self.positions,
-                self._sample_key, jnp.uint32(self._sample_ctr + 1),
-                self.temps, self.topks, steps=n,
+        block-decode trade). Paged mode scans paged_decode_rounds with
+        the (loop-invariant) page tables; overshoot rows land on
+        reserved pages or the trash page."""
+        if self.paged:
+            if self._tables_dirty:
+                self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
+                self._tables_dirty = False
+            self.pool, self.last_tokens, self.positions, toks = (
+                self._decode_rounds(
+                    self.params, self.pool, self.last_tokens,
+                    self.positions, self._tables_dev,
+                    self._sample_key, jnp.uint32(self._sample_ctr + 1),
+                    self.temps, self.topks, steps=n,
+                )
             )
-        )
+        else:
+            self.cache, self.last_tokens, self.positions, toks = (
+                self._decode_rounds(
+                    self.params, self.cache, self.last_tokens,
+                    self.positions,
+                    self._sample_key, jnp.uint32(self._sample_ctr + 1),
+                    self.temps, self.topks, steps=n,
+                )
+            )
         self._sample_ctr += n
         toks_host = jax.device_get(toks).tolist()  # [B, n]
         emitted = 0
