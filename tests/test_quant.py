@@ -128,7 +128,7 @@ def test_quantized_tensor_parallel_serving():
     assert sh["layers"][0]["w_down"].scale.spec == P(None)  # row-parallel
 
     scfg = ServeConfig(model=CFG, slots=2, prefill_len=8, quantize="int8")
-    pre, dec, placed, cache = make_sharded_serving(scfg, mesh, params)
+    pre, dec, placed, cache, _ = make_sharded_serving(scfg, mesh, params)
     assert placed["layers"][0]["wq"].q.dtype == jnp.int8
     toks = jnp.array([1, 2, 3, 0, 0, 0, 0, 0], jnp.int32)
     cache, plog = pre(cache, toks, jnp.int32(3), jnp.int32(0))

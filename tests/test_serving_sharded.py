@@ -35,7 +35,7 @@ def mesh():
 
 def test_sharded_matches_single_device(mesh):
     params = init_params(CFG.model, jax.random.PRNGKey(3))
-    pre, dec, placed, cache_s = make_sharded_serving(CFG, mesh, params)
+    pre, dec, placed, cache_s, _ = make_sharded_serving(CFG, mesh, params)
 
     prompt = [9, 4, 77]
     n = len(prompt)
@@ -67,7 +67,7 @@ def test_sharded_cache_layout(mesh):
     """The KV cache must actually be sharded: head axis over "model",
     slot axis over "data" — per-append writes stay device-local."""
     params = init_params(CFG.model, jax.random.PRNGKey(3))
-    _, _, _, cache_s = make_sharded_serving(CFG, mesh, params)
+    _, _, _, cache_s, _ = make_sharded_serving(CFG, mesh, params)
     spec = cache_s["k"].sharding.spec
     assert tuple(spec) == (None, "data", None, "model", None)
     shard_shape = cache_s["k"].addressable_shards[0].data.shape
@@ -93,6 +93,29 @@ def test_engine_runs_tensor_parallel(mesh):
     sharded.drain()
     assert [r.output for r in m_reqs] == [r.output for r in s_reqs]
     # Params and cache really live sharded on the mesh.
+    assert tuple(sharded.cache["k"].sharding.spec) == (
+        None, "data", None, "model", None)
+
+
+def test_engine_mesh_block_decode_matches_single_device(mesh):
+    """decode_block over the mesh: the fused (decode_step -> sample)
+    scan runs under the same shardings (make_sharded_serving rounds_fn)
+    and emits exactly the single-device per-step tokens."""
+    import dataclasses
+
+    from tpumon.loadgen.serving import ServingEngine
+
+    prompts = [[9, 4, 77], [5, 2, 8, 1], [3, 3], [60, 11, 42]]
+    single = ServingEngine(cfg=CFG, seed=3)
+    s_reqs = [single.submit(p, max_new=8) for p in prompts]
+    single.drain()
+
+    cfg = dataclasses.replace(CFG, decode_block=4)
+    sharded = ServingEngine(cfg=cfg, seed=3, mesh=mesh)
+    assert sharded._decode_rounds is not None
+    m_reqs = [sharded.submit(p, max_new=8) for p in prompts]
+    sharded.drain()
+    assert [r.output for r in m_reqs] == [r.output for r in s_reqs]
     assert tuple(sharded.cache["k"].sharding.spec) == (
         None, "data", None, "model", None)
 

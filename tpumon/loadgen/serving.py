@@ -88,8 +88,9 @@ class ServeConfig:
     # plain-decode analogue of the speculative verify fusion. Cuts
     # per-token dispatch overhead at the cost of up to block-1 wasted
     # tokens past a stop/max_new and block-1 steps of added admission
-    # latency. 1 = off. Dense and paged KV (paged_kv.paged_decode_rounds);
-    # not yet composed with a tensor-parallel mesh.
+    # latency. 1 = off. Composes with dense KV, paged KV
+    # (paged_kv.paged_decode_rounds), and the tensor-parallel mesh
+    # (make_sharded_serving rounds_fn).
     decode_block: int = 1
 
 
@@ -284,7 +285,9 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
 
     Requires ``n_kv_heads % mesh.shape["model"] == 0`` and
     ``slots % mesh.shape["data"] == 0`` (slots are data-parallel).
-    Returns (prefill_fn, decode_fn, placed_params, placed_cache).
+    Returns (prefill_fn, decode_fn, placed_params, placed_cache,
+    rounds_fn) — rounds_fn is the fused block-decode twin
+    (decode_rounds over the same shardings; ServeConfig.decode_block).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -315,6 +318,16 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
         donate_argnums=(1,),
     )
 
+    _rounds = jax.jit(
+        partial(decode_rounds, cfg),
+        in_shardings=(shardings, cache_sh, rep, rep, rep, rep, rep, rep),
+        out_shardings=(cache_sh, rep, rep, rep),
+        # static_argnums, not argnames: pjit with in_shardings rejects
+        # kwargs, so steps is passed positionally below.
+        static_argnums=(8,),
+        donate_argnums=(1,),
+    )
+
     def prefill_fn(cache, tokens, length, slot, start=None):
         if start is None:
             start = jnp.int32(0)
@@ -323,8 +336,13 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
     def decode_fn(cache, last_tokens, positions):
         return _dec(placed, cache, last_tokens, positions)
 
+    def rounds_fn(cache, last_tokens, positions, base_key, ctr0,
+                  temps, topks, steps):
+        return _rounds(placed, cache, last_tokens, positions,
+                       base_key, ctr0, temps, topks, steps)
+
     placed_cache = jax.device_put(init_cache(cfg), cache_sh)
-    return prefill_fn, decode_fn, placed, placed_cache
+    return prefill_fn, decode_fn, placed, placed_cache, rounds_fn
 
 
 # ---------------------------------------------------------------------------
@@ -451,11 +469,6 @@ class ServingEngine:
         if self.cfg.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {self.cfg.decode_block}")
-        if self.cfg.decode_block > 1 and mesh is not None:
-            raise ValueError(
-                "decode_block > 1 currently composes with the "
-                "single-device engine only (mesh decode needs its own "
-                "fused variant)")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -495,8 +508,8 @@ class ServingEngine:
             # (make_sharded_serving). Same call signatures as the
             # single-chip jits (params are pre-placed, so the params
             # argument the engine passes is ignored via the adapters).
-            pre_fn, dec_fn, placed, placed_cache = make_sharded_serving(
-                self.cfg, mesh, self.params)
+            pre_fn, dec_fn, placed, placed_cache, rounds_fn = (
+                make_sharded_serving(self.cfg, mesh, self.params))
             self.params = placed
             self.cache = placed_cache  # sharded on the KV-head axis
             self._prefill = (
@@ -505,16 +518,22 @@ class ServingEngine:
             self._decode = (
                 lambda _params, cache, last, positions:
                 dec_fn(cache, last, positions))
+            self._decode_rounds = (
+                (lambda _params, cache, last, positions, key, ctr,
+                 temps, topks, steps:
+                 rounds_fn(cache, last, positions, key, ctr,
+                           temps, topks, steps))
+                if self.cfg.decode_block > 1 else None)
         else:
             self._prefill = jax.jit(partial(prefill, self.cfg),
                                     donate_argnums=(1,))
             self._decode = jax.jit(partial(decode_step, self.cfg),
                                    donate_argnums=(1,))
-        self._decode_rounds = None
-        if self.cfg.decode_block > 1 and self.cfg.kv_layout != "paged":
-            self._decode_rounds = jax.jit(
-                partial(decode_rounds, self.cfg),
-                static_argnames=("steps",), donate_argnums=(1,))
+            self._decode_rounds = None
+            if self.cfg.decode_block > 1 and self.cfg.kv_layout != "paged":
+                self._decode_rounds = jax.jit(
+                    partial(decode_rounds, self.cfg),
+                    static_argnames=("steps",), donate_argnums=(1,))
         # Speculative decoding state (after quantization so a self-
         # speculating draft shares the quantized weights, not a second
         # f32 copy).
