@@ -30,6 +30,25 @@ def test_forward_shapes_and_dtype():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+def test_remat_matches_plain_forward_and_grads():
+    """ModelConfig.remat changes memory scheduling, never math: logits
+    and gradients must match the plain forward exactly."""
+    import dataclasses
+    from functools import partial
+
+    from tpumon.loadgen.model import sgd_train_step
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, CFG.vocab)
+    rcfg = dataclasses.replace(CFG, remat=True)
+    plain = jax.jit(lambda p, t: forward(CFG, p, t))(params, tokens)
+    remat = jax.jit(lambda p, t: forward(rcfg, p, t))(params, tokens)
+    assert jnp.array_equal(plain, remat)
+    _, loss_plain = jax.jit(partial(sgd_train_step, CFG))(params, tokens)
+    _, loss_remat = jax.jit(partial(sgd_train_step, rcfg))(params, tokens)
+    assert float(loss_plain) == float(loss_remat)
+
+
 def test_causality():
     """Changing a future token must not affect earlier logits."""
     params = init_params(CFG, jax.random.PRNGKey(0))

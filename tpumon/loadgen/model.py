@@ -40,6 +40,12 @@ class ModelConfig:
     max_seq: int = 256
     rope_theta: float = 10000.0
     compute_dtype: str = "bfloat16"
+    # Per-layer rematerialization (jax.checkpoint): the backward pass
+    # recomputes each layer's activations instead of keeping them —
+    # notably the [B, H, T, T] attention scores that otherwise dominate
+    # training HBM (a d2048/L12/seq1024 model OOMs a 16 GiB v5e without
+    # this and trains with it). ~1/3 extra forward FLOPs.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -200,9 +206,15 @@ def forward(
     dt = jnp.dtype(cfg.compute_dtype)
     x = params["embed"].astype(dt)[tokens]
     x = _constrain(x, mesh, P("data", None, None))
-    for layer in params["layers"]:
+
+    def layer_block(x, layer):
         x = x + _attention(cfg, layer, _rms_norm(x, layer["attn_norm"]), mesh)
-        x = x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]), mesh)
+        return x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]), mesh)
+
+    if cfg.remat:
+        layer_block = jax.checkpoint(layer_block)
+    for layer in params["layers"]:
+        x = layer_block(x, layer)
     x = _rms_norm(x, params["final_norm"])
     return (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
 
