@@ -364,12 +364,18 @@ def main(argv: list[str] | None = None) -> int:
         help="per-chip peak dense bf16 TFLOP/s for MFU (default: "
         "auto-detect from the TPU device kind; unknown kinds omit MFU)",
     )
+    ap.add_argument(
+        "--remat", action="store_true",
+        help="per-layer rematerialization (jax.checkpoint): trade ~1/3 "
+        "extra forward FLOPs for the activation HBM that otherwise "
+        "bounds model size",
+    )
     args = ap.parse_args(argv)
 
     cfg = TrainConfig(
         model=ModelConfig(
             vocab=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
-            d_ff=1024, max_seq=max(64, args.seq),
+            d_ff=1024, max_seq=max(64, args.seq), remat=args.remat,
         ),
         steps=args.steps, batch=args.batch, seq=args.seq,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
