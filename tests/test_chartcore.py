@@ -220,6 +220,38 @@ def test_topo_draw_multi_slice(js):
     assert "slice-0 · 4 chips" in texts and "slice-1 · 4 chips" in texts
 
 
+def test_pod_badge(js):
+    assert js.call("podBadge", {"status": "Running"}) == {
+        "cls": "badge Running", "text": "Running"}
+    assert js.call("podBadge", {"status": "Failed", "reason": "OOMKilled"}) == {
+        "cls": "badge Failed", "text": "Failed · OOMKilled"}
+    # A reason on a Running pod (e.g. recovered) doesn't clutter the badge.
+    assert js.call("podBadge", {"status": "Running", "reason": "x"})["text"] == "Running"
+    assert js.call("podBadge", {}) == {"cls": "badge Unknown", "text": "?"}
+
+
+def test_pod_tpu_cell(js):
+    assert js.call("podTpuCell", {}) == "–"
+    assert js.call("podTpuCell", {"tpu_request": 4.0}) == "4 req"
+    assert js.call("podTpuCell", {"tpu_request": 4.0, "chips": 4.0}) == "4 req · 4 live"
+
+
+def test_overall_dot_class(js):
+    assert js.call("overallDotClass", {"critical": [1.0]}) == "bad"
+    assert js.call("overallDotClass", {"serious": [1.0]}) == "warn"
+    assert js.call("overallDotClass", {"minor": [1.0]}) == "warn"
+    assert js.call("overallDotClass", {"minor": [], "critical": []}) == "ok"
+    assert js.call("overallDotClass", None) == "ok"
+
+
+def test_silence_prefix(js):
+    # Severity leaf stripped -> prefix mutes the whole condition.
+    assert js.call("silencePrefix", "host.cpu.critical") == "host.cpu."
+    assert js.call("silencePrefix", "chip.h0/chip-1.hbm.serious") == "chip.h0/chip-1.hbm."
+    # Keys without a severity leaf pass through unchanged.
+    assert js.call("silencePrefix", "chip.h0/chip-1.ici_down") == "chip.h0/chip-1.ici_down"
+
+
 def test_mean_of(js):
     assert js.call("meanOf", [1.0, None, 3.0]) == 2.0
     assert js.call("meanOf", [None, None]) is None

@@ -224,6 +224,43 @@ function topoDraw(ctx, chips, w, h) {
   return hits;
 }
 
+/* ----------------------------- pods & alerts --------------------------- */
+
+/* status badge: CSS class + label ("Failed · OOMKilled" when a reason
+   accompanies a non-Running phase) */
+function podBadge(p) {
+  const status = p.status || "Unknown";
+  const text = p.reason && p.status !== "Running"
+    ? `${p.status} · ${p.reason}` : (p.status || "?");
+  return { cls: "badge " + status, text: text };
+}
+
+/* "TPU chips" cell: requested count + live chip attribution when an
+   accel source reports chips */
+function podTpuCell(p) {
+  if (!p.tpu_request) return "–";
+  if (p.chips) return `${p.tpu_request} req · ${p.chips} live`;
+  return `${p.tpu_request} req`;
+}
+
+/* header dot: worst severity present */
+function overallDotClass(a) {
+  if ((a?.critical?.length ?? 0) > 0) return "bad";
+  if ((a?.serious?.length ?? 0) > 0 || (a?.minor?.length ?? 0) > 0) return "warn";
+  return "ok";
+}
+
+/* Silence the *condition*, not one severity tier: strip a trailing
+   severity leaf so "host.cpu.critical" mutes host.cpu.* (otherwise the
+   same signal re-pages the moment it crosses into another tier). */
+function silencePrefix(key) {
+  const parts = key.split(".");
+  const last = parts[parts.length - 1];
+  if (["minor", "serious", "critical"].includes(last))
+    return parts.slice(0, -1).join(".") + ".";
+  return key;
+}
+
 /* ------------------------------ aggregates ----------------------------- */
 
 /* mean of the non-null entries, or null (chip-grid MXU card) */
