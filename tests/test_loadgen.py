@@ -49,6 +49,33 @@ def test_remat_matches_plain_forward_and_grads():
     assert float(loss_plain) == float(loss_remat)
 
 
+def test_chunked_attention_matches_naive():
+    """attention='chunked' (online-softmax K/V streaming) must reproduce
+    the naive path's logits and training step to f32 rounding — incl.
+    sequence lengths that don't divide the block."""
+    import dataclasses
+    from functools import partial
+
+    from tpumon.loadgen.model import sgd_train_step
+
+    cfg = dataclasses.replace(CFG, compute_dtype="float32", max_seq=256)
+    ccfg = dataclasses.replace(cfg, attention="chunked", attn_block_k=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 100), 0, cfg.vocab)  # 100 % 32 != 0
+    naive = jax.jit(lambda p, t: forward(cfg, p, t))(params, tokens)
+    chunk = jax.jit(lambda p, t: forward(ccfg, p, t))(params, tokens)
+    np.testing.assert_allclose(naive, chunk, rtol=2e-5, atol=2e-5)
+    _, l1 = jax.jit(partial(sgd_train_step, cfg))(params, tokens)
+    _, l2 = jax.jit(partial(sgd_train_step, ccfg))(params, tokens)
+    assert abs(float(l1) - float(l2)) < 1e-5
+    # T <= block: the chunked config silently uses the naive schedule.
+    short = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, cfg.vocab)
+    a = jax.jit(lambda p, t: forward(cfg, p, t))(params, short)
+    b = jax.jit(lambda p, t: forward(ccfg, p, t))(params, short)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
 def test_causality():
     """Changing a future token must not affect earlier logits."""
     params = init_params(CFG, jax.random.PRNGKey(0))

@@ -46,6 +46,14 @@ class ModelConfig:
     # training HBM (a d2048/L12/seq1024 model OOMs a 16 GiB v5e without
     # this and trains with it). ~1/3 extra forward FLOPs.
     remat: bool = False
+    # Attention schedule: "naive" materializes [B, H, T, T] scores
+    # (fastest at short seq); "chunked" streams K/V in attn_block_k-row
+    # blocks with an online softmax (lax.scan, checkpointed body) —
+    # peak attention memory O(T * block) instead of O(T^2), fully
+    # differentiable, the long-context single-chip path (the multi-chip
+    # counterpart is loadgen.ring_attention).
+    attention: str = "naive"
+    attn_block_k: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -54,6 +62,7 @@ class ModelConfig:
 
     def abstract(self) -> "ModelConfig":
         assert self.n_heads % self.n_kv_heads == 0
+        assert self.attention in ("naive", "chunked"), self.attention
         return self
 
 
@@ -167,6 +176,67 @@ def _rope(x: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+_NEG_INF = -1e30
+
+
+def _chunked_attention_core(
+    q: jax.Array, k: jax.Array, v: jax.Array, block_k: int
+) -> jax.Array:
+    """Causal attention with K/V streamed in blocks (online softmax).
+
+    q/k/v: [B, T, H, D] (RoPE'd, GQA-repeated). A lax.scan over
+    block_k-row K/V blocks carries the running max m, denominator l and
+    f32 accumulator — peak transient is one [B, H, T, block_k] score
+    block instead of the naive [B, H, T, T]. The body is checkpointed
+    so the backward pass recomputes each block instead of storing its
+    probabilities (without this the scan's saved residuals would add
+    back the O(T^2) the schedule removes). Differentiable end to end —
+    this is the training-side analogue of the inference flash kernel
+    (tpumon.ops.flash_attention, forward-only).
+    """
+    b, t, h, d = q.shape
+    n_blocks = -(-t // block_k)
+    pad = n_blocks * block_k - t
+    # Pad K/V up to a whole number of blocks; padded rows are masked out
+    # by the causal test below (their positions exceed every q position).
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(t, dtype=jnp.int32)
+    scale = 1.0 / d**0.5
+
+    @jax.checkpoint
+    def body(carry, blk):
+        m, el, acc = carry
+        j, k_blk, v_blk = blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        k_pos = j * block_k + jnp.arange(block_k, dtype=jnp.int32)
+        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        # Explicit re-mask: in a fully-masked block s == m_new == -1e30,
+        # where exp(s - m_new) would be exp(0) = 1 per masked entry.
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        el = el * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(q.dtype), v_blk
+        ).astype(jnp.float32)
+        return (m_new, el, acc), ()
+
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
+    (m, el, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0),
+        (jnp.arange(n_blocks, dtype=jnp.int32), kb, vb),
+    )
+    out = acc / el.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
 def _attention(
     cfg: ModelConfig, layer: dict, x: jax.Array, mesh: Mesh | None = None
 ) -> jax.Array:
@@ -183,11 +253,16 @@ def _attention(
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / (hd**0.5)
-    causal = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(causal[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, nh * hd)
+    if cfg.attention == "chunked" and t > cfg.attn_block_k:
+        out = _chunked_attention_core(q, k, v, cfg.attn_block_k)
+        out = out.reshape(b, t, nh * hd)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / (
+            hd**0.5)
+        causal = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(causal[None, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, nh * hd)
     out = _constrain(out, mesh, P("data", None, "model"))
     return out @ layer["wo"].astype(dt)
 

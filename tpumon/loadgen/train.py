@@ -370,12 +370,20 @@ def main(argv: list[str] | None = None) -> int:
         "extra forward FLOPs for the activation HBM that otherwise "
         "bounds model size",
     )
+    ap.add_argument(
+        "--attention", choices=["naive", "chunked"], default="naive",
+        help="'chunked' streams K/V blocks with an online softmax — "
+        "O(T*block) attention memory, the long-sequence path",
+    )
+    ap.add_argument("--attn-block", type=int, default=512,
+                    help="K/V block rows for --attention chunked")
     args = ap.parse_args(argv)
 
     cfg = TrainConfig(
         model=ModelConfig(
             vocab=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
             d_ff=1024, max_seq=max(64, args.seq), remat=args.remat,
+            attention=args.attention, attn_block_k=args.attn_block,
         ),
         steps=args.steps, batch=args.batch, seq=args.seq,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
