@@ -102,3 +102,25 @@ def test_meta_pointing_at_missing_step_dir(tmp_path, params):
     shutil.rmtree(path)  # simulate a partially-deleted checkpoint
     assert latest_step(d) is None
     assert restore_checkpoint(d, like=params) is None
+
+
+def test_schedule_only_change_still_resumes(tmp_path):
+    """remat/attention/attn_block_k change memory scheduling, not the
+    params - a resumed run with a different schedule must restore
+    (VERDICT-class bug: it used to silently cold-start at step 0)."""
+    import dataclasses
+
+    from tpumon.loadgen.checkpoint import restore_checkpoint, save_checkpoint
+    from tpumon.loadgen.model import ModelConfig, init_params
+
+    cfg = ModelConfig(vocab=64, d_model=32, n_layers=1, n_heads=2,
+                      n_kv_heads=2, d_ff=64, max_seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    save_checkpoint(str(tmp_path), params, step=7, cfg=cfg)
+    resched = dataclasses.replace(cfg, remat=True, attention="chunked",
+                                  attn_block_k=16)
+    out = restore_checkpoint(str(tmp_path), like=params, cfg=resched)
+    assert out is not None and out[1] == 7
+    # A REAL architecture change still refuses.
+    other = dataclasses.replace(cfg, d_ff=128)
+    assert restore_checkpoint(str(tmp_path), like=params, cfg=other) is None

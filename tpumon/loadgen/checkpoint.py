@@ -75,6 +75,21 @@ def latest_step(directory: str) -> int | None:
     return step
 
 
+# Execution-schedule fields: they change memory/scheduling, never the
+# parameter pytree, so differing values must not invalidate a resume
+# (e.g. extending a run with --remat or --attention chunked).
+_SCHEDULE_FIELDS = ("remat", "attention", "attn_block_k")
+
+
+def _arch_key(cfg: ModelConfig) -> dict:
+    import dataclasses as _dc
+
+    d = _dc.asdict(cfg)
+    for f in _SCHEDULE_FIELDS:
+        d.pop(f, None)
+    return d
+
+
 def saved_model_config(directory: str) -> ModelConfig | None:
     try:
         with open(os.path.join(directory, _META)) as f:
@@ -106,7 +121,7 @@ def restore_checkpoint(
         return None
     if cfg is not None:
         saved = saved_model_config(directory)
-        if saved is not None and saved != cfg:
+        if saved is not None and _arch_key(saved) != _arch_key(cfg):
             return None  # architecture changed under the checkpoint dir
     abstract = jax.tree.map(
         lambda x: x

@@ -55,6 +55,15 @@ class ModelConfig:
     attention: str = "naive"
     attn_block_k: int = 512
 
+    def __post_init__(self) -> None:
+        # Validate at construction (a typo'd schedule string silently
+        # falling through to the naive path would defeat the point of
+        # selecting the memory-saving one).
+        if self.attention not in ("naive", "chunked"):
+            raise ValueError(f"unknown attention schedule {self.attention!r}")
+        if self.attn_block_k < 1:
+            raise ValueError(f"attn_block_k must be >= 1, got {self.attn_block_k}")
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -62,7 +71,6 @@ class ModelConfig:
 
     def abstract(self) -> "ModelConfig":
         assert self.n_heads % self.n_kv_heads == 0
-        assert self.attention in ("naive", "chunked"), self.attention
         return self
 
 
@@ -185,55 +193,49 @@ def _chunked_attention_core(
     """Causal attention with K/V streamed in blocks (online softmax).
 
     q/k/v: [B, T, H, D] (RoPE'd, GQA-repeated). A lax.scan over
-    block_k-row K/V blocks carries the running max m, denominator l and
-    f32 accumulator — peak transient is one [B, H, T, block_k] score
-    block instead of the naive [B, H, T, T]. The body is checkpointed
-    so the backward pass recomputes each block instead of storing its
-    probabilities (without this the scan's saved residuals would add
-    back the O(T^2) the schedule removes). Differentiable end to end —
-    this is the training-side analogue of the inference flash kernel
-    (tpumon.ops.flash_attention, forward-only).
+    block_k-row K/V blocks accumulates through the SAME
+    ``_block_attend`` update ring attention uses (one in-repo
+    implementation of the online-softmax numerics; ring streams blocks
+    across chips over ICI, this streams them through time on one chip).
+    Peak transient is one [B, H, T, block_k] score block instead of the
+    naive [B, H, T, T]; the body is checkpointed so the backward pass
+    recomputes each block instead of storing its probabilities (without
+    this the scan's saved residuals would add back the O(T^2) the
+    schedule removes). Differentiable end to end — the training-side
+    analogue of the inference flash kernel (tpumon.ops.flash_attention,
+    forward-only).
     """
+    from tpumon.loadgen.ring_attention import _block_attend
+
     b, t, h, d = q.shape
     n_blocks = -(-t // block_k)
     pad = n_blocks * block_k - t
     # Pad K/V up to a whole number of blocks; padded rows are masked out
-    # by the causal test below (their positions exceed every q position).
+    # by the causal test (their positions exceed every q position).
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     kb = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
     vb = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
-    q_pos = jnp.arange(t, dtype=jnp.int32)
     scale = 1.0 / d**0.5
 
     @jax.checkpoint
     def body(carry, blk):
-        m, el, acc = carry
+        m, el, o = carry
         j, k_blk, v_blk = blk
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
-        k_pos = j * block_k + jnp.arange(block_k, dtype=jnp.int32)
-        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
-        s = jnp.where(mask, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        # Explicit re-mask: in a fully-masked block s == m_new == -1e30,
-        # where exp(s - m_new) would be exp(0) = 1 per masked entry.
-        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-        el = el * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(q.dtype), v_blk
-        ).astype(jnp.float32)
-        return (m_new, el, acc), ()
+        m, el, o = _block_attend(
+            q, k_blk, v_blk, 0, j * block_k, scale, True, m, el, o)
+        return (m, el, o), ()
 
-    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    m0 = jnp.full((b, h, t), float("-inf"), jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    acc0 = jnp.zeros((b, t, h, d), jnp.float32)
-    (m, el, acc), _ = jax.lax.scan(
-        body, (m0, l0, acc0),
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    (_, el, o), _ = jax.lax.scan(
+        body, (m0, l0, o0),
         (jnp.arange(n_blocks, dtype=jnp.int32), kb, vb),
     )
-    out = acc / el.transpose(0, 2, 1)[..., None]
+    l_safe = jnp.where(el == 0.0, 1.0, el)
+    out = o / l_safe.swapaxes(1, 2)[..., None]
     return out.astype(q.dtype)
 
 
