@@ -202,6 +202,10 @@ class Sampler:
             for c in chips:
                 rec(f"chip.{c.chip_id}.mxu", c.mxu_duty_pct, ts)
                 rec(f"chip.{c.chip_id}.hbm", c.hbm_pct, ts)
+                # SDK health score (x10 so the drill-down shares the
+                # 0-100% chart scale: 70 = score 7).
+                if c.ici_link_health is not None:
+                    rec(f"chip.{c.chip_id}.link", c.ici_link_health * 10, ts)
         serving = self.serving_data()
 
         def mean(vals):
