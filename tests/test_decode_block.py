@@ -125,6 +125,25 @@ def test_paged_block_under_pool_pressure():
     assert fused == per_step
 
 
+def test_block_composes_with_int8_and_prefix_cache():
+    """decode_block + weight-only int8 + prefix cache: orthogonal
+    features (weights representation / prefill reuse / decode
+    batching) must compose without changing greedy output."""
+    eng = ServingEngine(cfg=ServeConfig(
+        model=MODEL, slots=2, prefill_len=16, decode_block=4,
+        prefix_cache_entries=4), quantize="int8")
+    reqs = [eng.submit(p, max_new=8) for p in PROMPTS + PROMPTS]
+    eng.drain()
+    outs = [r.output for r in reqs]
+    # Prefix-cache hit on the repeat round: identical outputs.
+    assert outs[: len(PROMPTS)] == outs[len(PROMPTS):]
+    plain = ServingEngine(cfg=ServeConfig(
+        model=MODEL, slots=2, prefill_len=16), quantize="int8")
+    p_reqs = [plain.submit(p, max_new=8) for p in PROMPTS]
+    plain.drain()
+    assert outs[: len(PROMPTS)] == [r.output for r in p_reqs]
+
+
 def test_block_composes_with_spec_fallback():
     """decode_block + spec_len: spec rounds run when there's room; the
     plain fallback near max_seq uses the fused path. Greedy output still
