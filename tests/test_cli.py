@@ -43,6 +43,22 @@ def test_render_link_health_and_throttle():
     assert out.splitlines()[-1].rstrip().endswith("–")  # unknown link
 
 
+def test_render_runtime_lines():
+    from tpumon.cli import render_runtime_lines
+
+    assert render_runtime_lines(None) == []
+    assert render_runtime_lines({}) == []
+    lines = render_runtime_lines({
+        "hlo_queue_size": {"tensorcore_0": 2, "tensorcore_1": 0},
+        "collective_e2e_latency": {
+            "2MB+-ALL_REDUCE": {"p50": 210.0, "p999": 800.0}},
+        "buffer_transfer_latency": {"8MB+": {"p50": 120.0}},
+    })
+    assert lines[0] == "hlo queue: tensorcore_0:2 tensorcore_1:0"
+    assert "collective e2e 2MB+-ALL_REDUCE: p50 210µs · p99.9 800µs" in lines
+    assert "DCN transfer 8MB+: p50 120µs" in lines
+
+
 def test_render_no_chips():
     out = render([], {"cpu": {}, "memory": {}})
     assert "no TPU chips visible" in out

@@ -82,6 +82,32 @@ def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -
     return "\n".join(lines)
 
 
+def render_runtime_lines(runtime: dict | None) -> list[str]:
+    """libtpu SDK slice-level extras (/api/accel/metrics "runtime"):
+    HLO queue depth and collective/DCN latency p50s, one line each."""
+    lines: list[str] = []
+    if not runtime:
+        return lines
+    queue = runtime.get("hlo_queue_size") or {}
+    if queue:
+        cells = " ".join(f"{k}:{v:.0f}" for k, v in sorted(queue.items()))
+        lines.append(f"hlo queue: {cells}")
+    for family, label in (
+        ("collective_e2e_latency", "collective e2e"),
+        ("buffer_transfer_latency", "DCN transfer"),
+    ):
+        table = runtime.get(family) or {}
+        for bucket, pcts in sorted(table.items()):
+            p50 = pcts.get("p50")
+            p999 = pcts.get("p999")
+            if p50 is not None:
+                lines.append(
+                    f"{label} {bucket}: p50 {p50:.0f}µs"
+                    + (f" · p99.9 {p999:.0f}µs" if p999 is not None else "")
+                )
+    return lines
+
+
 def render_status_lines(alerts: dict | None, serving: dict | None) -> list[str]:
     """Alert/serving/training summary lines for the remote view."""
     lines: list[str] = []
@@ -169,6 +195,8 @@ async def _run_remote(url: str, watch: float | None) -> int:
             print("\x1b[2J\x1b[H", end="")
             print(time.strftime("%H:%M:%S"), f"· tpumon info · {base}")
         print(render(chips, host or {}, rates))
+        for line in render_runtime_lines((accel or {}).get("runtime")):
+            print(line)
         for line in render_status_lines(alerts, serving):
             print(line)
         if failed:
