@@ -5,7 +5,12 @@ import asyncio
 
 import pytest
 
-from tpumon.collectors.host import HostCollector, parse_meminfo, _read_proc_stat_cpu
+from tpumon.collectors.host import (
+    HostCollector,
+    _read_proc_stat_cpu,
+    parse_meminfo,
+    parse_net_dev,
+)
 
 MEMINFO = """\
 MemTotal:       16384000 kB
@@ -21,11 +26,22 @@ STAT_T0 = "cpu  1000 50 500 8000 200 0 50 0 0 0\ncpu0 500 25 250 4000 100 0 25 0
 # +300 busy (user+system), +700 total
 STAT_T1 = "cpu  1250 50 550 8400 200 0 50 0 0 0\ncpu0 625 25 275 4200 100 0 25 0 0 0\n"
 
+NET_DEV_T0 = """\
+Inter-|   Receive                                                |  Transmit
+ face |bytes    packets errs drop fifo frame compressed multicast|bytes    packets errs drop fifo colls carrier compressed
+    lo: 9999999    9999    0    0    0     0          0         0  9999999    9999    0    0    0     0       0          0
+  eth0: 1000000    5000    0    0    0     0          0         0  2000000    4000    0    0    0     0       0          0
+  ens5:  500000    2500    0    0    0     0          0         0   300000    1500    0    0    0     0       0          0
+"""
+NET_DEV_T1 = NET_DEV_T0.replace("1000000", "1600000").replace("2000000", "3200000")
+
 
 def make_proc(tmp_path, stat=STAT_T0):
     (tmp_path / "meminfo").write_text(MEMINFO)
     (tmp_path / "loadavg").write_text(LOADAVG)
     (tmp_path / "stat").write_text(stat)
+    (tmp_path / "net").mkdir(exist_ok=True)
+    (tmp_path / "net" / "dev").write_text(NET_DEV_T0)
     return str(tmp_path)
 
 
@@ -66,11 +82,47 @@ def test_host_cpu_percent_from_stat_delta(tmp_path):
     assert s.data["cpu"]["percent"] == pytest.approx(100 * 300 / 700, abs=0.1)
 
 
+def test_parse_net_dev_excludes_loopback():
+    out = parse_net_dev(NET_DEV_T0)
+    assert "lo" not in out
+    assert out["eth0"] == (1000000, 2000000)
+    assert out["ens5"] == (500000, 300000)
+
+
+def test_host_collect_net_counters(tmp_path):
+    c = HostCollector(cpu_count=8, proc_root=make_proc(tmp_path))
+    s = asyncio.run(c.collect())
+    assert s.ok
+    net = s.data["net"]
+    assert net["rx_bytes"] == 1500000 and net["tx_bytes"] == 2300000
+    assert net["interfaces"]["eth0"]["tx_bytes"] == 2000000
+
+
+def test_sampler_net_rates_as_dcn_series(tmp_path):
+    """NIC byte deltas become the DCN-traffic proxy rate + history
+    series (SURVEY §5.8: ICI within a slice, DCN across hosts)."""
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    proc = make_proc(tmp_path)
+    c = HostCollector(cpu_count=8, proc_root=proc)
+    cfg = load_config(env={"TPUMON_COLLECTORS": "host"})
+    sampler = Sampler(cfg, host=c)
+    asyncio.run(sampler.tick_fast())
+    (tmp_path / "net" / "dev").write_text(NET_DEV_T1)
+    asyncio.run(sampler.tick_fast())
+    assert sampler.net_rates["rx_bps"] > 0
+    assert sampler.net_rates["tx_bps"] > sampler.net_rates["rx_bps"]
+    assert sampler.history.series["dcn"].points
+
+
 def test_host_degrades_per_subsource(tmp_path):
     """Reference contract: errors degrade to empty objects, not a crash
     (monitor_server.js:80) — but tpumon records the error."""
     (tmp_path / "loadavg").write_text(LOADAVG)
     (tmp_path / "stat").write_text(STAT_T0)
+    (tmp_path / "net").mkdir(exist_ok=True)
+    (tmp_path / "net" / "dev").write_text(NET_DEV_T0)
     # no meminfo file
     c = HostCollector(cpu_count=8, proc_root=str(tmp_path))
     s = asyncio.run(c.collect())

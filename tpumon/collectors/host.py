@@ -57,6 +57,31 @@ def parse_meminfo(text: str) -> dict[str, int]:
     return out
 
 
+def parse_net_dev(text: str) -> dict[str, tuple[int, int]]:
+    """Parse /proc/net/dev into {iface: (rx_bytes, tx_bytes)}.
+
+    The loopback interface is excluded: for a multi-host TPU deployment
+    the NIC counters are the host's DCN-traffic proxy (SURVEY §5.8 —
+    ICI within a slice, DCN across hosts), and lo traffic would swamp
+    the signal with scrape-loop chatter."""
+    out: dict[str, tuple[int, int]] = {}
+    for line in text.splitlines():
+        if ":" not in line:
+            continue
+        iface, _, rest = line.partition(":")
+        iface = iface.strip()
+        if iface == "lo":
+            continue
+        fields = rest.split()
+        if len(fields) < 10:
+            continue
+        try:
+            out[iface] = (int(fields[0]), int(fields[8]))
+        except ValueError:
+            continue
+    return out
+
+
 @dataclass
 class HostCollector:
     name: str = "host"
@@ -156,6 +181,18 @@ class HostCollector:
         primary = mounts[self.disk_mounts[0]]
         return {**primary, "mounts": mounts}
 
+    def _net(self, ns: dict | None) -> dict:
+        with open(os.path.join(self.proc_root, "net", "dev")) as f:
+            ifaces = parse_net_dev(f.read())
+        return {
+            "rx_bytes": sum(rx for rx, _ in ifaces.values()),
+            "tx_bytes": sum(tx for _, tx in ifaces.values()),
+            "interfaces": {
+                name: {"rx_bytes": rx, "tx_bytes": tx}
+                for name, (rx, tx) in sorted(ifaces.items())
+            },
+        }
+
     async def collect(self) -> Sample:
         ns = None
         if self._native is not None:
@@ -165,7 +202,8 @@ class HostCollector:
                 ns = None
         data: dict = {}
         errors: list[str] = []
-        for key, fn in (("cpu", self._cpu), ("memory", self._memory), ("disk", self._disk)):
+        for key, fn in (("cpu", self._cpu), ("memory", self._memory),
+                        ("disk", self._disk), ("net", self._net)):
             try:
                 data[key] = fn(ns)
             except Exception as e:

@@ -47,6 +47,19 @@ def render_exporter(sampler: Sampler) -> str:
         for mount, d in (disk.get("mounts") or {}).items():
             if d.get("percent") is not None:
                 g.add({"mount": mount}, d["percent"])
+        net = host.get("net") or {}
+        if net.get("interfaces"):
+            rxc = w.counter(
+                "tpumon_host_net_rx_bytes_total",
+                "Cumulative NIC bytes received (DCN-traffic proxy)",
+            )
+            txc = w.counter(
+                "tpumon_host_net_tx_bytes_total",
+                "Cumulative NIC bytes transmitted (DCN-traffic proxy)",
+            )
+            for iface, d in net["interfaces"].items():
+                rxc.add({"iface": iface}, d["rx_bytes"])
+                txc.add({"iface": iface}, d["tx_bytes"])
 
     # ---- chips (tpu_*) ----
     chips = sampler.chips()

@@ -37,6 +37,8 @@ PROM_QUERIES: dict[str, str] = {
     "hbm": "avg(tpu_hbm_used_pct)",
     "temp": "avg(tpu_temp_celsius)",
     "ici": "sum(rate(tpu_ici_tx_bytes_total[1m]))",
+    # Cross-host DCN traffic proxy: NIC tx rate summed over hosts.
+    "dcn": "sum(rate(tpumon_host_net_tx_bytes_total[1m]))",
     # Worst-of-fleet libtpu SDK scores (0-10): max so one degrading
     # link / throttling chip shows in the fleet curve.
     "ici_health_max": "max(tpu_ici_link_health_score)",

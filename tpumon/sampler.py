@@ -86,6 +86,10 @@ class Sampler:
         self.stats: dict[str, SourceStats] = {}
         self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
         self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
+        # Host NIC rates — the DCN-traffic proxy (SURVEY §5.8: ICI
+        # within a slice, DCN across hosts).
+        self.net_rates: dict = {}  # {rx_bps, tx_bps} once two samples exist
+        self._prev_net: tuple[float, int, int] | None = None  # (ts, rx, tx)
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
 
@@ -164,6 +168,23 @@ class Sampler:
                     }
             self._prev_ici[c.chip_id] = (ts, c.ici_tx_bytes, c.ici_rx_bytes or 0)
 
+    def _update_net_rates(self, host: dict, ts: float) -> None:
+        net = host.get("net") or {}
+        rx, tx = net.get("rx_bytes"), net.get("tx_bytes")
+        if rx is None or tx is None:
+            self.net_rates = {}
+            self._prev_net = None
+            return
+        prev = self._prev_net
+        if prev is not None:
+            dt_s = ts - prev[0]
+            if dt_s > 0:
+                self.net_rates = {
+                    "rx_bps": max(0.0, (rx - prev[1]) / dt_s),
+                    "tx_bps": max(0.0, (tx - prev[2]) / dt_s),
+                }
+        self._prev_net = (ts, rx, tx)
+
     def _record_history(self, ts: float) -> None:
         host = self.host_data()
         rec = self.history.record
@@ -171,6 +192,9 @@ class Sampler:
             rec("cpu", (host.get("cpu") or {}).get("percent"), ts)
             rec("memory", (host.get("memory") or {}).get("percent"), ts)
             rec("disk", (host.get("disk") or {}).get("percent"), ts)
+            self._update_net_rates(host, ts)
+            if self.net_rates:
+                rec("dcn", self.net_rates["tx_bps"], ts)
         chips = self.chips()
         if chips:
             duty = [c.mxu_duty_pct for c in chips if c.mxu_duty_pct is not None]

@@ -125,6 +125,9 @@ class MonitorServer:
         s = self.sampler.sample_of("host")
         return {
             **self.sampler.host_data(),
+            # NIC byte rates (the host's DCN-traffic proxy); present
+            # once two samples have established a delta.
+            "net_rates": self.sampler.net_rates,
             "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
         }
 
