@@ -122,6 +122,7 @@ def main(argv: list[str] | None = None) -> int:
     loadgen_kv = "dense"
     loadgen_pool = 0
     loadgen_block = 1
+    loadgen_kv_dtype = "compute"
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -192,8 +193,12 @@ def main(argv: list[str] | None = None) -> int:
             loadgen_pool = take_int(arg)
             serve_loadgen = True
         elif arg == "--loadgen-decode-block":
-            # Fuse N plain-decode steps per dispatch (dense KV only).
+            # Fuse N plain-decode steps per dispatch.
             loadgen_block = take_int(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-kv-dtype":
+            # "compute" | "int8" KV cache element type.
+            loadgen_kv_dtype = take(arg)
             serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
@@ -205,6 +210,7 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-quant int8] [--loadgen-spec-len N] "
                 "[--loadgen-prefix-cache N] [--loadgen-kv-layout dense|paged] "
                 "[--loadgen-pool-pages N] [--loadgen-decode-block N] "
+                "[--loadgen-kv-dtype compute|int8] "
                 "[--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
@@ -233,7 +239,7 @@ def main(argv: list[str] | None = None) -> int:
                 ckpt_dir=loadgen_ckpt, quantize=loadgen_quant,
                 spec_len=loadgen_spec, prefix_cache=loadgen_prefix,
                 kv_layout=loadgen_kv, pool_pages=loadgen_pool,
-                decode_block=loadgen_block,
+                decode_block=loadgen_block, kv_dtype=loadgen_kv_dtype,
             )
         except ValueError as e:  # uncomposable/unknown engine options
             print(f"--serve-loadgen: {e}", file=sys.stderr)

@@ -63,7 +63,25 @@ def decode_block(cfg, params: dict, cache: dict, tokens: jax.Array,
         # cache_l: [S, nkv, hd]; kv: [T, nkv, hd] — contiguous T-row write.
         return lax.dynamic_update_slice(cache_l, kv, (p, 0, 0))
 
+    def append_scale(scale_l: jax.Array, s: jax.Array, p: jax.Array) -> jax.Array:
+        # scale_l: [S, nkv]; s: [T, nkv].
+        return lax.dynamic_update_slice(scale_l, s, (p, 0))
+
     def kv_update(li, k, v):
+        if "ks" in cache:  # int8 cache layout (serving.init_cache)
+            from tpumon.loadgen.serving import _kv_dequant, _kv_quant
+
+            (qk, sk), (qv, sv) = _kv_quant(k), _kv_quant(v)
+            new_k = jax.vmap(append)(cache["k"][li], qk, positions)
+            new_v = jax.vmap(append)(cache["v"][li], qv, positions)
+            new_ks = jax.vmap(append_scale)(cache["ks"][li], sk, positions)
+            new_vs = jax.vmap(append_scale)(cache["vs"][li], sv, positions)
+            cache["k"] = cache["k"].at[li].set(new_k)
+            cache["v"] = cache["v"].at[li].set(new_v)
+            cache["ks"] = cache["ks"].at[li].set(new_ks)
+            cache["vs"] = cache["vs"].at[li].set(new_vs)
+            return (_kv_dequant(new_k, new_ks, k.dtype),
+                    _kv_dequant(new_v, new_vs, v.dtype))
         new_k = jax.vmap(append)(cache["k"][li], k, positions)
         new_v = jax.vmap(append)(cache["v"][li], v, positions)
         cache["k"] = cache["k"].at[li].set(new_k)
