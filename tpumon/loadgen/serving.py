@@ -1477,7 +1477,7 @@ def main(argv: list[str] | None = None) -> int:
                          "cache HBM (dense engine)")
     ap.add_argument("--decode-block", type=int, default=1,
                     help="fuse N plain-decode steps into one dispatch "
-                         "(dense KV only; 1 = off)")
+                         "(dense or paged KV; 1 = off)")
     ap.add_argument("--kv-layout", choices=["dense", "paged"],
                     default="dense",
                     help="paged: per-request page reservation from a "
