@@ -121,8 +121,28 @@ def test_int8_kv_composes_with_block_decode_and_int8_weights():
     assert base == fused
 
 
+def test_int8_kv_paged_pool():
+    """int8 KV over the paged pool: same quantization scheme page-wise;
+    fused block decode composes; output matches per-step paged int8."""
+    base = run({"kv_dtype": "int8", "kv_layout": "paged", "pool_pages": 9})
+    fused = run({"kv_dtype": "int8", "kv_layout": "paged", "pool_pages": 9,
+                 "decode_block": 4})
+    assert base == fused
+    assert all(len(o) == 13 for o in base)
+    # Pool halves too (net of scales).
+    from tpumon.loadgen.paged_kv import init_pool
+
+    qp = init_pool(ServeConfig(model=MODEL, prefill_len=16,
+                               kv_dtype="int8"), 8)
+    bp = init_pool(ServeConfig(model=MODEL, prefill_len=16), 8)
+    assert qp["k"].dtype == jnp.int8
+    qb = sum(a.size * a.dtype.itemsize for a in qp.values())
+    bb = sum(a.size * a.dtype.itemsize for a in bp.values())
+    assert qb < bb * 0.6
+
+
 def test_int8_kv_invalid_compositions():
-    for kw in ({"kv_layout": "paged", "pool_pages": 9}, {"spec_len": 2},
+    for kw in ({"spec_len": 2},
                {"prefix_cache_entries": 4}):
         with pytest.raises(ValueError, match="int8"):
             ServingEngine(cfg=ServeConfig(

@@ -73,6 +73,15 @@ def init_pool(cfg, num_pages: int) -> dict:
     m = cfg.model
     shape = (m.n_layers, m.n_kv_heads, num_pages, cfg.prefill_len,
              m.head_dim)
+    if getattr(cfg, "kv_dtype", "compute") == "int8":
+        # Quantized pool: int8 rows + per-(page-row, kv-head) f32 scales
+        # (same scheme as the dense int8 cache, serving.init_cache).
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(shape[:-1], jnp.float32),
+            "vs": jnp.zeros(shape[:-1], jnp.float32),
+        }
     dt = jnp.dtype(m.compute_dtype)
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -106,14 +115,27 @@ def paged_prefill(cfg, params: dict, pool: dict, tokens: jax.Array,
 
     def kv_update(li, k, v):
         # Write the chunk into its fresh page, then attend over the
-        # sequence's pages (this chunk's page included).
-        for name, new in (("k", k), ("v", v)):
+        # sequence's pages (this chunk's page included). "ks" present =
+        # the int8 pool layout (init_pool) — trace-time branch.
+        quant = "ks" in pool
+        from tpumon.loadgen.serving import _kv_dequant, _kv_quant
+
+        for name, sname, new in (("k", "ks", k), ("v", "vs", v)):
+            if quant:
+                new, scale = _kv_quant(new)
+                sblock = scale[0].transpose(1, 0)[:, None]  # [nkv, 1, ps]
+                pool[sname] = pool[sname].at[li].set(
+                    lax.dynamic_update_slice(
+                        pool[sname][li], sblock, (0, page_id, 0)))
             block = new[0].transpose(1, 0, 2)[:, None]  # [nkv, 1, ps, hd]
             pool[name] = pool[name].at[li].set(
                 lax.dynamic_update_slice(
                     pool[name][li], block, (0, page_id, 0, 0)))
         ck = pool["k"][li][:, table_row]  # [nkv, max_pages, ps, hd]
         cv = pool["v"][li][:, table_row]
+        if quant:
+            ck = _kv_dequant(ck, pool["ks"][li][:, table_row], k.dtype)
+            cv = _kv_dequant(cv, pool["vs"][li][:, table_row], v.dtype)
         ck = ck.reshape(nkv, s_max, hd).transpose(1, 0, 2)[None]
         cv = cv.reshape(nkv, s_max, hd).transpose(1, 0, 2)[None]
         return ck, cv  # [1, S, nkv, hd]
@@ -156,10 +178,20 @@ def paged_decode_step(cfg, params: dict, pool: dict,
         # mixed basic/advanced index puts the broadcast batch dim FIRST,
         # so the update value is [B, nkv, hd] (no transpose — passing
         # [nkv, B, hd] would broadcast silently whenever nkv == B).
-        for name, new in (("k", k), ("v", v)):
+        quant = "ks" in pool  # int8 pool layout (init_pool)
+        from tpumon.loadgen.serving import _kv_dequant, _kv_quant
+
+        for name, sname, new in (("k", "ks", k), ("v", "vs", v)):
+            if quant:
+                new, scale = _kv_quant(new)
+                pool[sname] = pool[sname].at[li, :, page, off].set(
+                    scale[:, 0])
             pool[name] = pool[name].at[li, :, page, off].set(new[:, 0])
         ck = pool["k"][li][:, tables]  # [nkv, B, max_pages, ps, hd]
         cv = pool["v"][li][:, tables]
+        if quant:
+            ck = _kv_dequant(ck, pool["ks"][li][:, tables], k.dtype)
+            cv = _kv_dequant(cv, pool["vs"][li][:, tables], v.dtype)
         ck = ck.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
         cv = cv.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
         return ck, cv  # [B, S, nkv, hd]

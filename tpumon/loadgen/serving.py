@@ -527,11 +527,11 @@ class ServingEngine:
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
         if self.cfg.kv_dtype == "int8" and (
-                self.cfg.kv_layout == "paged" or mesh is not None
+                mesh is not None
                 or self.cfg.spec_len or self.cfg.prefix_cache_entries):
             raise ValueError(
-                "kv_dtype='int8' currently composes with the dense "
-                "single-device engine (with decode_block and int8 "
+                "kv_dtype='int8' currently composes with the dense and "
+                "paged single-device engine (with decode_block and int8 "
                 "weights) only")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
