@@ -161,24 +161,50 @@ def _bench_kernels() -> dict:
         measure_paged_gbps,
     )
 
-    mm_pallas = measure_mxu_tflops(use_pallas=True)
-    mm_xla = measure_mxu_tflops(use_pallas=False)
-    i8_pallas = measure_int8_tflops(use_pallas=True)
-    i8_xla = measure_int8_tflops(use_pallas=False)
-    pa_pallas = measure_paged_gbps(use_pallas=True)
-    pa_xla = measure_paged_gbps(use_pallas=False)
+    def safe(fn, **kw):
+        # A single unresolvable measurement (roofline/noise guard raised
+        # after retries, loadgen.burn._guarded_slope) nulls its own keys,
+        # not the whole phase.
+        try:
+            return fn(**kw)
+        except Exception as e:
+            _note(f"kernel measurement {fn.__name__}({kw}) failed: {e}")
+            return None
+
+    mm_pallas = safe(measure_mxu_tflops, use_pallas=True)
+    mm_xla = safe(measure_mxu_tflops, use_pallas=False)
+    i8_pallas = safe(measure_int8_tflops, use_pallas=True)
+    i8_xla = safe(measure_int8_tflops, use_pallas=False)
+    pa_pallas = safe(measure_paged_gbps, use_pallas=True)
+    pa_xla = safe(measure_paged_gbps, use_pallas=False)
+
+    def val(out, key, digits):
+        return round(out[key], digits) if out else None
+
+    def ratio(a, b, key):
+        return round(a[key] / b[key], 2) if a and b else None
+
     return {
-        "mxu_matmul_pallas_tflops": round(mm_pallas["tflops"], 2),
-        "mxu_matmul_xla_tflops": round(mm_xla["tflops"], 2),
-        "mxu_matmul_vs_xla": round(mm_pallas["tflops"] / mm_xla["tflops"], 2),
-        "int8_matmul_pallas_tflops": round(i8_pallas["tflops"], 2),
-        "int8_matmul_xla_tflops": round(i8_xla["tflops"], 2),
-        "int8_matmul_vs_xla": round(i8_pallas["tflops"] / i8_xla["tflops"], 2),
-        "paged_attention_pallas_kv_gbps": round(pa_pallas["kv_gbps"], 1),
-        "paged_attention_xla_kv_gbps": round(pa_xla["kv_gbps"], 1),
-        "paged_attention_vs_xla": round(
-            pa_pallas["kv_gbps"] / pa_xla["kv_gbps"], 2
-        ),
+        "mxu_matmul_pallas_tflops": val(mm_pallas, "tflops", 2),
+        "mxu_matmul_xla_tflops": val(mm_xla, "tflops", 2),
+        "mxu_matmul_vs_xla": ratio(mm_pallas, mm_xla, "tflops"),
+        "int8_matmul_pallas_tflops": val(i8_pallas, "tflops", 2),
+        "int8_matmul_xla_tflops": val(i8_xla, "tflops", 2),
+        "int8_matmul_vs_xla": ratio(i8_pallas, i8_xla, "tflops"),
+        "paged_attention_pallas_kv_gbps": val(pa_pallas, "kv_gbps", 1),
+        "paged_attention_xla_kv_gbps": val(pa_xla, "kv_gbps", 1),
+        "paged_attention_vs_xla": ratio(pa_pallas, pa_xla, "kv_gbps"),
+        # Per-measurement marginal durations: the slope each number came
+        # from resolved this much device time above the tunnel's ±60 ms
+        # per-call noise (roofline+noise-floor guards in loadgen.burn).
+        "kernel_marginal_s": {
+            "mxu_pallas": val(mm_pallas, "marginal_s", 3),
+            "mxu_xla": val(mm_xla, "marginal_s", 3),
+            "int8_pallas": val(i8_pallas, "marginal_s", 3),
+            "int8_xla": val(i8_xla, "marginal_s", 3),
+            "paged_pallas": val(pa_pallas, "marginal_s", 3),
+            "paged_xla": val(pa_xla, "marginal_s", 3),
+        },
     }
 
 
@@ -187,7 +213,11 @@ def _bench_train(on_tpu: bool) -> dict:
     measured with the whole step loop fused into one jitted scan
     (loadgen.train.fused_train_bench) so the number reflects device
     throughput, not Python dispatch or tunnel RTT. Off-TPU shapes shrink
-    to keep CI fast (MFU is null there — no known peak for CPU)."""
+    to keep CI fast (MFU is null there — no known peak for CPU).
+
+    train_seq8k_mfu pins the round-2 long-sequence features (per-layer
+    remat + chunked online-softmax attention) at seq 8192 — a shape that
+    does not fit a 16 GiB v5e without them."""
     from tpumon.loadgen.model import ModelConfig
     from tpumon.loadgen.train import TrainConfig, fused_train_bench
 
@@ -201,28 +231,54 @@ def _bench_train(on_tpu: bool) -> dict:
         )
         cfg = TrainConfig(model=model, batch=8, seq=1024)
         steps = 16
+        model_8k = ModelConfig(
+            vocab=4096, d_model=2048, n_layers=6, n_heads=16, n_kv_heads=16,
+            d_ff=8192, max_seq=8192, remat=True,
+            attention="chunked", attn_block_k=512,
+        )
+        cfg_8k = TrainConfig(model=model_8k, batch=1, seq=8192)
+        steps_8k = 4
     else:
         model = ModelConfig()
         cfg = TrainConfig(model=model, batch=2, seq=64)
         steps = 4
+        model_8k = ModelConfig(
+            remat=True, attention="chunked", attn_block_k=64, max_seq=256
+        )
+        cfg_8k = TrainConfig(model=model_8k, batch=1, seq=256)
+        steps_8k = 2
     out = fused_train_bench(cfg, steps=steps)
+    out_8k = fused_train_bench(cfg_8k, steps=steps_8k)
     return {
         "train_mfu_pct": round(out["mfu_pct"], 2)
         if out["mfu_pct"] is not None
         else None,
         "train_tokens_per_sec": round(out["tokens_per_sec"], 1),
+        "train_seq8k_mfu_pct": round(out_8k["mfu_pct"], 2)
+        if out_8k["mfu_pct"] is not None
+        else None,
+        "train_seq8k_tokens_per_sec": round(out_8k["tokens_per_sec"], 1),
     }
 
 
 def _bench_serving(on_tpu: bool) -> dict:
-    """End-to-end engine throughput: continuous batching, KV-cached
-    decode, greedy sampling. Tokens/s = generated tokens / wall time
-    including prefill (the serving-loop number PARITY claims)."""
+    """End-to-end engine throughput across the whole feature matrix:
+    dense step decode, fused block decode, speculative decoding (with
+    measured acceptance), paged KV, int8 KV, and prefix-cache TTFT —
+    every serving perf claim gets a keyed per-round number (VERDICT r02
+    item #4). Tokens/s = generated tokens / wall time including prefill
+    (the serving-loop number PARITY claims)."""
+    import dataclasses
+
     from tpumon.loadgen.model import ModelConfig
-    from tpumon.loadgen.serving import ServeConfig, ServingEngine
+    from tpumon.loadgen.serving import (
+        ServeConfig,
+        ServingEngine,
+        default_engine_config,
+    )
 
     if on_tpu:
-        cfg = ServeConfig(
+        base = ServeConfig(
             model=ModelConfig(vocab=4096, d_model=512, n_layers=4,
                               n_heads=8, n_kv_heads=8, d_ff=2048,
                               max_seq=512),
@@ -230,35 +286,69 @@ def _bench_serving(on_tpu: bool) -> dict:
         )
         n_req, max_new = 24, 64
     else:
-        cfg = None  # tiny default model
+        base = default_engine_config()
         n_req, max_new = 8, 16
     prompt = list(range(1, 17))
 
-    def run(block: int) -> float:
-        import dataclasses
-
-        c = cfg
-        if c is not None:
-            c = dataclasses.replace(c, decode_block=block)
-        elif block > 1:
-            from tpumon.loadgen.serving import default_engine_config
-
-            c = dataclasses.replace(default_engine_config(),
-                                    decode_block=block)
-        engine = ServingEngine(c)
+    def run(**over) -> tuple[float, "ServingEngine"]:
+        engine = ServingEngine(dataclasses.replace(base, **over))
         # Warmup: compile prefill + decode out of the measured window.
         engine.submit(prompt, max_new=2)
         engine.drain()
         t0 = time.perf_counter()
         reqs = [engine.submit(prompt, max_new=max_new) for _ in range(n_req)]
         engine.drain()
-        return sum(len(r.output) for r in reqs) / (time.perf_counter() - t0)
+        tps = sum(len(r.output) for r in reqs) / (time.perf_counter() - t0)
+        return tps, engine
 
+    def spec_accept(engine) -> float | None:
+        from tpumon.collectors.serving import distill_serving_metrics
+
+        return distill_serving_metrics(engine.metrics_text()).get(
+            "spec_accept_pct"
+        )
+
+    def prefix_ttft() -> tuple[float, float]:
+        """TTFT (ms) for a cold prompt vs a prefix-cache hit on the
+        same prompt — the cache's whole point is prefill elision."""
+        engine = ServingEngine(
+            dataclasses.replace(base, prefix_cache_entries=8)
+        )
+        engine.submit(list(range(21, 29)), max_new=2)  # compile
+        engine.drain()
+
+        def ttft(p) -> float:
+            t0 = time.perf_counter()
+            engine.submit(p, max_new=1)
+            engine.drain()
+            return (time.perf_counter() - t0) * 1e3
+
+        cold = ttft(prompt)
+        hit = ttft(prompt)  # same prompt again -> prefix hit
+        return cold, hit
+
+    tps_step, _ = run()
+    # Fused plain decode (ServeConfig.decode_block): 8 steps per
+    # dispatch — the engine's dispatch-overhead amortization.
+    tps_block, _ = run(decode_block=8)
+    tps_spec, eng_spec = run(spec_len=3)
+    # pool_pages=0 = the dense-equivalent pool the engine computes itself
+    # (slots*max_pages+1): measures the paged indirection at equal memory.
+    tps_paged, _ = run(decode_block=8, kv_layout="paged")
+    tps_int8kv, _ = run(decode_block=8, kv_dtype="int8")
+    ttft_cold, ttft_hit = prefix_ttft()
+    accept = spec_accept(eng_spec)
     return {
-        "serving_tokens_per_sec": round(run(1), 1),
-        # Fused plain decode (ServeConfig.decode_block): 8 steps per
-        # dispatch — the engine's dispatch-overhead amortization.
-        "serving_block8_tokens_per_sec": round(run(8), 1),
+        "serving_tokens_per_sec": round(tps_step, 1),
+        "serving_block8_tokens_per_sec": round(tps_block, 1),
+        "serving_spec_tokens_per_sec": round(tps_spec, 1),
+        # A missing acceptance metric must null, not fabricate 0%.
+        "serving_spec_accept_pct": round(accept, 1)
+        if accept is not None else None,
+        "serving_paged_block8_tokens_per_sec": round(tps_paged, 1),
+        "serving_int8kv_block8_tokens_per_sec": round(tps_int8kv, 1),
+        "serving_prefix_ttft_cold_ms": round(ttft_cold, 1),
+        "serving_prefix_ttft_hit_ms": round(ttft_hit, 1),
         "serving_requests": n_req,
     }
 
@@ -362,10 +452,19 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "mxu_matmul_vs_xla",
                       "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
                       "int8_matmul_vs_xla", "paged_attention_pallas_kv_gbps",
-                      "paged_attention_xla_kv_gbps", "paged_attention_vs_xla")),
-    "train": (420, ("train_mfu_pct", "train_tokens_per_sec")),
-    "serving": (700, ("serving_tokens_per_sec",
-                      "serving_block8_tokens_per_sec", "serving_requests")),
+                      "paged_attention_xla_kv_gbps", "paged_attention_vs_xla",
+                      "kernel_marginal_s")),
+    "train": (540, ("train_mfu_pct", "train_tokens_per_sec",
+                    "train_seq8k_mfu_pct", "train_seq8k_tokens_per_sec")),
+    "serving": (900, ("serving_tokens_per_sec",
+                      "serving_block8_tokens_per_sec",
+                      "serving_spec_tokens_per_sec",
+                      "serving_spec_accept_pct",
+                      "serving_paged_block8_tokens_per_sec",
+                      "serving_int8kv_block8_tokens_per_sec",
+                      "serving_prefix_ttft_cold_ms",
+                      "serving_prefix_ttft_hit_ms",
+                      "serving_requests")),
 }
 
 

@@ -83,7 +83,12 @@ def test_fault_episodes():
     c.clock = lambda: 30.0  # inside both episode windows
     by_idx = {ch.index: ch for ch in c.chips()}
     assert by_idx[3].ici_link_health == 7
-    assert by_idx[5].throttle_score == 4
+    # 5 is the lowest score past the strict '>' serious threshold
+    # (TriLevel(0, 4, 7)) so the demo exercises the serious alert.
+    assert by_idx[5].throttle_score == 5
+    from tpumon.config import Thresholds
+
+    assert Thresholds().throttle_score.severity(by_idx[5].throttle_score) == "serious"
     assert by_idx[0].ici_link_health == 0
     c.clock = lambda: 200.0  # between episodes
     assert all(ch.ici_link_health == 0 for ch in c.chips())

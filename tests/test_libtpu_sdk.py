@@ -288,6 +288,98 @@ def test_accel_jax_clears_extras_when_sdk_disappears():
     assert c.last_extras == {}
 
 
+def test_accel_jax_partial_sdk_falls_through_to_grpc_per_field():
+    """An SDK snapshot reporting only link health (empty duty/HBM maps)
+    must not preempt the gRPC source wholesale: duty/HBM fall through
+    per-field while the SDK's ici_health is kept."""
+    snap = SdkSnapshot(ici_health={0: 3, 1: 0})
+    c = _collector_with_sdk(snap)
+
+    class _Grpc:
+        async def snapshot(self):
+            return {
+                "duty_pct": {0: 12.0, 1: 34.0},
+                "hbm_used": {0: 2**30, 1: 2**31},
+                "hbm_total": {0: 16 * 2**30, 1: 16 * 2**30},
+            }
+
+    c._client = _Grpc()
+    s = asyncio.run(run_collector(c))
+    assert s.ok
+    by_idx = {ch.index: ch for ch in s.data}
+    assert by_idx[0].ici_link_health == 3  # from SDK
+    assert by_idx[0].mxu_duty_pct == 12.0  # from gRPC
+    assert by_idx[1].hbm_used == 2**31  # from gRPC
+
+
+def test_accel_jax_per_chip_sdk_gap_falls_through_to_grpc():
+    """A NON-empty SDK map that covers only some chips must still pull
+    the missing chips from gRPC (gap detection is per-chip, not
+    per-family)."""
+    snap = SdkSnapshot(duty_pct={0: 42.0}, hbm_used={0: 2**30},
+                       hbm_total={0: 16 * 2**30})
+    c = _collector_with_sdk(snap)
+
+    class _Grpc:
+        async def snapshot(self):
+            return {
+                "duty_pct": {0: 1.0, 1: 34.0},
+                "hbm_used": {0: 1, 1: 2**31},
+                "hbm_total": {0: 1, 1: 16 * 2**30},
+            }
+
+    c._client = _Grpc()
+    s = asyncio.run(run_collector(c))
+    assert s.ok
+    by_idx = {ch.index: ch for ch in s.data}
+    assert by_idx[0].mxu_duty_pct == 42.0  # SDK still wins where present
+    assert by_idx[0].hbm_used == 2**30
+    assert by_idx[1].mxu_duty_pct == 34.0  # gap filled from gRPC
+    assert by_idx[1].hbm_used == 2**31
+    assert by_idx[1].counter_source == "grpc"
+
+
+def test_accel_jax_dark_sources_probe_off_tick_path():
+    """After a source goes dark its probe cost must leave the sampler
+    tick: re-probes ride a background task (BENCH_r02's 3.6x
+    sampler-rate regression), and a source that comes alive is adopted
+    on the next tick."""
+    calls = {"sdk": 0, "grpc": 0}
+    alive = {"sdk": False}
+
+    c = JaxTpuCollector(hostname="testhost", slice_id="s0")
+    c._devices = [_FakeDevice(0)]
+
+    class _Sdk:
+        async def snapshot(self):
+            calls["sdk"] += 1
+            return SdkSnapshot(duty_pct={0: 9.0}) if alive["sdk"] else None
+
+    class _Grpc:
+        async def snapshot(self):
+            calls["grpc"] += 1
+            return None
+
+    c._sdk = _Sdk()
+    c._client = _Grpc()
+
+    async def main():
+        await run_collector(c)  # first collect probes inline, goes dark
+        assert calls["sdk"] == 1 and calls["grpc"] == 1
+        for _ in range(28):  # collects 2..29: dark sources stay skipped
+            await run_collector(c)
+        assert calls["sdk"] == 1 and calls["grpc"] == 1
+        alive["sdk"] = True
+        await run_collector(c)  # collect 30 kicks the background probe
+        assert c._reprobe_task is not None
+        await c._reprobe_task
+        assert calls["sdk"] == 2  # probed off-tick, found alive
+        s = await run_collector(c)  # next tick adopts the source inline
+        assert s.data[0].mxu_duty_pct == 9.0
+
+    asyncio.run(main())
+
+
 def test_accel_jax_unattributed_ici_links_hit_every_chip():
     """A bad link whose location lacks a chipN token (rolled up under -1)
     must surface on the host's chips, not vanish."""
@@ -390,6 +482,11 @@ def test_exporter_emits_runtime_extras():
     assert 'tpu_hlo_queue_size{core="tensorcore_0"} 3' in text
     assert ('tpu_collective_e2e_latency_us{bucket="2MB+-ALL_REDUCE",'
             'quantile="p50"} 200' in text)
+    # The mean is not a quantile: it rides its own series, and no sample
+    # ever carries quantile="mean" (a reserved summary-type convention).
+    assert ('tpu_collective_e2e_latency_us_mean{bucket="2MB+-ALL_REDUCE"} 100'
+            in text)
+    assert 'quantile="mean"' not in text
 
 
 def test_exporter_emits_new_gauges():

@@ -172,3 +172,97 @@ def test_ici_burn_on_cpu_mesh():
     assert out["devices"] == 4
     assert out["bytes_shifted"] == 4 * 1 * 2**20 * 4
     assert out["gbps"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Slope-measurement integrity guards (BENCH_r02 regression: a paged-
+# attention "bandwidth" 1.4x the HBM roofline was published because the
+# marginal work sat below the tunnel's noise floor).
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    """Deterministic stand-in device: run(n) 'takes' overhead + n*per_iter
+    seconds, with optional per-call noise, without actually sleeping."""
+
+    def __init__(self, per_iter_s: float, overhead_s: float = 0.05,
+                 noise: list[float] | None = None):
+        self.per_iter_s = per_iter_s
+        self.overhead_s = overhead_s
+        self.noise = list(noise or [])
+        self.now = 0.0
+        self.calls: list[int] = []
+
+    def run(self, n: int) -> None:
+        self.calls.append(n)
+        dt = self.overhead_s + n * self.per_iter_s
+        if self.noise:
+            dt += self.noise.pop(0)
+        self.now += dt
+
+
+def _patched_guarded_slope(clock, **kw):
+    from unittest import mock
+
+    from tpumon.loadgen import burn
+
+    with mock.patch.object(burn.time, "perf_counter", lambda: clock.now):
+        return burn._guarded_slope(clock.run, **kw)
+
+
+def test_guarded_slope_clean_measurement():
+    # 10 ms/iter: n=32 -> marginal 96 iters = 0.96 s >= floor; rate exact.
+    clock = _FakeClock(per_iter_s=0.01)
+    rate, marginal, dt = _patched_guarded_slope(
+        clock, iters=32, units_per_iter=100.0, peak_per_sec=None,
+        what="t", reps=2)
+    assert marginal == 96
+    assert abs(dt - 0.96) < 1e-9
+    assert abs(rate - 100.0 / 0.01) < 1e-6
+
+
+def test_guarded_slope_grows_past_noise_floor():
+    # 1 ms/iter at n=16: marginal 48 iters = 48 ms < 500 ms floor ->
+    # must auto-scale until the marginal clears the floor.
+    clock = _FakeClock(per_iter_s=0.001)
+    rate, marginal, dt = _patched_guarded_slope(
+        clock, iters=16, units_per_iter=1.0, peak_per_sec=None,
+        what="t", reps=2)
+    assert dt >= 0.5
+    assert abs(rate - 1.0 / 0.001) < 1e-6
+
+
+def test_guarded_slope_rejects_above_roofline():
+    # True rate 1000 units/s but peak claims 500: physically impossible,
+    # must raise after retries rather than publish.
+    import pytest
+
+    clock = _FakeClock(per_iter_s=0.01)
+    with pytest.raises(RuntimeError, match="roofline"):
+        _patched_guarded_slope(
+            clock, iters=32, units_per_iter=10.0, peak_per_sec=500.0,
+            what="t", reps=2)
+
+
+def test_guarded_slope_roofline_retry_recovers():
+    # First window poisoned by noise (t(n1) inflated -> slope too small
+    # -> rate absurdly high); retries at doubled scale converge to truth.
+    clock = _FakeClock(per_iter_s=0.01, noise=[0.0, 0.0, -0.4, 0.0])
+    # reps=1: the -0.4 s hiccup lands on the timed n2 rep -> slope 0.56 s
+    # (clears the noise floor) -> rate 17,143 > peak; the doubled-scale
+    # retry (64 iters) is clean and lands below peak.
+    rate, marginal, dt = _patched_guarded_slope(
+        clock, iters=32, units_per_iter=100.0, peak_per_sec=12_000.0,
+        what="t", reps=1)
+    assert abs(rate - 10_000.0) < 1e-6
+    assert marginal == 192
+
+
+def test_measure_rooflines_table():
+    from tpumon.loadgen.burn import device_rooflines
+
+    peaks = device_rooflines()
+    # On the CPU test platform every peak is unknown -> guards disengage.
+    assert set(peaks) == {"bf16_tflops", "int8_tops", "hbm_gbps"}
+    for v in peaks.values():
+        assert v is None or v > 0

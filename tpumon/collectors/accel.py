@@ -48,7 +48,7 @@ def make_accel_collector(cfg: Config) -> Collector:
             kw["host_prefix"] = prefix
         local = FakeTpuCollector(topology=topology, **kw)
     elif backend in ("auto", "jax"):
-        local = JaxTpuCollector()
+        local = JaxTpuCollector(workload_dir=cfg.workload_dir or None)
     else:
         raise ValueError(f"unknown accel backend {backend!r}")
     if cfg.peers:

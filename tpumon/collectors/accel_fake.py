@@ -91,7 +91,9 @@ class FakeTpuCollector:
                     if g == 3 and (t % 480) < 60:
                         link_health = 7  # persistent problem -> serious
                     if g == 5 and (t % 660) < 45:
-                        throttle = 4  # ~40% throttled -> serious
+                        # Thresholds.throttle_score = TriLevel(0, 4, 7) uses
+                        # strict '>', so 5 is the lowest serious-severity score.
+                        throttle = 5  # ~50% throttled -> serious
                 sample = ChipSample(
                     chip_id=f"{host}/chip-{i}",
                     host=host,

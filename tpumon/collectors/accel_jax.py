@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from tpumon.collectors import Sample
 from tpumon.collectors.libtpu_grpc import LibtpuMetricsClient
 from tpumon.collectors.libtpu_sdk import LibtpuSdkSource, SdkSnapshot
+from tpumon.collectors.workload import WorkloadFileSource
 from tpumon.topology import HBM_BYTES_BY_KIND, ChipSample, normalize_chip_kind
 
 #: Health-strip note attached to every real-hardware accel sample: the
@@ -53,6 +54,9 @@ class JaxTpuCollector:
     slice_id: str | None = None  # default: derived from env / "slice-0"
     hostname: str | None = None
     libtpu_addr: str = "localhost:8431"
+    # Directory workloads self-report into (tpumon.collectors.workload);
+    # None disables the source.
+    workload_dir: str | None = None
     # JAX backend init can hang indefinitely when the device runtime is
     # wedged (e.g. a lost remote-device grant); a monitor must degrade,
     # not hang with it.
@@ -65,6 +69,7 @@ class JaxTpuCollector:
     _sdk_ok: bool | None = field(default=None, repr=False)
     _init_error: str | None = field(default=None, repr=False)
     _collects: int = field(default=0, repr=False)
+    _reprobe_task: object | None = field(default=None, repr=False)
     #: Slice-level SDK extras (HLO queue sizes, transfer/collective
     #: latency percentiles) from the last successful SDK snapshot;
     #: the server surfaces these under /api/accel/metrics -> "runtime".
@@ -86,6 +91,11 @@ class JaxTpuCollector:
             )
         self._client = LibtpuMetricsClient(addr=self.libtpu_addr)
         self._sdk = LibtpuSdkSource()
+        self._workload = (
+            WorkloadFileSource(directory=self.workload_dir)
+            if self.workload_dir
+            else None
+        )
 
     def _init_devices(self) -> list:
         """Blocking JAX init; run in a thread."""
@@ -113,6 +123,24 @@ class JaxTpuCollector:
                 self._devices = []
         return self._devices or []
 
+    def _kick_reprobe(self) -> None:
+        """Re-probe dark counter sources off the tick path. The probe
+        runs as a fire-and-forget task; if a source answers, its ok-flag
+        resets to None so the next tick adopts it inline."""
+        task = self._reprobe_task
+        if task is not None and not task.done():
+            return
+
+        async def probe() -> None:
+            if self._sdk_ok is False:
+                if await self._sdk.snapshot() is not None:
+                    self._sdk_ok = None
+            if self._libtpu_ok is False:
+                if await self._client.snapshot() is not None:
+                    self._libtpu_ok = None
+
+        self._reprobe_task = asyncio.create_task(probe())
+
     async def collect(self) -> Sample:
         devices = await self._devices_cached()
         if not devices:
@@ -124,33 +152,67 @@ class JaxTpuCollector:
             )
 
         # Counter sources, preference order (a) SDK, (b) gRPC. On a miss,
-        # skip for a while but keep re-probing — either service appears
-        # when a workload initializes libtpu in-process / on-host.
+        # skip on the tick path but keep re-probing in a *background* task
+        # — either service appears when a workload initializes libtpu
+        # in-process / on-host, but a dark source's probe cost (thread
+        # hop + 12 SDK metric reads / a refused connect, all riding the
+        # tunnel) must not land on the sampler tick (BENCH_r02's 3.6x
+        # sampler-rate regression traced to exactly this).
         self._collects += 1
-        reprobe = self._collects % self.LIBTPU_REPROBE_EVERY == 0
+        if self._collects % self.LIBTPU_REPROBE_EVERY == 0:
+            self._kick_reprobe()
         sdk_snap: SdkSnapshot | None = None
-        if self._sdk_ok is not False or reprobe:
+        if self._sdk_ok is not False:
             sdk_snap = await self._sdk.snapshot()
             self._sdk_ok = sdk_snap is not None
             # Extras mirror the *probed* state: cleared when the SDK stops
             # reporting so /api/accel "runtime" never serves a dead
             # workload's queue depths as current.
             self.last_extras = sdk_snap.extras if sdk_snap is not None else {}
+        # The SDK may report only some families (empty duty/HBM maps) or
+        # only some chips (gaps in a non-empty map); either way fall
+        # through to the gRPC source per-field rather than gating the
+        # whole probe on sdk_snap is None.
+        local_idxs = [
+            int(
+                d.id
+                if getattr(d, "local_hardware_id", None) is None
+                else d.local_hardware_id
+            )
+            for d in devices
+        ]
+        sdk_partial = sdk_snap is not None and any(
+            i not in sdk_snap.duty_pct or i not in sdk_snap.hbm_used
+            for i in local_idxs
+        )
         libtpu_snap = None
-        if sdk_snap is None and (self._libtpu_ok is not False or reprobe):
+        if (sdk_snap is None or sdk_partial) and self._libtpu_ok is not False:
             libtpu_snap = await self._client.snapshot()
             self._libtpu_ok = libtpu_snap is not None
 
+        # Counter source (d): workload self-reports, ranked below every
+        # platform source. Read lazily — the directory is listed only if
+        # some chip actually has a gap after the platform sources, so a
+        # fully healthy SDK keeps the tick path file-IO-free.
+        workload_snap: dict[int, dict] | None = None
+
+        def workload_lookup(idx: int) -> dict | None:
+            nonlocal workload_snap
+            if self._workload is None:
+                return None
+            if workload_snap is None:
+                workload_snap = self._workload.snapshot()
+            return workload_snap.get(idx)
+
         chips: list[ChipSample] = []
         degraded: list[str] = []
-        for d in devices:
+        workload_names: list[str] = []
+        for d, local_idx in zip(devices, local_idxs):
             kind = normalize_chip_kind(d.device_kind)
-            local_idx = getattr(d, "local_hardware_id", None)
-            if local_idx is None:
-                local_idx = d.id
             hbm_used = hbm_total = None
             duty = None
             ici_health = throttle = None
+            sources: list[str] = []  # provenance, in fill order
             if sdk_snap is not None:
                 duty = sdk_snap.duty_pct.get(local_idx)
                 hbm_used = sdk_snap.hbm_used.get(local_idx)
@@ -165,10 +227,20 @@ class JaxTpuCollector:
                 if unattributed is not None:
                     ici_health = max(ici_health or 0, unattributed)
                 throttle = sdk_snap.throttle.get(local_idx)
-            elif libtpu_snap is not None:
-                hbm_used = libtpu_snap["hbm_used"].get(local_idx)
-                hbm_total = libtpu_snap["hbm_total"].get(local_idx)
-                duty = libtpu_snap["duty_pct"].get(local_idx)
+                if duty is not None or hbm_used is not None:
+                    sources.append("sdk")
+            if libtpu_snap is not None:
+                grpc_used = False
+                if hbm_used is None:
+                    hbm_used = libtpu_snap["hbm_used"].get(local_idx)
+                    grpc_used = hbm_used is not None
+                if hbm_total is None:
+                    hbm_total = libtpu_snap["hbm_total"].get(local_idx)
+                if duty is None:
+                    duty = libtpu_snap["duty_pct"].get(local_idx)
+                    grpc_used = grpc_used or duty is not None
+                if grpc_used:
+                    sources.append("grpc")
             if hbm_used is None:
                 # Counter source (c): PJRT memory stats (process-local view).
                 try:
@@ -178,6 +250,28 @@ class JaxTpuCollector:
                 if ms:
                     hbm_used = ms.get("bytes_in_use")
                     hbm_total = ms.get("bytes_limit") or hbm_total
+                    if hbm_used is not None:
+                        sources.append("pjrt")
+            wl = (
+                workload_lookup(int(local_idx))
+                if (hbm_used is None or duty is None)
+                else None
+            )
+            if wl is not None:
+                wl_used = False
+                if hbm_used is None and wl["hbm_used"] is not None:
+                    hbm_used = wl["hbm_used"]
+                    wl_used = True
+                if hbm_total is None and wl["hbm_total"] is not None:
+                    hbm_total = wl["hbm_total"]
+                if duty is None and wl["busy_frac"] is not None:
+                    duty = round(100.0 * wl["busy_frac"], 1)
+                    wl_used = True
+                if wl_used:
+                    sources.append("workload")
+                    for name in wl.get("workloads", []):
+                        if name not in workload_names:
+                            workload_names.append(name)
             if hbm_total is None:
                 hbm_total = HBM_BYTES_BY_KIND.get(kind)
             if hbm_used is None and duty is None:
@@ -199,16 +293,33 @@ class JaxTpuCollector:
                     # A chip's ICI is down iff any of its links scores 10
                     # ("link is not usable" per the SDK metric description).
                     ici_link_up=(ici_health < 10) if ici_health is not None else None,
+                    counter_source="+".join(sources) or None,
                 )
+            )
+        notes = [TEMP_UNAVAILABLE_NOTE]
+        if workload_names:
+            notes.append(
+                "duty/HBM include workload self-reports "
+                f"(source: workload — {', '.join(sorted(workload_names))}); "
+                "no platform counter source covers these fields on this host"
             )
         return Sample(
             source=self.name,
             ok=not degraded,
             data=chips,
             error=("; ".join(degraded) or None),
-            notes=[TEMP_UNAVAILABLE_NOTE],
+            notes=notes,
         )
 
     async def close(self) -> None:
+        # Stop a pending background reprobe before closing the client it
+        # may be about to use (and retrieve its exception, if any).
+        task = self._reprobe_task
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._client is not None:
             await self._client.close()

@@ -1,0 +1,193 @@
+"""Workload self-report counter source (``source: workload``).
+
+Every platform counter source can be dark on a dev chip — on the
+axon-tunneled v5e this repo validates against, the libtpu SDK answers
+``[]``, the gRPC metrics service refuses connections, and PJRT
+``memory_stats()`` is ``{}`` (PROBE_libtpu.md finding #3). The reference
+faces no such gap: ``nvidia-smi`` always answers
+(``/root/reference/monitor_server.js:83-95``). The TPU-native fallback
+is the workload itself: a JAX process *knows* its own HBM footprint
+(its live device buffers) and its device activity (the fraction of wall
+time it spends blocked on device execution), so it can publish them.
+
+Channel: one small JSON file per workload process in a shared directory
+(default ``/tmp/tpumon-workload``), written atomically (tmp + rename)
+every ~1 s by ``tpumon.loadgen.report.WorkloadReporter`` and merged here
+by the collector. Entries older than ``MAX_AGE_S`` are ignored, so a
+killed workload disappears from the monitor within seconds.
+
+Provenance is explicit end-to-end: chips whose counters came from this
+source carry ``counter_source: "workload"`` in ``/api/accel/metrics``,
+and the sample note (surfaced in ``/api/health`` and the dashboard
+health strip) says self-reported — these are *workload-declared*
+values, deliberately ranked below the SDK/gRPC/PJRT platform sources in
+``accel_jax``'s chain (VERDICT r02 item #2).
+
+File format (version 1)::
+
+    {"v": 1, "name": "train", "pid": 1234, "ts": 1753900000.0,
+     "devices": [{"index": 0, "hbm_used": 2147483648,
+                  "hbm_total": 17179869184, "busy_frac": 0.93}]}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+#: Default shared directory for workload report files, uid-suffixed so a
+#: multi-user host's users can't collide or squat each other's channel.
+#: Overridable via Config.workload_dir (TPUMON_WORKLOAD_DIR).
+DEFAULT_DIR = f"/tmp/tpumon-workload-{os.getuid()}" if hasattr(os, "getuid") \
+    else "/tmp/tpumon-workload"
+
+
+def _owned_by_us(path: str) -> bool:
+    """True iff ``path`` exists and is owned by this process's uid —
+    the trust boundary for the self-report channel (a monitor must not
+    publish counters another local user planted)."""
+    if not hasattr(os, "getuid"):
+        return True  # no POSIX ownership model; nothing to check
+    try:
+        return os.stat(path).st_uid == os.getuid()
+    except OSError:
+        return False
+
+#: Reports older than this are a dead/stalled workload and are ignored.
+MAX_AGE_S = 10.0
+
+REPORT_VERSION = 1
+
+
+def write_report(
+    directory: str,
+    name: str,
+    devices: list[dict],
+    pid: int | None = None,
+    now: float | None = None,
+) -> str:
+    """Atomically write one workload's report; returns the file path.
+
+    Atomic (tmp + rename on the same filesystem) so the collector never
+    reads a half-written JSON.
+    """
+    pid = os.getpid() if pid is None else pid
+    now = time.time() if now is None else now
+    os.makedirs(directory, mode=0o700, exist_ok=True)
+    if not _owned_by_us(directory):
+        raise PermissionError(
+            f"workload report dir {directory!r} is not owned by this "
+            "user — refusing to write into a squattable channel"
+        )
+    path = os.path.join(directory, f"{name}-{pid}.json")
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "v": REPORT_VERSION,
+                "name": name,
+                "pid": pid,
+                "ts": now,
+                "devices": devices,
+            },
+            f,
+        )
+    os.replace(tmp, path)
+    return path
+
+
+def remove_report(directory: str, name: str, pid: int | None = None) -> None:
+    """Best-effort cleanup on workload shutdown (staleness also covers
+    an unclean exit)."""
+    pid = os.getpid() if pid is None else pid
+    try:
+        os.unlink(os.path.join(directory, f"{name}-{pid}.json"))
+    except OSError:
+        pass
+
+
+def read_reports(
+    directory: str, now: float | None = None, max_age_s: float = MAX_AGE_S
+) -> list[dict]:
+    """All fresh, well-formed reports in the directory. Corrupt or stale
+    files are skipped (a monitor must not crash on a torn write or a
+    dead workload's leftovers)."""
+    now = time.time() if now is None else now
+    out: list[dict] = []
+    if not _owned_by_us(directory):
+        return out  # absent, or another user's dir: no trusted reports
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for fname in sorted(names):
+        if not fname.endswith(".json"):
+            continue
+        fpath = os.path.join(directory, fname)
+        if not _owned_by_us(fpath):
+            continue
+        try:
+            with open(fpath) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rep, dict) or rep.get("v") != REPORT_VERSION:
+            continue
+        ts = rep.get("ts")
+        if not isinstance(ts, (int, float)) or now - ts > max_age_s:
+            continue
+        if not isinstance(rep.get("devices"), list):
+            continue
+        out.append(rep)
+    return out
+
+
+def merge_reports(reports: list[dict]) -> dict[int, dict]:
+    """Merge per-process reports into one view per device index.
+
+    Several workloads can share a chip (e.g. a trainer and the serving
+    engine): HBM footprints add; busy fractions add but cap at 1.0 (two
+    processes can't make one chip more than fully busy).
+    """
+    merged: dict[int, dict] = {}
+    for rep in reports:
+        for dev in rep["devices"]:
+            idx = dev.get("index")
+            if not isinstance(idx, int):
+                continue
+            m = merged.setdefault(
+                idx,
+                {"hbm_used": None, "hbm_total": None, "busy_frac": None,
+                 "workloads": []},
+            )
+            hbm = dev.get("hbm_used")
+            if isinstance(hbm, (int, float)):
+                m["hbm_used"] = int((m["hbm_used"] or 0) + hbm)
+            total = dev.get("hbm_total")
+            if isinstance(total, (int, float)):
+                m["hbm_total"] = max(int(total), m["hbm_total"] or 0)
+            busy = dev.get("busy_frac")
+            if isinstance(busy, (int, float)):
+                m["busy_frac"] = min(1.0, (m["busy_frac"] or 0.0) + busy)
+            wname = str(rep.get("name", "?"))
+            if wname not in m["workloads"]:
+                m["workloads"].append(wname)
+    return merged
+
+
+@dataclass
+class WorkloadFileSource:
+    """Collector-side reader. ``snapshot()`` is synchronous — a handful
+    of tiny local file reads is cheaper than a thread hop, and the tick
+    path must stay lean (BENCH_r02 sampler-rate lesson)."""
+
+    directory: str = DEFAULT_DIR
+    max_age_s: float = MAX_AGE_S
+    clock: object = field(default=time.time, repr=False)
+
+    def snapshot(self) -> dict[int, dict]:
+        return merge_reports(
+            read_reports(self.directory, now=self.clock(), max_age_s=self.max_age_s)
+        )

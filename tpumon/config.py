@@ -19,6 +19,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Any, Mapping
 
+from tpumon.collectors.workload import DEFAULT_DIR as _WORKLOAD_DEFAULT_DIR
+
 _DURATION_RE = re.compile(r"^(\d+)([smhd])$")
 _DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
 
@@ -145,6 +147,11 @@ class Config:
     # Peer tpumon instances whose chips are merged into this one's view
     # (realtime multi-host federation, BASELINE config 5)
     peers: tuple[str, ...] = ()
+    # Directory where workloads self-report HBM/activity
+    # (tpumon.collectors.workload) — the explicitly-labeled fallback
+    # counter source when every platform source is dark. "" disables.
+    # Default is uid-suffixed and ownership-checked (multi-user /tmp).
+    workload_dir: str = _WORKLOAD_DEFAULT_DIR
 
     # --- topology expectations (for slice-failure alerting, SURVEY §2.2) ---
     # e.g. {"slice-0": 8} => alert critical if fewer chips report
@@ -201,6 +208,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "auth_token": str,
+    "workload_dir": str,
 }
 # Config-file/env key -> Config field for duration-valued settings
 # ("30m"-style strings accepted via parse_duration).

@@ -133,9 +133,19 @@ def render_exporter(sampler: Sampler) -> str:
             f"tpu_{family}_us",
             f"libtpu {family.replace('_', ' ')} percentiles (microseconds)",
         )
+        mg = w.gauge(
+            f"tpu_{family}_us_mean",
+            f"libtpu {family.replace('_', ' ')} mean (microseconds)",
+        )
         for label, pcts in sorted(table.items()):
             for q, val in pcts.items():
-                fg.add({"bucket": str(label), "quantile": q}, float(val))
+                # "mean" is not a quantile; Prometheus treats the
+                # "quantile" label as a summary-type convention, so the
+                # mean rides its own series instead.
+                if q == "mean":
+                    mg.add({"bucket": str(label)}, float(val))
+                else:
+                    fg.add({"bucket": str(label), "quantile": q}, float(val))
 
     # ---- slices ----
     slices = sampler.slices()

@@ -718,6 +718,10 @@ class ServingEngine:
         self._ttft_counts = [0] * len(TTFT_BUCKETS_S)
         self._ttft_inf = 0
         self._ttft_sum = 0.0
+        # Optional tpumon.loadgen.report.WorkloadReporter: when attached,
+        # step() time counts as declared device activity (source:
+        # workload in the monitor's counter chain).
+        self.reporter = None
 
     # -- submission ---------------------------------------------------------
 
@@ -907,6 +911,12 @@ class ServingEngine:
     def step(self) -> bool:
         """Admit + one decode step (plain or speculative round);
         returns True if any work remains."""
+        if self.reporter is not None:
+            with self.reporter.device_work():
+                return self._step_inner()
+        return self._step_inner()
+
+    def _step_inner(self) -> bool:
         self._admit()
         # Cancelled mid-flight requests free their slot (and paged
         # pages) instead of decoding for a client that went away.
@@ -1486,6 +1496,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="paged pool size in pages (0 = dense "
                          "equivalent; smaller = real memory savings "
                          "with admission backpressure)")
+    ap.add_argument("--no-report", action="store_true",
+                    help="disable the workload self-report (HBM "
+                         "footprint + activity to the monitor's "
+                         "source:workload channel)")
     args = ap.parse_args(argv)
     if args.spec_draft_layers and not args.spec_len:
         ap.error("--spec-draft-layers requires --spec-len > 0")
@@ -1510,12 +1524,21 @@ def main(argv: list[str] | None = None) -> int:
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
           f"(point TPUMON_SERVING_TARGETS=http://127.0.0.1:{port}/metrics)")
+    reporter = None
+    if not args.no_report:
+        from tpumon.loadgen.report import WorkloadReporter
+
+        reporter = WorkloadReporter(name="serve").start()
+        engine.reporter = reporter
     try:
         _arrival_loop(engine, args.rps, args.max_new, threading.Event(),
                       duration=args.duration, temperature=args.temperature,
                       top_k=args.top_k)
     except KeyboardInterrupt:
         pass
+    finally:
+        if reporter is not None:
+            reporter.stop()
     return 0
 
 

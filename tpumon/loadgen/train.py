@@ -17,6 +17,7 @@ single-device falls back to a plain jit.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -271,6 +272,7 @@ def run_train(
     mesh: Mesh | None = None,
     log=lambda s: None,
     metrics: TrainMetrics | None = None,
+    reporter=None,
 ) -> dict:
     """Run (or resume) the loop; returns {step, loss, resumed_from, ...}."""
     if mesh is None:
@@ -303,6 +305,24 @@ def run_train(
     loss = None  # stays None when resume lands at/past the final step
     t0 = time.perf_counter()
     tokens_seen = 0
+    # Self-report (tpumon.loadgen.report): the step loop saturates the
+    # device queue (async dispatch), so loop wall time is declared
+    # device activity — labeled source:workload downstream.
+    work_ctx = (
+        reporter.device_work() if reporter is not None
+        else contextlib.nullcontext()
+    )
+    with work_ctx:
+        return _train_loop(
+            cfg, mesh, log, metrics, step_fn, placed, token_sharding,
+            start, resumed_from, loss, t0, tokens_seen,
+        )
+
+
+def _train_loop(
+    cfg, mesh, log, metrics, step_fn, placed, token_sharding,
+    start, resumed_from, loss, t0, tokens_seen,
+) -> dict:
     for step in range(start, cfg.steps):
         t_step = time.perf_counter()
         tokens = synthetic_batch(cfg, step)
@@ -377,6 +397,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--attn-block", type=int, default=512,
                     help="K/V block rows for --attention chunked")
+    ap.add_argument("--no-report", action="store_true",
+                    help="disable the workload self-report (HBM "
+                         "footprint + activity to the monitor's "
+                         "source:workload channel)")
     args = ap.parse_args(argv)
 
     cfg = TrainConfig(
@@ -401,7 +425,16 @@ def main(argv: list[str] | None = None) -> int:
             peak_flops=peak)
         httpd, url = start_metrics_server(metrics, port=args.metrics_port)
         print(f"train metrics at {url}")
-    out = run_train(cfg, log=print, metrics=metrics)
+    reporter = None
+    if not args.no_report:
+        from tpumon.loadgen.report import WorkloadReporter
+
+        reporter = WorkloadReporter(name="train").start()
+    try:
+        out = run_train(cfg, log=print, metrics=metrics, reporter=reporter)
+    finally:
+        if reporter is not None:
+            reporter.stop()
     out.pop("params")
     print(out)
     if httpd is not None:

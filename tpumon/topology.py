@@ -67,6 +67,11 @@ class ChipSample:
     # chip (0 healthy .. 10 unusable) and throttle score (0 .. 10 = 100%).
     ici_link_health: int | None = None
     throttle_score: int | None = None
+    # Provenance of the duty/HBM counters, e.g. "sdk", "grpc", "pjrt",
+    # "workload" (self-reported), "fake", or a "+"-joined mix — surfaced
+    # in /api/accel/metrics and the dashboard health strip so a reader
+    # can always tell a hardware counter from a workload's declaration.
+    counter_source: str | None = None
 
     @property
     def hbm_pct(self) -> float | None:
@@ -92,6 +97,7 @@ class ChipSample:
             "ici_link_up": self.ici_link_up,
             "ici_link_health": self.ici_link_health,
             "throttle_score": self.throttle_score,
+            "counter_source": self.counter_source,
         }
         return d
 
