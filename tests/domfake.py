@@ -1,0 +1,178 @@
+"""Fake DOM + network adapters for executing dashboard.js under jsmini.
+
+dashboard.js touches the document only through the injected ``doc``
+adapter and a small element contract (textContent/innerHTML/style/
+classList/append/appendChild/replaceChildren/onclick/title/dataset/
+colSpan — see its header comment). This module implements that contract
+with plain dicts (jsmini member access/assignment works on dicts, and
+JS closures stored into them are Python-callable), plus helpers to walk
+the built tree in assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tests.canvas2d import RecordingCtx
+
+
+def make_el(tag: str) -> dict:
+    """One fake element. Children live under "_children"; everything
+    else is the element contract dashboard.js uses."""
+    el: dict[str, Any] = {
+        "_tag": tag,
+        "_children": [],
+        "textContent": "",
+        "innerHTML": "",
+        "title": "",
+        "className": "",
+        "colSpan": 0,
+        "onclick": None,
+        "style": {},
+        "dataset": {},
+    }
+
+    def append_child(child):
+        el["_children"].append(child)
+        return child
+
+    def append(*children):
+        el["_children"].extend(children)
+
+    def replace_children(*children):
+        el["_children"] = list(children)
+
+    classes: set[str] = set()
+
+    def cl_add(name):
+        classes.add(name)
+
+    def cl_remove(name):
+        classes.discard(name)
+
+    def cl_toggle(name, force=None):
+        on = (name not in classes) if force is None else bool(force)
+        (classes.add if on else classes.discard)(name)
+        return on
+
+    def cl_contains(name):
+        return name in classes
+
+    el["appendChild"] = append_child
+    el["append"] = append
+    el["replaceChildren"] = replace_children
+    el["classList"] = {
+        "add": cl_add,
+        "remove": cl_remove,
+        "toggle": cl_toggle,
+        "contains": cl_contains,
+        "_classes": classes,
+    }
+    return el
+
+
+def all_text(el: dict) -> str:
+    """Concatenated textContent of an element's subtree (innerHTML
+    fragments included verbatim)."""
+    parts = [str(el.get("textContent") or ""), str(el.get("innerHTML") or "")]
+    for ch in el.get("_children", []):
+        parts.append(all_text(ch))
+    return " ".join(p for p in parts if p)
+
+
+def find_by_class(el: dict, cls: str) -> list[dict]:
+    out = []
+    if cls in str(el.get("className", "")).split():
+        out.append(el)
+    for ch in el.get("_children", []):
+        out.extend(find_by_class(ch, cls))
+    return out
+
+
+class FakeDoc:
+    """doc adapter: elements by id (created on demand, so the test
+    doesn't have to enumerate every id in dashboard.html) + registered
+    selector results for queryAll."""
+
+    def __init__(self) -> None:
+        self.els: dict[str, dict] = {}
+        self.queries: dict[str, list[dict]] = {}
+
+    def el(self, el_id: str) -> dict:
+        if el_id not in self.els:
+            self.els[el_id] = make_el("div")
+            self.els[el_id]["_id"] = el_id
+        return self.els[el_id]
+
+    def js(self) -> dict:
+        return {
+            "el": self.el,
+            "mk": make_el,
+            "queryAll": lambda sel: self.queries.get(sel, []),
+        }
+
+
+class FakeNet:
+    """net adapter: synchronous, serves canned payloads per URL.
+
+    ``routes`` maps a URL (exact, or prefix ending the query string at
+    '?') to a JSON-shaped payload; missing/None routes deliver null to
+    the callback (the fetch-failed path). POSTs are recorded.
+    """
+
+    def __init__(self, routes: dict[str, Any] | None = None) -> None:
+        self.routes = dict(routes or {})
+        self.gets: list[str] = []
+        self.posts: list[tuple[str, Any]] = []
+
+    def _lookup(self, url: str):
+        if url in self.routes:
+            return self.routes[url]
+        base = url.split("?", 1)[0]
+        return self.routes.get(base)
+
+    def js(self) -> dict:
+        def get_json(url, cb):
+            self.gets.append(url)
+            cb(self._lookup(url))
+
+        def post_json(url, payload, done):
+            self.posts.append((url, payload))
+            done()
+
+        return {"getJson": get_json, "postJson": post_json}
+
+
+class FakeEnv:
+    def __init__(self, now_ms: float = 1_700_000_000_000.0) -> None:
+        self.now = now_ms
+
+    def js(self) -> dict:
+        return {
+            "nowMs": lambda: self.now,
+            "timeStr": lambda: "12:34:56",
+            "localeTime": lambda ms: f"t{int(ms / 1000) % 100000}",
+            "winWidth": lambda: 1280.0,
+        }
+
+
+class FakeSurfaces:
+    """mkSurface factory: one RecordingCtx per canvas element, with a
+    fixed geometry — tests read .ops per canvas id afterwards."""
+
+    def __init__(self, w: float = 600.0, h: float = 190.0) -> None:
+        self.w, self.h = w, h
+        self.by_id: dict[str, RecordingCtx] = {}
+
+    def mk_surface(self, canvas_el: dict) -> dict:
+        cid = canvas_el.get("_id") or f"anon{len(self.by_id)}"
+        ctx = self.by_id.setdefault(cid, RecordingCtx())
+        geom = {
+            "w": self.w, "h": self.h,
+            "l": 44.0, "r": 10.0, "t": 8.0, "b": 20.0,
+        }
+        return {"geom": lambda: geom, "ctx": ctx.js}
+
+    def ops(self, cid: str) -> list:
+        ctx = self.by_id.get(cid)
+        return list(ctx.ops) if ctx else []

@@ -180,14 +180,61 @@ def merge_reports(reports: list[dict]) -> dict[int, dict]:
 @dataclass
 class WorkloadFileSource:
     """Collector-side reader. ``snapshot()`` is synchronous — a handful
-    of tiny local file reads is cheaper than a thread hop, and the tick
-    path must stay lean (BENCH_r02 sampler-rate lesson)."""
+    of tiny local file stats is cheaper than a thread hop, and the tick
+    path must stay lean (BENCH_r02 sampler-rate lesson). Parsed reports
+    are cached per (path, mtime, size) so an unchanged file costs one
+    stat per tick, not a JSON parse."""
 
     directory: str = DEFAULT_DIR
     max_age_s: float = MAX_AGE_S
     clock: object = field(default=time.time, repr=False)
+    _cache: dict = field(default_factory=dict, repr=False)
+
+    def _read_cached(self, fpath: str) -> dict | None:
+        try:
+            st = os.stat(fpath)
+        except OSError:
+            self._cache.pop(fpath, None)
+            return None
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+            return None
+        key = (st.st_mtime_ns, st.st_size)
+        hit = self._cache.get(fpath)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        try:
+            with open(fpath) as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            rep = None
+        if not (
+            isinstance(rep, dict)
+            and rep.get("v") == REPORT_VERSION
+            and isinstance(rep.get("ts"), (int, float))
+            and isinstance(rep.get("devices"), list)
+        ):
+            rep = None
+        self._cache[fpath] = (key, rep)
+        return rep
 
     def snapshot(self) -> dict[int, dict]:
-        return merge_reports(
-            read_reports(self.directory, now=self.clock(), max_age_s=self.max_age_s)
-        )
+        now = self.clock()
+        if not _owned_by_us(self.directory):
+            return {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return {}
+        live = set()
+        fresh: list[dict] = []
+        for fname in sorted(names):
+            if not fname.endswith(".json"):
+                continue
+            fpath = os.path.join(self.directory, fname)
+            live.add(fpath)
+            rep = self._read_cached(fpath)
+            if rep is not None and now - rep["ts"] <= self.max_age_s:
+                fresh.append(rep)
+        for gone in [p for p in self._cache if p not in live]:
+            del self._cache[gone]
+        return merge_reports(fresh)

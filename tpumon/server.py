@@ -117,6 +117,10 @@ class MonitorServer:
             os.path.join(WEB_DIR, "chartcore.js"),
             "application/javascript; charset=utf-8",
         )
+        self._dashboard_js = StaticFile(
+            os.path.join(WEB_DIR, "dashboard.js"),
+            "application/javascript; charset=utf-8",
+        )
         self._profiler = None  # built lazily; jax may be absent
 
     # ------------------------------ handlers ------------------------------
@@ -335,6 +339,8 @@ class MonitorServer:
             return 200, self._logo.content_type, self._logo.read()
         if path == "/chartcore.js":
             return 200, self._chartcore.content_type, self._chartcore.read()
+        if path == "/dashboard.js":
+            return 200, self._dashboard_js.content_type, self._dashboard_js.read()
         if path == "/metrics":
             return 200, "text/plain; version=0.0.4; charset=utf-8", render_exporter(
                 self.sampler
