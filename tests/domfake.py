@@ -16,6 +16,23 @@ from typing import Any
 from tests.canvas2d import RecordingCtx
 
 
+def tojs(v):
+    """JSON -> jsmini values: numbers are floats in the interpreter
+    (json.loads yields ints for whole numbers; the browser has only
+    doubles, so this mirrors reality rather than papering over it).
+    Shared by tests/test_dashboard_js.py and tools/render_dashboard.py
+    so the committed artifact and the tests use one coercion rule."""
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(v, int):
+        return float(v)
+    if isinstance(v, list):
+        return [tojs(x) for x in v]
+    if isinstance(v, dict):
+        return {k: tojs(x) for k, x in v.items()}
+    return v
+
+
 def make_el(tag: str) -> dict:
     """One fake element. Children live under "_children"; everything
     else is the element contract dashboard.js uses."""

@@ -40,6 +40,7 @@ fails the test, which is the whole point.
 
 from __future__ import annotations
 
+import inspect
 import math
 import re
 from dataclasses import dataclass
@@ -1177,10 +1178,26 @@ class Interp:
 
 
 def _call1(fn, *args):
-    """Invoke a JS callback that may take fewer args than provided."""
+    """Invoke a JS callback that may take fewer args than provided.
+
+    JsFunction already ignores surplus args (JS semantics). Native
+    callables (Number, or a Python lambda injected by a test adapter)
+    are trimmed to their declared positional arity so e.g.
+    ``arr.map(Number)`` works — JS ignores surplus call arguments, a
+    Python def raises TypeError on them."""
     if isinstance(fn, JsFunction):
         return fn(*args)
-    return fn(*args)
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return fn(*args)
+    max_pos = 0
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            max_pos += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            return fn(*args)
+    return fn(*args[:max_pos])
 
 
 def _array_method(arr: list, prop: str):

@@ -30,16 +30,25 @@ def html():
 
 @pytest.fixture(scope="module")
 def script(html):
-    """The inline script PLUS chartcore.js — together they are what the
-    browser executes (dashboard.html includes /chartcore.js first)."""
+    """Everything the browser executes, in load order: chartcore.js,
+    dashboard.js, then the inline bootstrap (dashboard.html:298-300).
+    The two .js files are also EXECUTED by tests/test_chartcore.py and
+    tests/test_dashboard_js.py; this module's static checks cover the
+    markup consistency the interpreter can't see."""
     inline = html.split("<script>")[1].split("</script>")[0]
-    with open(os.path.join(os.path.dirname(HTML_PATH), "chartcore.js")) as f:
-        return f.read() + "\n" + inline
+    web = os.path.dirname(HTML_PATH)
+    parts = []
+    for name in ("chartcore.js", "dashboard.js"):
+        with open(os.path.join(web, name)) as f:
+            parts.append(f.read())
+    parts.append(inline)
+    return "\n".join(parts)
 
 
 def test_fetched_endpoints_are_served(script):
-    # Both j("/api/x") and j("/api/x?param=" + v) forms; query stripped.
-    endpoints = {e.split("?")[0] for e in re.findall(r'j\("(/api/[^"]+)"', script)}
+    # Both getJson("/api/x") and getJson("/api/x?param=" + v); query stripped.
+    endpoints = {e.split("?")[0]
+                 for e in re.findall(r'getJson\("(/api/[^"]+)"', script)}
     assert {"/api/history", "/api/accel/metrics"} <= endpoints
     sampler, server = serve()
 
@@ -66,7 +75,7 @@ def test_dom_ids_exist(html, script):
 def test_polling_cadences_match_reference(script):
     """Reference cadences: realtime 5s, history 30s, pods 10s, alerts 10s,
     clock 1s (monitor.html:605-609)."""
-    intervals = dict(re.findall(r"setInterval\((\w+), (\d+)\)", script))
+    intervals = dict(re.findall(r"setInterval\(dash\.(\w+), (\d+)\)", script))
     assert intervals["fetchRealtime"] == "5000"
     assert intervals["fetchHistory"] == "30000"
     assert intervals["fetchPods"] == "10000"

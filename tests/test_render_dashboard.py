@@ -1,0 +1,36 @@
+"""The committed docs/dashboard.svg is produced by executing the real
+frontend (chartcore.js + dashboard.js under jsmini, driven by real
+server payloads) — this proves the producer script stays runnable and
+keeps emitting every section of the page (the analogue of the
+reference's screenshot.png staying truthful)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+
+def _load_tool():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "render_dashboard.py")
+    spec = importlib.util.spec_from_file_location("render_dashboard", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_full_page_artifact_renders():
+    svg = _load_tool().render()
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    # Every dashboard section made it into the page.
+    for marker in ("HOST CPU", "TPU CHIPS", "ICI TOPOLOGY", "SERVING",
+                   "TRAINING", "KUBERNETES TPU PODS", "ACTIVE ALERTS (MODAL)"):
+        assert marker in svg, marker
+    # Executed-content spot checks: chip grid cells, pod badge text, and
+    # alert title all flowed through dashboard.js, not a mockup.
+    assert svg.count("% MXU") >= 8
+    assert "Failed · OOMKilled" in svg
+    assert "HBM pressure on tpu-host-0/chip-2" in svg
+    # No un-rendered sentinel leaked into the picture.
+    for bad in ("NaN", "undefined", "None"):
+        assert bad not in svg

@@ -205,6 +205,72 @@ def test_reporter_drain_does_not_double_count(monkeypatch):
     assert abs(rep._drain_busy(clock["t"]) - 2.0) < 1e-9
 
 
+def test_reporter_concurrent_device_work_blocks(monkeypatch):
+    """Two threads sharing one reporter must each get their own busy
+    interval — a single start-stamp slot lets the second entry
+    overwrite the first and undercount (ADVICE r03)."""
+    import threading
+
+    from tpumon.loadgen import report as report_mod
+    from tpumon.loadgen.report import WorkloadReporter
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(report_mod.time, "monotonic", lambda: clock["t"])
+    rep = WorkloadReporter(name="t", directory="/nonexistent")
+
+    enter_b = threading.Event()
+    exit_b = threading.Event()
+
+    def worker_b():
+        with rep.device_work():
+            enter_b.set()
+            exit_b.wait(5.0)
+
+    t = threading.Thread(target=worker_b, daemon=True)
+    with rep.device_work():  # A opens at t=0
+        t.start()
+        assert enter_b.wait(5.0)  # B opens at t=0 too
+        clock["t"] = 3.0
+        exit_b.set()
+        t.join(5.0)  # B charges 3 s
+        clock["t"] = 5.0
+    # A charges 5 s; overlapping busy sums (clamped downstream).
+    assert abs(rep._drain_busy(clock["t"]) - 8.0) < 1e-9
+
+
+def test_symlinked_report_dir_refused(tmp_path):
+    """/tmp is world-writable and the channel path is predictable:
+    a pre-planted symlink to a victim-owned directory must not pass the
+    ownership check even though the target is owned by this uid
+    (os.stat would follow it; the check must lstat — ADVICE r03)."""
+    import pytest
+
+    real = tmp_path / "victim"
+    real.mkdir()
+    link = tmp_path / "planted"
+    link.symlink_to(real)
+    from tpumon.collectors.workload import _owned_by_us
+
+    assert _owned_by_us(str(real), want_dir=True)
+    assert not _owned_by_us(str(link), want_dir=True)
+    with pytest.raises(PermissionError):
+        write_report(str(link), "x", [], pid=1)
+    assert read_reports(str(link)) == []
+
+
+def test_symlinked_report_file_refused(tmp_path):
+    """Both readers (read_reports and the cached WorkloadFileSource
+    path) must refuse a symlinked report file inside the channel, even
+    when its target is owned by this uid."""
+    d = str(tmp_path)
+    write_report(d, "real", [{"index": 0, "hbm_used": 1}], pid=1)
+    (tmp_path / "planted-2.json").symlink_to(tmp_path / "real-1.json")
+    assert [r["name"] for r in read_reports(d)] == ["real"]
+    src = WorkloadFileSource(directory=d)
+    assert len(src.snapshot()) == 1  # device 0 from real-1.json only
+    assert str(tmp_path / "planted-2.json") not in src._cache
+
+
 def test_reports_ignore_foreign_owned_dir(tmp_path, monkeypatch):
     """The self-report channel is a trust boundary: a directory (or
     file) owned by another uid yields no reports and refuses writes."""

@@ -1,125 +1,420 @@
-"""Render docs/dashboard.svg by EXECUTING the shipped chart code.
+"""Render docs/dashboard.svg by EXECUTING the shipped dashboard code.
 
 The reference repo ships ``screenshot.png`` of a live deployment as its
 only UI verification artifact. This environment has no browser, so the
 analogue is produced differently but more rigorously: the actual
-``tpumon/web/chartcore.js`` the dashboard loads is executed under
-tests/jsmini.py against a recording canvas, and the recorded draw ops
-are replayed as SVG — i.e. the committed picture is provably what the
-chart engine draws, not a mockup.
+``tpumon/web/chartcore.js`` + ``tpumon/web/dashboard.js`` the browser
+loads are executed under tests/jsmini.py with the tests/domfake.py
+adapters, driven by payloads from the REAL server (fake v5e-8 backend)
+plus representative pod/alert/serving payloads. The resulting element
+tree and recorded canvas draw ops are then laid out as one SVG page —
+every number, badge, chip card, pod row, and curve in the picture was
+produced by the shipped frontend logic, not typed into a mockup.
 
 Regenerate:  python tools/render_dashboard.py
-Verified by: tests/test_chartcore.py (same execution path)
+Verified by: tests/test_chartcore.py + tests/test_dashboard_js.py
+             (same execution path); tests/test_render_dashboard.py
+             (the producer stays runnable and emits every section —
+             the committed bytes themselves vary run to run because
+             the history curves carry real host samples).
 """
 
 from __future__ import annotations
 
-import math
+import asyncio
+import html
+import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from tests.canvas2d import RecordingCtx, ops_to_svg  # noqa: E402
+from tests.canvas2d import ops_to_svg  # noqa: E402
+from tests.domfake import (FakeDoc, FakeEnv, FakeNet,  # noqa: E402
+                           FakeSurfaces, tojs)
 from tests.jsmini import load  # noqa: E402
 
-CARD_W, CARD_H = 560.0, 190.0
-GEOM = {"w": CARD_W, "h": CARD_H, "l": 44.0, "r": 10.0, "t": 8.0, "b": 20.0}
+# ---------------------------------------------------------- page palette
+BG, CARD, EDGE = "#0b1020", "#121a33", "#1d2947"
+TEXT, DIM, TAG = "#e7ecf8", "#93a0c4", "#5a678c"
+GOOD, WARN, BAD = "#36d399", "#fbbf24", "#ef4444"
+
+CHART_W, CHART_H = 560.0, 190.0
 
 
-def series_chart(js, title, series, datasets, opts):
-    ctx = RecordingCtx()
-    labels = [f"10:{i:02d}" for i in range(0, 30, 2)]
-    js.call("chartDraw", ctx.js(), GEOM, labels, datasets, series, opts)
-    body = ops_to_svg(ctx.ops, CARD_W, CARD_H, background="#161f3a")
-    return title, body
+def esc(s) -> str:
+    return html.escape(str(s), quote=True)
+
+
+# ----------------------------------------------------- demo-only payloads
+# Pods / alerts / serving have no fake backend wired into serve(); these
+# representative payloads exercise the same dashboard.js code paths the
+# tests execute (badges, TPU attribution, severity cards, aggregation).
+
+PODS = {
+    "pods": [
+        {"namespace": "ml-prod", "name": "llama70b-train-0", "status": "Running",
+         "restarts": 0.0, "age": "2d", "node": "tpu-host-0",
+         "tpu_topology": "2x4", "tpu_request": 8.0, "chips": 8.0},
+        {"namespace": "ml-prod", "name": "jetstream-serve-0", "status": "Running",
+         "restarts": 1.0, "age": "6h", "node": "tpu-host-0",
+         "tpu_topology": "2x4", "tpu_request": 4.0, "chips": 4.0},
+        {"namespace": "ml-dev", "name": "sweep-worker-3", "status": "Pending",
+         "reason": "Unschedulable", "restarts": 0.0, "age": "4m"},
+        {"namespace": "ml-dev", "name": "dataprep-9", "status": "Failed",
+         "reason": "OOMKilled", "restarts": 3.0, "age": "1h",
+         "node": "cpu-pool-2"},
+    ],
+    "health": {"ok": True},
+}
+
+ALERTS = {
+    "minor": [
+        {"severity": "minor", "key": "chip.tpu-host-0/chip-2.hbm.minor",
+         "title": "HBM pressure on tpu-host-0/chip-2",
+         "desc": "HBM at 78.3% (12.5 / 16.0 GiB)",
+         "fix": "Reduce batch size or shard the model further"},
+    ],
+    "serious": [
+        {"severity": "serious", "key": "k8s.ml-dev/dataprep-9.crashloop",
+         "title": "Pod dataprep-9 OOMKilled",
+         "desc": "3 restarts; last exit OOMKilled on cpu-pool-2",
+         "fix": "Raise the pod memory limit or stream the dataset"},
+    ],
+    "critical": [],
+    "silenced": [],
+    "silences": [{"key": "host.disk.", "until": 1_700_000_000.0 + 2700.0}],
+    "events": [
+        {"ts": 1_699_999_760.0, "state": "fired", "title": "Pod dataprep-9 OOMKilled"},
+        {"ts": 1_699_999_300.0, "state": "resolved",
+         "title": "Serving TTFT p99 over budget"},
+    ],
+}
+
+SERVING = {
+    "targets": [
+        {"ok": True, "target": "jetstream-serve-0:9100", "ttft_p50_ms": 42.0,
+         "ttft_p99_ms": 118.0, "tokens_per_sec": 4130.0,
+         "requests_per_sec": 12.4, "queue_depth": 3.0,
+         "weight_bytes": 35.0 * 2**30, "spec_accept_pct": 74.0,
+         "kv_pages_used_pct": 61.0},
+        {"ok": True, "target": "llama70b-train-0:9100",
+         "train_step": 18423.0, "train_loss": 1.932,
+         "train_step_time_ms": 412.0, "train_tokens_per_sec": 39800.0,
+         "train_goodput_pct": 96.4, "train_mfu_pct": 52.1},
+    ],
+}
+
+
+def real_payloads(ticks: int = 24) -> dict:
+    """Host/accel/history/health from the real server over the fake
+    v5e-8 backend; several ticks so the history rings hold curves."""
+    from tests.test_server_api import serve
+
+    sampler, server = serve()
+
+    async def gather():
+        for _ in range(ticks):
+            await sampler.tick_all()
+        out = {}
+        for ep, q in (("/api/host/metrics", ""), ("/api/accel/metrics", ""),
+                      ("/api/history", "window=30m"), ("/api/health", "")):
+            status, _, body = await server.handle("GET", ep, query=q)
+            assert status == 200, ep
+            out[ep] = tojs(json.loads(body))
+        return out
+
+    return asyncio.run(gather())
+
+
+# -------------------------------------------------------------- SVG bits
+
+
+class Page:
+    def __init__(self, w: float) -> None:
+        self.w = w
+        self.parts: list[str] = []
+        self.y = 0.0
+
+    def add(self, s: str) -> None:
+        self.parts.append(s)
+
+    def text(self, x, y, s, fill=TEXT, size=12, weight=None, anchor=None,
+             spacing=None):
+        attrs = f'x="{x}" y="{y}" fill="{fill}" font-size="{size}"'
+        if weight:
+            attrs += f' font-weight="{weight}"'
+        if anchor:
+            attrs += f' text-anchor="{anchor}"'
+        if spacing:
+            attrs += f' letter-spacing="{spacing}"'
+        self.add(f"<text {attrs}>{esc(s)}</text>")
+
+    def rect(self, x, y, w, h, fill, rx=0.0, stroke=None):
+        s = (f'<rect x="{x}" y="{y}" width="{w}" height="{h}" '
+             f'fill="{fill}" rx="{rx}"')
+        if stroke:
+            s += f' stroke="{stroke}"'
+        self.add(s + "/>")
+
+    def card(self, x, y, w, h, title, tag=""):
+        self.rect(x, y, w, h, CARD, rx=8, stroke=EDGE)
+        self.text(x + 14, y + 20, title.upper(), fill=DIM, size=10, spacing=1)
+        if tag:
+            self.text(x + w - 14, y + 20, tag, fill=TAG, size=10, anchor="end")
+
+    def embed_svg(self, svg_body: str, x: float, y: float) -> None:
+        inner = svg_body.split(">", 1)[1].rsplit("</svg>", 1)[0]
+        self.add(f'<g transform="translate({x},{y})">{inner}</g>')
+
+
+def bar_color(cls: str) -> str:
+    return BAD if cls == "bad" else WARN if cls == "warn" else GOOD
+
+
+def width_pct(style_width: str) -> float:
+    try:
+        return max(0.0, min(100.0, float(str(style_width).rstrip("%"))))
+    except ValueError:
+        return 0.0
+
+
+# ------------------------------------------------------------- the page
+
+
+def render() -> str:
+    with open(os.path.join(REPO, "tpumon", "web", "chartcore.js")) as f:
+        src = f.read()
+    with open(os.path.join(REPO, "tpumon", "web", "dashboard.js")) as f:
+        src += "\n" + f.read()
+    js = load(src)
+
+    routes = real_payloads()
+    routes["/api/k8s/pods"] = tojs(PODS)
+    routes["/api/alerts"] = tojs(ALERTS)
+    routes["/api/serving"] = tojs(SERVING)
+
+    doc, net = FakeDoc(), FakeNet(routes)
+    env = FakeEnv(now_ms=1_700_000_000_000.0)
+    surf = FakeSurfaces(w=CHART_W, h=CHART_H)
+    dash = js.call("makeDashboard", doc.js(), net.js(), env.js(),
+                   surf.mk_surface)
+    dash["fetchAll"]()
+    dash["openModal"]()  # alert modal content, drawn as its own panel
+
+    pad = 20.0
+    page_w = 2 * CHART_W + 3 * pad
+    p = Page(page_w)
+    y = 16.0
+
+    # ---- header ----
+    p.text(pad, y + 8, "tpumon", size=17, weight=700)
+    p.text(pad + 84, y + 8, "TPU cluster monitor — MXU · HBM · ICI",
+           fill=DIM, size=12)
+    p.text(page_w - pad, y + 8,
+           f"rendered by executing dashboard.js · {doc.el('clock')['textContent']}",
+           fill=TAG, size=10, anchor="end")
+    y += 22
+
+    # ---- health strip (built by fetchHealth) ----
+    x = pad
+    for el in doc.el("health")["_children"]:
+        label = next((c["textContent"] for c in el["_children"]
+                      if c["textContent"]), "?")
+        ok = "ok" in str(el["className"]).split()
+        wpx = 7.2 * len(str(label)) + 26
+        p.rect(x, y, wpx, 22, CARD, rx=11, stroke=EDGE)
+        p.add(f'<circle cx="{x + 12}" cy="{y + 11}" r="3.5" '
+              f'fill="{GOOD if ok else BAD}"/>')
+        p.text(x + 21, y + 15, label, fill=DIM, size=10)
+        x += wpx + 8
+    # alert badges, far right (set by fetchAlerts)
+    bx = page_w - pad
+    for bid, color in (("n-critical", BAD), ("n-serious", WARN),
+                       ("n-minor", DIM)):
+        n = doc.el(bid)["textContent"]
+        bx -= 54
+        p.rect(bx, y, 48, 22, CARD, rx=11, stroke=EDGE)
+        p.add(f'<circle cx="{bx + 12}" cy="{y + 11}" r="3.5" fill="{color}"/>')
+        p.text(bx + 21, y + 15, f"{int(n)}", fill=TEXT, size=10)
+    y += 34
+
+    # ---- stat cards (setCard wrote value/sub/bar) ----
+    cards = [("cpu", "Host CPU"), ("mem", "Memory"), ("disk", "Disk"),
+             ("mxu", "TPU MXU (mean)")]
+    cw = (page_w - pad * (len(cards) + 1)) / len(cards)
+    for i, (prefix, title) in enumerate(cards):
+        x = pad + i * (cw + pad)
+        p.card(x, y, cw, 78, title)
+        p.text(x + 14, y + 48, doc.el(prefix + "-v")["textContent"],
+               size=22, weight=600)
+        p.text(x + 14, y + 64, doc.el(prefix + "-s")["textContent"],
+               fill=DIM, size=10)
+        bar = doc.el(prefix + "-b")
+        p.rect(x + 14, y + 68, cw - 28, 4, "#0c1220", rx=2)
+        p.rect(x + 14, y + 68,
+               (cw - 28) * width_pct(bar["style"].get("width", "0%")) / 100,
+               4, bar_color(bar["className"]), rx=2)
+    y += 78 + pad
+
+    # ---- chip grid (renderChips built the cards) ----
+    chips = doc.el("chips")["_children"]
+    grid_cols = 4
+    chip_w = (page_w - 2 * pad - 14 * 2 - (grid_cols - 1) * 10) / grid_cols
+    chip_h = 108.0
+    rows_n = (len(chips) + grid_cols - 1) // grid_cols
+    grid_h = 34 + rows_n * (chip_h + 10)
+    p.card(pad, y, page_w - 2 * pad, grid_h, "TPU chips",
+           tag=doc.el("topo-tag")["textContent"])
+    for i, el in enumerate(chips):
+        cx = pad + 14 + (i % grid_cols) * (chip_w + 10)
+        cy = y + 28 + (i // grid_cols) * (chip_h + 10)
+        p.rect(cx, cy, chip_w, chip_h, "#0e1630", rx=6, stroke=EDGE)
+        kids = el["_children"]
+        cid = next(k for k in kids if k["className"] == "cid")
+        p.text(cx + 10, cy + 16, cid["textContent"], fill=DIM, size=9)
+        duty = next(k for k in kids if k["className"] == "duty")
+        duty_txt = str(duty["innerHTML"]).replace("<small> % MXU</small>", "")
+        p.text(cx + 10, cy + 38, duty_txt, size=18, weight=600)
+        p.text(cx + 16 + 11 * len(duty_txt), cy + 38, "% MXU", fill=DIM, size=9)
+        bar = next(k for k in kids if k["className"] == "bar")
+        fill = bar["_children"][0]
+        p.rect(cx + 10, cy + 46, chip_w - 20, 4, "#0c1220", rx=2)
+        p.rect(cx + 10, cy + 46,
+               (chip_w - 20) * width_pct(fill["style"].get("width", "0%")) / 100,
+               4, bar_color(fill["className"]), rx=2)
+        ry = cy + 62
+        for row in (k for k in kids if k["className"] == "row"):
+            label, value = (row["_children"][0]["textContent"],
+                            row["_children"][1]["textContent"])
+            p.text(cx + 10, ry, label, fill=TAG, size=9)
+            p.text(cx + chip_w - 10, ry, value, fill=DIM, size=9, anchor="end")
+            ry += 13
+    y += grid_h + pad
+
+    # ---- topology map (renderTopo drew on c-topo via topoDraw) ----
+    topo_ops = surf.ops("c-topo")
+    if topo_ops and doc.el("topo-card")["style"].get("display") != "none":
+        th = CHART_H + 34
+        p.card(pad, y, page_w - 2 * pad, th, "ICI topology",
+               tag=doc.el("topo-map-tag")["textContent"])
+        topo_svg = ops_to_svg(topo_ops, CHART_W, CHART_H, background="#0e1630")
+        p.embed_svg(topo_svg, (page_w - CHART_W) / 2, y + 26)
+        y += th + pad
+
+    # ---- history charts (applyHistory drew these) ----
+    chart_cards = [
+        ("c-tpu", "MXU duty & HBM", "30 min"),
+        ("c-cpu", "Host CPU", "30 min"),
+        ("c-ici", "ICI / DCN traffic", "30 min"),
+        ("c-temp", "Chip temperature", "30 min"),
+    ]
+    ch = CHART_H + 34
+    for i, (cid, title, tag) in enumerate(chart_cards):
+        ops = surf.ops(cid)
+        if not ops:
+            continue
+        x = pad + (i % 2) * (CHART_W + pad)
+        cy = y + (i // 2) * (ch + pad)
+        p.card(x, cy, CHART_W, ch, title, tag=tag)
+        p.embed_svg(ops_to_svg(ops, CHART_W, CHART_H, background="#121a33"),
+                    x, cy + 26)
+    y += 2 * (ch + pad)
+
+    # ---- serving + training panels (fetchServing populated sv-*/tr-*) ----
+    if doc.el("serving-card")["style"].get("display") != "none":
+        sw = (page_w - 3 * pad) / 2
+
+        def stat_grid(x0, fields):
+            for i, (label, fid) in enumerate(fields):
+                fx = x0 + 14 + (i % 4) * (sw - 28) / 4
+                fy = y + 44 + (i // 4) * 34
+                p.text(fx, fy, label, fill=TAG, size=9)
+                p.text(fx, fy + 15, doc.el(fid)["textContent"],
+                       size=13, weight=600)
+
+        p.card(pad, y, sw, 96, "Serving",
+               tag=doc.el("serving-tag")["textContent"])
+        stat_grid(pad, [("TTFT p50", "sv-ttft"), ("TTFT p99", "sv-ttft99"),
+                        ("tokens/s", "sv-tps"), ("req/s", "sv-rps"),
+                        ("queue", "sv-q"), ("weights", "sv-wb"),
+                        ("spec accept", "sv-spec"), ("KV pool", "sv-kv")])
+        if doc.el("train-card")["style"].get("display") != "none":
+            tx = 2 * pad + sw
+            p.card(tx, y, sw, 96, "Training",
+                   tag=doc.el("train-tag")["textContent"])
+            stat_grid(tx, [("step", "tr-step"), ("loss", "tr-loss"),
+                           ("step time", "tr-dt"), ("tokens/s", "tr-tps"),
+                           ("goodput", "tr-gp"), ("MFU", "tr-mfu")])
+        y += 96 + pad
+
+    # ---- pods table (fetchPods built the rows) ----
+    prow = doc.el("pods-body")["_children"]
+    theight = 40 + 22 * len(prow)
+    p.card(pad, y, page_w - 2 * pad, theight, "Kubernetes TPU pods",
+           tag=f"{int(doc.el('pods-tag')['textContent'])} pods")
+    headers = ["namespace", "pod", "status", "restarts", "age", "node",
+               "topology", "TPU"]
+    colx = [0.0, 90.0, 260.0, 420.0, 490.0, 545.0, 680.0, 790.0]
+    for hx, htxt in zip(colx, headers):
+        p.text(pad + 16 + hx, y + 34, htxt.upper(), fill=TAG, size=9,
+               spacing=0.5)
+    for r, tr in enumerate(prow):
+        ry = y + 52 + r * 22
+        ci = 0
+        for cell in tr["_children"]:
+            if cell["_tag"] == "td" and cell["_children"]:
+                badge = cell["_children"][0]
+                status = str(badge["textContent"])
+                color = (GOOD if status.startswith("Running")
+                         else WARN if status.startswith("Pending") else BAD)
+                p.text(pad + 16 + colx[ci], ry, status, fill=color, size=10)
+            else:
+                p.text(pad + 16 + colx[ci], ry, cell["textContent"],
+                       fill=TEXT if ci < 2 else DIM, size=10)
+            ci += 1
+    y += theight + pad
+
+    # ---- alerts modal (openModal rendered cards + events) ----
+    body = doc.el("modal-body")["_children"]
+    ah = 40 + sum(54 if "alert-card" in str(el["className"]) else 20
+                  for el in body)
+    p.card(pad, y, page_w - 2 * pad, ah, "Active alerts (modal)",
+           tag="silence = POST /api/silence")
+    ay = y + 38
+    for el in body:
+        cls = str(el["className"])
+        if "alert-card" in cls:
+            sev = (BAD if "critical" in cls else WARN if "serious" in cls
+                   else TAG if "silenced" in cls else DIM)
+            p.rect(pad + 14, ay - 12, page_w - 2 * pad - 28, 46, "#0e1630",
+                   rx=6, stroke=EDGE)
+            p.rect(pad + 14, ay - 12, 3, 46, sev, rx=1.5)
+            texts = [c["textContent"] for c in el["_children"]]
+            p.text(pad + 26, ay + 2, texts[0] if texts else "", size=11,
+                   weight=600)
+            rest = " — ".join(t for t in texts[1:] if t)
+            p.text(pad + 26, ay + 18, rest, fill=DIM, size=10)
+            ay += 54
+        else:  # events header / event rows / all-clear note
+            p.text(pad + 26, ay + 2, el["textContent"], fill=DIM, size=10)
+            ay += 20
+    y += ah + 24
+
+    head = (f'<svg xmlns="http://www.w3.org/2000/svg" width="{page_w}" '
+            f'height="{y}" viewBox="0 0 {page_w} {y}" '
+            'font-family="system-ui, sans-serif">'
+            f'<rect width="{page_w}" height="{y}" fill="{BG}"/>')
+    return head + "\n" + "\n".join(p.parts) + "\n</svg>"
 
 
 def main() -> int:
-    with open(os.path.join(REPO, "tpumon", "web", "chartcore.js")) as f:
-        js = load(f.read())
-
-    n = 15
-    t = list(range(n))
-    mxu = [55 + 35 * math.sin(i / 3.1) for i in t]
-    hbm = [62 + 8 * math.sin(i / 5.0 + 1) for i in t]
-    cpu = [30 + 20 * math.sin(i / 4.0) for i in t]
-    ici = [2.1e9 + 1.6e9 * math.sin(i / 2.7) for i in t]
-    tps = [4200 + 700 * math.sin(i / 3.3) for i in t]
-    ttft = [38 + 9 * math.sin(i / 2.2 + 2) for i in t]
-
-    cards = [
-        series_chart(js, "MXU duty & HBM · 30 min",
-                     [{"label": "MXU duty %", "color": "#36d399", "fill": True},
-                      {"label": "HBM %", "color": "#22d3ee"}],
-                     [mxu, hbm], {"yMax": 100.0, "unit": "%"}),
-        series_chart(js, "Host CPU · 30 min",
-                     [{"label": "CPU %", "color": "#3b82f6", "fill": True}],
-                     [cpu], {"yMax": 100.0, "unit": "%"}),
-        series_chart(js, "ICI traffic · 30 min",
-                     [{"label": "ICI tx", "color": "#f472b6", "fill": True}],
-                     [ici], {"unit": "bps"}),
-        series_chart(js, "Serving · tokens/s & TTFT · 30 min",
-                     [{"label": "tokens/s", "color": "#36d399", "fill": True},
-                      {"label": "TTFT p50 ms", "color": "#fbbf24"}],
-                     [tps, ttft], {}),
-    ]
-
-    # Topology map of a v5e-8 slice, one degraded link, one busy chip.
-    topo_ctx = RecordingCtx()
-    chips = []
-    for i in range(8):
-        chips.append({
-            "chip": f"tpu-host-0/chip-{i}", "slice": "slice-0",
-            "index": float(i), "coords": [float(i % 4), float(i // 4)],
-            "mxu_duty_pct": [72.0, 68.0, 90.0, 15.0, 60.0, 75.0, 66.0, 71.0][i],
-            "hbm_pct": 55.0 + 4 * i,
-            "tx_bps": 2.2e9 if i not in (3,) else 0.4e9,
-            "ici_link_health": 7.0 if i == 3 else 0.0,
-            "ici_link_up": True,
-        })
-    hits = js.call("topoDraw", topo_ctx.js(), chips, 2 * CARD_W + 20, 250.0)
-    assert len(hits) == 8
-    topo_svg = ops_to_svg(topo_ctx.ops, 2 * CARD_W + 20, 250.0,
-                          background="#161f3a")
-
-    # Composite page.
-    pad, title_h = 20.0, 26.0
-    page_w = 2 * CARD_W + 3 * pad
-    page_h = pad + 2 * (CARD_H + title_h + pad) + (250 + title_h + pad) + 30
-    out = [
-        f'<svg xmlns="http://www.w3.org/2000/svg" width="{page_w}" '
-        f'height="{page_h}" viewBox="0 0 {page_w} {page_h}" '
-        'font-family="system-ui, sans-serif">',
-        f'<rect width="{page_w}" height="{page_h}" fill="#0b1020"/>',
-        '<text x="20" y="22" fill="#e7ecf8" font-size="15" font-weight="600">'
-        'tpumon — TPU cluster monitor (rendered by executing '
-        'tpumon/web/chartcore.js under tests/jsmini.py)</text>',
-    ]
-
-    def embed(svg_body, x, y, title, w):
-        inner = svg_body.split(">", 1)[1].rsplit("</svg>", 1)[0]
-        out.append(
-            f'<text x="{x}" y="{y + 14}" fill="#93a0c4" font-size="11" '
-            f'letter-spacing="1">{title.upper()}</text>'
-        )
-        out.append(f'<g transform="translate({x},{y + title_h - 6})">{inner}</g>')
-
-    y0 = 34.0
-    for i, (title, body) in enumerate(cards):
-        x = pad + (i % 2) * (CARD_W + pad)
-        y = y0 + (i // 2) * (CARD_H + title_h + pad)
-        embed(body, x, y, title, CARD_W)
-    embed(topo_svg, pad, y0 + 2 * (CARD_H + title_h + pad),
-          "ICI topology · slice-0 · chip 3 link degraded (amber ring)",
-          2 * CARD_W + pad)
-    out.append("</svg>")
-
+    svg = render()
     dest = os.path.join(REPO, "docs", "dashboard.svg")
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     with open(dest, "w") as f:
-        f.write("\n".join(out))
+        f.write(svg)
     print(f"wrote {dest} ({os.path.getsize(dest)} bytes)")
     return 0
 

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import stat
 import time
 from dataclasses import dataclass, field
 
@@ -44,16 +45,26 @@ DEFAULT_DIR = f"/tmp/tpumon-workload-{os.getuid()}" if hasattr(os, "getuid") \
     else "/tmp/tpumon-workload"
 
 
-def _owned_by_us(path: str) -> bool:
-    """True iff ``path`` exists and is owned by this process's uid —
-    the trust boundary for the self-report channel (a monitor must not
-    publish counters another local user planted)."""
+def _owned_by_us(path: str, want_dir: bool = False) -> bool:
+    """True iff ``path`` is a real file/directory (never a symlink)
+    owned by this process's uid — the trust boundary for the
+    self-report channel (a monitor must not publish counters another
+    local user planted).
+
+    lstat, not stat: /tmp is world-writable, so another user can
+    pre-create the predictable uid-suffixed path as a symlink into a
+    victim-owned tree; following it would pass an os.stat ownership
+    check while redirecting writes and reads to an attacker-chosen
+    location. ``want_dir`` additionally requires a directory (the
+    channel root); otherwise a regular file (one report)."""
     if not hasattr(os, "getuid"):
         return True  # no POSIX ownership model; nothing to check
     try:
-        return os.stat(path).st_uid == os.getuid()
+        st = os.lstat(path)
     except OSError:
         return False
+    kind_ok = stat.S_ISDIR(st.st_mode) if want_dir else stat.S_ISREG(st.st_mode)
+    return kind_ok and st.st_uid == os.getuid()
 
 #: Reports older than this are a dead/stalled workload and are ignored.
 MAX_AGE_S = 10.0
@@ -76,7 +87,7 @@ def write_report(
     pid = os.getpid() if pid is None else pid
     now = time.time() if now is None else now
     os.makedirs(directory, mode=0o700, exist_ok=True)
-    if not _owned_by_us(directory):
+    if not _owned_by_us(directory, want_dir=True):
         raise PermissionError(
             f"workload report dir {directory!r} is not owned by this "
             "user — refusing to write into a squattable channel"
@@ -116,7 +127,7 @@ def read_reports(
     dead workload's leftovers)."""
     now = time.time() if now is None else now
     out: list[dict] = []
-    if not _owned_by_us(directory):
+    if not _owned_by_us(directory, want_dir=True):
         return out  # absent, or another user's dir: no trusted reports
     try:
         names = os.listdir(directory)
@@ -191,12 +202,17 @@ class WorkloadFileSource:
     _cache: dict = field(default_factory=dict, repr=False)
 
     def _read_cached(self, fpath: str) -> dict | None:
+        # lstat, same trust boundary as read_reports: a symlink planted
+        # in the channel must not let the collector ingest (or cache)
+        # some other user-owned JSON it points at.
         try:
-            st = os.stat(fpath)
+            st = os.lstat(fpath)
         except OSError:
             self._cache.pop(fpath, None)
             return None
-        if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        if not stat.S_ISREG(st.st_mode) or (
+            hasattr(os, "getuid") and st.st_uid != os.getuid()
+        ):
             return None
         key = (st.st_mtime_ns, st.st_size)
         hit = self._cache.get(fpath)
@@ -219,7 +235,7 @@ class WorkloadFileSource:
 
     def snapshot(self) -> dict[int, dict]:
         now = self.clock()
-        if not _owned_by_us(self.directory):
+        if not _owned_by_us(self.directory, want_dir=True):
             return {}
         try:
             names = os.listdir(self.directory)

@@ -112,7 +112,11 @@ class WorkloadReporter:
         self.directory = directory or DEFAULT_DIR
         self.interval_s = interval_s
         self._busy_s = 0.0
-        self._busy_since: float | None = None
+        # Open device_work intervals keyed by thread ident: one reporter
+        # may be shared by several worker threads (the serving engine's
+        # streams), and a single slot would let them overwrite each
+        # other's start stamp and undercount busy time.
+        self._busy_since: dict[int, float] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -121,20 +125,25 @@ class WorkloadReporter:
 
     @contextlib.contextmanager
     def device_work(self):
+        """Mark device-busy time. Concurrent blocks from different
+        threads each get their own interval; overlapping intervals sum
+        (busy_frac is clamped to 1.0 downstream), matching "any thread
+        kept the device busy" semantics."""
+        ident = threading.get_ident()
         with self._lock:
-            self._busy_since = time.monotonic()
+            self._busy_since[ident] = time.monotonic()
         try:
             yield
         finally:
             t1 = time.monotonic()
             with self._lock:
-                # Charge from _busy_since, not the block start: a drain
-                # mid-block already counted the earlier slice and
-                # advanced _busy_since (charging from t0 would double-
+                # Charge from the stored stamp, not the block start: a
+                # drain mid-block already counted the earlier slice and
+                # advanced the stamp (charging from t0 would double-
                 # count the whole block on exit).
-                if self._busy_since is not None:
-                    self._busy_s += t1 - self._busy_since
-                self._busy_since = None
+                since = self._busy_since.pop(ident, None)
+                if since is not None:
+                    self._busy_s += t1 - since
 
     def _drain_busy(self, now: float) -> float:
         """Busy seconds accumulated since the last drain, counting a
@@ -143,9 +152,9 @@ class WorkloadReporter:
         with self._lock:
             busy = self._busy_s
             self._busy_s = 0.0
-            if self._busy_since is not None:
-                busy += now - self._busy_since
-                self._busy_since = now
+            for ident, since in self._busy_since.items():
+                busy += now - since
+                self._busy_since[ident] = now
         return busy
 
     # ---- report loop ----
