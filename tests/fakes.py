@@ -113,3 +113,103 @@ def fake_jetstream(text: str) -> FakeBackend:
     b = FakeBackend()
     b.routes["/metrics"] = lambda q: (200, "text/plain", text)
     return b
+
+
+class FakeK8sWatchApi:
+    """A K8s apiserver fake speaking the real transport protocol over
+    HTTP: GET /api/v1/pods (list, with resourceVersion), the chunked
+    ``?watch=1`` event stream (JSON lines written incrementally over a
+    held-open connection), Bearer-token auth (401 without it when
+    ``token`` is set), and scripted per-connection watch behavior so
+    tests can drive clean ends, ERROR/410 events, and dead streams.
+
+    Watch connections consume one script from ``push_watch_script``:
+    a list of event dicts streamed immediately, then "HOLD" keeps the
+    connection open until release; when no script is queued the stream
+    ends at once (a clean server-side timeout).
+    """
+
+    def __init__(self, pods: list[dict] | None = None,
+                 token: str | None = None, port: int = 0):
+        import queue
+
+        self.token = token
+        self.pods = list(pods or [])
+        self.rv = 10
+        self.list_calls = 0
+        self.watch_calls: list[dict] = []
+        self.auth_failures = 0
+        self.seen_auth: list[str | None] = []
+        self._scripts: "queue.Queue[list]" = queue.Queue()
+        self._release = threading.Event()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                fake.seen_auth.append(self.headers.get("Authorization"))
+                if fake.token is not None and (
+                    self.headers.get("Authorization")
+                    != f"Bearer {fake.token}"
+                ):
+                    fake.auth_failures += 1
+                    self.send_response(401)
+                    self.end_headers()
+                    return
+                if u.path != "/api/v1/pods":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                if q.get("watch"):
+                    fake.watch_calls.append(q)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    try:
+                        script = fake._scripts.get_nowait()
+                    except Exception:
+                        return  # no script queued: clean immediate end
+                    for entry in script:
+                        if entry == "HOLD":
+                            fake._release.wait(30.0)
+                            return
+                        self.wfile.write(json.dumps(entry).encode() + b"\n")
+                        self.wfile.flush()
+                    return  # clean end after scripted events
+                # ---- list ----
+                fake.list_calls += 1
+                body = json.dumps({
+                    "kind": "PodList",
+                    "metadata": {"resourceVersion": str(fake.rv)},
+                    "items": list(fake.pods),
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server.server_port}"
+
+    @property
+    def port(self) -> int:
+        return self.server.server_port
+
+    def push_watch_script(self, script: list) -> None:
+        self._scripts.put(script)
+
+    def close(self):
+        self._release.set()
+        self.server.shutdown()
+        self.server.server_close()

@@ -110,6 +110,38 @@ class TestSpeculativeEngine:
         assert eng.spec_proposed_total > 0
         assert eng.spec_accepted_total <= eng.spec_proposed_total
 
+    def test_layer_truncated_draft_shares_target_weights(self):
+        """--spec-draft-layers: a draft that is a pure layer truncation
+        of the target gets the target's bottom layers + embed/head, not
+        random weights (random agreement ~1/vocab makes the whole
+        speculative path meaningless)."""
+        draft = dataclasses.replace(SMALL, n_layers=1)
+        _, plain = _engine_outputs(PROMPTS)
+        eng, spec = _engine_outputs(PROMPTS, spec_len=3, draft_model=draft)
+        assert spec == plain  # lossless regardless of draft quality
+        assert eng.draft_params["layers"][0] is eng.params["layers"][0]
+        assert eng.draft_params["embed"] is eng.params["embed"]
+        assert len(eng.draft_params["layers"]) == 1
+        assert eng.spec_proposed_total > 0
+
+    def test_acceptance_rises_with_draft_depth(self):
+        """Acceptance responds to draft quality: a 2-of-3-layer
+        truncation agrees more than 1-of-3. Deterministic given the
+        fixed seed + greedy decode."""
+        deep = dataclasses.replace(SMALL, n_layers=3)
+
+        def accept_frac(draft_layers: int) -> float:
+            eng = ServingEngine(cfg=ServeConfig(
+                model=deep, slots=2, prefill_len=8, spec_len=3,
+                draft_model=dataclasses.replace(deep,
+                                                n_layers=draft_layers)))
+            reqs = [eng.submit(p, max_new=12) for p in PROMPTS]
+            eng.drain()
+            assert all(r.done.is_set() for r in reqs)
+            return eng.spec_accepted_total / max(1, eng.spec_proposed_total)
+
+        assert accept_frac(1) < accept_frac(2)
+
     def test_fewer_target_dispatches_than_plain(self):
         eng_plain, _ = _engine_outputs(PROMPTS, max_new=16)
         eng_spec, _ = _engine_outputs(PROMPTS, max_new=16, spec_len=4)
@@ -160,6 +192,15 @@ class TestSpeculativeEngine:
         assert eng.spec_accepted_total == eng.spec_proposed_total
         _, plain = _engine_outputs([[3, 1, 4, 1]], max_new=20)
         assert greedy.output == plain[0]
+
+    def test_draft_as_deep_as_target_rejected(self):
+        """A draft with >= the target's layers silently truncates to
+        the target itself (acceptance tautologically 100%) — refuse."""
+        for n in (2, 3):
+            with pytest.raises(ValueError, match="shallower"):
+                ServingEngine(cfg=ServeConfig(
+                    model=SMALL, slots=2, prefill_len=8, spec_len=3,
+                    draft_model=dataclasses.replace(SMALL, n_layers=n)))
 
     def test_draft_vocab_mismatch_rejected(self):
         bad = dataclasses.replace(SMALL, vocab=SMALL.vocab * 2)

@@ -141,6 +141,69 @@ class JaxTpuCollector:
 
         self._reprobe_task = asyncio.create_task(probe())
 
+    async def probe_sources(self) -> dict[str, dict]:
+        """Actively probe every counter source once and report, per
+        source, whether it answered and why not (validate.py provenance
+        — VERDICT r03 item #8: a future host with live libtpu counters
+        must upgrade the evidence chain visibly, and a dark host must
+        say per source WHY it is dark)."""
+        out: dict[str, dict] = {}
+        devices = await self._devices_cached()
+
+        snap = await self._sdk.snapshot()
+        out["sdk"] = {
+            "live": snap is not None,
+            "detail": (
+                f"duty×{len(snap.duty_pct)} hbm×{len(snap.hbm_used)} "
+                f"extras={sorted(snap.extras)}" if snap is not None
+                else getattr(self._sdk, "last_error", None) or "no data"),
+        }
+
+        gsnap = await self._client.snapshot()
+        out["grpc"] = {
+            "live": gsnap is not None,
+            "detail": (
+                f"{getattr(self._client, 'addr', '?')}: "
+                f"hbm×{len(gsnap['hbm_used'])} "
+                f"duty×{len(gsnap['duty_pct'])}" if gsnap is not None
+                else f"{getattr(self._client, 'addr', '?')}: "
+                     f"{getattr(self._client, 'last_error', None) or 'no data'}"),
+        }
+
+        if not devices:
+            out["pjrt"] = {"live": False,
+                           "detail": self._init_error or "no devices"}
+        else:
+            stats = None
+            err = None
+            try:
+                stats = await asyncio.to_thread(devices[0].memory_stats)
+            except Exception as e:
+                err = f"memory_stats: {type(e).__name__}: {str(e)[:120]}"
+            live = bool(stats) and stats.get("bytes_in_use") is not None
+            out["pjrt"] = {
+                "live": live,
+                "detail": (
+                    f"{len(devices)} device(s); memory_stats keys: "
+                    f"{sorted(stats)[:6]}" if live else
+                    err or f"{len(devices)} device(s); memory_stats "
+                           f"{'empty' if not stats else 'lacks bytes_in_use'}"),
+            }
+
+        if self._workload is None:
+            out["workload"] = {"live": False,
+                               "detail": "disabled (no workload_dir)"}
+        else:
+            wsnap = await asyncio.to_thread(self._workload.snapshot)
+            out["workload"] = {
+                "live": bool(wsnap),
+                "detail": (
+                    f"{self._workload.directory}: {len(wsnap)} device "
+                    f"entr{'y' if len(wsnap) == 1 else 'ies'}" if wsnap
+                    else f"{self._workload.directory}: no fresh reports"),
+            }
+        return out
+
     async def collect(self) -> Sample:
         devices = await self._devices_cached()
         if not devices:

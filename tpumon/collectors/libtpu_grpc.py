@@ -90,6 +90,8 @@ class LibtpuMetricsClient:
     addr: str = DEFAULT_ADDR
     timeout_s: float = 2.0
     _channel: object = field(default=None, repr=False)
+    #: Why the last get_metric returned None (validate.py provenance).
+    last_error: str | None = field(default=None, repr=False)
 
     def _get_channel(self):
         if self._channel is None:
@@ -113,7 +115,8 @@ class LibtpuMetricsClient:
                 call(encode_metric_request(metric_name)), timeout=self.timeout_s
             )
             return extract_gauges(resp)
-        except Exception:
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {str(e)[:160]}"
             return None
 
     async def snapshot(self) -> dict[str, dict[int, float]] | None:
@@ -126,6 +129,9 @@ class LibtpuMetricsClient:
         usage, total, duty = results
         if usage is None and total is None and duty is None:
             return None
+        # Some metric answered: the source is live, so a per-metric
+        # failure recorded above must not linger as the "why dark".
+        self.last_error = None
         return {
             "hbm_used": usage or {},
             "hbm_total": total or {},

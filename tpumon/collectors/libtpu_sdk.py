@@ -226,6 +226,8 @@ class LibtpuSdkSource:
         self._mod = None
         self._import_failed = False
         self._supported: list[str] | None = None
+        #: Why the source is dark (validate.py provenance).
+        self.last_error: str | None = None
 
     def _api(self):
         if self._mod is None and not self._import_failed:
@@ -234,8 +236,10 @@ class LibtpuSdkSource:
 
                 self._mod = tpumonitoring
                 self._supported = list(tpumonitoring.list_supported_metrics())
-            except Exception:
+            except Exception as e:
                 self._import_failed = True
+                self.last_error = (
+                    f"libtpu.sdk import: {type(e).__name__}: {str(e)[:160]}")
         return self._mod
 
     def _get(self, name: str) -> list[str]:
@@ -244,12 +248,17 @@ class LibtpuSdkSource:
             return []
         try:
             return list(mod.get_metric(name).data())
-        except Exception:
+        except Exception as e:
+            self.last_error = f"{name}: {type(e).__name__}: {str(e)[:160]}"
             return []
 
     def _snapshot_blocking(self) -> SdkSnapshot | None:
         if self._api() is None:
             return None
+        # Fresh provenance per attempt: last_error must describe THIS
+        # snapshot, not a transient failure from hours ago (the import
+        # error above persists naturally — _api() won't retry).
+        self.last_error = None
         snap = SdkSnapshot()
         snap.duty_pct = parse_float_list(self._get(METRIC_DUTY))
         if not snap.duty_pct:
@@ -268,7 +277,14 @@ class LibtpuSdkSource:
             pct = parse_labeled_percentiles(self._get(name))
             if pct:
                 snap.extras[name] = pct
-        return None if snap.empty() else snap
+        if snap.empty():
+            if self.last_error is None:
+                sup = len(self._supported or [])
+                self.last_error = (
+                    f"sdk imported ({sup} supported metrics) but every "
+                    "queried family answered empty")
+            return None
+        return snap
 
     async def snapshot(self) -> SdkSnapshot | None:
         return await asyncio.to_thread(self._snapshot_blocking)

@@ -26,6 +26,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -208,35 +209,62 @@ def _chunked_attention_core(
     from tpumon.loadgen.ring_attention import _block_attend
 
     b, t, h, d = q.shape
-    n_blocks = -(-t // block_k)
-    pad = n_blocks * block_k - t
-    # Pad K/V up to a whole number of blocks; padded rows are masked out
-    # by the causal test (their positions exceed every q position).
-    if pad:
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    kb = k.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
-    vb = v.reshape(b, n_blocks, block_k, h, d).transpose(1, 0, 2, 3, 4)
+    dtype = q.dtype
+    bk = block_k
+    # 2D causal blocking, flash-attention structure in XLA. r03's
+    # schedule streamed K/V blocks against the FULL q — every
+    # (q row, k block) pair was computed and then causally masked, i.e.
+    # T² work where the causal triangle needs T²/2, and the per-block
+    # score transient was [B, H, T, block] (268 MB at seq 8192). Here q
+    # is split into a few LARGE blocks (a static Python unroll), and
+    # each q block's inner lax.scan runs only over the k blocks at or
+    # below the diagonal — the trip count is static per q block, so the
+    # skipped near-half of the blocks costs nothing, Mosaic pipelines
+    # each scan normally (no lax.cond on the hot path — measured: a
+    # cond-per-block variant starves the MXU on sub-5µs blocks), and q
+    # blocks stay big enough to amortize per-step overheads.
+    nq = min(4, -(-t // bk))  # few big q blocks: overhead amortization
+    bq = -(-t // (nq * bk)) * bk  # q block rows, a multiple of bk
+    nq = -(-t // bq)
+    if nq * bq - t:
+        q = jnp.pad(q, ((0, 0), (0, nq * bq - t), (0, 0), (0, 0)))
+    nk = -(-t // bk)
+    if nk * bk - t:
+        # Padded K rows have positions >= t > every real q position, so
+        # the causal test masks them; padded q rows are sliced off.
+        k = jnp.pad(k, ((0, 0), (0, nk * bk - t), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, nk * bk - t), (0, 0), (0, 0)))
     scale = 1.0 / d**0.5
 
+    # Checkpointed per k-block: the backward pass recomputes each
+    # block's probabilities instead of storing them (without this the
+    # scan's residuals would re-add the O(T²) the schedule removes).
     @jax.checkpoint
-    def body(carry, blk):
-        m, el, o = carry
-        j, k_blk, v_blk = blk
-        m, el, o = _block_attend(
-            q, k_blk, v_blk, 0, j * block_k, scale, True, m, el, o)
-        return (m, el, o), ()
+    def k_body(q_i, q0, carry, kj):
+        j, k_j, v_j = kj
+        return _block_attend(q_i, k_j, v_j, q0, j * bk, scale, True,
+                             *carry), ()
 
-    m0 = jnp.full((b, h, t), float("-inf"), jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
-    o0 = jnp.zeros((b, t, h, d), jnp.float32)
-    (_, el, o), _ = jax.lax.scan(
-        body, (m0, l0, o0),
-        (jnp.arange(n_blocks, dtype=jnp.int32), kb, vb),
-    )
-    l_safe = jnp.where(el == 0.0, 1.0, el)
-    out = o / l_safe.swapaxes(1, 2)[..., None]
-    return out.astype(q.dtype)
+    outs = []
+    for i in range(nq):
+        q0 = i * bq
+        q_i = q[:, q0:q0 + bq]
+        # Causal horizon: rows < q0+bq only ever attend k rows < q0+bq,
+        # so this q block's scan covers k blocks [0, nkj) — static.
+        nkj = min(nk, -(-(q0 + bq) // bk))
+        kb = k[:, :nkj * bk].reshape(b, nkj, bk, h, d).transpose(
+            1, 0, 2, 3, 4)
+        vb = v[:, :nkj * bk].reshape(b, nkj, bk, h, d).transpose(
+            1, 0, 2, 3, 4)
+        m0 = jnp.full((b, h, bq), float("-inf"), jnp.float32)
+        l0 = jnp.zeros((b, h, bq), jnp.float32)
+        o0 = jnp.zeros((b, bq, h, d), jnp.float32)
+        (_, el, o), _ = lax.scan(
+            partial(k_body, q_i, q0), (m0, l0, o0),
+            (jnp.arange(nkj, dtype=jnp.int32), kb, vb))
+        l_safe = jnp.where(el == 0.0, 1.0, el)
+        outs.append((o / l_safe.swapaxes(1, 2)[..., None]).astype(dtype))
+    return jnp.concatenate(outs, axis=1)[:, :t]
 
 
 def _attention(

@@ -603,6 +603,8 @@ class ServingEngine:
         # f32 copy).
         self.spec_len = self.cfg.spec_len
         if self.spec_len:
+            import dataclasses as _dc
+
             from tpumon.loadgen.speculative import decode_block
 
             dm = self.cfg.draft_model or m
@@ -611,6 +613,15 @@ class ServingEngine:
                     "draft_model must share vocab and max_seq with the "
                     f"target (draft {dm.vocab}/{dm.max_seq} vs "
                     f"target {m.vocab}/{m.max_seq})")
+            if self.cfg.draft_model is not None and dm.n_layers >= m.n_layers:
+                # As deep as the target = self-speculation with extra
+                # steps (and a deeper draft would silently truncate to
+                # exactly that while over-allocating its KV cache) —
+                # reported acceptance would be the r03 tautology.
+                raise ValueError(
+                    f"draft_model must be shallower than the target "
+                    f"({dm.n_layers} >= {m.n_layers} layers; use "
+                    "draft_model=None for self-speculation)")
             self._draft_scfg = ServeConfig(
                 model=dm, slots=self.cfg.slots,
                 prefill_len=self.cfg.prefill_len)
@@ -618,6 +629,18 @@ class ServingEngine:
                 self.draft_params = draft_params
             elif self.cfg.draft_model is None:
                 self.draft_params = self.params  # self-speculation
+            elif dm == _dc.replace(m, n_layers=dm.n_layers):
+                # Layer-truncated draft (--spec-draft-layers): share the
+                # target's first k layers + embed/head instead of random
+                # weights — a fresh random draft agrees with the target
+                # ~1/vocab of the time, which makes acceptance (and the
+                # whole speculative path) meaningless.
+                self.draft_params = {
+                    "embed": self.params["embed"],
+                    "layers": self.params["layers"][:dm.n_layers],
+                    "final_norm": self.params["final_norm"],
+                    "lm_head": self.params["lm_head"],
+                }
             else:
                 self.draft_params = init_params(
                     dm, jax.random.PRNGKey(seed + 1))
@@ -1503,6 +1526,8 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     if args.spec_draft_layers and not args.spec_len:
         ap.error("--spec-draft-layers requires --spec-len > 0")
+    if args.spec_draft_layers >= 4:  # the CLI model's n_layers below
+        ap.error("--spec-draft-layers must be < 4 (the target's depth)")
     if args.spec_len < 0:
         ap.error("--spec-len must be >= 0")
     if args.pool_pages and args.kv_layout != "paged":

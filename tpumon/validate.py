@@ -246,6 +246,22 @@ async def validate(backend: str = "jax") -> list[CheckResult]:
             reporter = None
 
     try:
+        # Per-source probe provenance (VERDICT r03 item #8): one line
+        # per counter source saying live/dark and WHY, so a run on a
+        # host where libtpu counters answer is immediately
+        # distinguishable from the self-report-only evidence chain.
+        # After reporter start, so the workload channel reflects this
+        # run; before the checks, which consume these sources.
+        # Informational: dark platform sources SKIP (the fallback chain
+        # existing is the design), they never FAIL.
+        if hasattr(collector, "probe_sources"):
+            for src, info in (await collector.probe_sources()).items():
+                results.append(CheckResult(
+                    f"source-{src}",
+                    "PASS" if info["live"] else "SKIP",
+                    ("live: " if info["live"] else "dark: ")
+                    + info["detail"],
+                ))
         chips0 = (
             await _sample_chips(collector) if reporter else probe_chips
         )
