@@ -117,3 +117,135 @@ def ring_attention(
         return out.astype(q_blk.dtype)
 
     return run(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag schedule: load-balanced CAUSAL ring attention.
+#
+# Plain ring attention wastes half the machine under a causal mask: chip 0
+# holds the earliest block and is needed in 1 of n steps, chip n-1 in all
+# n — but the ring synchronizes at every ppermute, so each step's wall
+# time is the BUSIEST chip's attend and the total stays O(T²/n), as if
+# the mask didn't exist. The zigzag layout (as used by production
+# context-parallel trainers) gives every chip one EARLY and one LATE
+# half-block — chip i holds half-blocks (i, 2n-1-i) of the sequence cut
+# into 2n — so at every step every chip has ~the same two causally-live
+# (q half, k half) pairs to compute and the per-step critical path is
+# half a plain-ring attend: causal-optimal O(T²/2n) total, with the
+# same ppermute traffic.
+# ---------------------------------------------------------------------------
+
+
+def zigzag_indices(t: int, n: int) -> jnp.ndarray:
+    """Gather indices mapping a contiguous sequence to zigzag order.
+
+    ``x[:, zigzag_indices(t, n)]`` puts rows so that an even split over
+    n chips gives chip i the half-blocks (i, 2n-1-i). 2n must divide t.
+    """
+    assert t % (2 * n) == 0, (t, n)
+    hb = t // (2 * n)
+    order: list[int] = []
+    for i in range(n):
+        order.extend(range(i * hb, (i + 1) * hb))
+        order.extend(range((2 * n - 1 - i) * hb, (2 * n - i) * hb))
+    return jnp.asarray(order, jnp.int32)
+
+
+def zigzag_inverse(t: int, n: int) -> jnp.ndarray:
+    """Inverse permutation: zigzag order back to contiguous."""
+    return jnp.argsort(zigzag_indices(t, n))
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "seq",
+) -> jax.Array:
+    """Causal ring attention over zigzag-ordered inputs.
+
+    Arrays are [B, T, H, D] with the sequence axis ALREADY in zigzag
+    order (``zigzag_indices``) — long-context pipelines keep this layout
+    end to end; one-off callers can permute in/out:
+
+        zi = zigzag_indices(t, n)
+        out = zigzag_ring_attention(q[:, zi], k[:, zi], v[:, zi], mesh)
+        out = out[:, zigzag_inverse(t, n)]
+
+    Output is returned in the same zigzag layout/sharding as q.
+    """
+    n = mesh.shape[axis]
+    t = q.shape[1]
+    assert t % (2 * n) == 0, (t, n)
+    hb = t // (2 * n)
+    scale = 1.0 / q.shape[-1] ** 0.5
+    spec = P(None, axis, None, None)
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    def run(q_blk, k_blk, v_blk):
+        b, tq, h, _ = q_blk.shape  # tq == 2*hb: halves (my, 2n-1-my)
+        my = jax.lax.axis_index(axis)
+        # Global row offsets of this chip's early/late q halves.
+        qa_off = my * hb
+        qb_off = (2 * n - 1 - my) * hb
+        q_a, q_b = q_blk[:, :hb], q_blk[:, hb:]
+
+        def fresh():
+            return (
+                jnp.full((b, h, hb), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, hb), jnp.float32),
+                jnp.zeros((b, hb, h, q_blk.shape[3]), jnp.float32),
+            )
+
+        # Mark the accumulators device-varying up front: the attend
+        # branch's outputs depend on axis_index, and lax.cond requires
+        # both branches (and so the carry) to agree on that.
+        acc = jax.tree.map(
+            lambda x: jax.lax.pcast(x, (axis,), to="varying"),
+            {"a": fresh(), "b": fresh()})
+        k_cur, v_cur = k_blk, v_blk
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        for step in range(n):
+            j = (my - step) % n  # owner of the visiting K/V
+            ka_off = j * hb
+            kb_off = (2 * n - 1 - j) * hb
+            k_a, v_a = k_cur[:, :hb], v_cur[:, :hb]
+            k_b, v_b = k_cur[:, hb:], v_cur[:, hb:]
+            # The causally-possible (q half, k half) pairs; a pair is
+            # live iff its k half starts at or before its q half's last
+            # row. q_a × k_b is omitted: an early q half (block < n)
+            # can never see a late k half (block >= n). Of the three
+            # below, ~2 are live per chip per step (all 3 on the
+            # self-step, 2 of them half-masked diagonals) — and every
+            # chip has the same load, which is the whole point
+            # (balanced critical path).
+            for q_half, q_off, tag, kvs in (
+                (q_a, qa_off, "a", ((k_a, v_a, ka_off),)),
+                (q_b, qb_off, "b", ((k_a, v_a, ka_off),
+                                    (k_b, v_b, kb_off))),
+            ):
+                for k_half, v_half, k_off in kvs:
+                    live = k_off <= q_off + (hb - 1)
+                    acc[tag] = jax.lax.cond(
+                        live,
+                        lambda c, qh=q_half, kh=k_half, vh=v_half,
+                        qo=q_off, ko=k_off: _block_attend(
+                            qh, kh, vh, qo, ko, scale, True, *c),
+                        lambda c: c,
+                        acc[tag],
+                    )
+            if step != n - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+        outs = []
+        for tag in ("a", "b"):
+            m, l, o = acc[tag]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            outs.append(o / l_safe.swapaxes(1, 2)[..., None])
+        return jnp.concatenate(outs, axis=1).astype(q_blk.dtype)
+
+    return run(q, k, v)

@@ -7,9 +7,14 @@ back once on the last step — the canonical Pallas TPU matmul schedule
 (double-buffered HBM→VMEM pipelining is handled by Mosaic from the
 BlockSpecs).
 
-Block defaults are MXU/VMEM-friendly: 512×512 bf16 tiles (multiples of
-the (16, 128) bf16 min tile), three tiles ≈ 1.5 MB of VMEM plus the
-256 KB f32 accumulator.
+Block defaults are MXU/VMEM-friendly and swept on hardware (r04, v5e,
+4096³ bf16, slope-timed): (1024, 1024, 512) measured 172.8 TFLOP/s vs
+XLA's 194.9 (0.89×) — the best of 13 candidates; r03's (512, 512, 512)
+default measured 153 (0.79×), and every larger tiling (bk 1024+,
+bm/bn 2048) fails Mosaic compilation on the ~16 MB VMEM budget
+(A 2 MB + B 1 MB double-buffered + 4 MB f32 accumulator + 2 MB out ≈
+12 MB). See BENCH_NOTES.md for why the remaining ~11% belongs to XLA's
+native scheduler.
 """
 
 from __future__ import annotations
@@ -42,8 +47,8 @@ def _matmul_kernel(a_ref, b_ref, out_ref, acc_ref, *, k_steps: int):
 def matmul(
     a: jax.Array,
     b: jax.Array,
-    block_m: int = 512,
-    block_n: int = 512,
+    block_m: int = 1024,
+    block_n: int = 1024,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:

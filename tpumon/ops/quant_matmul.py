@@ -15,10 +15,13 @@ last K step. ``quantized_matmul`` falls back to the fused XLA path for
 shapes that don't tile (tiny decode batches), so callers can use it
 unconditionally.
 
-Measured on v5e (4096³, 32 chained iterations, dependency-forcing scan):
-512³ blocks run ~3.4× faster than XLA's fused dequant-matmul of the same
-program; 256-row M blocks are catastrophically slower (sub-MXU-height
-tiles), hence the 512 defaults.
+Measured on v5e (4096³, slope-timed, r04 sweep): (1024, 1024, 512)
+blocks are the best tiling at 177.6 TOP/s — ~15% over r03's 512³
+default (154.4) — hence the defaults; sub-512 M/N tiles lose badly
+(sub-MXU-height), larger ones overflow VMEM. XLA's fused dequant path
+remains at or slightly above this kernel (r02 slope timing; r01's
+"3.4×" claim was a timing artifact — BENCH_NOTES.md), so the serving
+engine streams quantized weights through plain ``x @ q.astype(dt)``.
 """
 
 from __future__ import annotations
@@ -55,8 +58,8 @@ def quantized_matmul_pallas(
     a: jax.Array,
     q: jax.Array,
     scale: jax.Array,
-    block_m: int = 512,
-    block_n: int = 512,
+    block_m: int = 1024,
+    block_n: int = 1024,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
@@ -91,8 +94,8 @@ def quantized_matmul(
     a: jax.Array,
     q: jax.Array,
     scale: jax.Array,
-    block_m: int = 512,
-    block_n: int = 512,
+    block_m: int = 1024,
+    block_n: int = 1024,
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
