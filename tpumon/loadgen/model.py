@@ -173,11 +173,18 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight.astype(x.dtype)
 
 
-def _rope(x: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding over the last dim; x: [B, T, H, D]."""
+def _rope(x: jax.Array, theta: float,
+          positions: jax.Array | None = None) -> jax.Array:
+    """Rotary embedding over the last dim; x: [B, T, H, D].
+
+    ``positions`` [T] overrides the default 0..T-1 — sequence-parallel
+    shards (loadgen.sp_train) pass each row's GLOBAL position, which for
+    the zigzag layout is non-contiguous."""
     _, t, _, d = x.shape
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -268,16 +275,30 @@ def _chunked_attention_core(
 
 
 def _attention(
-    cfg: ModelConfig, layer: dict, x: jax.Array, mesh: Mesh | None = None
+    cfg: ModelConfig,
+    layer: dict,
+    x: jax.Array,
+    mesh: Mesh | None = None,
+    positions: jax.Array | None = None,
+    attn_core=None,
 ) -> jax.Array:
+    """One attention sublayer (projections + RoPE + core + wo).
+
+    ``attn_core(q, k, v) -> [B, T, H, D]`` replaces the built-in
+    naive/chunked core and receives the UNREPEATED nkv-head K/V (the
+    core owns GQA widening — the sp path repeats locally after each
+    ring receive so the ppermute stays narrow)."""
     b, t, _ = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = x.dtype
     q = (x @ layer["wq"].astype(dt)).reshape(b, t, nh, hd)
     k = (x @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd)
     v = (x @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
-    q = _rope(q, cfg.rope_theta)
-    k = _rope(k, cfg.rope_theta)
+    q = _rope(q, cfg.rope_theta, positions=positions)
+    k = _rope(k, cfg.rope_theta, positions=positions)
+    if attn_core is not None:
+        out = attn_core(q, k, v).reshape(b, t, nh * hd)
+        return out @ layer["wo"].astype(dt)
     # Grouped-query attention: repeat kv heads.
     if nkv != nh:
         rep = nh // nkv

@@ -71,6 +71,57 @@ def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     )
 
 
+def ring_attend_inner(
+    q_blk: jax.Array,
+    k_blk: jax.Array,
+    v_blk: jax.Array,
+    axis: str,
+    n: int,
+    causal: bool = True,
+    kv_rep: int = 1,
+) -> jax.Array:
+    """Per-device ring-attention body: local q against rotating K/V.
+
+    For use INSIDE an existing shard_map over ``axis`` (shard_map does
+    not nest) — the sp training step (loadgen.sp_train) calls this with
+    its layer activations; ``ring_attention`` below is the standalone
+    wrapper. Arrays are the LOCAL blocks [B, T/n, H, D].
+
+    ``kv_rep``: GQA head-repeat factor applied LOCALLY at each use —
+    the ppermute rotates the narrow nkv-head K/V (repeating before the
+    ring would multiply the ICI traffic by nh/nkv for nothing).
+    """
+    b, tq, h, d = q_blk.shape
+    scale = 1.0 / d**0.5
+    my = jax.lax.axis_index(axis)
+    q_off = my * tq
+
+    def widen(x):
+        return jnp.repeat(x, kv_rep, axis=2) if kv_rep > 1 else x
+
+    m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+    o = jnp.zeros(q_blk.shape[:3] + (q_blk.shape[3],), jnp.float32)
+    k_cur, v_cur = k_blk, v_blk
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        # Block j visits us at step s where j = (my - s) mod n.
+        j = (my - step) % n
+        k_off = j * tq
+        m, l, o = _block_attend(
+            q_blk, widen(k_cur), widen(v_cur), q_off, k_off, scale,
+            causal, m, l, o
+        )
+        if step != n - 1:
+            # Rotate K/V around the ICI ring; XLA overlaps this
+            # collective-permute with the next block's compute.
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # [B, H, Tq]
+    out = o / l_safe.swapaxes(1, 2)[..., None]
+    return out.astype(q_blk.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -85,36 +136,13 @@ def ring_attention(
     Returns the output with the same sharding as q.
     """
     n = mesh.shape[axis]
-    scale = 1.0 / q.shape[-1] ** 0.5
     spec = P(None, axis, None, None)
 
     @partial(
         jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     def run(q_blk, k_blk, v_blk):
-        b, tq, h, _ = q_blk.shape
-        my = jax.lax.axis_index(axis)
-        q_off = my * tq
-        m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
-        l = jnp.zeros((b, h, tq), jnp.float32)
-        o = jnp.zeros(q_blk.shape[:3] + (q_blk.shape[3],), jnp.float32)
-        k_cur, v_cur = k_blk, v_blk
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        for step in range(n):
-            # Block j visits us at step s where j = (my - s) mod n.
-            j = (my - step) % n
-            k_off = j * tq
-            m, l, o = _block_attend(
-                q_blk, k_cur, v_cur, q_off, k_off, scale, causal, m, l, o
-            )
-            if step != n - 1:
-                # Rotate K/V around the ICI ring; XLA overlaps this
-                # collective-permute with the next block's compute.
-                k_cur = jax.lax.ppermute(k_cur, axis, perm)
-                v_cur = jax.lax.ppermute(v_cur, axis, perm)
-        l_safe = jnp.where(l == 0.0, 1.0, l)  # [B, H, Tq]
-        out = o / l_safe.swapaxes(1, 2)[..., None]
-        return out.astype(q_blk.dtype)
+        return ring_attend_inner(q_blk, k_blk, v_blk, axis, n, causal)
 
     return run(q, k, v)
 
@@ -178,74 +206,96 @@ def zigzag_ring_attention(
     n = mesh.shape[axis]
     t = q.shape[1]
     assert t % (2 * n) == 0, (t, n)
-    hb = t // (2 * n)
-    scale = 1.0 / q.shape[-1] ** 0.5
     spec = P(None, axis, None, None)
 
     @partial(
         jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     def run(q_blk, k_blk, v_blk):
-        b, tq, h, _ = q_blk.shape  # tq == 2*hb: halves (my, 2n-1-my)
-        my = jax.lax.axis_index(axis)
-        # Global row offsets of this chip's early/late q halves.
-        qa_off = my * hb
-        qb_off = (2 * n - 1 - my) * hb
-        q_a, q_b = q_blk[:, :hb], q_blk[:, hb:]
-
-        def fresh():
-            return (
-                jnp.full((b, h, hb), _NEG_INF, jnp.float32),
-                jnp.zeros((b, h, hb), jnp.float32),
-                jnp.zeros((b, hb, h, q_blk.shape[3]), jnp.float32),
-            )
-
-        # Mark the accumulators device-varying up front: the attend
-        # branch's outputs depend on axis_index, and lax.cond requires
-        # both branches (and so the carry) to agree on that.
-        acc = jax.tree.map(
-            lambda x: jax.lax.pcast(x, (axis,), to="varying"),
-            {"a": fresh(), "b": fresh()})
-        k_cur, v_cur = k_blk, v_blk
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        for step in range(n):
-            j = (my - step) % n  # owner of the visiting K/V
-            ka_off = j * hb
-            kb_off = (2 * n - 1 - j) * hb
-            k_a, v_a = k_cur[:, :hb], v_cur[:, :hb]
-            k_b, v_b = k_cur[:, hb:], v_cur[:, hb:]
-            # The causally-possible (q half, k half) pairs; a pair is
-            # live iff its k half starts at or before its q half's last
-            # row. q_a × k_b is omitted: an early q half (block < n)
-            # can never see a late k half (block >= n). Of the three
-            # below, ~2 are live per chip per step (all 3 on the
-            # self-step, 2 of them half-masked diagonals) — and every
-            # chip has the same load, which is the whole point
-            # (balanced critical path).
-            for q_half, q_off, tag, kvs in (
-                (q_a, qa_off, "a", ((k_a, v_a, ka_off),)),
-                (q_b, qb_off, "b", ((k_a, v_a, ka_off),
-                                    (k_b, v_b, kb_off))),
-            ):
-                for k_half, v_half, k_off in kvs:
-                    live = k_off <= q_off + (hb - 1)
-                    acc[tag] = jax.lax.cond(
-                        live,
-                        lambda c, qh=q_half, kh=k_half, vh=v_half,
-                        qo=q_off, ko=k_off: _block_attend(
-                            qh, kh, vh, qo, ko, scale, True, *c),
-                        lambda c: c,
-                        acc[tag],
-                    )
-            if step != n - 1:
-                k_cur = jax.lax.ppermute(k_cur, axis, perm)
-                v_cur = jax.lax.ppermute(v_cur, axis, perm)
-
-        outs = []
-        for tag in ("a", "b"):
-            m, l, o = acc[tag]
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            outs.append(o / l_safe.swapaxes(1, 2)[..., None])
-        return jnp.concatenate(outs, axis=1).astype(q_blk.dtype)
+        return zigzag_attend_inner(q_blk, k_blk, v_blk, axis, n)
 
     return run(q, k, v)
+
+
+def zigzag_attend_inner(
+    q_blk: jax.Array,
+    k_blk: jax.Array,
+    v_blk: jax.Array,
+    axis: str,
+    n: int,
+    kv_rep: int = 1,
+) -> jax.Array:
+    """Per-device zigzag body, for use inside an existing shard_map over
+    ``axis`` (the sp training step) — local blocks hold the zigzag
+    halves (my, 2n-1-my), each of hb rows. ``kv_rep``: GQA head-repeat
+    applied locally inside each live pair (the ring rotates the narrow
+    nkv-head K/V)."""
+    b, tq, h, d = q_blk.shape  # tq == 2*hb: halves (my, 2n-1-my)
+    hb = tq // 2
+    scale = 1.0 / d**0.5
+    my = jax.lax.axis_index(axis)
+
+    def widen(x):
+        return jnp.repeat(x, kv_rep, axis=2) if kv_rep > 1 else x
+    # Global row offsets of this chip's early/late q halves.
+    qa_off = my * hb
+    qb_off = (2 * n - 1 - my) * hb
+    q_a, q_b = q_blk[:, :hb], q_blk[:, hb:]
+
+    def fresh():
+        return (
+            jnp.full((b, h, hb), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, hb), jnp.float32),
+            jnp.zeros((b, hb, h, q_blk.shape[3]), jnp.float32),
+        )
+
+    # Mark the accumulators device-varying up front: the attend
+    # branch's outputs depend on axis_index, and lax.cond requires
+    # both branches (and so the carry) to agree on that.
+    acc = jax.tree.map(
+        lambda x: jax.lax.pcast(x, (axis,), to="varying"),
+        {"a": fresh(), "b": fresh()})
+    k_cur, v_cur = k_blk, v_blk
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        j = (my - step) % n  # owner of the visiting K/V
+        ka_off = j * hb
+        kb_off = (2 * n - 1 - j) * hb
+        k_a, v_a = k_cur[:, :hb], v_cur[:, :hb]
+        k_b, v_b = k_cur[:, hb:], v_cur[:, hb:]
+        # The causally-possible (q half, k half) pairs; a pair is
+        # live iff its k half starts at or before its q half's last
+        # row. q_a × k_b is omitted: an early q half (block < n)
+        # can never see a late k half (block >= n). Of the three
+        # below, ~2 are live per chip per step (all 3 on the
+        # self-step, 2 of them half-masked diagonals) — and every
+        # chip has the same load, which is the whole point
+        # (balanced critical path).
+        for q_half, q_off, tag, kvs in (
+            (q_a, qa_off, "a", ((k_a, v_a, ka_off),)),
+            (q_b, qb_off, "b", ((k_a, v_a, ka_off),
+                                (k_b, v_b, kb_off))),
+        ):
+            for k_half, v_half, k_off in kvs:
+                live = k_off <= q_off + (hb - 1)
+                acc[tag] = jax.lax.cond(
+                    live,
+                    # widen() inside the branch: a skipped pair never
+                    # materializes the repeated heads.
+                    lambda c, qh=q_half, kh=k_half, vh=v_half,
+                    qo=q_off, ko=k_off: _block_attend(
+                        qh, widen(kh), widen(vh), qo, ko, scale,
+                        True, *c),
+                    lambda c: c,
+                    acc[tag],
+                )
+        if step != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    outs = []
+    for tag in ("a", "b"):
+        m, l, o = acc[tag]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        outs.append(o / l_safe.swapaxes(1, 2)[..., None])
+    return jnp.concatenate(outs, axis=1).astype(q_blk.dtype)

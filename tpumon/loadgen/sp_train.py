@@ -1,0 +1,160 @@
+"""Sequence-parallel (sp) training: the full train step over a "seq" mesh.
+
+Round-3 shipped ring attention as an *op* (loadgen.ring_attention);
+this module makes sequence parallelism a *training mode*: activations
+are sharded on the sequence axis end to end — embedding, norms, and
+MLPs are per-token (trivially local), attention runs through the
+ring/zigzag inner bodies inside one enclosing shard_map, and the loss
+reduces with a psum. The only cross-chip traffic per layer is the K/V
+ppermute ring — rotating the NARROW nkv-head K/V (GQA widening happens
+locally after each receive) — the long-context layout the reference's
+NCCL world has no counterpart for (SURVEY §5.7; the monitor observes
+this traffic as ICI counters).
+
+Design notes (TPU-first):
+- one shard_map over the WHOLE loss: shard_map does not nest, so the
+  attention uses ring_attend_inner / zigzag_attend_inner via
+  model._attention's ``attn_core`` hook (one copy of the per-layer
+  projection/RoPE/residual math for all schedules).
+- positions travel as data: each row's GLOBAL position is passed in as
+  a sharded array, so RoPE and the loss are layout-agnostic — the
+  contiguous and zigzag layouts differ only in a host-side gather of
+  (inputs, labels, positions) before the step. No layout logic inside
+  the traced step.
+- labels are pre-shifted on the host (labels = tokens[:, 1:] against
+  inputs = tokens[:, :-1]) and sharded alongside the inputs, so no
+  boundary exchange is needed for the shifted targets.
+- grads: jax.grad through ppermute/cond transposes cleanly (pinned by
+  tests/test_ring_attention.py grad tests); the layer body is
+  checkpointed when cfg.remat is set, same as the dp×tp path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpumon.loadgen.model import ModelConfig, _attention, _mlp, _rms_norm
+from tpumon.loadgen.ring_attention import (
+    ring_attend_inner,
+    zigzag_attend_inner,
+    zigzag_indices,
+)
+
+SCHEDULES = ("ring", "zigzag")
+
+
+def sp_batch(tokens: jax.Array, n: int, schedule: str):
+    """Host-side prep: (inputs, labels, positions), layout-applied.
+
+    tokens: [B, T+1]; n (ring) resp. 2n (zigzag) must divide T. Returns
+    the three arrays to shard over the sequence axis. The layout MUST
+    match the step's schedule — prefer the ``prep`` bound to the step
+    by ``make_sp_train_step``, which can't mismatch.
+    """
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown sp schedule {schedule!r} (expected {SCHEDULES})")
+    inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    t = inputs.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)
+    if schedule == "zigzag":
+        zi = zigzag_indices(t, n)
+        inputs, labels, pos = inputs[:, zi], labels[:, zi], pos[zi]
+    return inputs, labels, pos
+
+
+def sp_loss_fn(
+    cfg: ModelConfig,
+    params: dict,
+    inputs: jax.Array,
+    labels: jax.Array,
+    positions: jax.Array,
+    mesh: Mesh,
+    axis: str = "seq",
+    schedule: str = "zigzag",
+) -> jax.Array:
+    """Mean next-token NLL with everything sharded over ``axis``."""
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown sp schedule {schedule!r} (expected {SCHEDULES})")
+    n = mesh.shape[axis]
+    total = inputs.shape[0] * inputs.shape[1]
+    kv_rep = cfg.n_heads // cfg.n_kv_heads
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis), P(None, axis), P(axis)),
+        out_specs=P(),
+    )
+    def run(p, inp, lab, pos):
+        dt = jnp.dtype(cfg.compute_dtype)
+        x = p["embed"].astype(dt)[inp]
+
+        def core(q, k, v):
+            if schedule == "zigzag":
+                return zigzag_attend_inner(q, k, v, axis, n, kv_rep=kv_rep)
+            return ring_attend_inner(q, k, v, axis, n, causal=True,
+                                     kv_rep=kv_rep)
+
+        def layer_block(x, layer):
+            x = x + _attention(cfg, layer, _rms_norm(x, layer["attn_norm"]),
+                               positions=pos, attn_core=core)
+            return x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]))
+
+        blk = jax.checkpoint(layer_block) if cfg.remat else layer_block
+        for layer in p["layers"]:
+            x = blk(x, layer)
+        x = _rms_norm(x, p["final_norm"])
+        logits = (x @ p["lm_head"].astype(dt)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
+        # Every local row has a valid pre-shifted label; the mean is a
+        # psum of local sums over the global token count.
+        return jax.lax.psum(jnp.sum(nll), axis) / total
+
+    return run(params, inputs, labels, positions)
+
+
+def make_sp_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    params: dict,
+    axis: str = "seq",
+    schedule: str = "zigzag",
+    lr: float = 1e-3,
+):
+    """jit an SGD step over the seq mesh; returns (step_fn, placed).
+
+    step_fn(params, inputs, labels, positions) -> (params, loss), with
+    (inputs, labels, positions) from ``step_fn.prep(tokens)`` — prep is
+    bound to this step's mesh size and schedule so the batch layout
+    can't silently mismatch the traced step. Params replicate (sp
+    shards activations, not weights — compose with dp/tp meshes for
+    weight sharding); activations shard over ``axis``.
+    """
+    n = mesh.shape[axis]
+    rep = NamedSharding(mesh, P())
+    seq2 = NamedSharding(mesh, P(None, axis))
+    seq1 = NamedSharding(mesh, P(axis))
+    placed = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+
+    @partial(
+        jax.jit,
+        in_shardings=(jax.tree.map(lambda _: rep, params), seq2, seq2, seq1),
+        out_shardings=(jax.tree.map(lambda _: rep, params), rep),
+    )
+    def step(p, inputs, labels, positions):
+        loss, grads = jax.value_and_grad(
+            lambda p_: sp_loss_fn(cfg, p_, inputs, labels, positions,
+                                  mesh, axis, schedule)
+        )(p)
+        new = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
+        return new, loss
+
+    step.prep = partial(sp_batch, n=n, schedule=schedule)
+    return step, placed
