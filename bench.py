@@ -308,7 +308,7 @@ def _bench_serving(on_tpu: bool) -> dict:
             "spec_accept_pct"
         )
 
-    def prefix_ttft() -> tuple[float, float, dict]:
+    def prefix_ttft(**over) -> tuple[float, float, dict]:
         """Median TTFT (ms) over repeated cold/hit pairs.
 
         r03's number was meaningless twice over: the 16-token prompt was
@@ -320,9 +320,12 @@ def _bench_serving(on_tpu: bool) -> dict:
         construction) then resubmits it (chunk-aligned prefix hit);
         pairs accumulate until the observed spread is below the measured
         effect (or a cap); medians + spreads are published.
+
+        ``over``: extra ServeConfig fields — kv_layout="paged" measures
+        the page-SHARING cache (zero-copy hits) vs dense's HBM restore.
         """
         engine = ServingEngine(
-            dataclasses.replace(base, prefix_cache_entries=24)
+            dataclasses.replace(base, prefix_cache_entries=24, **over)
         )
         # As many prompt chunks as max_seq allows (+decode headroom):
         # the elided prefill must dwarf the tunnel's per-call noise.
@@ -394,6 +397,8 @@ def _bench_serving(on_tpu: bool) -> dict:
     tps_paged, _ = run(decode_block=8, kv_layout="paged")
     tps_int8kv, _ = run(decode_block=8, kv_dtype="int8")
     ttft_cold, ttft_hit, ttft_stats = prefix_ttft()
+    pttft_cold, pttft_hit, pttft_stats = prefix_ttft(
+        kv_layout="paged", decode_block=8)
     accept = spec_accept(eng_spec)
     accept_draft = spec_accept(eng_spec_draft)
     return {
@@ -412,6 +417,11 @@ def _bench_serving(on_tpu: bool) -> dict:
         "serving_prefix_ttft_cold_ms": round(ttft_cold, 1),
         "serving_prefix_ttft_hit_ms": round(ttft_hit, 1),
         "serving_prefix_ttft_stats": ttft_stats,
+        # Paged layout: hits point the page table at shared pages —
+        # zero HBM copy (the dense cache's restore is a copy).
+        "serving_paged_prefix_ttft_cold_ms": round(pttft_cold, 1),
+        "serving_paged_prefix_ttft_hit_ms": round(pttft_hit, 1),
+        "serving_paged_prefix_ttft_stats": pttft_stats,
         "serving_requests": n_req,
     }
 
@@ -531,6 +541,9 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "serving_prefix_ttft_cold_ms",
                       "serving_prefix_ttft_hit_ms",
                       "serving_prefix_ttft_stats",
+                      "serving_paged_prefix_ttft_cold_ms",
+                      "serving_paged_prefix_ttft_hit_ms",
+                      "serving_paged_prefix_ttft_stats",
                       "serving_requests")),
 }
 

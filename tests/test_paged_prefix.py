@@ -1,0 +1,170 @@
+"""Paged prefix caching: share pages, copy nothing.
+
+The dense prefix cache snapshots K/V rows (an HBM copy on restore);
+paged mode shares the prefix's PAGES into later requests' tables with
+refcounts (vLLM-style). These tests pin the sharing semantics, the
+refcount lifecycle, eviction under pool pressure (no admission
+deadlock), and that greedy outputs are bit-identical on hits — pages
+are reused, not recomputed, so there is nothing to drift.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpumon.collectors.serving import distill_serving_metrics  # noqa: E402
+from tpumon.loadgen.model import ModelConfig  # noqa: E402
+from tpumon.loadgen.paged_kv import PageAllocator, PagePrefixCache  # noqa: E402
+from tpumon.loadgen.serving import ServeConfig, ServingEngine  # noqa: E402
+
+SMALL = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=64,
+                    compute_dtype="float32")
+
+
+def engine(**over):
+    kw = dict(model=SMALL, slots=2, prefill_len=8,
+              kv_layout="paged", prefix_cache_entries=4)
+    kw.update(over)
+    return ServingEngine(ServeConfig(**kw))
+
+
+PROMPT = list(range(1, 21))  # 20 tokens = 2 full chunks + a 4-token tail
+
+
+# ----------------------------------------------------------- allocator
+
+
+def test_allocator_refcounts():
+    a = PageAllocator(4)
+    pages = a.alloc(2)
+    assert a.free_pages == 2
+    a.retain(pages)
+    a.release(pages)  # one of two refs dropped: still live
+    assert a.free_pages == 2
+    a.release(pages)  # last ref: freed
+    assert a.free_pages == 4
+
+
+def test_cache_pin_and_evict():
+    a = PageAllocator(8)
+    c = PagePrefixCache(chunk=4, allocator=a, max_entries=2)
+    p1 = a.alloc(2)
+    c.store(list(range(9)), p1)  # strict prefix = 2 chunks -> pins both
+    a.release(p1)  # request completes; cache still pins them
+    assert a.free_pages == 6
+    m, shared = c.lookup(list(range(9)))
+    assert m == 8 and shared == p1 and c.hits == 1
+    a.release(shared)  # the sharer completes
+    assert c.evict_one()
+    assert a.free_pages == 8  # eviction dropped the last refs
+    assert not c.evict_one()
+
+
+# ------------------------------------------------------------- engine
+
+
+def test_hit_skips_prefill_and_output_is_identical():
+    eng = engine()
+    calls = {"n": 0}
+    real = eng._paged_prefill
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    eng._paged_prefill = counting
+    r1 = eng.submit(PROMPT, max_new=6)
+    eng.drain()
+    cold_calls = calls["n"]
+    assert cold_calls == 3  # 2 full chunks + tail
+    r2 = eng.submit(PROMPT, max_new=6)
+    eng.drain()
+    assert calls["n"] - cold_calls == 1  # only the tail chunk ran
+    assert r2.output == r1.output  # shared pages: bit-identical reads
+    pc = eng.prefix_cache
+    assert pc.hits == 1 and pc.saved_tokens == 16
+    assert pc.entries == 1
+
+
+def test_shared_pages_freed_only_after_last_user():
+    eng = engine()
+    free0 = eng.allocator.free_pages
+    r1 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    # Request done; the cache still pins the 2 prefix pages.
+    assert eng.allocator.free_pages == free0 - 2
+    r2 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    assert eng.allocator.free_pages == free0 - 2
+    while eng.prefix_cache.evict_one():
+        pass
+    assert eng.allocator.free_pages == free0  # pool fully reclaimed
+
+
+def test_pool_pressure_evicts_instead_of_deadlocking():
+    # Pool sized so a second distinct prompt CANNOT be admitted while
+    # the first prompt's prefix stays pinned: 5 = trash(1) + 4 usable;
+    # each request reserves 3 pages and its cached prefix pins 2, so
+    # admitting p2 (3 pages, 2 free) forces eviction of p1's entry.
+    eng = engine(pool_pages=5, slots=1)
+    p1 = list(range(1, 21))
+    p2 = list(range(40, 60))
+    eng.submit(p1, max_new=4)
+    eng.drain()
+    assert eng.prefix_cache.entries == 1
+    r = eng.submit(p2, max_new=4)
+    eng.drain()
+    assert r.done.is_set() and len(r.output) == 5  # 1 prefill + 4 decoded
+    # The first prefix was evicted to make room, then p2's was pinned.
+    assert eng.prefix_cache.entries == 1
+
+
+def test_blocked_queue_head_does_not_inflate_counters():
+    """A queued request re-probed every step while waiting for pages
+    must not pump the hit/miss counters (each failed admission rolls
+    its lookup back)."""
+    eng = engine(pool_pages=5, slots=2)
+    a = eng.submit(list(range(1, 21)), max_new=8)   # reserves all 4 pages
+    b = eng.submit(list(range(40, 60)), max_new=4)  # blocked on pages
+    eng.drain()
+    assert a.done.is_set() and b.done.is_set()
+    # Exactly two ADMITTED lookups happened (one per request, both
+    # misses); the blocked re-probes left no trace.
+    assert eng.prefix_cache.misses == 2
+    assert eng.prefix_cache.hits == 0
+
+
+def test_concurrent_sharers_and_metrics():
+    eng = engine()
+    r1 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    # Two live sharers at once (2 slots), both hitting the same entry.
+    r2 = eng.submit(PROMPT, max_new=4)
+    r3 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    assert r2.output == r1.output == r3.output
+    d = distill_serving_metrics(eng.metrics_text())
+    assert d.get("prefix_hits") == 2 or eng.prefix_cache.hits == 2
+    assert eng.prefix_cache.resident_bytes() > 0
+
+
+def test_int8_kv_composes_with_paged_prefix():
+    eng = engine(kv_dtype="int8", decode_block=2)
+    r1 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    r2 = eng.submit(PROMPT, max_new=4)
+    eng.drain()
+    assert eng.prefix_cache.hits == 1
+    assert r2.output == r1.output
+
+
+def test_dense_prefix_cache_still_dense():
+    from tpumon.loadgen.prefix_cache import PrefixCache
+
+    eng = ServingEngine(ServeConfig(model=SMALL, slots=2, prefill_len=8,
+                                    prefix_cache_entries=4))
+    assert isinstance(eng.prefix_cache, PrefixCache)

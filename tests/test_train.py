@@ -119,3 +119,59 @@ def test_serving_engine_adopts_checkpoint_config(tmp_path):
     engine = ServingEngine(ckpt_dir=d)
     assert engine.cfg.model == MODEL
     assert engine.ckpt_step == 1
+
+
+def test_run_train_sp_mode():
+    """--parallel sp: the trainer runs the sequence-parallel step over
+    the full virtual mesh (zigzag schedule) and the loss is finite."""
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.train import TrainConfig, run_train
+
+    import jax
+    import pytest as _pytest
+
+    n = len(jax.devices())
+    if n < 2:
+        _pytest.skip("sp mode refuses single-device (by design)")
+    seq = 2 * n * 2 + 1  # seq-1 divisible by 2n
+    cfg = TrainConfig(
+        model=ModelConfig(vocab=128, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=2, d_ff=64, max_seq=seq),
+        steps=2, batch=2, seq=seq, parallel="sp")
+    out = run_train(cfg)
+    assert out["step"] == 1 and out["loss"] is not None
+    import numpy as np
+
+    assert np.isfinite(out["loss"])
+
+
+def test_train_config_rejects_unknown_parallel():
+    import pytest as _pytest
+
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.train import TrainConfig
+
+    with _pytest.raises(ValueError, match="parallel"):
+        TrainConfig(model=ModelConfig(), parallel="pp")
+
+
+def test_run_train_sp_rejects_single_device_and_indivisible_seq():
+    import jax
+    import pytest as _pytest
+
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.train import TrainConfig, run_train
+
+    if len(jax.devices()) < 2:
+        # The refusal IS the contract on a 1-device host: sp must
+        # never silently fall back to the dense step.
+        with _pytest.raises(ValueError, match="device"):
+            run_train(TrainConfig(model=ModelConfig(), steps=1,
+                                  parallel="sp"))
+        return
+    cfg = TrainConfig(
+        model=ModelConfig(vocab=128, d_model=32, n_layers=1, n_heads=4,
+                          n_kv_heads=2, d_ff=64, max_seq=64),
+        steps=1, batch=1, seq=30, parallel="sp")
+    with _pytest.raises(ValueError, match="divisible"):
+        run_train(cfg)

@@ -29,9 +29,11 @@ TPU-first design:
   no extra page is needed for it): the hot loop never allocates, and a
   mid-decode out-of-pages state cannot exist.
 
-Composes with int8 weights, sampling, and streaming; speculative
-decoding and prefix caching currently require dense mode (their cache
-surgery assumes contiguous rows) and are rejected at engine init.
+Composes with int8 weights/KV, sampling, streaming, and prefix caching
+(``PagePrefixCache`` below — pages of a cached prompt prefix are SHARED
+into new requests' tables, refcounted, zero-copy); speculative decoding
+currently requires dense mode (the draft cache surgery assumes
+contiguous rows) and is rejected at engine init.
 """
 
 from __future__ import annotations
@@ -46,27 +48,51 @@ from jax import lax
 
 @dataclass
 class PageAllocator:
-    """Host-side free-list allocator over the shared pool."""
+    """Host-side refcounted free-list allocator over the shared pool.
+
+    Pages are refcounted so the paged prefix cache can SHARE a cached
+    prompt prefix's pages across requests (and pin them itself): alloc
+    gives each page one reference, ``retain`` adds one per additional
+    user, and ``release`` only returns a page to the free list when its
+    last reference drops. Plain alloc/release pairs behave exactly as
+    the unrefcounted r03 allocator did.
+    """
 
     num_pages: int
     _free: list[int] = field(default_factory=list)
+    _refs: dict = field(default_factory=dict)
 
     def __post_init__(self):
         self._free = list(range(self.num_pages - 1, -1, -1))
+        self._refs = {}
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int) -> list[int] | None:
-        """n pages, or None (and no change) if not enough are free."""
+        """n fresh pages (refcount 1 each), or None if not enough free."""
         if n > len(self._free):
             return None
         taken = [self._free.pop() for _ in range(n)]
+        for pg in taken:
+            self._refs[pg] = 1
         return taken
 
+    def retain(self, pages: list[int]) -> None:
+        """Add a reference per page (a new sharer)."""
+        for pg in pages:
+            self._refs[pg] += 1
+
     def release(self, pages: list[int]) -> None:
-        self._free.extend(pages)
+        """Drop a reference per page; last reference frees the page."""
+        for pg in pages:
+            left = self._refs[pg] - 1
+            if left:
+                self._refs[pg] = left
+            else:
+                del self._refs[pg]
+                self._free.append(pg)
 
 
 def init_pool(cfg, num_pages: int) -> dict:
@@ -226,3 +252,92 @@ def paged_decode_rounds(cfg, params: dict, pool: dict,
     (pool, last, pos, _), toks = lax.scan(
         body, (pool, last_tokens, positions, ctr0), None, length=steps)
     return pool, last, pos, toks.T
+
+
+class PagePrefixCache:
+    """Prefix caching for the paged layout: share pages, copy nothing.
+
+    The dense prefix cache (tpumon.loadgen.prefix_cache) snapshots a
+    prompt prefix's K/V rows and restores them with an HBM copy. Paged
+    mode does strictly better: because page == prefill chunk, a
+    chunk-aligned prompt prefix IS a whole number of pages, so a later
+    prompt sharing the prefix just points its page table at the SAME
+    pages (vLLM-style sharing) — zero HBM traffic, prefill elided for
+    every shared chunk. The allocator's refcounts keep a shared page
+    alive until its last user (cache entry or live request) drops it.
+
+    Entries are keyed by the exact token tuple of the chunk-aligned
+    STRICT prefix (the chunk holding the prompt's last token is always
+    recomputed, so prefill still yields first-token logits — same
+    contract as the dense cache). Bounded LRU; ``evict_one`` lets the
+    engine reclaim pinned pages under pool pressure instead of
+    deadlocking admission.
+    """
+
+    def __init__(self, chunk: int, allocator: PageAllocator,
+                 max_entries: int = 16):
+        from collections import OrderedDict
+
+        self.chunk = chunk
+        self.allocator = allocator
+        self.max_entries = max_entries
+        self._store: "OrderedDict[tuple, list[int]]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.saved_tokens = 0
+        self.page_bytes = 0  # set by the engine (pool row bytes / page)
+
+    def lookup(self, prompt: list[int]) -> tuple[int, list[int]]:
+        """(prefix_len, shared_pages) for the longest cached
+        chunk-aligned strict prefix; retains the pages for the caller
+        (who must release them — normally at request completion).
+        (0, []) on miss."""
+        n = len(prompt)
+        m = ((n - 1) // self.chunk) * self.chunk
+        while m >= self.chunk:
+            key = tuple(prompt[:m])
+            pages = self._store.get(key)
+            if pages is not None:
+                self._store.move_to_end(key)
+                self.allocator.retain(pages)
+                self.hits += 1
+                self.saved_tokens += m
+                return m, list(pages)
+            m -= self.chunk
+        self.misses += 1
+        return 0, []
+
+    def store(self, prompt: list[int], pages: list[int]) -> None:
+        """Pin the chunk-aligned strict prefix's pages (``pages`` is
+        the request's full page list, one page per prefill chunk first).
+        No-op if already cached or shorter than one chunk."""
+        n = len(prompt)
+        m = ((n - 1) // self.chunk) * self.chunk
+        if m < self.chunk:
+            return
+        key = tuple(prompt[:m])
+        if key in self._store:
+            self._store.move_to_end(key)
+            return
+        pinned = pages[: m // self.chunk]
+        self.allocator.retain(pinned)
+        self._store[key] = list(pinned)
+        while len(self._store) > self.max_entries:
+            self.evict_one()
+
+    def evict_one(self) -> bool:
+        """Drop the LRU entry (its pages free once no live request
+        shares them); False when empty."""
+        if not self._store:
+            return False
+        _, pages = self._store.popitem(last=False)
+        self.allocator.release(pages)
+        return True
+
+    @property
+    def entries(self) -> int:
+        return len(self._store)
+
+    def resident_bytes(self) -> int:
+        pinned = {pg for pages in self._store.values() for pg in pages}
+        return len(pinned) * self.page_bytes

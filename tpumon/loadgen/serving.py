@@ -527,12 +527,15 @@ class ServingEngine:
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
         if self.cfg.kv_dtype == "int8" and (
-                mesh is not None
-                or self.cfg.spec_len or self.cfg.prefix_cache_entries):
+                mesh is not None or self.cfg.spec_len
+                or (self.cfg.prefix_cache_entries
+                    and self.cfg.kv_layout != "paged")):
             raise ValueError(
                 "kv_dtype='int8' currently composes with the dense and "
-                "paged single-device engine (with decode_block and int8 "
-                "weights) only")
+                "paged single-device engine (with decode_block, int8 "
+                "weights, and paged prefix caching) only — not with "
+                "speculative decoding, a mesh, or the dense prefix "
+                "cache")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -661,20 +664,20 @@ class ServingEngine:
         self.spec_proposed_total = 0
         self.spec_accepted_total = 0
         self.prefix_cache = None
-        if self.cfg.prefix_cache_entries:
+        self.paged = self.cfg.kv_layout == "paged"
+        if self.cfg.prefix_cache_entries and not self.paged:
             from tpumon.loadgen.prefix_cache import PrefixCache
 
             self.prefix_cache = PrefixCache(
                 chunk=self.cfg.prefill_len,
                 max_entries=self.cfg.prefix_cache_entries)
         # Paged KV mode (tpumon.loadgen.paged_kv).
-        self.paged = self.cfg.kv_layout == "paged"
         if self.paged:
-            if self.spec_len or self.prefix_cache is not None:
+            if self.spec_len:
                 raise ValueError(
                     "paged KV mode does not compose with speculative "
-                    "decoding or prefix caching yet (their cache surgery "
-                    "assumes contiguous dense rows)")
+                    "decoding yet (the draft cache surgery assumes "
+                    "contiguous dense rows)")
             from tpumon.loadgen.paged_kv import (
                 PageAllocator,
                 init_pool,
@@ -695,6 +698,20 @@ class ServingEngine:
             # never corrupt pages reallocated to live requests.
             trash = self.allocator.alloc(1)
             assert trash == [0]
+            if self.cfg.prefix_cache_entries:
+                # Paged prefix caching: page == prefill chunk, so a
+                # cached prefix is shared by POINTING new requests'
+                # tables at the same pages — no HBM copy at all (the
+                # dense cache's restore is a copy). Exposes the same
+                # counter surface as the dense PrefixCache, so the
+                # /metrics block below serves both unchanged.
+                from tpumon.loadgen.paged_kv import PagePrefixCache
+
+                self.prefix_cache = PagePrefixCache(
+                    chunk=p, allocator=self.allocator,
+                    max_entries=self.cfg.prefix_cache_entries)
+                self.prefix_cache.page_bytes = sum(
+                    v.nbytes for v in self.pool.values()) // pool_pages
             self._slot_pages: list[list[int]] = [
                 [] for _ in range(self.cfg.slots)]
             self._tables_host = [
@@ -827,17 +844,43 @@ class ServingEngine:
             if self._slots[slot] is not None:
                 continue
             pages: list[int] | None = None
+            shared_n = 0
             with self._lock:
                 if not self._queue:
                     return
                 if self.paged:
-                    # Reserve the request's worst-case pages before
-                    # admission; exhaustion blocks the queue head (KV
-                    # memory backpressure, head-of-line to stay FIFO).
-                    pages = self.allocator.alloc(
-                        self._pages_needed(self._queue[0]))
+                    # Prefix hit: point this request's table at the
+                    # cached prefix's pages (lookup retains them) and
+                    # reserve only the remainder. Reservation before
+                    # admission; exhaustion first evicts cache entries
+                    # (their pinned pages are reclaimable capacity),
+                    # then blocks the queue head (KV memory
+                    # backpressure, head-of-line to stay FIFO).
+                    shared: list[int] = []
+                    if self.prefix_cache is not None:
+                        _, shared = self.prefix_cache.lookup(
+                            self._queue[0].prompt)
+                    shared_n = len(shared)
+                    need = self._pages_needed(self._queue[0]) - shared_n
+                    pages = self.allocator.alloc(need)
+                    while pages is None and (
+                            self.prefix_cache is not None
+                            and self.prefix_cache.evict_one()):
+                        pages = self.allocator.alloc(need)
                     if pages is None:
+                        # The admission didn't happen; roll back the
+                        # lookup's counters — a blocked queue head is
+                        # re-probed every step and must not inflate
+                        # hit/miss totals into meaninglessness.
+                        if shared:
+                            self.allocator.release(shared)
+                            self.prefix_cache.hits -= 1
+                            self.prefix_cache.saved_tokens -= (
+                                shared_n * self.cfg.prefill_len)
+                        elif self.prefix_cache is not None:
+                            self.prefix_cache.misses -= 1
                         return
+                    pages = shared + pages
                 req = self._queue.popleft()
             n = len(req.prompt)
             p = self.cfg.prefill_len
@@ -849,12 +892,18 @@ class ServingEngine:
                 self._tables_dirty = True
                 table_row = jnp.asarray(trow, jnp.int32)
                 for ci, c0 in enumerate(range(0, n, p)):
+                    if ci < shared_n:
+                        continue  # chunk served from shared pages
                     chunk = req.prompt[c0:c0 + p]
                     ln = len(chunk)
                     toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
                     self.pool, logits = self._paged_prefill(
                         self.params, self.pool, toks, jnp.int32(ln),
                         jnp.int32(pages[ci]), table_row, jnp.int32(c0))
+                if self.prefix_cache is not None:
+                    # Pin this prompt's chunk-aligned strict prefix for
+                    # later sharers (no-op if already cached).
+                    self.prefix_cache.store(req.prompt, pages)
                 self._after_prefill(slot, req, n, logits)
                 continue
             # Prefix cache: restore a previously-computed chunk-aligned

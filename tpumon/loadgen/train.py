@@ -47,6 +47,16 @@ class TrainConfig:
     ckpt_dir: str | None = None
     ckpt_every: int = 20
     seed: int = 0
+    # "auto": dp×tp over the local devices (single-device when alone).
+    # "sp" / "sp-ring": sequence parallelism over a 1-D "seq" mesh of
+    # all local devices — activations sequence-sharded through zigzag
+    # (sp) or plain ring (sp-ring) attention (loadgen.sp_train); needs
+    # seq-1 divisible by 2×devices (sp) / devices (sp-ring).
+    parallel: str = "auto"
+
+    def __post_init__(self) -> None:
+        if self.parallel not in ("auto", "sp", "sp-ring"):
+            raise ValueError(f"unknown parallel mode {self.parallel!r}")
 
 
 def _default_mesh() -> Mesh | None:
@@ -275,11 +285,47 @@ def run_train(
     reporter=None,
 ) -> dict:
     """Run (or resume) the loop; returns {step, loss, resumed_from, ...}."""
-    if mesh is None:
+    if cfg.parallel != "auto":
+        # Explicit over silent: a 1-device host "running sp" would
+        # really be running the dense step, misattributing every number
+        # it produces; and a caller-provided dp×tp mesh can't carry the
+        # sp step (it builds its own 1-D seq mesh).
+        if len(jax.devices()) < 2:
+            raise ValueError(
+                f"parallel={cfg.parallel!r} needs >1 device "
+                f"(have {len(jax.devices())})")
+        if mesh is not None:
+            raise ValueError(
+                "pass either mesh= or parallel=; the sp modes build "
+                "their own 1-D 'seq' mesh over all local devices")
+    if mesh is None and cfg.parallel == "auto":
         mesh = _default_mesh()
     params = init_params(cfg.model, jax.random.PRNGKey(cfg.seed))
 
-    if mesh is not None:
+    if cfg.parallel != "auto":
+        # Sequence parallelism: 1-D "seq" mesh over all local devices;
+        # each synthetic [B, seq] batch trains on seq-1 tokens, so the
+        # shardable length is seq-1.
+        from tpumon.loadgen.sp_train import make_sp_train_step
+
+        n = len(jax.devices())
+        need = 2 * n if cfg.parallel == "sp" else n
+        if (cfg.seq - 1) % need:
+            raise ValueError(
+                f"parallel={cfg.parallel!r} over {n} devices needs "
+                f"seq-1 divisible by {need} (got seq={cfg.seq})")
+        sp_mesh = Mesh(np.array(jax.devices()), ("seq",))
+        schedule = "zigzag" if cfg.parallel == "sp" else "ring"
+        sp_step, placed = make_sp_train_step(
+            cfg.model, sp_mesh, params, schedule=schedule, lr=cfg.lr)
+
+        def step_fn(p, tokens):
+            return sp_step(p, *sp_step.prep(tokens))
+
+        mesh = sp_mesh
+        token_sharding = None  # prep shards per-array via in_shardings
+        like = params  # replicated
+    elif mesh is not None:
         step_fn, placed = make_sharded_train_step(cfg.model, mesh, params)
         token_sharding = NamedSharding(mesh, P("data", None))
         like = jax.tree.map(
@@ -397,6 +443,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--attn-block", type=int, default=512,
                     help="K/V block rows for --attention chunked")
+    ap.add_argument(
+        "--parallel", choices=["auto", "sp", "sp-ring"], default="auto",
+        help="'auto': dp×tp over local devices; 'sp'/'sp-ring': "
+        "sequence parallelism through zigzag/plain ring attention "
+        "(long-context mode; needs seq-1 divisible by 2×devices / "
+        "devices)")
     ap.add_argument("--no-report", action="store_true",
                     help="disable the workload self-report (HBM "
                          "footprint + activity to the monitor's "
@@ -411,6 +463,7 @@ def main(argv: list[str] | None = None) -> int:
         ),
         steps=args.steps, batch=args.batch, seq=args.seq,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+        parallel=args.parallel,
     )
     metrics = httpd = None
     if args.metrics_port is not None:
