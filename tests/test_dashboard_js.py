@@ -392,9 +392,10 @@ SERVING = {
         {"ok": True, "ttft_p50_ms": 100.0, "ttft_p99_ms": 300.0,
          "tokens_per_sec": 1000.0, "requests_per_sec": 2.5, "queue_depth": 3.0,
          "weight_bytes": 3.0 * 2**30, "spec_accept_pct": 80.0,
-         "kv_pages_used_pct": 40.0},
+         "prefix_hit_pct": 50.0, "kv_pages_used_pct": 40.0},
         {"ok": True, "ttft_p50_ms": 200.0, "tokens_per_sec": 500.0,
-         "spec_accept_pct": 90.0, "kv_pages_used_pct": 70.0,
+         "spec_accept_pct": 90.0, "prefix_hit_pct": 90.0,
+         "kv_pages_used_pct": 70.0,
          "train_step": 100.0, "train_loss": 2.345, "train_step_time_ms": 150.0,
          "train_tokens_per_sec": 50000.0, "train_goodput_pct": 95.0,
          "train_mfu_pct": 45.0, "train_ckpt_step": 90.0},
@@ -413,6 +414,7 @@ def test_serving_aggregation_semantics(js):
     assert doc.el("sv-tps")["textContent"] == "1500.0"
     assert doc.el("sv-wb")["textContent"] == "3.00 GiB"
     assert doc.el("sv-spec")["textContent"] == "85.0%"
+    assert doc.el("sv-prefix")["textContent"] == "70.0%"
     # KV pool: max across targets (the tightest pool).
     assert doc.el("sv-kv")["textContent"] == "70%"
     # Training panel from the one target exporting train_* families.

@@ -74,6 +74,29 @@ def test_distill_spec_acceptance():
         JETSTREAM_TEXT, now=1000.0)
 
 
+def test_distill_prefix_hit_rate():
+    def pfx_text(hits, misses):
+        return JETSTREAM_TEXT + (
+            "# TYPE tpumon_serving_prefix_hits counter\n"
+            f"tpumon_serving_prefix_hits {hits}\n"
+            "# TYPE tpumon_serving_prefix_misses counter\n"
+            f"tpumon_serving_prefix_misses {misses}\n"
+        )
+
+    # First scrape: lifetime ratio.
+    d = distill_serving_metrics(pfx_text(30, 10), now=1000.0)
+    assert d["prefix_hit_pct"] == 75.0
+    # Windowed: +10 hits, +30 misses since last scrape -> 25%.
+    d2 = distill_serving_metrics(pfx_text(40, 40), prev=d, now=1010.0)
+    assert d2["prefix_hit_pct"] == 25.0
+    # Idle window: omitted, not stale-repeated.
+    d3 = distill_serving_metrics(pfx_text(40, 40), prev=d2, now=1020.0)
+    assert "prefix_hit_pct" not in d3
+    # No prefix counters exported at all: no field.
+    assert "prefix_hit_pct" not in distill_serving_metrics(
+        JETSTREAM_TEXT, now=1000.0)
+
+
 def test_counter_rates_between_scrapes():
     prev = distill_serving_metrics(JETSTREAM_TEXT, now=1000.0)
     later = JETSTREAM_TEXT.replace("50000", "53000").replace("420", "440")

@@ -98,7 +98,7 @@ SERVING = {
          "ttft_p99_ms": 118.0, "tokens_per_sec": 4130.0,
          "requests_per_sec": 12.4, "queue_depth": 3.0,
          "weight_bytes": 35.0 * 2**30, "spec_accept_pct": 74.0,
-         "kv_pages_used_pct": 61.0},
+         "prefix_hit_pct": 68.0, "kv_pages_used_pct": 61.0},
         {"ok": True, "target": "llama70b-train-0:9100",
          "train_step": 18423.0, "train_loss": 1.932,
          "train_step_time_ms": 412.0, "train_tokens_per_sec": 39800.0,
@@ -334,20 +334,28 @@ def render() -> str:
                 p.text(fx, fy + 15, doc.el(fid)["textContent"],
                        size=13, weight=600)
 
-        p.card(pad, y, sw, 96, "Serving",
+        sv_fields = [("TTFT p50", "sv-ttft"), ("TTFT p99", "sv-ttft99"),
+                     ("tokens/s", "sv-tps"), ("req/s", "sv-rps"),
+                     ("queue", "sv-q"), ("weights", "sv-wb"),
+                     ("spec accept", "sv-spec"),
+                     ("prefix hits", "sv-prefix"), ("KV pool", "sv-kv")]
+        tr_fields = [("step", "tr-step"), ("loss", "tr-loss"),
+                     ("step time", "tr-dt"), ("tokens/s", "tr-tps"),
+                     ("goodput", "tr-gp"), ("MFU", "tr-mfu")]
+
+        def grid_h(fields):  # chrome + 34px per 4-wide row + descender
+            return 34 + 34 * (-(-len(fields) // 4))
+
+        panel_h = max(grid_h(sv_fields), grid_h(tr_fields))
+        p.card(pad, y, sw, panel_h, "Serving",
                tag=doc.el("serving-tag")["textContent"])
-        stat_grid(pad, [("TTFT p50", "sv-ttft"), ("TTFT p99", "sv-ttft99"),
-                        ("tokens/s", "sv-tps"), ("req/s", "sv-rps"),
-                        ("queue", "sv-q"), ("weights", "sv-wb"),
-                        ("spec accept", "sv-spec"), ("KV pool", "sv-kv")])
+        stat_grid(pad, sv_fields)
         if doc.el("train-card")["style"].get("display") != "none":
             tx = 2 * pad + sw
-            p.card(tx, y, sw, 96, "Training",
+            p.card(tx, y, sw, panel_h, "Training",
                    tag=doc.el("train-tag")["textContent"])
-            stat_grid(tx, [("step", "tr-step"), ("loss", "tr-loss"),
-                           ("step time", "tr-dt"), ("tokens/s", "tr-tps"),
-                           ("goodput", "tr-gp"), ("MFU", "tr-mfu")])
-        y += 96 + pad
+            stat_grid(tx, tr_fields)
+        y += panel_h + pad
 
     # ---- pods table (fetchPods built the rows) ----
     prow = doc.el("pods-body")["_children"]

@@ -164,6 +164,22 @@ def distill_serving_metrics(
                 out["spec_accept_pct"] = 100.0 * da / dp
         elif spec_prop[1] > 0:
             out["spec_accept_pct"] = 100.0 * spec_acc[1] / spec_prop[1]
+    # Prefix-cache hit rate (tpumon.loadgen.prefix_cache / the paged
+    # page-sharing cache): windowed like spec acceptance — the value
+    # tracks CURRENT traffic, not the lifetime average.
+    pf_hits = _sum_samples(by_name, ("tpumon_serving_prefix_hits",))
+    pf_miss = _sum_samples(by_name, ("tpumon_serving_prefix_misses",))
+    if pf_hits and pf_miss:
+        out["prefix_hits_total"] = pf_hits[1]
+        out["prefix_misses_total"] = pf_miss[1]
+        if prev and "prefix_hits_total" in prev:
+            dh = pf_hits[1] - prev["prefix_hits_total"]
+            dm = pf_miss[1] - prev["prefix_misses_total"]
+            if dh >= 0 and dm >= 0 and dh + dm > 0:
+                out["prefix_hit_pct"] = 100.0 * dh / (dh + dm)
+        elif pf_hits[1] + pf_miss[1] > 0:
+            out["prefix_hit_pct"] = (
+                100.0 * pf_hits[1] / (pf_hits[1] + pf_miss[1]))
     # Paged KV pool occupancy (tpumon.loadgen.paged_kv): reserved pages
     # over the pool — the engine's KV-memory pressure signal.
     pg_total = _sum_samples(by_name, ("tpumon_serving_kv_pages_total",))

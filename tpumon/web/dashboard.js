@@ -479,6 +479,10 @@ function makeDashboard(doc, net, env, mkSurface) {
       const specVals = ok.map(t => t.spec_accept_pct).filter(v => v != null);
       $("sv-spec").textContent = specVals.length
         ? (agg(specVals, true)).toFixed(1) + "%" : "–";
+      // Prefix-cache hit rate (avg across targets exporting it).
+      const pfxVals = ok.map(t => t.prefix_hit_pct).filter(v => v != null);
+      $("sv-prefix").textContent = pfxVals.length
+        ? (agg(pfxVals, true)).toFixed(1) + "%" : "–";
       // Paged KV pool occupancy (max across targets: the tightest pool).
       const kvVals = ok.map(t => t.kv_pages_used_pct).filter(v => v != null);
       $("sv-kv").textContent = kvVals.length
