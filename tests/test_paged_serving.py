@@ -132,11 +132,10 @@ class TestPagedEngine:
         assert "tpumon_serving_kv_pages_total 8" in text
         assert "tpumon_serving_kv_pages_free 8" in text
 
-    def test_rejects_spec_composition(self):
-        with pytest.raises(ValueError, match="paged"):
-            make_engine("paged", spec_len=2)
-        # prefix caching DOES compose with paged KV since r04
-        # (tests/test_paged_prefix.py covers the page-sharing path).
+    def test_rejects_unknown_layout(self):
+        # Speculative decoding and prefix caching both compose with
+        # paged KV since r04 (tests/test_paged_prefix.py executes the
+        # page-sharing and paged-verify paths).
         with pytest.raises(ValueError, match="kv_layout"):
             make_engine("diagonal")
 

@@ -250,8 +250,16 @@ def test_start_background_engine_option_passthrough():
     finally:
         stop2.set()
 
+    # paged + spec compose since r04 (paged_kv.paged_decode_block).
+    engine3, _, stop3 = start_background(
+        rps=0.0, kv_layout="paged", spec_len=2)
+    try:
+        assert engine3.paged and engine3.spec_len == 2
+    finally:
+        stop3.set()
+    # int8 KV + spec remains rejected.
     with pytest.raises(ValueError):
-        start_background(rps=0.0, kv_layout="paged", spec_len=2)
+        start_background(rps=0.0, kv_dtype="int8", spec_len=2)
 
 
 def test_pool_pages_requires_paged_layout():

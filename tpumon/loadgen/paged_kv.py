@@ -29,11 +29,11 @@ TPU-first design:
   no extra page is needed for it): the hot loop never allocates, and a
   mid-decode out-of-pages state cannot exist.
 
-Composes with int8 weights/KV, sampling, streaming, and prefix caching
+Composes with int8 weights/KV, sampling, streaming, prefix caching
 (``PagePrefixCache`` below — pages of a cached prompt prefix are SHARED
-into new requests' tables, refcounted, zero-copy); speculative decoding
-currently requires dense mode (the draft cache surgery assumes
-contiguous rows) and is rejected at engine init.
+into new requests' tables, refcounted, zero-copy), and speculative
+decoding (``paged_decode_block`` is the verify step over the pool; the
+shallow draft keeps its own dense cache).
 """
 
 from __future__ import annotations
@@ -225,6 +225,72 @@ def paged_decode_step(cfg, params: dict, pool: dict,
     x = decoder_forward(cfg, params, last_tokens[:, None], pos, mask,
                         kv_update)
     logits = (x[:, 0] @ params["lm_head"].astype(dt)).astype(jnp.float32)
+    return pool, logits
+
+
+def paged_decode_block(cfg, params: dict, pool: dict, tokens: jax.Array,
+                       positions: jax.Array, tables: jax.Array
+                       ) -> tuple[dict, jax.Array]:
+    """Advance every slot ``T`` tokens in one dispatch over the paged
+    pool — the paged twin of ``speculative.decode_block`` (the engine's
+    speculative VERIFY step). tokens: [B, T] (tokens[:, 0] is the feed
+    token at row ``positions``); returns (pool, logits [B, T, vocab])
+    where logits[:, t] predicts the token at row positions + t + 1.
+
+    Each of the T tokens' K/V scatters to its own (page, offset) via
+    the slot's table, so a block may span a page boundary; overshooting
+    a request's reserved rows lands on the trash page (same guard as
+    paged_decode_rounds), and rejected draft rows are simply
+    overwritten by later true tokens — identical rollback semantics to
+    the dense verify.
+    """
+    m = cfg.model
+    ps = cfg.prefill_len
+    dt = jnp.dtype(m.compute_dtype)
+    nkv, hd = m.n_kv_heads, m.head_dim
+    b, t_blk = tokens.shape
+    max_pages = tables.shape[1]
+    s_max = max_pages * ps
+
+    from tpumon.loadgen.serving import decoder_forward
+
+    pos = positions[:, None] + jnp.arange(t_blk, dtype=jnp.int32)[None]
+    pos = jnp.minimum(pos, s_max - 1)  # [B, T]
+    page = jnp.take_along_axis(tables, pos // ps, axis=1)  # [B, T]
+    off = pos % ps
+    row = jnp.arange(s_max, dtype=jnp.int32)
+    # Prior context plus causal order within the block (decode_block's
+    # frontier rule).
+    mask = (row[None, None] <= pos[:, :, None])[:, None]  # [B, 1, T, S]
+
+    def kv_update(li, k, v):  # k/v: [B, T, nkv, hd]
+        quant = "ks" in pool  # int8 pool layout (init_pool)
+        from tpumon.loadgen.serving import _kv_dequant, _kv_quant
+
+        for name, sname, new in (("k", "ks", k), ("v", "vs", v)):
+            scale = None
+            if quant:
+                new, scale = _kv_quant(new)  # scale: [B, T, nkv]
+            # One batched scatter per block position (T is small —
+            # spec_len+1); same mixed basic/advanced indexing as
+            # paged_decode_step, value [B, nkv, ...] batch-first.
+            for tt in range(t_blk):
+                if quant:
+                    pool[sname] = pool[sname].at[
+                        li, :, page[:, tt], off[:, tt]].set(scale[:, tt])
+                pool[name] = pool[name].at[
+                    li, :, page[:, tt], off[:, tt]].set(new[:, tt])
+        ck = pool["k"][li][:, tables]  # [nkv, B, max_pages, ps, hd]
+        cv = pool["v"][li][:, tables]
+        if quant:
+            ck = _kv_dequant(ck, pool["ks"][li][:, tables], k.dtype)
+            cv = _kv_dequant(cv, pool["vs"][li][:, tables], v.dtype)
+        ck = ck.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
+        cv = cv.reshape(nkv, b, s_max, hd).transpose(1, 2, 0, 3)
+        return ck, cv  # [B, S, nkv, hd]
+
+    x = decoder_forward(cfg, params, tokens, pos, mask, kv_update)
+    logits = (x @ params["lm_head"].astype(dt)).astype(jnp.float32)
     return pool, logits
 
 

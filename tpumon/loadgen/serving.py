@@ -64,16 +64,21 @@ class ServeConfig:
     # (tpumon.loadgen.quant — halves decode's HBM weight traffic vs bf16).
     quantize: str | None = None
     # Speculative decoding (tpumon.loadgen.speculative): propose spec_len
-    # draft tokens per round, verify them in one target dispatch. 0 = off.
-    # draft_model None = self-speculation (draft shares target weights —
-    # 100% acceptance; the correctness/demo mode). Greedy output matches
-    # plain decode regardless of draft quality (see
-    # tpumon.loadgen.speculative on bf16 argmax near-ties).
+    # draft tokens per round, verify them in one target dispatch (over
+    # the dense cache or the paged pool — paged_kv.paged_decode_block).
+    # 0 = off. draft_model None = self-speculation (draft shares target
+    # weights — 100% acceptance; the correctness/demo mode); a
+    # layer-truncated draft_model shares the target's bottom layers.
+    # Greedy output matches plain decode regardless of draft quality
+    # (see tpumon.loadgen.speculative on bf16 argmax near-ties).
     spec_len: int = 0
     draft_model: ModelConfig | None = None
-    # Prefix caching (tpumon.loadgen.prefix_cache): LRU entries of
-    # chunk-aligned prompt-prefix K/V; 0 = off. Each entry pins HBM —
-    # the deliberate trade of memory for prefill FLOPs.
+    # Prefix caching: LRU entries of chunk-aligned prompt-prefix K/V;
+    # 0 = off. Dense layout snapshots+restores rows with an HBM copy
+    # (tpumon.loadgen.prefix_cache); paged layout SHARES the prefix's
+    # refcounted pages, zero-copy (paged_kv.PagePrefixCache). Each
+    # entry pins HBM — the deliberate trade of memory for prefill
+    # FLOPs; the paged engine evicts entries under pool pressure.
     prefix_cache_entries: int = 0
     # KV layout: "dense" reserves slots*max_seq rows forever; "paged"
     # (tpumon.loadgen.paged_kv) allocates page_size(=prefill_len) pages
@@ -527,15 +532,15 @@ class ServingEngine:
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
         if self.cfg.kv_dtype == "int8" and (
-                mesh is not None or self.cfg.spec_len
-                or (self.cfg.prefix_cache_entries
+                mesh is not None
+                or ((self.cfg.spec_len or self.cfg.prefix_cache_entries)
                     and self.cfg.kv_layout != "paged")):
             raise ValueError(
-                "kv_dtype='int8' currently composes with the dense and "
-                "paged single-device engine (with decode_block, int8 "
-                "weights, and paged prefix caching) only — not with "
-                "speculative decoding, a mesh, or the dense prefix "
-                "cache")
+                "kv_dtype='int8' composes with the dense engine (with "
+                "decode_block and int8 weights) and the full paged "
+                "engine (incl. prefix caching and speculative "
+                "decoding) — not with a mesh, or with the DENSE "
+                "layout's speculative/prefix cache surgery")
         m = self.cfg.model
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
@@ -673,14 +678,10 @@ class ServingEngine:
                 max_entries=self.cfg.prefix_cache_entries)
         # Paged KV mode (tpumon.loadgen.paged_kv).
         if self.paged:
-            if self.spec_len:
-                raise ValueError(
-                    "paged KV mode does not compose with speculative "
-                    "decoding yet (the draft cache surgery assumes "
-                    "contiguous dense rows)")
             from tpumon.loadgen.paged_kv import (
                 PageAllocator,
                 init_pool,
+                paged_decode_block,
                 paged_decode_step,
                 paged_prefill,
             )
@@ -723,6 +724,14 @@ class ServingEngine:
                 partial(paged_prefill, self.cfg), donate_argnums=(1,))
             self._paged_decode = jax.jit(
                 partial(paged_decode_step, self.cfg), donate_argnums=(1,))
+            if self.spec_len:
+                # Speculative verify over the pool: re-point the verify
+                # jit at the paged twin (same contract — logits[:, t]
+                # predicts row positions+t+1; rejected rows overwritten
+                # by later true tokens, trash page absorbs overshoot).
+                self._verify = jax.jit(
+                    partial(paged_decode_block, self.cfg),
+                    donate_argnums=(1,))
             if self.cfg.decode_block > 1:
                 from tpumon.loadgen.paged_kv import paged_decode_rounds
 
@@ -837,6 +846,27 @@ class ServingEngine:
                 kept.append(r)
         self._queue = kept
 
+    def _sync_tables(self) -> None:
+        """Upload the host page tables when admission changed them."""
+        if self._tables_dirty:
+            self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
+            self._tables_dirty = False
+
+    def _draft_prefill_prompt(self, slot: int, req: "Request") -> None:
+        """Prefill the draft's dense cache with the FULL prompt (the
+        draft cache is unshared, so prefix-shared target chunks still
+        need draft K/V; draft prefill is cheap — the draft is shallow)."""
+        n = len(req.prompt)
+        p = self.cfg.prefill_len
+        for c0 in range(0, n, p):
+            chunk = req.prompt[c0:c0 + p]
+            ln = len(chunk)
+            toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
+            self.draft_cache, _ = self._draft_prefill(
+                self.draft_params, self.draft_cache, toks,
+                jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
+        self._draft_pos[slot] = n
+
     def _admit(self) -> None:
         with self._lock:
             self._purge_cancelled_locked()
@@ -904,6 +934,8 @@ class ServingEngine:
                     # Pin this prompt's chunk-aligned strict prefix for
                     # later sharers (no-op if already cached).
                     self.prefix_cache.store(req.prompt, pages)
+                if self.spec_len:
+                    self._draft_prefill_prompt(slot, req)
                 self._after_prefill(slot, req, n, logits)
                 continue
             # Prefix cache: restore a previously-computed chunk-aligned
@@ -927,16 +959,7 @@ class ServingEngine:
                 self.prefix_cache.store(
                     self.cache, req.prompt, jnp.int32(slot))
             if self.spec_len:
-                # Draft needs the full prompt's K/V (the prefix cache
-                # holds target K/V only — draft prefill is cheap).
-                for c0 in range(0, n, p):
-                    chunk = req.prompt[c0:c0 + p]
-                    ln = len(chunk)
-                    toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
-                    self.draft_cache, _ = self._draft_prefill(
-                        self.draft_params, self.draft_cache, toks,
-                        jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
-                self._draft_pos[slot] = n
+                self._draft_prefill_prompt(slot, req)
             self._after_prefill(slot, req, n, logits)
 
     def _after_prefill(self, slot: int, req: Request, n: int,
@@ -1034,9 +1057,7 @@ class ServingEngine:
             self._block_step(active, n)
             return
         if self.paged:
-            if self._tables_dirty:
-                self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
-                self._tables_dirty = False
+            self._sync_tables()
             self.pool, logits = self._paged_decode(
                 self.params, self.pool, self.last_tokens, self.positions,
                 self._tables_dev)
@@ -1077,9 +1098,7 @@ class ServingEngine:
         the (loop-invariant) page tables; overshoot rows land on
         reserved pages or the trash page."""
         if self.paged:
-            if self._tables_dirty:
-                self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
-                self._tables_dirty = False
+            self._sync_tables()
             self.pool, self.last_tokens, self.positions, toks = (
                 self._decode_rounds(
                     self.params, self.pool, self.last_tokens,
@@ -1174,8 +1193,14 @@ class ServingEngine:
         proposed = jnp.stack(drafts, axis=1)  # [B, g]
         ver_in = jnp.concatenate(
             [self.last_tokens[:, None], proposed], axis=1)  # [B, g+1]
-        self.cache, vlogits = self._verify(
-            self.params, self.cache, ver_in, self.positions)
+        if self.paged:
+            self._sync_tables()
+            self.pool, vlogits = self._verify(
+                self.params, self.pool, ver_in, self.positions,
+                self._tables_dev)
+        else:
+            self.cache, vlogits = self._verify(
+                self.params, self.cache, ver_in, self.positions)
         tgt = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # [B, g+1]
         # The sampling dispatch (full-vocab sort for top-k) only pays
         # off when a temperature slot shares the batch; all-greedy
