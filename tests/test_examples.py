@@ -52,7 +52,9 @@ def exported_families() -> set[str]:
     engine_src = open(os.path.join(
         EXAMPLES, "..", "tpumon", "loadgen", "serving.py")).read()
     for fam in ("tpumon_serving_kv_pages_total",
-                "tpumon_serving_kv_pages_free"):
+                "tpumon_serving_kv_pages_free",
+                "tpumon_serving_prefix_hits",
+                "tpumon_serving_prefix_misses"):
         assert fam in engine_src, f"{fam} not found in loadgen/serving.py"
         names.add(fam)
     return names

@@ -51,11 +51,14 @@ def test_serving_spec_and_pool_series_in_contract():
     values appear under the same names PROM_QUERIES re-keys."""
     ring = RingHistory(1800)
     ring.record("spec_accept_pct", 91.5, ts=1000.0)
+    ring.record("prefix_hit_pct", 42.0, ts=1000.0)
     ring.record("kv_pool_pct", 64.0, ts=1000.0)
     out = asyncio.run(HistoryService(ring, prometheus_url=None).snapshot())
     assert out["spec_accept_pct"]["data"] == [91.5]
+    assert out["prefix_hit_pct"]["data"] == [42.0]
     assert out["kv_pool_pct"]["data"] == [64.0]
     assert "spec_accept_pct" in PROM_QUERIES and "kv_pool_pct" in PROM_QUERIES
+    assert "prefix_hit_pct" in PROM_QUERIES
 
 
 def test_history_service_prometheus_unreachable_falls_back():

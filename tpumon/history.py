@@ -55,6 +55,11 @@ PROM_QUERIES: dict[str, str] = {
         "max(100 * (tpumon_serving_kv_pages_total "
         "- tpumon_serving_kv_pages_free) / tpumon_serving_kv_pages_total)"
     ),
+    "prefix_hit_pct": (
+        "100 * sum(rate(tpumon_serving_prefix_hits[5m])) "
+        "/ ((sum(rate(tpumon_serving_prefix_hits[5m])) "
+        "+ sum(rate(tpumon_serving_prefix_misses[5m]))) > 0)"
+    ),
     # Direct trainer series preferred; tpumon's re-export (distinct name,
     # tpumon/exporter.py) is the fallback when Prometheus only scrapes us.
     # Limitation: PromQL `or` is all-or-nothing — in a mixed deployment

@@ -242,6 +242,7 @@ class Sampler:
             ("train_loss", "train_loss", mean),
             ("train_tokens_per_sec", "train_tokens_per_sec", sum),
             ("spec_accept_pct", "spec_accept_pct", mean),
+            ("prefix_hit_pct", "prefix_hit_pct", mean),
             ("kv_pages_used_pct", "kv_pool_pct", max),  # tightest pool
         ):
             vals = [s[key] for s in serving if s.get(key) is not None]

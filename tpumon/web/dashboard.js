@@ -76,6 +76,7 @@ function makeDashboard(doc, net, env, mkSurface) {
                                    {label:"TTFT p50 ms", color:"#fbbf24"}], {}),
     servingHealth: mkChart("c-serving-health",
       [{label:"spec accept %", color:"#22d3ee"},
+       {label:"prefix hit %", color:"#36d399"},
        {label:"KV pool %", color:"#a78bfa", fill:true}], {yMax:100, unit:"%"}),
     tpuHealth: mkChart("c-tpu-health",
       [{label:"worst ICI link score", color:"#f59e0b", fill:true},
@@ -269,22 +270,23 @@ function makeDashboard(doc, net, env, mkSurface) {
     charts.temp.update(h.temp?.labels, [h.temp?.data]);
     charts.ici.update(h.ici?.labels?.length ? h.ici.labels : h.dcn?.labels,
                       [h.ici?.data, h.dcn?.data]);
-    // Optional two-series charts: card shows when either series has
+    // Optional multi-series charts: card shows when any series has
     // data; labels come from whichever series has them.
-    const optionalChart = (cardId, chart, a, b) => {
-      const has = a?.data?.length || b?.data?.length;
+    const optionalChart = (cardId, chart, list) => {
+      const has = list.some(s => s?.data?.length);
       $(cardId).style.display = has ? "" : "none";
-      if (has) chart.update(a?.labels?.length ? a.labels : b?.labels,
-                            [a?.data, b?.data]);
+      if (!has) return;
+      const lab = list.find(s => s?.labels?.length);
+      chart.update(lab ? lab.labels : [], list.map(s => s?.data));
     };
     optionalChart("tpu-health-card", charts.tpuHealth,
-                  h.ici_health_max, h.throttle_max);
+                  [h.ici_health_max, h.throttle_max]);
     optionalChart("serving-chart-card", charts.serving,
-                  h.tokens_per_sec, h.ttft_p50_ms);
+                  [h.tokens_per_sec, h.ttft_p50_ms]);
     optionalChart("serving-health-card", charts.servingHealth,
-                  h.spec_accept_pct, h.kv_pool_pct);
+                  [h.spec_accept_pct, h.prefix_hit_pct, h.kv_pool_pct]);
     optionalChart("train-chart-card", charts.train,
-                  h.train_loss, h.train_tokens_per_sec);
+                  [h.train_loss, h.train_tokens_per_sec]);
   }
 
   function fetchHistory() {
