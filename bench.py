@@ -395,6 +395,10 @@ def _bench_serving(on_tpu: bool) -> dict:
     # pool_pages=0 = the dense-equivalent pool the engine computes itself
     # (slots*max_pages+1): measures the paged indirection at equal memory.
     tps_paged, _ = run(decode_block=8, kv_layout="paged")
+    # Speculative verify over the paged pool (r04: paged_decode_block) —
+    # self-speculation, so this isolates the paged-verify overhead vs
+    # the dense spec number above at equal acceptance.
+    tps_paged_spec, _ = run(spec_len=3, kv_layout="paged")
     tps_int8kv, _ = run(decode_block=8, kv_dtype="int8")
     ttft_cold, ttft_hit, ttft_stats = prefix_ttft()
     pttft_cold, pttft_hit, pttft_stats = prefix_ttft(
@@ -413,6 +417,7 @@ def _bench_serving(on_tpu: bool) -> dict:
         "serving_spec_draft_accept_pct": round(accept_draft, 1)
         if accept_draft is not None else None,
         "serving_paged_block8_tokens_per_sec": round(tps_paged, 1),
+        "serving_paged_spec_tokens_per_sec": round(tps_paged_spec, 1),
         "serving_int8kv_block8_tokens_per_sec": round(tps_int8kv, 1),
         "serving_prefix_ttft_cold_ms": round(ttft_cold, 1),
         "serving_prefix_ttft_hit_ms": round(ttft_hit, 1),
@@ -537,6 +542,7 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "serving_spec_draft_tokens_per_sec",
                       "serving_spec_draft_accept_pct",
                       "serving_paged_block8_tokens_per_sec",
+                      "serving_paged_spec_tokens_per_sec",
                       "serving_int8kv_block8_tokens_per_sec",
                       "serving_prefix_ttft_cold_ms",
                       "serving_prefix_ttft_hit_ms",
