@@ -123,6 +123,8 @@ def main(argv: list[str] | None = None) -> int:
     loadgen_pool = 0
     loadgen_block = 1
     loadgen_kv_dtype = "compute"
+    loadgen_paged_attn = "gather"
+    loadgen_spec_source = "draft"
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -200,6 +202,18 @@ def main(argv: list[str] | None = None) -> int:
             # "compute" | "int8" KV cache element type.
             loadgen_kv_dtype = take(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-paged-attn":
+            # "gather" | "kernel" paged decode read path (kernel =
+            # the Pallas paged-attention kernel; needs --loadgen-kv-layout
+            # paged).
+            loadgen_paged_attn = take(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-spec-source":
+            # "draft" | "prompt": speculative proposal source (prompt =
+            # n-gram prompt lookup, no draft model; needs
+            # --loadgen-spec-len).
+            loadgen_spec_source = take(arg)
+            serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
         elif arg in ("-h", "--help"):
@@ -211,6 +225,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-prefix-cache N] [--loadgen-kv-layout dense|paged] "
                 "[--loadgen-pool-pages N] [--loadgen-decode-block N] "
                 "[--loadgen-kv-dtype compute|int8] "
+                "[--loadgen-paged-attn gather|kernel] "
+                "[--loadgen-spec-source draft|prompt] "
                 "[--state FILE]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
@@ -240,6 +256,8 @@ def main(argv: list[str] | None = None) -> int:
                 spec_len=loadgen_spec, prefix_cache=loadgen_prefix,
                 kv_layout=loadgen_kv, pool_pages=loadgen_pool,
                 decode_block=loadgen_block, kv_dtype=loadgen_kv_dtype,
+                paged_attn=loadgen_paged_attn,
+                spec_source=loadgen_spec_source,
             )
         except ValueError as e:  # uncomposable/unknown engine options
             print(f"--serve-loadgen: {e}", file=sys.stderr)

@@ -1,0 +1,62 @@
+"""Prompt-lookup speculative drafting (n-gram proposal from context).
+
+Speculative decoding needs a proposer the target can cheaply verify;
+a draft MODEL is one choice, but for repetitive workloads (extraction,
+summarization-with-quotes, code edit, periodic logs — anywhere the
+continuation echoes earlier context) the context itself is a better
+one: find the most recent prior occurrence of the sequence's trailing
+n-gram and propose the tokens that followed it. No trained model, no
+draft cache, no extra device dispatches — the proposal is a host-side
+list search — and the verify step (speculative.decode_block /
+paged_kv.paged_decode_block) is unchanged, so the lossless-greedy
+contract holds no matter how bad the guesses are.
+
+This is the "prompt lookup decoding" idea used by production serving
+stacks (e.g. vLLM's ngram speculator and transformers'
+prompt_lookup_num_tokens); implemented from the idea, not anyone's
+code. Engine integration: ServeConfig(spec_source="prompt");
+measured honestly in bench.py `serving_spec_prompt_*` on a workload
+that is repetitive by construction (the use case this exists for),
+with a model trained by the in-repo trainer to actually continue the
+repetition (acceptance is a property of target agreement — an
+untrained target makes any proposer's acceptance noise).
+"""
+
+from __future__ import annotations
+
+
+def ngram_propose(context: list[int], g: int, max_n: int = 3) -> list[int]:
+    """Propose ``g`` next tokens for ``context`` by n-gram lookup.
+
+    Searches for the most recent PRIOR occurrence of the longest
+    trailing n-gram (n = max_n down to 1) and copies the tokens that
+    followed it; if the copied run is shorter than ``g`` it extends by
+    continuing the copy from where the match's continuation itself
+    repeats (natural for periodic text) and finally pads by repeating
+    the last token. With no match at any n (or an empty context), the
+    fallback is ``g`` repeats of the last token — acceptance then just
+    measures how often the target emits runs, and the verify step makes
+    any wrong guess harmless.
+    """
+    if g <= 0:
+        return []
+    if not context:
+        return [0] * g
+    last = context[-1]
+    for n in range(min(max_n, len(context)), 0, -1):
+        tail = context[-n:]
+        # Rightmost occurrence strictly before the trailing one, with
+        # at least one continuation token available.
+        hi = len(context) - n - 1  # last candidate start index
+        for i in range(hi, -1, -1):
+            if context[i:i + n] == tail:
+                prop = context[i + n:i + n + g]
+                if not prop:
+                    continue  # match flush against the tail: no info
+                span = len(context) - i - n  # tokens after the match
+                while len(prop) < g:
+                    # Cycle the post-match span: for periodic text this
+                    # continues the period past the end of context.
+                    prop.append(context[i + n + (len(prop) % span)])
+                return prop[:g]
+    return [last] * g

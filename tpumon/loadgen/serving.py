@@ -73,6 +73,13 @@ class ServeConfig:
     # (see tpumon.loadgen.speculative on bf16 argmax near-ties).
     spec_len: int = 0
     draft_model: ModelConfig | None = None
+    # Speculative proposal source: "draft" runs a draft model (above);
+    # "prompt" proposes by n-gram prompt lookup
+    # (tpumon.loadgen.prompt_lookup) — no draft model/cache/dispatches,
+    # proposals copied from the request's own context, the win case
+    # being repetitive continuations. Verify step identical either way,
+    # so greedy output stays lossless regardless of proposal quality.
+    spec_source: str = "draft"
     # Prefix caching: LRU entries of chunk-aligned prompt-prefix K/V;
     # 0 = off. Dense layout snapshots+restores rows with an HBM copy
     # (tpumon.loadgen.prefix_cache); paged layout SHARES the prefix's
@@ -88,6 +95,23 @@ class ServeConfig:
     # lower); exhaustion blocks admission instead of OOMing.
     kv_layout: str = "dense"
     pool_pages: int = 0
+    # Paged decode attention read path: "gather" lets XLA fuse the page
+    # table gather into the attention einsum; "kernel" routes the decode
+    # step through the Pallas paged-attention kernel
+    # (tpumon.ops.paged_attention — scalar-prefetched page tables, pages
+    # DMA'd straight through VMEM). Which wins is a function of scale,
+    # measured both ways on v5e (BENCH_NOTES r05): at PRODUCTION shape
+    # (370M params, 16 slots x 4k context, page 128, GQA 4 — KV pool far
+    # beyond on-chip memory) the kernel cuts the engine decode step
+    # 1.49x (11.0 -> 7.4 ms, bench paged_engine_step_*); at the
+    # demo/test shape (page 32, hd 64, pool ~8-135 MB) the pool sits in
+    # on-chip memory, the kernel's tiny grid cells starve the MXU, and
+    # gather wins ~9x — hence the default. Covers the T=1 hot loop
+    # (plain step + decode_block rounds); the speculative verify block
+    # (multi-token queries) stays on the gather path. Requires
+    # kv_layout="paged" and kv_dtype="compute" (the kernel reads bf16/f32
+    # pages, not the int8 pool).
+    paged_attn: str = "gather"
     # Fused plain decode: run this many (decode_step -> sample) pairs
     # inside ONE dispatch per engine step (serving.decode_rounds) — the
     # plain-decode analogue of the speculative verify fusion. Cuts
@@ -160,7 +184,7 @@ def _gqa_repeat(kv: jax.Array, n_heads: int) -> jax.Array:
 
 def decoder_forward(cfg: ServeConfig, params: dict, tokens: jax.Array,
                     pos: jax.Array, mask: jax.Array,
-                    kv_update) -> jax.Array:
+                    kv_update, attend=None) -> jax.Array:
     """The ONE transformer body shared by every serving path — dense
     prefill/decode, speculative verify, and paged prefill/decode differ
     only in how K/V is stored and read back, which ``kv_update``
@@ -173,6 +197,14 @@ def decoder_forward(cfg: ServeConfig, params: dict, tokens: jax.Array,
     layer li's store and return the full context (ck, cv) as
     [B, S, nkv, hd]. Returns final-norm hidden states [B, T, D]
     (callers apply lm_head to the rows they need).
+
+    attend(li, q, k, v), when given, REPLACES kv_update + the in-body
+    attention for every layer: it must write the block's K/V into layer
+    li's store and return the attention output [B, T, n_heads, hd]
+    directly. This is the ServeConfig.paged_attn="kernel" path — the
+    Pallas paged-attention kernel reads pages in-kernel via scalar-
+    prefetched tables, so a gathered [B, S] context never exists and
+    ``mask`` is unused (the kernel masks by sequence length).
     """
     m = cfg.model
     dt = jnp.dtype(m.compute_dtype)
@@ -186,13 +218,17 @@ def decoder_forward(cfg: ServeConfig, params: dict, tokens: jax.Array,
         k = _rope_at((h @ layer["wk"].astype(dt)).reshape(b, t, nkv, hd),
                      pos, m.rope_theta)
         v = (h @ layer["wv"].astype(dt)).reshape(b, t, nkv, hd)
-        ck, cv = kv_update(li, k, v)
-        kr, vr = _gqa_repeat(ck, nh), _gqa_repeat(cv, nh)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
-        scores = scores / (hd**0.5)
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
+        if attend is not None:
+            att = attend(li, q, k, v).reshape(b, t, nh * hd)
+        else:
+            ck, cv = kv_update(li, k, v)
+            kr, vr = _gqa_repeat(ck, nh), _gqa_repeat(cv, nh)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, kr).astype(jnp.float32)
+            scores = scores / (hd**0.5)
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            att = jnp.einsum(
+                "bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
         x = x + att @ layer["wo"].astype(dt)
         hm = _rms_norm(x, layer["mlp_norm"])
         gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
@@ -515,22 +551,46 @@ class ServingEngine:
         if self.cfg.spec_len < 0:
             raise ValueError(
                 f"spec_len must be >= 0, got {self.cfg.spec_len}")
+        if self.cfg.spec_source not in ("draft", "prompt"):
+            raise ValueError(
+                f"unknown spec_source {self.cfg.spec_source!r}")
+        if self.cfg.spec_source == "prompt" and self.cfg.draft_model:
+            raise ValueError(
+                "spec_source='prompt' proposes from the request context "
+                "— a draft_model has no role (drop one of the two)")
         if self.cfg.pool_pages and self.cfg.kv_layout != "paged":
             raise ValueError(
                 "pool_pages requires kv_layout='paged' (a dense cache "
                 "has no page pool to size)")
-        if mesh is not None and (
-                self.cfg.spec_len or self.cfg.prefix_cache_entries
-                or self.cfg.kv_layout == "paged"):
+        if mesh is not None and self.cfg.prefix_cache_entries:
             raise ValueError(
-                "a tensor-parallel mesh currently composes with the "
-                "dense KV layout only (no speculative decoding, prefix "
-                "caching, or paged KV)")
+                "a tensor-parallel mesh does not compose with prefix "
+                "caching (host-side cache surgery on sharded buffers)")
+        if mesh is not None and (
+                self.cfg.spec_len and self.cfg.kv_layout != "paged"):
+            raise ValueError(
+                "over a mesh, speculative decoding composes with the "
+                "PAGED layout (r05 _shard_paged_jits); dense-layout "
+                "spec is single-device only")
+        if mesh is not None and self.cfg.paged_attn == "kernel":
+            raise ValueError(
+                "paged_attn='kernel' is single-device (the Pallas "
+                "kernel is not pjit-partitionable); use the gather "
+                "path over a mesh")
         if self.cfg.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {self.cfg.decode_block}")
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
+        if self.cfg.paged_attn not in ("gather", "kernel"):
+            raise ValueError(f"unknown paged_attn {self.cfg.paged_attn!r}")
+        if self.cfg.paged_attn == "kernel" and (
+                self.cfg.kv_layout != "paged"
+                or self.cfg.kv_dtype == "int8"):
+            raise ValueError(
+                "paged_attn='kernel' requires kv_layout='paged' with "
+                "kv_dtype='compute' (the Pallas kernel reads bf16/f32 "
+                "pages, not the int8 pool)")
         if self.cfg.kv_dtype == "int8" and (
                 mesh is not None
                 or ((self.cfg.spec_len or self.cfg.prefix_cache_entries)
@@ -573,7 +633,17 @@ class ServingEngine:
         # weights into the executable as constants, duplicating them in
         # HBM); only the cache is donated for in-place updates.
         self.mesh = mesh
-        if mesh is not None:
+        if mesh is not None and self.cfg.kv_layout == "paged":
+            # Paged over a mesh: the single-device jits below are
+            # placeholders — the paged setup block re-points every
+            # paged fn (and the spec draft/verify) at tensor-parallel
+            # versions via _shard_paged_jits.
+            self._prefill = jax.jit(partial(prefill, self.cfg),
+                                    donate_argnums=(1,))
+            self._decode = jax.jit(partial(decode_step, self.cfg),
+                                   donate_argnums=(1,))
+            self._decode_rounds = None
+        elif mesh is not None:
             # Tensor-parallel engine: the whole continuous-batching loop
             # runs over the mesh — Megatron-split projections, KV cache
             # sharded on its head axis, XLA inserting the psums over ICI
@@ -610,7 +680,16 @@ class ServingEngine:
         # speculating draft shares the quantized weights, not a second
         # f32 copy).
         self.spec_len = self.cfg.spec_len
-        if self.spec_len:
+        if self.spec_len and self.cfg.spec_source == "prompt":
+            # Prompt-lookup proposals (loadgen.prompt_lookup): no draft
+            # model, no draft cache — only the verify jit is needed.
+            from tpumon.loadgen.speculative import decode_block
+
+            self.draft_params = None
+            self._draft_pos = [0] * self.cfg.slots  # unused; kept uniform
+            self._verify = jax.jit(
+                partial(decode_block, self.cfg), donate_argnums=(1,))
+        elif self.spec_len:
             import dataclasses as _dc
 
             from tpumon.loadgen.speculative import decode_block
@@ -738,6 +817,8 @@ class ServingEngine:
                 self._decode_rounds = jax.jit(
                     partial(paged_decode_rounds, self.cfg),
                     static_argnames=("steps",), donate_argnums=(1,))
+            if mesh is not None:
+                self._shard_paged_jits(mesh)
         if self.paged:
             self.cache = None
         elif mesh is None:
@@ -771,6 +852,132 @@ class ServingEngine:
         # step() time counts as declared device activity (source:
         # workload in the monitor's counter chain).
         self.reporter = None
+
+    def _shard_paged_jits(self, mesh) -> None:
+        """Tensor-parallel PAGED serving (r05): re-point every paged
+        engine fn at a pjit over mesh axis "model".
+
+        The Megatron param split (model.PARAM_SPECS) carries over
+        exactly as in make_sharded_serving; the page POOL shards on its
+        kv-head axis (``[layers, kv_heads, pages, page, hd]`` →
+        "model" on axis 1) so both the batched append scatter and the
+        attention gather touch only device-local pages — page tables
+        are host-side ints and replicate. Slots are NOT data-parallel
+        here (continuous batching is serving's batch axis), so the mesh
+        must be tp-only. Speculative decoding composes: the draft's
+        dense cache shards on ITS kv-head axis, the layer-truncated
+        draft re-slices the PLACED target params (pure aliasing — no
+        second copy in HBM), and the paged verify block runs over the
+        sharded pool. The Pallas kernel path does not (manual-mode
+        kernel; engine init rejects it with a mesh).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpumon.loadgen.model import param_shardings
+        from tpumon.loadgen.paged_kv import (
+            paged_decode_block,
+            paged_decode_rounds,
+            paged_decode_step,
+            paged_prefill,
+        )
+
+        tp = mesh.shape["model"]
+        dp = mesh.shape.get("data", 1)
+        if dp != 1:
+            raise ValueError(
+                "paged serving over a mesh is tensor-parallel only "
+                f"(axis 'model'); got data={dp} — slots batch via "
+                "continuous batching, not a data axis")
+        if self.cfg.model.n_kv_heads % tp:
+            raise ValueError(
+                f"n_kv_heads={self.cfg.model.n_kv_heads} not divisible "
+                f"by tp={tp}")
+        # Capture draft aliasing BEFORE rebinding self.params: after
+        # device_put the old identities are gone.
+        draft_is_target = self.spec_len and self.draft_params is self.params
+        draft_shares_layers = (
+            self.spec_len and not draft_is_target
+            and isinstance(self.draft_params, dict)
+            and self.draft_params.get("layers")
+            and self.draft_params["layers"][0]
+            is self.params["layers"][0])
+        shardings = param_shardings(mesh, self.params)
+        self.params = jax.device_put(self.params, shardings)
+        rep = NamedSharding(mesh, P())
+        pool_sh = {
+            k: NamedSharding(mesh, P(None, "model", None, None, None))
+            for k in self.pool
+        }
+        self.pool = jax.device_put(self.pool, pool_sh)
+        self._paged_prefill = jax.jit(
+            partial(paged_prefill, self.cfg),
+            in_shardings=(shardings, pool_sh, rep, rep, rep, rep, rep),
+            out_shardings=(pool_sh, rep), donate_argnums=(1,))
+        self._paged_decode = jax.jit(
+            partial(paged_decode_step, self.cfg),
+            in_shardings=(shardings, pool_sh, rep, rep, rep),
+            out_shardings=(pool_sh, rep), donate_argnums=(1,))
+        if self.cfg.decode_block > 1:
+            _rounds = jax.jit(
+                partial(paged_decode_rounds, self.cfg),
+                in_shardings=(shardings, pool_sh,
+                              rep, rep, rep, rep, rep, rep, rep),
+                out_shardings=(pool_sh, rep, rep, rep),
+                # static_argnums, not argnames: pjit with in_shardings
+                # rejects kwargs; the engine passes steps= by keyword,
+                # so adapt positionally. steps is arg index 9 after
+                # partial(cfg): params, pool, last, positions, tables,
+                # key, ctr, temps, topks, steps.
+                static_argnums=(9,), donate_argnums=(1,))
+            self._decode_rounds = (
+                lambda params, pool, last, pos, tables, key, ctr,
+                temps, topks, steps:
+                _rounds(params, pool, last, pos, tables, key, ctr,
+                        temps, topks, steps))
+        if self.spec_len and self.cfg.spec_source == "prompt":
+            from tpumon.loadgen.paged_kv import paged_decode_block as _pdb
+
+            self._verify = jax.jit(
+                partial(_pdb, self.cfg),
+                in_shardings=(shardings, pool_sh, rep, rep, rep),
+                out_shardings=(pool_sh, rep), donate_argnums=(1,))
+            return
+        if self.spec_len:
+            dm = self._draft_scfg.model
+            # Re-derive the draft from the PLACED target so shared
+            # leaves stay aliases of the sharded arrays (no second
+            # HBM copy); a genuinely distinct draft is placed itself.
+            if draft_is_target:
+                self.draft_params = self.params  # self-speculation
+            elif draft_shares_layers:
+                self.draft_params = {
+                    "embed": self.params["embed"],
+                    "layers": self.params["layers"][:dm.n_layers],
+                    "final_norm": self.params["final_norm"],
+                    "lm_head": self.params["lm_head"],
+                }
+            else:
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    param_shardings(mesh, self.draft_params))
+            d_shard = param_shardings(mesh, self.draft_params)
+            dcache_sh = {
+                k: NamedSharding(mesh, P(None, None, None, "model", None))
+                for k in self.draft_cache
+            }
+            self.draft_cache = jax.device_put(self.draft_cache, dcache_sh)
+            self._draft_prefill = jax.jit(
+                partial(prefill, self._draft_scfg),
+                in_shardings=(d_shard, dcache_sh, rep, rep, rep, rep),
+                out_shardings=(dcache_sh, rep), donate_argnums=(1,))
+            self._draft_decode = jax.jit(
+                partial(decode_step, self._draft_scfg),
+                in_shardings=(d_shard, dcache_sh, rep, rep),
+                out_shardings=(dcache_sh, rep), donate_argnums=(1,))
+            self._verify = jax.jit(
+                partial(paged_decode_block, self.cfg),
+                in_shardings=(shardings, pool_sh, rep, rep, rep),
+                out_shardings=(pool_sh, rep), donate_argnums=(1,))
 
     # -- submission ---------------------------------------------------------
 
@@ -855,7 +1062,10 @@ class ServingEngine:
     def _draft_prefill_prompt(self, slot: int, req: "Request") -> None:
         """Prefill the draft's dense cache with the FULL prompt (the
         draft cache is unshared, so prefix-shared target chunks still
-        need draft K/V; draft prefill is cheap — the draft is shallow)."""
+        need draft K/V; draft prefill is cheap — the draft is shallow).
+        No-op for prompt-lookup proposals (no draft cache exists)."""
+        if self.cfg.spec_source == "prompt":
+            return
         n = len(req.prompt)
         p = self.cfg.prefill_len
         for c0 in range(0, n, p):
@@ -1150,6 +1360,9 @@ class ServingEngine:
         the target's bonus token. Temperature>0 slots emit one sampled
         token from the verified logits (== plain decode for them)."""
         g = self.spec_len
+        if self.cfg.spec_source == "prompt":
+            self._spec_round_prompt(active)
+            return
         # Catch the draft cache up to the target frontier first:
         # plain-step fallbacks advance the sequence without touching the
         # draft cache, and proposing over those K/V holes would degrade
@@ -1191,6 +1404,37 @@ class ServingEngine:
         self.draft_cache, _ = self._draft_decode(
             self.draft_params, self.draft_cache, dt_tok, dpos)
         proposed = jnp.stack(drafts, axis=1)  # [B, g]
+        self._spec_verify_emit(active, proposed, prop_h=None)
+
+    def _spec_round_prompt(self, active: list[int]) -> None:
+        """Prompt-lookup speculative round: proposals are host-side
+        n-gram copies from each request's own context
+        (loadgen.prompt_lookup.ngram_propose) — zero draft dispatches;
+        the verify/accept path is the shared one, so greedy output is
+        lossless regardless of guess quality."""
+        from tpumon.loadgen.prompt_lookup import ngram_propose
+
+        g = self.spec_len
+        prop_rows = []
+        for s in range(self.cfg.slots):
+            req = self._slots[s]
+            if req is None:
+                prop_rows.append([0] * g)
+            else:
+                prop_rows.append(
+                    ngram_propose(req.prompt + req.output, g))
+        proposed = jnp.asarray(prop_rows, jnp.int32)  # [B, g]
+        self._spec_verify_emit(active, proposed, prop_h=prop_rows)
+
+    def _spec_verify_emit(self, active: list[int], proposed,
+                          prop_h: list | None) -> None:
+        """Shared speculative tail: one target verify dispatch over
+        [feed, proposals], greedy-prefix acceptance + bonus token,
+        temperature slots sampled from the verified logits. prop_h is
+        the host copy of ``proposed`` when the proposer already has one
+        (prompt lookup); None fetches it with the verify results in the
+        single per-round device sync."""
+        g = self.spec_len
         ver_in = jnp.concatenate(
             [self.last_tokens[:, None], proposed], axis=1)  # [B, g+1]
         if self.paged:
@@ -1212,11 +1456,19 @@ class ServingEngine:
                                   jnp.uint32(self._sample_ctr),
                                   self.temps, self.topks)
             # ONE host-device sync per round.
-            prop_h, tgt_h, samp_h = (
-                a.tolist() for a in jax.device_get((proposed, tgt, samp0)))
+            if prop_h is None:
+                prop_h, tgt_h, samp_h = (
+                    a.tolist()
+                    for a in jax.device_get((proposed, tgt, samp0)))
+            else:
+                tgt_h, samp_h = (
+                    a.tolist() for a in jax.device_get((tgt, samp0)))
         else:
-            prop_h, tgt_h = (
-                a.tolist() for a in jax.device_get((proposed, tgt)))
+            if prop_h is None:
+                prop_h, tgt_h = (
+                    a.tolist() for a in jax.device_get((proposed, tgt)))
+            else:
+                tgt_h = jax.device_get(tgt).tolist()
             samp_h = None
         from tpumon.loadgen.speculative import greedy_accept_len
 
@@ -1302,13 +1554,22 @@ class ServingEngine:
                 ).add(value=queue)
         w.gauge("jetstream_slots_available", "free decode slots"
                 ).add(value=free)
-        from tpumon.loadgen.quant import param_bytes
+        from tpumon.loadgen.quant import QTensor, param_bytes
 
         weight_bytes = param_bytes(self.params)
         if self.spec_len and self.draft_params is not self.params:
-            # A distinct draft model's weights are resident too;
-            # self-speculation shares the target's and adds nothing.
-            weight_bytes += param_bytes(self.draft_params)
+            # A distinct draft model's weights are resident too — but
+            # only the leaves that are actually separate arrays: the
+            # layer-truncated draft (engine init) aliases the target's
+            # arrays leaf-for-leaf, so counting it wholesale would
+            # report HBM that is not separately resident.
+            _is_q = lambda x: isinstance(x, QTensor)  # noqa: E731
+            target_ids = {
+                id(x) for x in jax.tree.leaves(self.params, is_leaf=_is_q)}
+            weight_bytes += sum(
+                x.nbytes
+                for x in jax.tree.leaves(self.draft_params, is_leaf=_is_q)
+                if id(x) not in target_ids)
         w.gauge("tpumon_serving_weight_bytes",
                 "resident model weight bytes (int8 when quantized)"
                 ).add(value=weight_bytes)
@@ -1510,7 +1771,9 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                      quantize: str | None = None,
                      spec_len: int = 0, prefix_cache: int = 0,
                      kv_layout: str = "dense", pool_pages: int = 0,
-                     decode_block: int = 1, kv_dtype: str = "compute"):
+                     decode_block: int = 1, kv_dtype: str = "compute",
+                     paged_attn: str = "gather",
+                     spec_source: str = "draft"):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
@@ -1518,7 +1781,9 @@ def start_background(rps: float = 0.5, max_new: int = 16,
     scraping it."""
     if cfg is None and (spec_len or prefix_cache or pool_pages
                         or kv_layout != "dense" or decode_block != 1
-                        or kv_dtype != "compute"):
+                        or kv_dtype != "compute"
+                        or paged_attn != "gather"
+                        or spec_source != "draft"):
         import dataclasses
 
         # Keep the checkpoint-architecture adoption the engine would do
@@ -1536,7 +1801,8 @@ def start_background(rps: float = 0.5, max_new: int = 16,
             base or default_engine_config(), spec_len=spec_len,
             prefix_cache_entries=prefix_cache,
             kv_layout=kv_layout, pool_pages=pool_pages,
-            decode_block=decode_block, kv_dtype=kv_dtype)
+            decode_block=decode_block, kv_dtype=kv_dtype,
+            paged_attn=paged_attn, spec_source=spec_source)
     engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()

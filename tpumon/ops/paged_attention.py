@@ -22,15 +22,32 @@ skipped with ``pl.when`` (their DMA may fetch an arbitrary valid page;
 its values are never read into the accumulator), and the final partial
 page is masked by position.
 
-Measured on v5e (B=16, 32/8 heads, hd=128, 4k context, bf16): this
-kernel and the XLA dense-gather path (``paged_attention_reference``
-under jit) both stream KV at ~555 GB/s — HBM-roofline-bound parity;
-XLA fuses the leading-axis gather into the attention consumer rather
-than materializing it. The kernel therefore buys the paged *structure*
-at zero cost, not a speedup today. Known headroom: ``pl.when`` skips
-compute but not the pipeline's page DMA, so short sequences in a mixed
-batch still pay max_pages of traffic in both paths — compacting the
-grid by prefetched page counts is the next step if that mix dominates.
+Measured on v5e (slope-timed; full regime map in BENCH_NOTES r05):
+
+- Isolated op, B=16, 32/8 heads, hd=128, 4k context, bf16, 268 MB pool,
+  RANDOM-permutation table (the layout a churned pool converges to):
+  this kernel streams KV at **149.3 GB/s vs 75.3 for the XLA
+  dense-gather path** (``paged_attention_reference`` under jit) —
+  1.98x (BENCH_r04). An earlier round claimed ~555 GB/s parity for
+  both; that run predated the noise-floor/roofline guards
+  (BENCH_NOTES.md "r02 -> r03 correction") and is superseded.
+- Full ENGINE decode step (the kernel consumed via
+  ``ServeConfig.paged_attn="kernel"`` in
+  loadgen/paged_kv.paged_decode_step) at production shape — 370M
+  params, 16 slots x 4k context, page 128, GQA 4, 537 MB of KV
+  streamed per step: **11.0 -> 7.4 ms/step (1.49x)** — bench
+  ``paged_engine_step_*``.
+- Same engine step at the demo/test shape (page 32, hd 64, group 1,
+  pool 8-135 MB): gather WINS ~9x — the small pool sits in on-chip
+  memory and the kernel's (1, group, hd) grid cells are too small to
+  feed the MXU; and end-to-end through the axon tunnel at that shape
+  both paths tie (dispatch-bound). Hence the engine default is
+  "gather"; production long-context configs should select "kernel".
+
+Known headroom: ``pl.when`` skips compute but not the pipeline's page
+DMA, so short sequences in a mixed batch still pay max_pages of
+traffic in both paths — compacting the grid by prefetched page counts
+is the next step if that mix dominates.
 """
 
 from __future__ import annotations
@@ -158,10 +175,11 @@ def paged_attention_reference(
 ) -> jax.Array:
     """Dense oracle: gather pages per sequence, plain softmax attention.
 
-    Under jit this is also a production-viable paged path: measured on
-    v5e, XLA fuses the leading-axis gather into the attention consumer
-    instead of materializing it, landing at HBM-roofline parity with
-    the Pallas kernel (see module docstring).
+    Under jit this is also the engine's ``paged_attn="gather"`` read
+    path: XLA fuses the leading-axis gather into the attention consumer
+    instead of materializing it — competitive while page tables stay
+    near-contiguous, ~2x slower than the kernel once the pool fragments
+    (see module docstring for the measured numbers).
     """
     b, nh, hd = q.shape
     nkv, _, page_size, _ = k_pages.shape
