@@ -76,6 +76,53 @@ def test_chunked_attention_matches_naive():
     np.testing.assert_allclose(a, b, rtol=1e-6)
 
 
+def test_flash_schedule_matches_naive():
+    """attention='flash' (Pallas kernel fwd, chunked-recompute bwd —
+    interpret mode on CPU) reproduces the naive logits AND gradients,
+    including T values that don't hit the kernel's 128-row grid
+    (internal padding; training T = seq-1 is never aligned)."""
+    import dataclasses
+    from functools import partial
+
+    from tpumon.loadgen.model import loss_fn, sgd_train_step
+
+    cfg = dataclasses.replace(CFG, compute_dtype="float32", max_seq=256)
+    fcfg = dataclasses.replace(cfg, attention="flash", attn_block_k=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    for t in (129, 100):  # aligned-to-128 inputs and unaligned
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(t), (2, t), 0, cfg.vocab)
+        naive = jax.jit(lambda p, tk: forward(cfg, p, tk))(params, tokens)
+        flash = jax.jit(lambda p, tk: forward(fcfg, p, tk))(params, tokens)
+        np.testing.assert_allclose(naive, flash, rtol=2e-5, atol=2e-5)
+        g1 = jax.grad(lambda p: loss_fn(cfg, p, tokens))(params)
+        g2 = jax.grad(lambda p: loss_fn(fcfg, p, tokens))(params)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+    _, l1 = jax.jit(partial(sgd_train_step, cfg))(params, tokens)
+    _, l2 = jax.jit(partial(sgd_train_step, fcfg))(params, tokens)
+    assert abs(float(l1) - float(l2)) < 1e-5
+
+
+def test_flash_schedule_composes_with_remat():
+    """remat + flash: the checkpointed layer body recomputes the kernel
+    forward; loss unchanged."""
+    import dataclasses
+    from functools import partial
+
+    from tpumon.loadgen.model import sgd_train_step
+
+    fcfg = dataclasses.replace(CFG, compute_dtype="float32", max_seq=256,
+                               attention="flash")
+    rcfg = dataclasses.replace(fcfg, remat=True)
+    params = init_params(fcfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, fcfg.vocab)
+    _, l1 = jax.jit(partial(sgd_train_step, fcfg))(params, tokens)
+    _, l2 = jax.jit(partial(sgd_train_step, rcfg))(params, tokens)
+    assert abs(float(l1) - float(l2)) < 1e-6
+
+
 def test_causality():
     """Changing a future token must not affect earlier logits."""
     params = init_params(CFG, jax.random.PRNGKey(0))

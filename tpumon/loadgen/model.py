@@ -52,7 +52,16 @@ class ModelConfig:
     # blocks with an online softmax (lax.scan, checkpointed body) —
     # peak attention memory O(T * block) instead of O(T^2), fully
     # differentiable, the long-context single-chip path (the multi-chip
-    # counterpart is loadgen.ring_attention).
+    # counterpart is loadgen.ring_attention); "flash" runs the FORWARD
+    # through the Pallas flash kernel (tpumon.ops.flash_attention) with
+    # a custom-vjp backward that recomputes through the chunked core
+    # (the standard flash recompute strategy — nothing but the running
+    # stats ever materializes in the fwd). Requires T % 128 == 0.
+    # Measured r05 (BENCH_NOTES): the jnp-blocked "chunked" schedule
+    # wins the seq-8k training bench — XLA's fusion of the scan body is
+    # already MXU-bound at that shape — so "chunked" stays the default
+    # long-context schedule; "flash" is kept as the wired, tested
+    # inference-grade kernel path.
     attention: str = "naive"
     attn_block_k: int = 512
 
@@ -60,7 +69,7 @@ class ModelConfig:
         # Validate at construction (a typo'd schedule string silently
         # falling through to the naive path would defeat the point of
         # selecting the memory-saving one).
-        if self.attention not in ("naive", "chunked"):
+        if self.attention not in ("naive", "chunked", "flash"):
             raise ValueError(f"unknown attention schedule {self.attention!r}")
         if self.attn_block_k < 1:
             raise ValueError(f"attn_block_k must be >= 1, got {self.attn_block_k}")
@@ -277,6 +286,51 @@ def _chunked_attention_core(
     return jnp.concatenate(outs, axis=1)[:, :t]
 
 
+def _flash_fwd(q, k, v, block_k):
+    from tpumon.ops.flash_attention import flash_attention
+
+    b, t, h, d = q.shape
+    # Pad T up to the kernel's 128-row block grid. Safe under the
+    # causal mask: padded K rows sit AFTER every real row so no real
+    # query attends them; padded query rows produce garbage that is
+    # sliced off below. (Training T is seq-1 = 8191 — never aligned.)
+    tp = -(-t // 128) * 128
+    if tp != t:
+        pad = ((0, 0), (0, tp - t), (0, 0), (0, 0))
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, tp, d)
+
+    out = flash_attention(fold(q), fold(k), fold(v), causal=True,
+                          interpret=jax.default_backend() != "tpu")
+    out = out.reshape(b, h, tp, d).transpose(0, 2, 1, 3)[:, :t]
+    return out, (q[:, :t], k[:, :t], v[:, :t])
+
+
+def _flash_bwd(block_k, res, g):
+    # Flash-style backward: recompute the attention through the
+    # differentiable chunked core (same online-softmax math, one
+    # in-repo implementation — ring/chunked/flash share _block_attend)
+    # and take ITS vjp. The kernel accelerates the forward; nothing
+    # from it needs to be stored.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_attention_core(q_, k_, v_, block_k),
+        q, k, v)
+    return vjp(g)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_attention_core(q, k, v, block_k):
+    """Causal attention via the Pallas flash kernel (fwd) + chunked-core
+    recompute (bwd). q/k/v: [B, T, H, D], GQA-widened."""
+    return _flash_fwd(q, k, v, block_k)[0]
+
+
+_flash_attention_core.defvjp(_flash_fwd, _flash_bwd)
+
+
 def _attention(
     cfg: ModelConfig,
     layer: dict,
@@ -307,7 +361,10 @@ def _attention(
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    if cfg.attention == "chunked" and t > cfg.attn_block_k:
+    if cfg.attention == "flash":
+        out = _flash_attention_core(q, k, v, cfg.attn_block_k)
+        out = out.reshape(b, t, nh * hd)
+    elif cfg.attention == "chunked" and t > cfg.attn_block_k:
         out = _chunked_attention_core(q, k, v, cfg.attn_block_k)
         out = out.reshape(b, t, nh * hd)
     else:

@@ -170,7 +170,13 @@ def zigzag_indices(t: int, n: int) -> jnp.ndarray:
     ``x[:, zigzag_indices(t, n)]`` puts rows so that an even split over
     n chips gives chip i the half-blocks (i, 2n-1-i). 2n must divide t.
     """
-    assert t % (2 * n) == 0, (t, n)
+    if t % (2 * n):
+        # ValueError, not assert: under ``python -O`` an assert is
+        # stripped and a non-divisible t would silently produce a
+        # wrong permutation (run_train pre-checks, but direct callers
+        # are unprotected).
+        raise ValueError(
+            f"zigzag layout needs 2*n ({2 * n}) to divide t ({t})")
     hb = t // (2 * n)
     order: list[int] = []
     for i in range(n):
@@ -205,7 +211,10 @@ def zigzag_ring_attention(
     """
     n = mesh.shape[axis]
     t = q.shape[1]
-    assert t % (2 * n) == 0, (t, n)
+    if t % (2 * n):
+        raise ValueError(
+            f"zigzag ring attention needs 2*n ({2 * n}) to divide the "
+            f"sequence length ({t})")
     spec = P(None, axis, None, None)
 
     @partial(
@@ -251,9 +260,13 @@ def zigzag_attend_inner(
 
     # Mark the accumulators device-varying up front: the attend
     # branch's outputs depend on axis_index, and lax.cond requires
-    # both branches (and so the carry) to agree on that.
+    # both branches (and so the carry) to agree on that. Varying over
+    # q's FULL vma, not just the ring axis — under a composed mesh
+    # (dp x sp, sp_train dp_axis) the blocks also vary over the batch
+    # axis and the carry must match.
+    vma = tuple(getattr(jax.typeof(q_blk), "vma", None) or (axis,))
     acc = jax.tree.map(
-        lambda x: jax.lax.pcast(x, (axis,), to="varying"),
+        lambda x: jax.lax.pcast(x, vma, to="varying"),
         {"a": fresh(), "b": fresh()})
     k_cur, v_cur = k_blk, v_blk
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -76,20 +76,39 @@ def sp_loss_fn(
     mesh: Mesh,
     axis: str = "seq",
     schedule: str = "zigzag",
+    dp_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> jax.Array:
-    """Mean next-token NLL with everything sharded over ``axis``."""
+    """Mean next-token NLL with activations sharded over ``axis``.
+
+    Composition (r05, pinning the make_sp_train_step promise):
+    ``dp_axis`` additionally shards the BATCH dimension — a second
+    manual mesh axis, with the loss psum running over both axes.
+    ``tp_axis`` Megatron-shards the WEIGHTS over that mesh axis, left
+    in shard_map "auto" mode (``axis_names`` excludes it): inside the
+    body those arrays keep their global sharding and XLA inserts the
+    tensor-parallel collectives declaratively, while the sp ring's
+    ppermute stays manual over ``axis``. The caller device_puts params
+    with model.param_shardings (which names the axis "model") — see
+    make_sp_train_step.
+    """
     if schedule not in SCHEDULES:
         raise ValueError(
             f"unknown sp schedule {schedule!r} (expected {SCHEDULES})")
     n = mesh.shape[axis]
     total = inputs.shape[0] * inputs.shape[1]
     kv_rep = cfg.n_heads // cfg.n_kv_heads
+    manual = {axis} | ({dp_axis} if dp_axis else set())
+    if tp_axis and tp_axis in manual:
+        raise ValueError(f"tp_axis {tp_axis!r} must be distinct")
+    reduce_axes = (dp_axis, axis) if dp_axis else (axis,)
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(), P(None, axis), P(None, axis), P(axis)),
+        in_specs=(P(), P(dp_axis, axis), P(dp_axis, axis), P(axis)),
         out_specs=P(),
+        axis_names=frozenset(manual),
     )
     def run(p, inp, lab, pos):
         dt = jnp.dtype(cfg.compute_dtype)
@@ -114,8 +133,9 @@ def sp_loss_fn(
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
         # Every local row has a valid pre-shifted label; the mean is a
-        # psum of local sums over the global token count.
-        return jax.lax.psum(jnp.sum(nll), axis) / total
+        # psum of local sums over the global token count (both manual
+        # axes when dp composes; tp's vocab reductions are XLA's).
+        return jax.lax.psum(jnp.sum(nll), reduce_axes) / total
 
     return run(params, inputs, labels, positions)
 
@@ -127,31 +147,53 @@ def make_sp_train_step(
     axis: str = "seq",
     schedule: str = "zigzag",
     lr: float = 1e-3,
+    dp_axis: str | None = None,
+    tp_axis: str | None = None,
 ):
     """jit an SGD step over the seq mesh; returns (step_fn, placed).
 
     step_fn(params, inputs, labels, positions) -> (params, loss), with
     (inputs, labels, positions) from ``step_fn.prep(tokens)`` — prep is
     bound to this step's mesh size and schedule so the batch layout
-    can't silently mismatch the traced step. Params replicate (sp
-    shards activations, not weights — compose with dp/tp meshes for
-    weight sharding); activations shard over ``axis``.
+    can't silently mismatch the traced step. Activations shard over
+    ``axis``; params replicate unless ``tp_axis`` is given.
+
+    Composition over a multi-axis mesh (pinned by
+    tests/test_sp_train.py::test_dp_sp parity tests and the dryrun):
+    ``dp_axis`` shards the batch (gradients all-reduce over it via the
+    loss psum's transpose), ``tp_axis`` Megatron-shards the weights
+    using model.PARAM_SPECS — that axis must be NAMED "model" (the
+    declarative spec table is keyed on it), e.g.
+    ``Mesh(devs.reshape(2, 2, 2), ("data", "model", "seq"))`` with
+    ``dp_axis="data", tp_axis="model"`` for dp2 x tp2 x sp2.
     """
+    if tp_axis and tp_axis != "model":
+        raise ValueError(
+            "tp_axis must be the mesh axis named 'model' — "
+            "model.PARAM_SPECS (the Megatron split table) is keyed on "
+            f"that name; got {tp_axis!r}")
     n = mesh.shape[axis]
     rep = NamedSharding(mesh, P())
-    seq2 = NamedSharding(mesh, P(None, axis))
+    seq2 = NamedSharding(mesh, P(dp_axis, axis))
     seq1 = NamedSharding(mesh, P(axis))
-    placed = jax.device_put(params, jax.tree.map(lambda _: rep, params))
+    if tp_axis:
+        from tpumon.loadgen.model import param_shardings
+
+        p_shard = param_shardings(mesh, params)
+    else:
+        p_shard = jax.tree.map(lambda _: rep, params)
+    placed = jax.device_put(params, p_shard)
 
     @partial(
         jax.jit,
-        in_shardings=(jax.tree.map(lambda _: rep, params), seq2, seq2, seq1),
-        out_shardings=(jax.tree.map(lambda _: rep, params), rep),
+        in_shardings=(p_shard, seq2, seq2, seq1),
+        out_shardings=(p_shard, rep),
     )
     def step(p, inputs, labels, positions):
         loss, grads = jax.value_and_grad(
             lambda p_: sp_loss_fn(cfg, p_, inputs, labels, positions,
-                                  mesh, axis, schedule)
+                                  mesh, axis, schedule,
+                                  dp_axis=dp_axis, tp_axis=tp_axis)
         )(p)
         new = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
         return new, loss
