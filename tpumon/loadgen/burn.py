@@ -545,6 +545,92 @@ def measure_paged_gbps(
     }
 
 
+@partial(jax.jit, static_argnames=("cfg", "steps"), donate_argnums=(2,))
+def _paged_engine_step_program(cfg, params, pool, last, positions, tables,
+                               steps):
+    """``steps`` engine decode steps (the REAL serving step fn —
+    tpumon.loadgen.paged_kv.paged_decode_step, gather or kernel read
+    path per cfg.paged_attn) scanned in one dispatch, so the per-call
+    tunnel/dispatch latency that dominates the end-to-end engine bench
+    is amortized away and only the step's device time remains."""
+    from tpumon.loadgen.paged_kv import paged_decode_step
+
+    def body(carry, _):
+        pool, last = carry
+        pool, logits = paged_decode_step(
+            cfg, params, pool, last, positions, tables)
+        return (pool, jnp.argmax(logits, -1).astype(jnp.int32)), ()
+
+    (pool, last), _ = jax.lax.scan(body, (pool, last), None, length=steps)
+    return pool, last
+
+
+def measure_paged_engine_step_ms(cfg, inner_steps: int = 24,
+                                 reps: int = 3) -> dict:
+    """Slope-timed device ms per engine paged-decode step at ``cfg``'s
+    exact shape, with FULL scrambled page tables (every slot at
+    max_seq-1 context, tables a random permutation of the pool — the
+    fully-fragmented worst case). This isolates what the
+    ``paged_attn`` read path buys at the step level: the end-to-end
+    engine tokens/s comparison in bench.py is dispatch-bound on the
+    axon tunnel (each block dispatch pays ~100 ms of round-trip before
+    any HBM traffic), so the 2x KV-streaming difference between gather
+    and kernel (ops/paged_attention docstring) only shows once the
+    dispatch is amortized — which a production multi-step server does
+    and this scan reproduces."""
+    import numpy as np
+
+    from tpumon.loadgen.model import init_params
+    from tpumon.loadgen.paged_kv import init_pool
+
+    m = cfg.model
+    ps = cfg.prefill_len
+    max_pages = m.max_seq // ps
+    num_pages = cfg.slots * max_pages + 1
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(np.arange(1, num_pages))
+    tables = jnp.asarray(
+        perm[: cfg.slots * max_pages].reshape(cfg.slots, max_pages),
+        jnp.int32)
+    positions = jnp.full((cfg.slots,), m.max_seq - 2, jnp.int32)
+    params = init_params(m, jax.random.PRNGKey(0))
+
+    state = {
+        "pool": init_pool(cfg, num_pages),
+        "last": jnp.zeros((cfg.slots,), jnp.int32),
+    }
+
+    def run(n: int):
+        pool, last = _paged_engine_step_program(
+            cfg, params, state["pool"], state["last"], positions, tables, n)
+        _sync(jnp.sum(last))
+        # The previous pool was donated into the call; carry the new one.
+        state["pool"], state["last"] = pool, last
+
+    # Per step the attention read streams the full table width of KV:
+    # slots * max_pages * ps rows * nkv * hd * 2 (K+V) * itemsize,
+    # per layer — plus the weights, which we exclude from units so the
+    # reported GB/s is a lower bound on KV streaming rate.
+    kv_bytes = (m.n_layers * 2 * cfg.slots * max_pages * ps
+                * m.n_kv_heads * m.head_dim
+                * jnp.dtype(m.compute_dtype).itemsize)
+    peak = _lookup_peak(HBM_PEAK_GBPS_BY_KIND)
+    rate, marginal, dt = _guarded_slope(
+        run,
+        inner_steps,
+        units_per_iter=kv_bytes,
+        peak_per_sec=peak * 1e9 if peak else None,
+        what=f"paged_engine_step[{cfg.paged_attn}]",
+        reps=reps,
+    )
+    return {
+        "ms_per_step": kv_bytes / rate * 1e3,
+        "kv_gbps_floor": rate / 1e9,
+        "paged_attn": cfg.paged_attn,
+        "marginal_s": round(dt, 3),
+    }
+
+
 def hbm_fill(fraction: float = 0.5, hbm_bytes: int | None = None) -> list[jax.Array]:
     """Allocate ~fraction of HBM (holds references; caller drops to free).
 
