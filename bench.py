@@ -466,29 +466,13 @@ def _bench_serving(on_tpu: bool) -> dict:
         the SAME prompts: identical outputs (greedy lossless), only
         the schedule differs.
         """
-        import jax
-        import jax.numpy as jnp
-
-        from tpumon.loadgen.model import init_params, sgd_train_step
+        from tpumon.loadgen.train import train_induction
 
         m = base.model
         period, seq = 16, min(256, m.max_seq)
         steps = 2000 if on_tpu else 40
-        params0 = init_params(m, jax.random.PRNGKey(0))
-
-        @jax.jit
-        def train(params, key):
-            def body(p, k):
-                pat = jax.random.randint(
-                    k, (16, period), 1, m.vocab, jnp.int32)
-                toks = jnp.tile(pat, (1, -(-seq // period)))[:, :seq]
-                p, loss = sgd_train_step(m, p, toks)
-                return p, loss
-
-            return jax.lax.scan(body, params, jax.random.split(key, steps))
-
-        trained, losses = train(params0, jax.random.PRNGKey(1))
-        jax.block_until_ready(losses)
+        trained, losses = train_induction(
+            m, steps=steps, period=period, seq=seq)
 
         def mk_prompt(i: int) -> list:
             rng = [1 + (i * 997 + j * 131) % (m.vocab - 1)
@@ -706,7 +690,7 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "kernel_marginal_s")),
     "train": (540, ("train_mfu_pct", "train_tokens_per_sec",
                     "train_seq8k_mfu_pct", "train_seq8k_tokens_per_sec")),
-    "serving": (900, ("serving_tokens_per_sec",
+    "serving": (1500, ("serving_tokens_per_sec",
                       "serving_block8_tokens_per_sec",
                       "serving_spec_tokens_per_sec",
                       "serving_spec_accept_pct",
@@ -777,6 +761,12 @@ def main(argv: list[str] | None = None) -> int:
             if proc.returncode != 0:
                 raise RuntimeError(proc.stderr.strip()[-500:])
             result.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+            # Surface within-phase nulled-measurement reasons (the
+            # child's safe() notes) — a null key whose cause is
+            # invisible reads as mystery, not as the guard working.
+            for line in proc.stderr.splitlines():
+                if " failed: " in line:
+                    _note(f"{name}: {line.strip()[:300]}")
             _note(f"{name} done")
         except Exception as e:
             _note(f"{name} FAILED: {type(e).__name__}: {str(e)[:200]}")

@@ -54,3 +54,44 @@ def test_flash_rejects_bad_shapes():
     q, k, v = qkv(t=200)  # not divisible by block
     with pytest.raises(AssertionError):
         flash_attention(q, k, v, interpret=True)
+
+
+class TestTriangleGrid:
+    """flash_attention_tri: lower-triangle-only grid (r05) — must match
+    the rectangular causal kernel exactly (same online_softmax_update
+    numerics, same block size)."""
+
+    def test_matches_rect_causal(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpumon.ops.flash_attention import (
+            flash_attention,
+            flash_attention_tri,
+        )
+
+        key = jax.random.PRNGKey(3)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (3, 384, 64), jnp.float32)
+                   for i in range(3))
+        rect = flash_attention(q, k, v, causal=True, interpret=True)
+        tri = flash_attention_tri(q, k, v, interpret=True)
+        assert jnp.allclose(rect, tri, atol=1e-5), (
+            float(jnp.abs(rect - tri).max()))
+
+    def test_single_block(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpumon.ops.flash_attention import (
+            flash_attention,
+            flash_attention_tri,
+        )
+
+        key = jax.random.PRNGKey(4)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (2, 128, 32), jnp.float32)
+                   for i in range(3))
+        rect = flash_attention(q, k, v, causal=True, interpret=True)
+        tri = flash_attention_tri(q, k, v, interpret=True)
+        assert jnp.allclose(rect, tri, atol=1e-5)

@@ -313,3 +313,23 @@ def test_measure_rooflines_table():
     assert set(peaks) == {"bf16_tflops", "int8_tops", "hbm_gbps"}
     for v in peaks.values():
         assert v is None or v > 0
+
+
+def test_measure_paged_engine_step_both_paths():
+    """measure_paged_engine_step_ms (the bench's gather-vs-kernel
+    engine-step settlement) runs both read paths on the CPU test shape
+    and returns coherent positive numbers."""
+    import dataclasses
+
+    from tpumon.loadgen.burn import measure_paged_engine_step_ms
+    from tpumon.loadgen.serving import ServeConfig
+
+    cfg = ServeConfig(
+        model=dataclasses.replace(
+            CFG, compute_dtype="float32", max_seq=64),
+        slots=2, prefill_len=8, kv_layout="paged")
+    for pa in ("gather", "kernel"):
+        out = measure_paged_engine_step_ms(
+            dataclasses.replace(cfg, paged_attn=pa), inner_steps=256)
+        assert out["ms_per_step"] > 0 and out["kv_gbps_floor"] > 0
+        assert out["paged_attn"] == pa

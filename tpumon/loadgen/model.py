@@ -53,15 +53,17 @@ class ModelConfig:
     # peak attention memory O(T * block) instead of O(T^2), fully
     # differentiable, the long-context single-chip path (the multi-chip
     # counterpart is loadgen.ring_attention); "flash" runs the FORWARD
-    # through the Pallas flash kernel (tpumon.ops.flash_attention) with
-    # a custom-vjp backward that recomputes through the chunked core
-    # (the standard flash recompute strategy — nothing but the running
-    # stats ever materializes in the fwd). Requires T % 128 == 0.
-    # Measured r05 (BENCH_NOTES): the jnp-blocked "chunked" schedule
-    # wins the seq-8k training bench — XLA's fusion of the scan body is
-    # already MXU-bound at that shape — so "chunked" stays the default
-    # long-context schedule; "flash" is kept as the wired, tested
-    # inference-grade kernel path.
+    # through the triangle-grid Pallas flash kernel
+    # (tpumon.ops.flash_attention_tri — only lower-diagonal block pairs
+    # are iterated or DMA'd; attn_block_k sets the pair block size)
+    # with a custom-vjp backward that recomputes through the chunked
+    # core (the standard flash recompute strategy). T is padded to the
+    # block internally. Measured r05 (BENCH_NOTES): at seq-8k training
+    # the kernel reaches 0.97x the jnp-blocked "chunked" schedule
+    # (43.0 vs 44.5% MFU at block 1024 — up from 0.58x before the
+    # triangle grid), so "chunked" stays the default long-context
+    # schedule by a hair and "flash" ships as a wired, tested,
+    # near-parity alternative.
     attention: str = "naive"
     attn_block_k: int = 512
 
@@ -287,14 +289,19 @@ def _chunked_attention_core(
 
 
 def _flash_fwd(q, k, v, block_k):
-    from tpumon.ops.flash_attention import flash_attention
+    from tpumon.ops.flash_attention import flash_attention_tri
 
     b, t, h, d = q.shape
-    # Pad T up to the kernel's 128-row block grid. Safe under the
-    # causal mask: padded K rows sit AFTER every real row so no real
-    # query attends them; padded query rows produce garbage that is
-    # sliced off below. (Training T is seq-1 = 8191 — never aligned.)
-    tp = -(-t // 128) * 128
+    # Triangle block size: follow attn_block_k (clamped to a 128
+    # multiple) — per-pair MXU work grows with block^2 while grid-step
+    # count shrinks with it, and sub-5 us pairs starve the MXU (the
+    # same knee BENCH_NOTES r04 measured for the jnp schedule).
+    blk = max(128, (block_k // 128) * 128)
+    # Pad T up to the kernel's block grid. Safe under the causal mask:
+    # padded K rows sit AFTER every real row so no real query attends
+    # them; padded query rows produce garbage that is sliced off
+    # below. (Training T is seq-1 = 8191 — never aligned.)
+    tp = -(-t // blk) * blk
     if tp != t:
         pad = ((0, 0), (0, tp - t), (0, 0), (0, 0))
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
@@ -302,8 +309,11 @@ def _flash_fwd(q, k, v, block_k):
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, tp, d)
 
-    out = flash_attention(fold(q), fold(k), fold(v), causal=True,
-                          interpret=jax.default_backend() != "tpu")
+    # Triangle-grid kernel: only lower-diagonal (q, k) block pairs are
+    # iterated or DMA'd — T^2/2 work, matching the causal-skipping jnp
+    # schedule's FLOP count (ops/flash_attention module docstring).
+    out = flash_attention_tri(fold(q), fold(k), fold(v), block=blk,
+                              interpret=jax.default_backend() != "tpu")
     out = out.reshape(b, h, tp, d).transpose(0, 2, 1, 3)[:, :t]
     return out, (q[:, :t], k[:, :t], v[:, :t])
 

@@ -31,6 +31,7 @@ from tpumon.loadgen.checkpoint import restore_checkpoint, save_checkpoint
 from tpumon.loadgen.model import (
     ModelConfig,
     init_params,
+    loss_fn,
     make_sharded_train_step,
     param_shardings,
     sgd_train_step,
@@ -275,6 +276,51 @@ def fused_train_bench(cfg: TrainConfig, steps: int) -> dict:
         "mfu_pct": mfu,
         "loss": float(loss),
     }
+
+
+def train_induction(model: ModelConfig, steps: int = 2000,
+                    period: int = 16, seq: int = 256, batch: int = 16,
+                    lr: float = 1e-3, seed: int = 0):
+    """Train ``model`` to CONTINUE periodic token sequences (the
+    induction/copy task) with Adam, the whole loop fused into one
+    jitted ``lax.scan``.
+
+    Exists for workloads that need a target model that genuinely
+    copies: bench.py's prompt-lookup speculation benchmark trains the
+    serving model here so measured acceptance is a property of real
+    target agreement (an untrained target makes any proposer's
+    acceptance noise — plain SGD at the loadgen's default lr leaves
+    the copy task unlearned, measured r05: 8.79 -> 8.68 after 2k
+    steps, vs Adam's 8.79 -> 0.51 which is the irreducible
+    first-period entropy, i.e. perfect copying). Returns
+    (trained_params, losses [steps]).
+    """
+    import optax
+
+    opt = optax.adam(lr)
+    params = init_params(model, jax.random.PRNGKey(seed))
+    state = opt.init(params)
+    reps = -(-seq // period)
+
+    @jax.jit
+    def fit(params, state, key):
+        def body(carry, k):
+            p, st = carry
+            pat = jax.random.randint(
+                k, (batch, period), 1, model.vocab, jnp.int32)
+            toks = jnp.tile(pat, (1, reps))[:, :seq]
+            loss, grads = jax.value_and_grad(
+                partial(loss_fn, model))(p, toks)
+            up, st = opt.update(grads, st)
+            return (optax.apply_updates(p, up), st), loss
+
+        return jax.lax.scan(
+            body, (params, state), jax.random.split(key, steps))
+
+    (params, _), losses = fit(params, state,
+                              jax.random.PRNGKey(seed ^ 0xC0FFEE))
+    jax.block_until_ready(losses)
+    return params, losses
 
 
 def run_train(

@@ -14,6 +14,16 @@ TPU specifics: m/l live in (block_q, 128) VMEM tiles (min lane width)
 with the statistic broadcast across lanes; causal block skipping uses
 pl.when so fully-masked K blocks cost no MXU work; the in-block mask is
 built from broadcasted iotas (2D, as TPU requires).
+
+``pl.when`` skips the MXU work above the diagonal but NOT the
+pipeline's K/V DMA or the grid step itself — the rectangular causal
+grid still pays ~2x the triangle's traffic and iterations. r05 adds
+``flash_attention_tri``: the grid enumerates ONLY the lower-triangle
+(q block, k block) pairs, with the pair -> (iq, ik) decoding shipped
+as scalar-prefetched index arrays (pltpu.PrefetchScalarGridSpec) that
+the BlockSpec index maps read — T^2/2 work AND T^2/2 DMA. The
+training schedule (loadgen.model attention="flash") uses the triangle
+kernel.
 """
 
 from __future__ import annotations
@@ -139,3 +149,100 @@ def flash_attention(
         ),
         interpret=interpret,
     )(q, k, v)
+
+
+def _flash_tri_kernel(
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    *, block: int, scale: float,
+):
+    p = pl.program_id(1)
+    qi = qi_ref[p]
+    kj = kj_ref[p]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [block, d]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block, block]
+    # Only the diagonal block needs the in-block causal mask, but the
+    # where() is cheap relative to the dot and a data-independent mask
+    # keeps the body branch-free.
+    qpos = qi * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 0)
+    kpos = kj * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)
+    s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    online_softmax_update(s, v_ref[0], m_ref, l_ref, acc_ref)
+
+    @pl.when(kj == qi)
+    def _store():
+        # The diagonal is each q row's LAST pair (row-major pair order),
+        # so the row's online-softmax state is complete here.
+        l_final = l_ref[:, 0]
+        l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+        out_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def flash_attention_tri(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal flash attention over a LOWER-TRIANGLE-ONLY grid.
+
+    q/k/v: [BH, T, D] -> [BH, T, D], T % block == 0 (callers pad — the
+    extra K rows sit above every real query's diagonal and mask out).
+    grid = (BH, T/block * (T/block + 1) / 2): pair p decodes to
+    (qi_of[p], kj_of[p]) via scalar-prefetched arrays read by the
+    BlockSpec index maps, so blocks above the causal diagonal are never
+    DMA'd at all (the rectangular kernel above skips their compute but
+    still streams them). Equal q/k block size by construction — the
+    diagonal pair is square.
+    """
+    bh, t, d = q.shape
+    assert k.shape == v.shape == (bh, t, d)
+    assert t % block == 0, (t, block)
+    nb = t // block
+    pairs = [(i, j) for i in range(nb) for j in range(i + 1)]
+    qi_of = jnp.asarray([i for i, _ in pairs], jnp.int32)
+    kj_of = jnp.asarray([j for _, j in pairs], jnp.int32)
+    kernel = functools.partial(
+        _flash_tri_kernel, block=block, scale=1.0 / d**0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # qi_of, kj_of
+        grid=(bh, len(pairs)),
+        in_specs=[
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, qi[p], 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, kj[p], 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, kj[p], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d),
+                               lambda b, p, qi, kj: (b, qi[p], 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block, d), jnp.float32),  # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qi_of, kj_of, q, k, v)
