@@ -295,8 +295,11 @@ def _flash_fwd(q, k, v, block_k):
     # Triangle block size: follow attn_block_k (clamped to a 128
     # multiple) — per-pair MXU work grows with block^2 while grid-step
     # count shrinks with it, and sub-5 us pairs starve the MXU (the
-    # same knee BENCH_NOTES r04 measured for the jnp schedule).
+    # same knee BENCH_NOTES r04 measured for the jnp schedule). Also
+    # clamp DOWN to the 128-aligned sequence length: a short sequence
+    # must pad to one small block, not to a full 512-row pair.
     blk = max(128, (block_k // 128) * 128)
+    blk = min(blk, -(-t // 128) * 128)
     # Pad T up to the kernel's block grid. Safe under the causal mask:
     # padded K rows sit AFTER every real row so no real query attends
     # them; padded query rows produce garbage that is sliced off
