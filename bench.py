@@ -248,29 +248,49 @@ def _bench_train(on_tpu: bool) -> dict:
     throughput, not Python dispatch or tunnel RTT. Off-TPU shapes shrink
     to keep CI fast (MFU is null there — no known peak for CPU).
 
-    train_seq8k_mfu pins the round-2 long-sequence features (per-layer
-    remat + chunked online-softmax attention) at seq 8192 — a shape that
-    does not fit a 16 GiB v5e without them."""
+    train_seq8k_mfu pins the flash fwd+bwd kernel schedule at seq 8192
+    WITHOUT remat (r05: the kernel never materializes T^2, so full
+    residuals fit 16 GiB); the r02-r04 long-sequence features (per-layer
+    remat + chunked online-softmax attention) stay measured under
+    train_seq8k_chunked_mfu_pct."""
     from tpumon.loadgen.model import ModelConfig
     from tpumon.loadgen.train import TrainConfig, fused_train_bench
 
+    import dataclasses
+
     if on_tpu:
-        # d2048/L6: the best-MFU shape that fits a 16 GiB v5e without
-        # remat (bigger models train via ModelConfig.remat — measured
-        # d2048/L12 at ~43% MFU — but the headline tracks the peak).
+        # d2048/L6 seq-1024: headline schedule is now the r05 flash
+        # kernel pair (triangle fwd + bwd, loadgen.model
+        # attention="flash") — naive's [B,H,T,T] score materialization
+        # traffic, not its FLOPs, was costing ~30% wall clock
+        # (55.5 -> 72.2% MFU measured; BENCH_NOTES r05). The old
+        # schedule stays pinned as train_mfu_naive_pct so either
+        # path's regression is visible per round.
         model = ModelConfig(
             vocab=4096, d_model=2048, n_layers=6, n_heads=16, n_kv_heads=16,
-            d_ff=8192, max_seq=1024,
+            d_ff=8192, max_seq=1024, attention="flash", attn_block_k=512,
         )
         cfg = TrainConfig(model=model, batch=8, seq=1024)
         steps = 16
+        # seq-8192: flash/1024 WITHOUT remat — the kernel never
+        # materializes T^2, so the shape now fits 16 GiB with full
+        # residuals (r04 needed remat + the jnp-chunked schedule;
+        # that path stays pinned as train_seq8k_chunked_mfu_pct).
         model_8k = ModelConfig(
             vocab=4096, d_model=2048, n_layers=6, n_heads=16, n_kv_heads=16,
-            d_ff=8192, max_seq=8192, remat=True,
-            attention="chunked", attn_block_k=512,
+            d_ff=8192, max_seq=8192,
+            attention="flash", attn_block_k=1024,
         )
         cfg_8k = TrainConfig(model=model_8k, batch=1, seq=8192)
         steps_8k = 4
+        alt = fused_train_bench(TrainConfig(
+            model=dataclasses.replace(model, attention="naive"),
+            batch=8, seq=1024), steps=steps)
+        alt_8k = fused_train_bench(TrainConfig(
+            model=dataclasses.replace(
+                model_8k, remat=True, attention="chunked",
+                attn_block_k=512),
+            batch=1, seq=8192), steps=steps_8k)
     else:
         model = ModelConfig()
         cfg = TrainConfig(model=model, batch=2, seq=64)
@@ -280,6 +300,7 @@ def _bench_train(on_tpu: bool) -> dict:
         )
         cfg_8k = TrainConfig(model=model_8k, batch=1, seq=256)
         steps_8k = 2
+        alt = alt_8k = None
     out = fused_train_bench(cfg, steps=steps)
     out_8k = fused_train_bench(cfg_8k, steps=steps_8k)
     return {
@@ -287,10 +308,14 @@ def _bench_train(on_tpu: bool) -> dict:
         if out["mfu_pct"] is not None
         else None,
         "train_tokens_per_sec": round(out["tokens_per_sec"], 1),
+        "train_mfu_naive_pct": round(alt["mfu_pct"], 2)
+        if alt and alt["mfu_pct"] is not None else None,
         "train_seq8k_mfu_pct": round(out_8k["mfu_pct"], 2)
         if out_8k["mfu_pct"] is not None
         else None,
         "train_seq8k_tokens_per_sec": round(out_8k["tokens_per_sec"], 1),
+        "train_seq8k_chunked_mfu_pct": round(alt_8k["mfu_pct"], 2)
+        if alt_8k and alt_8k["mfu_pct"] is not None else None,
     }
 
 
@@ -688,8 +713,10 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "paged_engine_step_kernel_ms",
                       "paged_engine_step_kernel_vs_gather",
                       "kernel_marginal_s")),
-    "train": (540, ("train_mfu_pct", "train_tokens_per_sec",
-                    "train_seq8k_mfu_pct", "train_seq8k_tokens_per_sec")),
+    "train": (840, ("train_mfu_pct", "train_tokens_per_sec",
+                    "train_mfu_naive_pct",
+                    "train_seq8k_mfu_pct", "train_seq8k_tokens_per_sec",
+                    "train_seq8k_chunked_mfu_pct")),
     "serving": (1500, ("serving_tokens_per_sec",
                       "serving_block8_tokens_per_sec",
                       "serving_spec_tokens_per_sec",

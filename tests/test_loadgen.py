@@ -77,7 +77,7 @@ def test_chunked_attention_matches_naive():
 
 
 def test_flash_schedule_matches_naive():
-    """attention='flash' (Pallas kernel fwd, chunked-recompute bwd —
+    """attention='flash' (triangle-grid Pallas kernels, fwd AND bwd —
     interpret mode on CPU) reproduces the naive logits AND gradients,
     including T values that don't hit the kernel's 128-row grid
     (internal padding; training T = seq-1 is never aligned)."""

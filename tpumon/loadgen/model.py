@@ -52,18 +52,19 @@ class ModelConfig:
     # blocks with an online softmax (lax.scan, checkpointed body) —
     # peak attention memory O(T * block) instead of O(T^2), fully
     # differentiable, the long-context single-chip path (the multi-chip
-    # counterpart is loadgen.ring_attention); "flash" runs the FORWARD
-    # through the triangle-grid Pallas flash kernel
-    # (tpumon.ops.flash_attention_tri — only lower-diagonal block pairs
-    # are iterated or DMA'd; attn_block_k sets the pair block size)
-    # with a custom-vjp backward that recomputes through the chunked
-    # core (the standard flash recompute strategy). T is padded to the
-    # block internally. Measured r05 (BENCH_NOTES): at seq-8k training
-    # the kernel reaches 0.97x the jnp-blocked "chunked" schedule
-    # (43.0 vs 44.5% MFU at block 1024 — up from 0.58x before the
-    # triangle grid), so "chunked" stays the default long-context
-    # schedule by a hair and "flash" ships as a wired, tested,
-    # near-parity alternative.
+    # counterpart is loadgen.ring_attention); "flash" runs BOTH passes
+    # through the triangle-grid Pallas kernels
+    # (tpumon.ops.flash_attention_tri_fwd / _tri_bwd — only
+    # lower-diagonal block pairs are iterated or DMA'd; dQ accumulated
+    # row-major, dK/dV column-major, P rebuilt from the saved lse;
+    # attn_block_k sets the pair block size, T pads internally).
+    # Measured r05 (BENCH_NOTES): "flash" WINS both bench shapes —
+    # seq-8k 72.8% MFU without remat vs 45.0 for remat+chunked (the
+    # kernel never materializes T^2, so the shape fits 16 GiB with
+    # full residuals), and even seq-1024 55.5 -> 72.2% (naive's score
+    # materialization traffic, not FLOPs, was the cost). "flash" is
+    # the recommended TPU schedule; the default stays "naive" only
+    # because CPU tests would crawl through interpret mode.
     attention: str = "naive"
     attn_block_k: int = 512
 
@@ -288,18 +289,22 @@ def _chunked_attention_core(
     return jnp.concatenate(outs, axis=1)[:, :t]
 
 
+def _flash_block(block_k: int, t: int) -> int:
+    """Triangle block size: follow attn_block_k (clamped to a 128
+    multiple) — per-pair MXU work grows with block^2 while grid-step
+    count shrinks with it, and sub-5 us pairs starve the MXU (the same
+    knee BENCH_NOTES r04 measured for the jnp schedule). Also clamp
+    DOWN to the 128-aligned sequence length: a short sequence must pad
+    to one small block, not to a full 512-row pair."""
+    blk = max(128, (block_k // 128) * 128)
+    return min(blk, -(-t // 128) * 128)
+
+
 def _flash_fwd(q, k, v, block_k):
-    from tpumon.ops.flash_attention import flash_attention_tri
+    from tpumon.ops.flash_attention import flash_attention_tri_fwd
 
     b, t, h, d = q.shape
-    # Triangle block size: follow attn_block_k (clamped to a 128
-    # multiple) — per-pair MXU work grows with block^2 while grid-step
-    # count shrinks with it, and sub-5 us pairs starve the MXU (the
-    # same knee BENCH_NOTES r04 measured for the jnp schedule). Also
-    # clamp DOWN to the 128-aligned sequence length: a short sequence
-    # must pad to one small block, not to a full 512-row pair.
-    blk = max(128, (block_k // 128) * 128)
-    blk = min(blk, -(-t // 128) * 128)
+    blk = _flash_block(block_k, t)
     # Pad T up to the kernel's block grid. Safe under the causal mask:
     # padded K rows sit AFTER every real row so no real query attends
     # them; padded query rows produce garbage that is sliced off
@@ -315,29 +320,59 @@ def _flash_fwd(q, k, v, block_k):
     # Triangle-grid kernel: only lower-diagonal (q, k) block pairs are
     # iterated or DMA'd — T^2/2 work, matching the causal-skipping jnp
     # schedule's FLOP count (ops/flash_attention module docstring).
-    out = flash_attention_tri(fold(q), fold(k), fold(v), block=blk,
-                              interpret=jax.default_backend() != "tpu")
-    out = out.reshape(b, h, tp, d).transpose(0, 2, 1, 3)[:, :t]
-    return out, (q[:, :t], k[:, :t], v[:, :t])
+    out_p, lse = flash_attention_tri_fwd(
+        fold(q), fold(k), fold(v), block=blk,
+        interpret=jax.default_backend() != "tpu")
+    out = out_p.reshape(b, h, tp, d).transpose(0, 2, 1, 3)[:, :t]
+    # Residuals: q/k/v stay FOLDED/PADDED (the backward kernels consume
+    # that layout directly), but the attention OUTPUT is saved as the
+    # returned `out` — it is already live downstream for the wo-matmul
+    # vjp, so saving out_p as well would keep a second full-size copy
+    # per layer alive into the backward; bwd re-folds it instead (a
+    # transpose is cheaper than ~32 MB/layer of duplicated residency
+    # at the no-remat seq-8k shape). Beyond that, only lse (one f32
+    # per row) exists.
+    return out, (fold(q), fold(k), fold(v), out, lse)
 
 
 def _flash_bwd(block_k, res, g):
-    # Flash-style backward: recompute the attention through the
-    # differentiable chunked core (same online-softmax math, one
-    # in-repo implementation — ring/chunked/flash share _block_attend)
-    # and take ITS vjp. The kernel accelerates the forward; nothing
-    # from it needs to be stored.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _chunked_attention_core(q_, k_, v_, block_k),
-        q, k, v)
-    return vjp(g)
+    # Flash backward kernels (ops.flash_attention_tri_bwd): two
+    # triangle passes rebuilding P from the saved lse — dQ accumulated
+    # row-major, dK/dV column-major. No chunked-core recompute.
+    from tpumon.ops.flash_attention import flash_attention_tri_bwd
+
+    qf, kf, vf, out, lse = res
+    b, t, h, d = g.shape
+    bh, tp, _ = qf.shape
+
+    def refold(x):
+        # [B, t, H, D] -> folded/padded [BH, Tp, D]. Zero padding is
+        # safe for BOTH re-folded tensors: padded rows of the cotangent
+        # are 0 (so dK/dV take no contribution and the padded dQ rows
+        # are sliced off), and the padded rows of `out` only enter
+        # D_i = rowsum(dO ∘ O), which those zero dO rows annihilate.
+        xf = x.transpose(0, 2, 1, 3).reshape(bh, t, d)
+        if tp != t:
+            xf = jnp.pad(xf, ((0, 0), (0, tp - t), (0, 0)))
+        return xf
+
+    dq, dk, dv = flash_attention_tri_bwd(
+        qf, kf, vf, refold(out), lse, refold(g),
+        block=_flash_block(block_k, t),
+        interpret=jax.default_backend() != "tpu")
+
+    def unfold(x):
+        return x.reshape(b, h, tp, d).transpose(0, 2, 1, 3)[:, :t]
+
+    return unfold(dq), unfold(dk), unfold(dv)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_attention_core(q, k, v, block_k):
-    """Causal attention via the Pallas flash kernel (fwd) + chunked-core
-    recompute (bwd). q/k/v: [B, T, H, D], GQA-widened."""
+    """Causal attention via the triangle-grid Pallas kernels: fwd
+    through flash_attention_tri_fwd, bwd through the two-pass
+    flash_attention_tri_bwd (P rebuilt from the saved lse).
+    q/k/v: [B, T, H, D], GQA-widened."""
     return _flash_fwd(q, k, v, block_k)[0]
 
 

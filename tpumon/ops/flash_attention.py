@@ -151,8 +151,25 @@ def flash_attention(
     )(q, k, v)
 
 
+def _tri_masked_scores(q, k, qi, kj, block: int, scale: float):
+    """Scaled, causally-masked scores for triangle pair (qi, kj) —
+    the ONE implementation shared by the fwd kernel and both backward
+    passes (same spirit as online_softmax_update: shared numerics are
+    provably identical numerics)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block, block]
+    qpos = qi * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 0)
+    kpos = kj * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, block), 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
+
+
 def _flash_tri_kernel(
-    qi_ref, kj_ref, q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref,
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, out_ref, lse_ref,
+    m_ref, l_ref, acc_ref,
     *, block: int, scale: float,
 ):
     p = pl.program_id(1)
@@ -165,20 +182,10 @@ def _flash_tri_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # [block, d]
-    k = k_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [block, block]
     # Only the diagonal block needs the in-block causal mask, but the
     # where() is cheap relative to the dot and a data-independent mask
     # keeps the body branch-free.
-    qpos = qi * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 0)
-    kpos = kj * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 1)
-    s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    s = _tri_masked_scores(q_ref[0], k_ref[0], qi, kj, block, scale)
     online_softmax_update(s, v_ref[0], m_ref, l_ref, acc_ref)
 
     @pl.when(kj == qi)
@@ -188,6 +195,89 @@ def _flash_tri_kernel(
         l_final = l_ref[:, 0]
         l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
         out_ref[0] = (acc_ref[:] / l_safe[:, None]).astype(out_ref.dtype)
+        # Per-row logsumexp of the scaled scores — the residual the
+        # backward kernels rebuild P from (P = exp(s - lse)); rows with
+        # an empty denominator keep lse = m (=-inf rows give P = 0).
+        # The lse ref is the WHOLE [1, 1, T] row (a 2-D per-q-row block
+        # would have a second-minor dim of 1, which the TPU lowering
+        # rejects); each diagonal stores its block's slice.
+        lse_ref[0, 0, pl.dslice(qi * block, block)] = (
+            m_ref[:, 0] + jnp.log(l_safe))
+
+
+def _tri_pairs(nb: int, order: str):
+    """(qi_of, kj_of) prefetch arrays for the lower-triangle grid.
+
+    order="row": (0,0) (1,0) (1,1) ... — each q row's pairs contiguous,
+    diagonal last (fwd + dq accumulate per q row).
+    order="col": (0,0) (1,0) (2,0) ... — each k column's pairs
+    contiguous, bottom row last (dk/dv accumulate per k column).
+    """
+    if order == "row":
+        pairs = [(i, j) for i in range(nb) for j in range(i + 1)]
+    else:
+        pairs = [(i, j) for j in range(nb) for i in range(j, nb)]
+    qi_of = jnp.asarray([i for i, _ in pairs], jnp.int32)
+    kj_of = jnp.asarray([j for _, j in pairs], jnp.int32)
+    return qi_of, kj_of, len(pairs)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def flash_attention_tri_fwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    block: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Triangle-grid causal flash forward returning (out, lse).
+
+    lse: [BH, T] float32 per-row logsumexp of the scaled scores — the
+    residual flash_attention_tri_bwd rebuilds P from.
+    """
+    bh, t, d = q.shape
+    assert k.shape == v.shape == (bh, t, d)
+    assert t % block == 0, (t, block)
+    nb = t // block
+    qi_of, kj_of, n_pairs = _tri_pairs(nb, "row")
+    kernel = functools.partial(
+        _flash_tri_kernel, block=block, scale=1.0 / d**0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # qi_of, kj_of
+        grid=(bh, n_pairs),
+        in_specs=[
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, qi[p], 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, kj[p], 0)),
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, kj[p], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d),
+                         lambda b, p, qi, kj: (b, qi[p], 0)),
+            pl.BlockSpec((1, 1, t),
+                         lambda b, p, qi, kj: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block, 128), jnp.float32),  # running denom l
+            pltpu.VMEM((block, d), jnp.float32),  # output accumulator
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qi_of, kj_of, q, k, v)
+    return out, lse[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
@@ -207,42 +297,196 @@ def flash_attention_tri(
     BlockSpec index maps, so blocks above the causal diagonal are never
     DMA'd at all (the rectangular kernel above skips their compute but
     still streams them). Equal q/k block size by construction — the
-    diagonal pair is square.
+    diagonal pair is square. Forward-only view of
+    flash_attention_tri_fwd; the differentiable training path is
+    loadgen.model's custom-vjp (tri fwd + tri bwd kernels).
+    """
+    return flash_attention_tri_fwd(q, k, v, block=block,
+                                   interpret=interpret)[0]
+
+
+def _flash_tri_bwd_dq_kernel(
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+    dq_acc, dcap_ref,
+    *, block: int, scale: float,
+):
+    p = pl.program_id(1)
+    qi = qi_ref[p]
+    kj = kj_ref[p]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+        # D_i = rowsum(dO ∘ O): constant per q row; computed once at
+        # the row's first pair and parked in a stat tile.
+        d_row = jnp.sum(
+            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=1)
+        dcap_ref[:] = d_row[:, None] + jnp.zeros_like(dcap_ref)
+
+    k = k_ref[0]
+    s = _tri_masked_scores(q_ref[0], k_ref[0], qi, kj, block, scale)
+    lse_i = lse_ref[0, 0, pl.dslice(qi * block, block)]
+    pmat = jnp.exp(s - lse_i[:, None])  # [block, block]
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block, block] = dO @ V^T
+    ds = pmat * (dp - dcap_ref[:, 0][:, None]) * scale
+    dq_acc[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kj == qi)
+    def _store():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_tri_bwd_dkv_kernel(
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
+    *, block: int, scale: float, nb: int,
+):
+    p = pl.program_id(1)
+    qi = qi_ref[p]
+    kj = kj_ref[p]
+
+    @pl.when(qi == kj)
+    def _init():
+        # Column-major pair order: (kj, kj) is the column's FIRST pair.
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    s = _tri_masked_scores(q, k_ref[0], qi, kj, block, scale)
+    lse_i = lse_ref[0, 0, pl.dslice(qi * block, block)]
+    pmat = jnp.exp(s - lse_i[:, None])
+    do = do_ref[0]
+    # dV_j += P^T dO
+    dv_acc[:] += jax.lax.dot_general(
+        pmat.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    d_row = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32), axis=1)
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = pmat * (dp - d_row[:, None]) * scale
+    # dK_j += dS^T Q
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == nb - 1)
+    def _store():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def flash_attention_tri_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    block: int = 128,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Backward of the triangle-grid causal flash attention.
+
+    Two lower-triangle passes over the same pair set: a ROW-major pass
+    accumulating dQ per q row (D_i parked in a stat tile at each row's
+    first pair), and a COLUMN-major pass accumulating dK/dV per k
+    column. P is rebuilt from the forward's saved lse (standard flash
+    recompute — no T^2 residual was ever stored); both passes skip
+    above-diagonal blocks entirely, like the forward.
     """
     bh, t, d = q.shape
-    assert k.shape == v.shape == (bh, t, d)
     assert t % block == 0, (t, block)
     nb = t // block
-    pairs = [(i, j) for i in range(nb) for j in range(i + 1)]
-    qi_of = jnp.asarray([i for i, _ in pairs], jnp.int32)
-    kj_of = jnp.asarray([j for _, j in pairs], jnp.int32)
-    kernel = functools.partial(
-        _flash_tri_kernel, block=block, scale=1.0 / d**0.5)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # qi_of, kj_of
-        grid=(bh, len(pairs)),
-        in_specs=[
-            pl.BlockSpec((1, block, d),
-                         lambda b, p, qi, kj: (b, qi[p], 0)),
-            pl.BlockSpec((1, block, d),
-                         lambda b, p, qi, kj: (b, kj[p], 0)),
-            pl.BlockSpec((1, block, d),
-                         lambda b, p, qi, kj: (b, kj[p], 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block, d),
-                               lambda b, p, qi, kj: (b, qi[p], 0)),
-        scratch_shapes=[
-            pltpu.VMEM((block, 128), jnp.float32),  # running max m
-            pltpu.VMEM((block, 128), jnp.float32),  # running denom l
-            pltpu.VMEM((block, d), jnp.float32),  # output accumulator
-        ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
+    scale = 1.0 / d**0.5
+    lse3 = lse.reshape(bh, 1, t)
+
+    qi_r, kj_r, n_pairs = _tri_pairs(nb, "row")
+    dq = pl.pallas_call(
+        functools.partial(_flash_tri_bwd_dq_kernel, block=block,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, n_pairs),
+            in_specs=[
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # q
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),  # k
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),  # v
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # dout
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # out
+                pl.BlockSpec((1, 1, t),
+                             lambda b, p, qi, kj: (b, 0, 0)),  # lse
+            ],
+            out_specs=pl.BlockSpec((1, block, d),
+                                   lambda b, p, qi, kj: (b, qi[p], 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block, d), jnp.float32),  # dq accumulator
+                pltpu.VMEM((block, 128), jnp.float32),  # D_i stat tile
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qi_of, kj_of, q, k, v)
+    )(qi_r, kj_r, q, k, v, dout, out, lse3)
+
+    qi_c, kj_c, _ = _tri_pairs(nb, "col")
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_tri_bwd_dkv_kernel, block=block,
+                          scale=scale, nb=nb),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, n_pairs),
+            in_specs=[
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # q
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),  # k
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),  # v
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # dout
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, qi[p], 0)),  # out
+                pl.BlockSpec((1, 1, t),
+                             lambda b, p, qi, kj: (b, 0, 0)),  # lse
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),
+                pl.BlockSpec((1, block, d),
+                             lambda b, p, qi, kj: (b, kj[p], 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block, d), jnp.float32),  # dk accumulator
+                pltpu.VMEM((block, d), jnp.float32),  # dv accumulator
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qi_c, kj_c, q, k, v, dout, out, lse3)
+    return dq, dk, dv
