@@ -63,8 +63,11 @@ class ModelConfig:
     # kernel never materializes T^2, so the shape fits 16 GiB with
     # full residuals), and even seq-1024 55.5 -> 72.2% (naive's score
     # materialization traffic, not FLOPs, was the cost). "flash" is
-    # the recommended TPU schedule; the default stays "naive" only
-    # because CPU tests would crawl through interpret mode.
+    # the recommended single-chip TPU schedule; the default stays
+    # "naive" only because CPU tests would crawl through interpret
+    # mode. Under a dp x tp mesh flash compiles and matches exactly
+    # (pinned by test) but the partitioner may replicate around the
+    # kernel; multi-chip long-context stays sp_train ring/zigzag.
     attention: str = "naive"
     attn_block_k: int = 512
 

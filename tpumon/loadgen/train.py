@@ -483,12 +483,17 @@ def main(argv: list[str] | None = None) -> int:
         "bounds model size",
     )
     ap.add_argument(
-        "--attention", choices=["naive", "chunked"], default="naive",
-        help="'chunked' streams K/V blocks with an online softmax — "
-        "O(T*block) attention memory, the long-sequence path",
+        "--attention", choices=["naive", "chunked", "flash"],
+        default="naive",
+        help="'chunked' streams K/V blocks with an online softmax "
+        "(O(T*block) attention memory); 'flash' runs the triangle-grid "
+        "Pallas fwd+bwd kernels — the fastest measured TPU schedule at "
+        "every bench shape (BENCH_NOTES r05) and needs no --remat at "
+        "long seq (no T^2 transient)",
     )
     ap.add_argument("--attn-block", type=int, default=512,
-                    help="K/V block rows for --attention chunked")
+                    help="K/V block rows for --attention chunked, pair "
+                    "block for flash (1024 is the measured seq-8k knee)")
     ap.add_argument(
         "--parallel", choices=["auto", "sp", "sp-ring"], default="auto",
         help="'auto': dp×tp over local devices; 'sp'/'sp-ring': "

@@ -306,8 +306,8 @@ def flash_attention_tri(
 
 
 def _flash_tri_bwd_dq_kernel(
-    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-    dq_acc, dcap_ref,
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+    dq_ref, dq_acc,
     *, block: int, scale: float,
 ):
     p = pl.program_id(1)
@@ -317,12 +317,6 @@ def _flash_tri_bwd_dq_kernel(
     @pl.when(kj == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
-        # D_i = rowsum(dO ∘ O): constant per q row; computed once at
-        # the row's first pair and parked in a stat tile.
-        d_row = jnp.sum(
-            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-            axis=1)
-        dcap_ref[:] = d_row[:, None] + jnp.zeros_like(dcap_ref)
 
     k = k_ref[0]
     s = _tri_masked_scores(q_ref[0], k_ref[0], qi, kj, block, scale)
@@ -332,7 +326,8 @@ def _flash_tri_bwd_dq_kernel(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [block, block] = dO @ V^T
-    ds = pmat * (dp - dcap_ref[:, 0][:, None]) * scale
+    d_i = dvec_ref[0, 0, pl.dslice(qi * block, block)]
+    ds = pmat * (dp - d_i[:, None]) * scale
     dq_acc[:] += jax.lax.dot_general(
         ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -344,7 +339,7 @@ def _flash_tri_bwd_dq_kernel(
 
 
 def _flash_tri_bwd_dkv_kernel(
-    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
     dk_ref, dv_ref, dk_acc, dv_acc,
     *, block: int, scale: float, nb: int,
 ):
@@ -368,13 +363,12 @@ def _flash_tri_bwd_dkv_kernel(
         pmat.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    d_row = jnp.sum(
-        do.astype(jnp.float32) * o_ref[0].astype(jnp.float32), axis=1)
+    d_i = dvec_ref[0, 0, pl.dslice(qi * block, block)]
     dp = jax.lax.dot_general(
         do, v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = pmat * (dp - d_row[:, None]) * scale
+    ds = pmat * (dp - d_i[:, None]) * scale
     # dK_j += dS^T Q
     dk_acc[:] += jax.lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -401,17 +395,43 @@ def flash_attention_tri_bwd(
     """Backward of the triangle-grid causal flash attention.
 
     Two lower-triangle passes over the same pair set: a ROW-major pass
-    accumulating dQ per q row (D_i parked in a stat tile at each row's
-    first pair), and a COLUMN-major pass accumulating dK/dV per k
-    column. P is rebuilt from the forward's saved lse (standard flash
-    recompute — no T^2 residual was ever stored); both passes skip
-    above-diagonal blocks entirely, like the forward.
+    accumulating dQ per q row, and a COLUMN-major pass accumulating
+    dK/dV per k column. P is rebuilt from the forward's saved lse and
+    D_i = rowsum(dO ∘ O) is precomputed once outside the kernels (both
+    ride as resident [BH, 1, T] f32 rows — `out` itself is never
+    streamed into the grid); both passes skip above-diagonal blocks
+    entirely, like the forward.
     """
     bh, t, d = q.shape
     assert t % block == 0, (t, block)
     nb = t // block
     scale = 1.0 / d**0.5
     lse3 = lse.reshape(bh, 1, t)
+    # D_i = rowsum(dO ∘ O) is constant per q row: precompute it ONCE
+    # in plain jnp and ship it like lse (a resident [BH, 1, T] f32 row
+    # per bh) instead of streaming the full `out` tensor into both
+    # kernels and recomputing the rowsum at every pair (~nb^2/2 times).
+    dvec = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                   axis=-1).reshape(bh, 1, t)
+
+    def qrow(b, p, qi, kj):
+        return (b, qi[p], 0)
+
+    def kcol(b, p, qi, kj):
+        return (b, kj[p], 0)
+
+    def whole_row(b, p, qi, kj):
+        return (b, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block, d), qrow),      # q
+        pl.BlockSpec((1, block, d), kcol),      # k
+        pl.BlockSpec((1, block, d), kcol),      # v
+        pl.BlockSpec((1, block, d), qrow),      # dout
+        pl.BlockSpec((1, 1, t), whole_row),     # lse
+        pl.BlockSpec((1, 1, t), whole_row),     # dvec
+    ]
+    operands = (q, k, v, dout, lse3, dvec)
 
     qi_r, kj_r, n_pairs = _tri_pairs(nb, "row")
     dq = pl.pallas_call(
@@ -420,25 +440,10 @@ def flash_attention_tri_bwd(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, n_pairs),
-            in_specs=[
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # q
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),  # k
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),  # v
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # dout
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # out
-                pl.BlockSpec((1, 1, t),
-                             lambda b, p, qi, kj: (b, 0, 0)),  # lse
-            ],
-            out_specs=pl.BlockSpec((1, block, d),
-                                   lambda b, p, qi, kj: (b, qi[p], 0)),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block, d), qrow),
             scratch_shapes=[
                 pltpu.VMEM((block, d), jnp.float32),  # dq accumulator
-                pltpu.VMEM((block, 128), jnp.float32),  # D_i stat tile
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -446,7 +451,7 @@ def flash_attention_tri_bwd(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qi_r, kj_r, q, k, v, dout, out, lse3)
+    )(qi_r, kj_r, *operands)
 
     qi_c, kj_c, _ = _tri_pairs(nb, "col")
     dk, dv = pl.pallas_call(
@@ -455,25 +460,10 @@ def flash_attention_tri_bwd(
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, n_pairs),
-            in_specs=[
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # q
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),  # k
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),  # v
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # dout
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, qi[p], 0)),  # out
-                pl.BlockSpec((1, 1, t),
-                             lambda b, p, qi, kj: (b, 0, 0)),  # lse
-            ],
+            in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),
-                pl.BlockSpec((1, block, d),
-                             lambda b, p, qi, kj: (b, kj[p], 0)),
+                pl.BlockSpec((1, block, d), kcol),
+                pl.BlockSpec((1, block, d), kcol),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block, d), jnp.float32),  # dk accumulator
@@ -488,5 +478,5 @@ def flash_attention_tri_bwd(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qi_c, kj_c, q, k, v, dout, out, lse3)
+    )(qi_c, kj_c, *operands)
     return dq, dk, dv
