@@ -1842,6 +1842,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--spec-draft-layers", type=int, default=0,
                     help="draft model layer count (0 = self-speculation: "
                          "draft shares the target weights)")
+    ap.add_argument("--spec-source", choices=["draft", "prompt"],
+                    default="draft",
+                    help="'prompt': n-gram prompt-lookup proposals from "
+                         "the request's own context — no draft model "
+                         "(tpumon.loadgen.prompt_lookup)")
     ap.add_argument("--prefix-cache", type=int, default=0,
                     help="prompt-prefix KV cache LRU entries (0 = off)")
     ap.add_argument("--kv-dtype", choices=["compute", "int8"],
@@ -1859,6 +1864,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="paged pool size in pages (0 = dense "
                          "equivalent; smaller = real memory savings "
                          "with admission backpressure)")
+    ap.add_argument("--paged-attn", choices=["gather", "kernel"],
+                    default="gather",
+                    help="paged decode read path: XLA fused gather or "
+                         "the Pallas paged-attention kernel (regime "
+                         "map in ops/paged_attention)")
     ap.add_argument("--no-report", action="store_true",
                     help="disable the workload self-report (HBM "
                          "footprint + activity to the monitor's "
@@ -1866,12 +1876,23 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     if args.spec_draft_layers and not args.spec_len:
         ap.error("--spec-draft-layers requires --spec-len > 0")
+    if args.spec_source == "prompt" and args.spec_draft_layers:
+        ap.error("--spec-source prompt proposes from context; drop "
+                 "--spec-draft-layers")
+    if args.spec_source == "prompt" and not args.spec_len:
+        ap.error("--spec-source prompt requires --spec-len > 0 "
+                 "(speculation is otherwise off and the flag would "
+                 "silently do nothing)")
     if args.spec_draft_layers >= 4:  # the CLI model's n_layers below
         ap.error("--spec-draft-layers must be < 4 (the target's depth)")
     if args.spec_len < 0:
         ap.error("--spec-len must be >= 0")
     if args.pool_pages and args.kv_layout != "paged":
         ap.error("--pool-pages requires --kv-layout paged")
+    if args.paged_attn == "kernel" and (
+            args.kv_layout != "paged" or args.kv_dtype == "int8"):
+        ap.error("--paged-attn kernel requires --kv-layout paged with "
+                 "--kv-dtype compute (the kernel reads bf16/f32 pages)")
 
     import dataclasses
 
@@ -1882,9 +1903,11 @@ def main(argv: list[str] | None = None) -> int:
     engine = ServingEngine(cfg=ServeConfig(
         model=model, slots=args.slots, prefill_len=32, quantize=args.quant,
         spec_len=args.spec_len, draft_model=draft,
+        spec_source=args.spec_source,
         prefix_cache_entries=args.prefix_cache,
         kv_layout=args.kv_layout, pool_pages=args.pool_pages,
         decode_block=args.decode_block, kv_dtype=args.kv_dtype,
+        paged_attn=args.paged_attn,
     ))
     _, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
