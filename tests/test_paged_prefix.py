@@ -243,3 +243,27 @@ def test_paged_spec_int8_kv_matches_paged_int8_plain():
     eng, spec = run(spec_len=3)
     assert spec == plain
     assert eng.spec_rounds_total > 0
+
+
+def test_prefix_sharing_composes_with_prompt_lookup_spec():
+    """Paged prefix page-sharing + prompt-lookup speculation + block
+    verify in ONE engine: outputs must match the plain paged engine
+    token for token (the full r05 feature stack composed)."""
+    import dataclasses
+
+    base = ServeConfig(model=SMALL, slots=2, prefill_len=8,
+                       kv_layout="paged")
+    shared = list(range(1, 17))  # two full chunks of shared prefix
+    prompts = [shared + [30 + i] for i in range(4)]
+
+    plain = ServingEngine(cfg=base)
+    ref = [plain.submit(p, max_new=8) for p in prompts]
+    plain.drain()
+
+    stacked = ServingEngine(cfg=dataclasses.replace(
+        base, prefix_cache_entries=8, spec_len=3, spec_source="prompt"))
+    got = [stacked.submit(p, max_new=8) for p in prompts]
+    stacked.drain()
+    assert [r.output for r in got] == [r.output for r in ref]
+    assert stacked.prefix_cache.hits > 0  # sharing actually happened
+    assert stacked.spec_rounds_total > 0  # speculation actually ran
