@@ -7,6 +7,7 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
+from tpumon.loadgen.model import ModelConfig  # noqa: E402
 from tpumon.loadgen.moe import (  # noqa: E402
     MoEConfig,
     _route,
@@ -82,3 +83,97 @@ def test_sharded_moe_train_step():
     p2, l2 = step(p1, x)
     assert np.isfinite(float(l1)) and float(l2) < float(l1)
     assert p1["w_in"].sharding.spec == P("expert", None, None)
+
+
+class TestMoEModelFamily:
+    """ModelConfig(n_experts>0): the Mixtral-style routed-FFN model
+    family (r05) — trains, serves across every engine mode with
+    identical greedy outputs (full-capacity routing makes MoE
+    shape-independent in serving), and shards over dp x tp."""
+
+    MOE = ModelConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq=64,
+                      compute_dtype="float32", n_experts=4)
+    PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5, 8, 9, 7], [2, 7]]
+
+    def test_moe_model_trains(self):
+        from functools import partial
+
+        from tpumon.loadgen.model import init_params, loss_fn, sgd_train_step
+
+        params = init_params(self.MOE, jax.random.PRNGKey(0))
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 33), 0, self.MOE.vocab, jnp.int32)
+        l0 = float(loss_fn(self.MOE, params, toks))
+        step = jax.jit(partial(sgd_train_step, self.MOE, lr=0.1))
+        p = params
+        for _ in range(30):
+            p, loss = step(p, toks)
+        assert float(loss) < l0 - 0.5, (l0, float(loss))
+
+    def _serve(self, **kw):
+        from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+        eng = ServingEngine(cfg=ServeConfig(
+            model=self.MOE, slots=2, prefill_len=8, **kw))
+        reqs = [eng.submit(p, max_new=8) for p in self.PROMPTS]
+        eng.drain()
+        assert all(r.done.is_set() for r in reqs)
+        return [r.output for r in reqs]
+
+    def test_serving_modes_token_identical(self):
+        """Full-capacity routing is batch-shape-independent, so step,
+        fused-block, paged, speculative, and prompt-lookup decode all
+        emit the same tokens. (int8 KV is excluded by design: its
+        quantization noise legitimately flips argmax near-ties.)"""
+        ref = self._serve()
+        assert self._serve(decode_block=4) == ref
+        assert self._serve(kv_layout="paged") == ref
+        assert self._serve(spec_len=3) == ref
+        assert self._serve(spec_len=3, spec_source="prompt",
+                           kv_layout="paged") == ref
+
+    def test_int8_kv_completes_with_valid_tokens(self):
+        outs = self._serve(kv_dtype="int8", decode_block=4)
+        assert all(len(o) == 9 for o in outs)
+        assert all(0 <= t < self.MOE.vocab for o in outs for t in o)
+
+    def test_dp_tp_train_step_matches_single_device(self):
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from tpumon.loadgen.model import (
+            init_params,
+            loss_fn,
+            make_sharded_train_step,
+        )
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            import pytest
+
+            pytest.skip("needs the 8-device CPU mesh")
+        mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("data", "model"))
+        params = init_params(self.MOE, jax.random.PRNGKey(0))
+        step, placed = make_sharded_train_step(self.MOE, mesh, params)
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                               self.MOE.vocab),
+            NamedSharding(mesh, P("data", None)))
+        _, loss = step(placed, tokens)
+        ref = loss_fn(self.MOE, params, tokens)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_moe_flops_accounting_counts_active_params(self):
+        from tpumon.loadgen.train import flops_per_token
+
+        import dataclasses
+
+        dense = dataclasses.replace(self.MOE, n_experts=0)
+        # Active params per token must not scale with the expert count.
+        f4 = flops_per_token(self.MOE, seq=32)
+        f8 = flops_per_token(
+            dataclasses.replace(self.MOE, n_experts=8), seq=32)
+        assert abs(f8 - f4) < f4 * 0.01
+        # One expert (2 matmuls) is cheaper than dense SwiGLU (3).
+        assert f4 < flops_per_token(dense, seq=32)

@@ -70,6 +70,16 @@ class ModelConfig:
     # kernel; multi-chip long-context stays sp_train ring/zigzag.
     attention: str = "naive"
     attn_block_k: int = 512
+    # Mixture-of-Experts FFN (Mixtral-style model family): n_experts>0
+    # replaces each layer's dense SwiGLU with a top-1 routed expert FFN
+    # (loadgen.moe — GShard dispatch/combine einsums, fixed capacity,
+    # dropped-overflow-to-residual). Works across training (dp x tp:
+    # experts shard over the "model" axis via PARAM_SPECS) and the full
+    # serving engine (decoder_forward routes per decoded token; decode
+    # batches are small so capacity floors at 1 token/expert). 0 = the
+    # dense Llama-style family.
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self) -> None:
         # Validate at construction (a typo'd schedule string silently
@@ -79,6 +89,8 @@ class ModelConfig:
             raise ValueError(f"unknown attention schedule {self.attention!r}")
         if self.attn_block_k < 1:
             raise ValueError(f"attn_block_k must be >= 1, got {self.attn_block_k}")
+        if self.n_experts < 0:
+            raise ValueError(f"n_experts must be >= 0, got {self.n_experts}")
 
     @property
     def head_dim(self) -> int:
@@ -101,19 +113,29 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append(
-            {
-                "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
-                "wq": dense(next(keys), (cfg.d_model, nh * hd)),
-                "wk": dense(next(keys), (cfg.d_model, nkv * hd)),
-                "wv": dense(next(keys), (cfg.d_model, nkv * hd)),
-                "wo": dense(next(keys), (nh * hd, cfg.d_model)),
-                "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        layer = {
+            "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "wq": dense(next(keys), (cfg.d_model, nh * hd)),
+            "wk": dense(next(keys), (cfg.d_model, nkv * hd)),
+            "wv": dense(next(keys), (cfg.d_model, nkv * hd)),
+            "wo": dense(next(keys), (nh * hd, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        }
+        if cfg.n_experts:
+            from tpumon.loadgen.moe import MoEConfig, init_moe_params
+
+            layer["moe"] = init_moe_params(
+                MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                          n_experts=cfg.n_experts,
+                          capacity_factor=cfg.moe_capacity_factor),
+                next(keys))
+        else:
+            layer.update({
                 "w_gate": dense(next(keys), (cfg.d_model, cfg.d_ff)),
                 "w_up": dense(next(keys), (cfg.d_model, cfg.d_ff)),
                 "w_down": dense(next(keys), (cfg.d_ff, cfg.d_model)),
-            }
-        )
+            })
+        layers.append(layer)
     return {
         "embed": dense(next(keys), (cfg.vocab, cfg.d_model), scale=0.02),
         "layers": layers,
@@ -142,6 +164,11 @@ PARAM_SPECS = {
     "w_gate": P(None, "model"),
     "w_up": P(None, "model"),
     "w_down": P("model", None),
+    # MoE family: experts sharded over the same mesh axis (expert
+    # parallelism on the tp axis); the router replicates.
+    "router": P(None, None),
+    "w_in": P("model", None, None),
+    "w_out": P("model", None, None),
 }
 
 
@@ -429,11 +456,41 @@ def _attention(
     return out @ layer["wo"].astype(dt)
 
 
-def _mlp(layer: dict, x: jax.Array, mesh: Mesh | None = None) -> jax.Array:
+def _mlp(layer: dict, x: jax.Array, mesh: Mesh | None = None,
+         cfg: ModelConfig | None = None) -> jax.Array:
     dt = x.dtype
+    if "moe" in layer:
+        return _moe_mlp(cfg, layer["moe"], x)
     h = jax.nn.silu(x @ layer["w_gate"].astype(dt)) * (x @ layer["w_up"].astype(dt))
     h = _constrain(h, mesh, P("data", None, "model"))
     return h @ layer["w_down"].astype(dt)
+
+
+def _moe_mlp(cfg: ModelConfig, moe_params: dict, x: jax.Array,
+             full_capacity: bool = False) -> jax.Array:
+    """Routed expert FFN over [B, T, D]: flattens tokens, routes
+    through loadgen.moe.moe_ffn (top-1, fixed capacity, dropped tokens
+    ride the residual), restores shape.
+    Sharding is declarative: expert weights carry PARAM_SPECS
+    placements and XLA inserts the dispatch/combine all-to-alls."""
+    from tpumon.loadgen.moe import MoEConfig, moe_ffn
+
+    if cfg is None:
+        raise ValueError(
+            "MoE layers need the ModelConfig at the _mlp call site; the "
+            "sp_train and pipeline paths run the dense family only "
+            "(their callers don't thread cfg — extend them before "
+            "training MoE there)")
+    b, t, d = x.shape
+    mcfg = MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                     n_experts=cfg.n_experts,
+                     capacity_factor=cfg.moe_capacity_factor)
+    dt = x.dtype
+    params = {k: v.astype(dt) if k != "router" else v
+              for k, v in moe_params.items()}
+    out = moe_ffn(mcfg, params, x.reshape(b * t, d).astype(dt),
+                  capacity=b * t if full_capacity else None)
+    return out.reshape(b, t, d).astype(dt)
 
 
 def forward(
@@ -446,7 +503,8 @@ def forward(
 
     def layer_block(x, layer):
         x = x + _attention(cfg, layer, _rms_norm(x, layer["attn_norm"]), mesh)
-        return x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]), mesh)
+        return x + _mlp(layer, _rms_norm(x, layer["mlp_norm"]), mesh,
+                        cfg=cfg)
 
     if cfg.remat:
         layer_block = jax.checkpoint(layer_block)
@@ -488,6 +546,17 @@ def sgd_train_step(
     return new_params, loss
 
 
+def _check_moe_tp(cfg: ModelConfig, mesh: Mesh) -> None:
+    """Experts shard over the "model" axis (PARAM_SPECS), so the expert
+    count must divide it — validate here instead of letting device_put
+    raise an opaque low-level dimension error."""
+    tp = mesh.shape.get("model", 1) if hasattr(mesh, "shape") else 1
+    if cfg.n_experts and cfg.n_experts % tp:
+        raise ValueError(
+            f"n_experts={cfg.n_experts} must be divisible by the mesh's "
+            f"'model' axis ({tp}) — experts shard over it")
+
+
 def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, params: dict):
     """jit the train step over a dp×tp mesh; returns (step_fn, placed_params).
 
@@ -495,6 +564,7 @@ def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, params: dict):
     derives the psum/all-reduce pattern (gradients over "data", activation
     reductions over "model") and routes them over ICI.
     """
+    _check_moe_tp(cfg, mesh)
     shardings = param_shardings(mesh, params)
     placed = jax.device_put(params, shardings)
     token_sharding = NamedSharding(mesh, P("data", None))

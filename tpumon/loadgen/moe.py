@@ -96,11 +96,15 @@ def moe_ffn(
     params: dict,
     x: jax.Array,
     mesh: Mesh | None = None,
+    capacity: int | None = None,
 ) -> jax.Array:
     """x: [G, d_model] -> [G, d_model]; dropped tokens return zeros
-    (callers add the residual)."""
+    (callers add the residual). ``capacity`` overrides the
+    capacity-factor default — the serving engine passes G (no drops)
+    so routing is independent of batch SHAPE and every decode mode
+    (step/block/spec-verify/paged) emits identical tokens."""
     g = x.shape[0]
-    capacity = cfg.capacity(g)
+    capacity = cfg.capacity(g) if capacity is None else capacity
     dispatch, combine = _route(cfg, params["router"], x, capacity)
     # Dispatch: [G, d] x [G, E, C] -> [E, C, d]. With tokens sharded over
     # "data" and experts over "expert", XLA lowers this to an all-to-all.

@@ -36,7 +36,9 @@ import jax.numpy as jnp
 # saves nothing and hurts), and the embedding table — its consumer is a
 # gather, so dequant can't fuse into a matmul and XLA would materialize
 # the whole dequantized table per step.
-SKIP_NAMES = ("embed", "attn_norm", "mlp_norm", "final_norm")
+# "router": quantizing routing logits would silently perturb the
+# argmax expert assignment — routing stays f32 (tiny weight anyway).
+SKIP_NAMES = ("embed", "attn_norm", "mlp_norm", "final_norm", "router")
 
 
 @jax.tree_util.register_pytree_node_class

@@ -231,9 +231,20 @@ def decoder_forward(cfg: ServeConfig, params: dict, tokens: jax.Array,
                 "bhqk,bkhd->bqhd", probs, vr).reshape(b, t, nh * hd)
         x = x + att @ layer["wo"].astype(dt)
         hm = _rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
-        x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
-            "w_down"].astype(dt)
+        if "moe" in layer:
+            # MoE family (model._moe_mlp): routed expert FFN at FULL
+            # capacity (no drops) — GShard capacity depends on the
+            # dispatch batch SHAPE, and serving runs the same sequence
+            # through different shapes (chunked prefill, step decode,
+            # fused blocks, spec verify); full capacity makes routing
+            # shape-independent so every mode emits identical tokens.
+            from tpumon.loadgen.model import _moe_mlp
+
+            x = x + _moe_mlp(m, layer["moe"], hm, full_capacity=True)
+        else:
+            gate = jax.nn.silu(hm @ layer["w_gate"].astype(dt))
+            x = x + (gate * (hm @ layer["w_up"].astype(dt))) @ layer[
+                "w_down"].astype(dt)
     return _rms_norm(x, params["final_norm"])
 
 
@@ -393,6 +404,9 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
     dp = mesh.shape.get("data", 1)
     assert cfg.model.n_kv_heads % tp == 0, (
         f"n_kv_heads={cfg.model.n_kv_heads} not divisible by tp={tp}")
+    from tpumon.loadgen.model import _check_moe_tp
+
+    _check_moe_tp(cfg.model, mesh)
     assert cfg.slots % dp == 0, f"slots={cfg.slots} not divisible by dp={dp}"
     shardings = param_shardings(mesh, params)
     placed = jax.device_put(params, shardings)
@@ -892,6 +906,9 @@ class ServingEngine:
             raise ValueError(
                 f"n_kv_heads={self.cfg.model.n_kv_heads} not divisible "
                 f"by tp={tp}")
+        from tpumon.loadgen.model import _check_moe_tp
+
+        _check_moe_tp(self.cfg.model, mesh)
         # Capture draft aliasing BEFORE rebinding self.params: after
         # device_put the old identities are gone.
         draft_is_target = self.spec_len and self.draft_params is self.params
@@ -1869,6 +1886,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="paged decode read path: XLA fused gather or "
                          "the Pallas paged-attention kernel (regime "
                          "map in ops/paged_attention)")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="serve the MoE model family: this many "
+                         "top-1-routed experts per layer (0 = dense; "
+                         "full-capacity routing in serving so every "
+                         "decode mode stays token-identical)")
     ap.add_argument("--no-report", action="store_true",
                     help="disable the workload self-report (HBM "
                          "footprint + activity to the monitor's "
@@ -1897,7 +1919,8 @@ def main(argv: list[str] | None = None) -> int:
     import dataclasses
 
     model = ModelConfig(vocab=2048, d_model=256, n_layers=4, n_heads=8,
-                        n_kv_heads=4, d_ff=1024, max_seq=256)
+                        n_kv_heads=4, d_ff=1024, max_seq=256,
+                        n_experts=args.experts)
     draft = (dataclasses.replace(model, n_layers=args.spec_draft_layers)
              if args.spec_draft_layers else None)
     engine = ServingEngine(cfg=ServeConfig(

@@ -114,13 +114,18 @@ def flops_per_token(cfg: ModelConfig, seq: int) -> float:
     """Training FLOPs per token: the standard 6·N (fwd 2N + bwd 4N over
     all parameters) plus the attention term 12·L·s·d (score+value
     matmuls, fwd+bwd, across layers at sequence length s)."""
+    # MoE family: FLOPs count ACTIVE parameters per token — the router
+    # plus the ONE routed expert (top-1, in+out projections) — not the
+    # full expert bank (standard MoE accounting).
+    ffn = (cfg.d_model * cfg.n_experts + 2 * cfg.d_model * cfg.d_ff
+           if cfg.n_experts else 3 * cfg.d_model * cfg.d_ff)
     n_params = (
         cfg.vocab * cfg.d_model * 2  # embed + untied lm_head
         + cfg.n_layers * (
             cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads)
             * cfg.head_dim  # qkv
             + cfg.n_heads * cfg.head_dim * cfg.d_model  # wo
-            + 3 * cfg.d_model * cfg.d_ff  # swiglu
+            + ffn
             + 2 * cfg.d_model  # norms
         )
         + cfg.d_model  # final norm
@@ -349,6 +354,11 @@ def run_train(
     params = init_params(cfg.model, jax.random.PRNGKey(cfg.seed))
 
     if cfg.parallel != "auto":
+        if cfg.model.n_experts:
+            raise ValueError(
+                "the MoE family does not compose with parallel="
+                f"{cfg.parallel!r} yet (the sp step's layer body runs "
+                "the dense family only); train MoE with parallel='auto'")
         # Sequence parallelism: 1-D "seq" mesh over all local devices;
         # each synthetic [B, seq] batch trains on seq-1 tokens, so the
         # shardable length is seq-1.
@@ -494,6 +504,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--attn-block", type=int, default=512,
                     help="K/V block rows for --attention chunked, pair "
                     "block for flash (1024 is the measured seq-8k knee)")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="MoE model family: replace each layer's dense "
+                    "SwiGLU with this many top-1-routed experts "
+                    "(0 = dense; GShard capacity-factor routing)")
     ap.add_argument(
         "--parallel", choices=["auto", "sp", "sp-ring"], default="auto",
         help="'auto': dp×tp over local devices; 'sp'/'sp-ring': "
@@ -511,6 +525,7 @@ def main(argv: list[str] | None = None) -> int:
             vocab=2048, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4,
             d_ff=1024, max_seq=max(64, args.seq), remat=args.remat,
             attention=args.attention, attn_block_k=args.attn_block,
+            n_experts=args.experts,
         ),
         steps=args.steps, batch=args.batch, seq=args.seq,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
