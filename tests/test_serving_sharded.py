@@ -236,3 +236,34 @@ def test_moe_model_serves_over_tp_mesh():
                 model=dataclasses.replace(CFG.model, n_experts=3),
                 slots=4, prefill_len=8),
             mesh=mesh)
+
+
+def test_moe_paged_spec_prompt_over_tp_mesh():
+    """The deepest composition in the engine: MoE model family + paged
+    KV pool + prompt-lookup speculation + tensor-parallel mesh — tokens
+    identical to the single-device paged MoE engine."""
+    import dataclasses
+
+    from tpumon.loadgen.serving import ServingEngine
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs multiple devices")
+    moe_model = dataclasses.replace(CFG.model, n_experts=4)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5], [2, 7]]
+
+    def run(mesh=None, **kw):
+        eng = ServingEngine(
+            cfg=ServeConfig(model=moe_model, slots=4, prefill_len=8,
+                            kv_layout="paged", **kw),
+            mesh=mesh)
+        reqs = [eng.submit(p, max_new=6) for p in prompts]
+        eng.drain()
+        assert all(r.done.is_set() for r in reqs)
+        return eng, [r.output for r in reqs]
+
+    _, ref = run()
+    mesh = Mesh(np.array(devs[:2]), ("model",))
+    eng, got = run(mesh=mesh, spec_len=2, spec_source="prompt")
+    assert got == ref
+    assert eng.spec_rounds_total > 0
