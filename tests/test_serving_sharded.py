@@ -203,6 +203,27 @@ class TestShardedPagedEngine:
                 mesh=self._tp_mesh())
 
 
+MOE_PROMPTS = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5], [2, 7]]
+
+
+def _moe_serve(mesh=None, **kw):
+    """Shared scaffold for the MoE serving tests: run the 4-prompt
+    batch through a ServeConfig(n_experts=4) engine."""
+    import dataclasses
+
+    from tpumon.loadgen.serving import ServingEngine
+
+    eng = ServingEngine(
+        cfg=ServeConfig(
+            model=dataclasses.replace(CFG.model, n_experts=4),
+            slots=4, prefill_len=8, **kw),
+        mesh=mesh)
+    reqs = [eng.submit(p, max_new=6) for p in MOE_PROMPTS]
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    return eng, [r.output for r in reqs]
+
+
 def test_moe_model_serves_over_tp_mesh():
     """The MoE model family through the tensor-parallel engine:
     experts shard over the 'model' axis alongside the Megatron attention
@@ -214,21 +235,9 @@ def test_moe_model_serves_over_tp_mesh():
     devs = jax.devices()
     if len(devs) < 2:
         pytest.skip("needs multiple devices")
-    moe_model = dataclasses.replace(CFG.model, n_experts=4)
-    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5], [2, 7]]
-
-    def run(mesh=None):
-        eng = ServingEngine(
-            cfg=ServeConfig(model=moe_model, slots=4, prefill_len=8),
-            mesh=mesh)
-        reqs = [eng.submit(p, max_new=6) for p in prompts]
-        eng.drain()
-        assert all(r.done.is_set() for r in reqs)
-        return [r.output for r in reqs]
-
-    ref = run()
+    _, ref = _moe_serve()
     mesh = Mesh(np.array(devs[:2]).reshape(1, 2), ("data", "model"))
-    assert run(mesh=mesh) == ref
+    assert _moe_serve(mesh=mesh)[1] == ref
     # Indivisible expert count fails with the clear validation error.
     with pytest.raises(ValueError, match="n_experts"):
         ServingEngine(
@@ -240,30 +249,18 @@ def test_moe_model_serves_over_tp_mesh():
 
 def test_moe_paged_spec_prompt_over_tp_mesh():
     """The deepest composition in the engine: MoE model family + paged
-    KV pool + prompt-lookup speculation + tensor-parallel mesh — tokens
-    identical to the single-device paged MoE engine."""
-    import dataclasses
-
-    from tpumon.loadgen.serving import ServingEngine
-
+    KV pool + prompt-lookup speculation + tensor-parallel mesh. The
+    mesh is the ONLY varied axis (spec settings identical on both
+    sides), and the spec engine must also equal plain paged decode
+    (the lossless contract) so a spec regression points at spec."""
     devs = jax.devices()
     if len(devs) < 2:
         pytest.skip("needs multiple devices")
-    moe_model = dataclasses.replace(CFG.model, n_experts=4)
-    prompts = [[3, 1, 4, 1, 5], [9, 2, 6], [5, 3, 5], [2, 7]]
-
-    def run(mesh=None, **kw):
-        eng = ServingEngine(
-            cfg=ServeConfig(model=moe_model, slots=4, prefill_len=8,
-                            kv_layout="paged", **kw),
-            mesh=mesh)
-        reqs = [eng.submit(p, max_new=6) for p in prompts]
-        eng.drain()
-        assert all(r.done.is_set() for r in reqs)
-        return eng, [r.output for r in reqs]
-
-    _, ref = run()
+    spec = dict(kv_layout="paged", spec_len=2, spec_source="prompt")
+    _, plain = _moe_serve(kv_layout="paged")
+    _, ref = _moe_serve(**spec)
+    assert ref == plain  # lossless speculation, single-device
     mesh = Mesh(np.array(devs[:2]), ("model",))
-    eng, got = run(mesh=mesh, spec_len=2, spec_source="prompt")
-    assert got == ref
+    eng, got = _moe_serve(mesh=mesh, **spec)
+    assert got == ref  # the mesh axis in isolation
     assert eng.spec_rounds_total > 0
