@@ -31,7 +31,16 @@ BENCH_r{N}.json (VERDICT round-1 item #2):
                        for a simulated 8-host × 8-chip (64-chip) fleet
 
 Kernel numbers need the real MXU and are null off-TPU; the rest run
-anywhere (small shapes off-TPU). Prints exactly ONE JSON line on stdout.
+anywhere (small shapes off-TPU).
+
+Artifact pipeline (VERDICT r05 weak #1: the full JSON outgrew the
+driver's 2000-char stdout tail and r05's number-of-record committed as
+``parsed: null``): the FULL result — every key, including the nested
+diagnostic dicts — is written to a results file (``--out``, default
+BENCH_FULL.json), and stdout's final line is a compact keys-of-record
+summary (KEYS_OF_RECORD, scalars only, < 1800 bytes — pinned by
+tests/test_bench_artifact.py) that points at the file. Truncating the
+tail can no longer lose the record.
 """
 
 from __future__ import annotations
@@ -745,6 +754,56 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
 }
 
 
+# The headline scalar per phase family — the driver's number-of-record.
+# Everything else (ratios' operands, IQR/oracle stats dicts, marginal
+# durations) lives only in the full results file. Keep this list scalar
+# and short: the serialized summary must stay under the driver's
+# tail-capture budget (tests/test_bench_artifact.py pins < 1800 bytes).
+KEYS_OF_RECORD: tuple[str, ...] = (
+    # scrape (driver metric contract: metric/value/unit/vs_baseline)
+    "metric", "value", "unit", "vs_baseline",
+    "sampler_samples_per_sec", "accel_backend",
+    # federation
+    "federation_chips", "federation_scrape_to_render_p50_ms",
+    # kernels
+    "mxu_matmul_pallas_tflops", "mxu_matmul_vs_xla",
+    "int8_matmul_pallas_tflops", "int8_matmul_vs_xla",
+    "paged_attention_pallas_kv_gbps", "paged_attention_vs_xla",
+    "paged_engine_step_gather_ms", "paged_engine_step_kernel_ms",
+    # train
+    "train_mfu_pct", "train_tokens_per_sec", "train_seq8k_mfu_pct",
+    # serving
+    "serving_tokens_per_sec", "serving_block8_tokens_per_sec",
+    "serving_spec_tokens_per_sec", "serving_spec_accept_pct",
+    "serving_spec_prompt_vs_block8",
+    "serving_paged_block8_tokens_per_sec",
+    "serving_paged_kernel_vs_gather",
+    "serving_int8kv_block8_tokens_per_sec",
+    "serving_prefix_ttft_cold_ms", "serving_prefix_ttft_hit_ms",
+)
+
+SUMMARY_MAX_BYTES = 1800
+
+
+def compact_summary(result: dict, full_path: str) -> dict:
+    """Keys-of-record only, nested dicts never — the one line the driver
+    tail-captures. Missing keys serialize as null (a failed phase must
+    still be visible in the record, not silently absent)."""
+
+    def scalar(v):
+        return None if isinstance(v, (dict, list)) else v
+
+    out = {k: scalar(result.get(k)) for k in KEYS_OF_RECORD}
+    out["full_results"] = full_path
+    return out
+
+
+def write_full_results(result: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+
+
 def _run_phase(name: str, backend: str) -> dict:
     on_tpu = backend == "jax"
     if name == "scrape":
@@ -775,6 +834,13 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(_run_phase(name, backend)))
         return 0
 
+    out_path = "BENCH_FULL.json"
+    if "--out" in argv:
+        i = argv.index("--out")
+        if i + 1 >= len(argv):
+            print("bench.py: --out requires a path", file=sys.stderr)
+            return 2
+        out_path = argv[i + 1]
     backend = _detect_backend()
     _note(f"backend={backend}")
     result: dict = {}
@@ -799,7 +865,15 @@ def main(argv: list[str] | None = None) -> int:
             _note(f"{name} FAILED: {type(e).__name__}: {str(e)[:200]}")
             for k in null_keys:
                 result.setdefault(k, None)
-    print(json.dumps(result))
+    # Record-of-truth to disk, compact summary (< SUMMARY_MAX_BYTES, so
+    # the driver's stdout tail always contains it whole) as the FINAL
+    # stdout line. A failed file write must not take the summary with it.
+    try:
+        write_full_results(result, out_path)
+        _note(f"full results -> {out_path}")
+    except OSError as e:
+        _note(f"full-results write FAILED: {e}")
+    print(json.dumps(compact_summary(result, out_path), separators=(",", ":")))
     return 0
 
 

@@ -1,0 +1,179 @@
+"""Chaos soak (ISSUE 1 acceptance): hang / error / slow / corrupt faults
+injected into host, accel, k8s and serving SIMULTANEOUSLY against the
+live server — every /api/* route keeps answering within 2x the sample
+interval, failing sources go stale and raise ``source-down`` alerts, and
+once the faults are lifted the breakers close and the alerts clear.
+
+This is the end-to-end proof of the resilience tentpole: the degraded
+modes are driven through the real app wiring (config --chaos ->
+collectors.chaos wrappers -> resilience deadlines/breakers -> alerts ->
+HTTP), not through unit seams."""
+
+import asyncio
+import time
+import urllib.request
+
+from tests.fakes import fake_jetstream, fake_k8s_api
+from tests.test_k8s import pod_doc
+from tests.test_server_api import get_json
+from tests.test_serving import JETSTREAM_TEXT
+from tpumon.app import build
+from tpumon.collectors.chaos import ChaosCollector
+from tpumon.config import load_config
+
+SAMPLE_INTERVAL_S = 0.75
+ROUTE_BUDGET_S = 2 * SAMPLE_INTERVAL_S
+
+ROUTES = (
+    "/",
+    "/api/host/metrics",
+    "/api/accel/metrics",
+    "/api/gpu/metrics",
+    "/api/k8s/pods",
+    "/api/history",
+    "/api/alerts",
+    "/api/serving",
+    "/api/topology",
+    "/api/health",
+    "/metrics",
+)
+
+# One fault mode per source, all four modes represented: host hangs
+# (deadline path), accel errors (breaker path), k8s errors behind the
+# real HTTP transport, serving is slow AND lies by omission.
+CHAOS_SPEC = (
+    "hang:host:1.0,err:accel:1.0,err:k8s:1.0,"
+    "slow:serving:120,corrupt:serving:1.0"
+)
+DOWN_TITLES = {f"Source {s} down" for s in ("host", "accel", "k8s")}
+
+
+def fetch_timed(port: int, path: str) -> tuple[int, float]:
+    t0 = time.monotonic()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=ROUTE_BUDGET_S + 5
+    ) as r:
+        r.read()
+        return r.status, time.monotonic() - t0
+
+
+async def wait_until(fn, what: str, timeout_s: float = 30.0):
+    """Poll ``fn`` (sync, cheap) until truthy while the sampler loops run
+    in the background; a bounded soak must fail loudly, never hang."""
+    t0 = time.monotonic()
+    while True:
+        v = fn()
+        if v:
+            return v
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError(f"soak: timed out waiting for {what}")
+        await asyncio.sleep(0.1)
+
+
+def test_chaos_soak_degrades_and_recovers():
+    k8s = fake_k8s_api([pod_doc(name="w0", phase="Running")])
+    js = fake_jetstream(JETSTREAM_TEXT)
+    cfg = load_config(env={
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "api",
+        "TPUMON_K8S_API_URL": k8s.url,
+        "TPUMON_SERVING_TARGETS": js.url,
+        "TPUMON_SAMPLE_INTERVAL_S": str(SAMPLE_INTERVAL_S),
+        "TPUMON_PODS_INTERVAL_S": str(SAMPLE_INTERVAL_S),
+        "TPUMON_SERVING_INTERVAL_S": str(SAMPLE_INTERVAL_S),
+        "TPUMON_COLLECT_DEADLINE_S": "0.3",
+        "TPUMON_BREAKER_FAILURES": "2",
+        "TPUMON_BREAKER_BACKOFF_S": "0.3",
+        "TPUMON_BREAKER_BACKOFF_MAX_S": "1.0",
+        "TPUMON_CHAOS": CHAOS_SPEC,
+        "TPUMON_CHAOS_SEED": "42",
+    })
+    sampler, server = build(cfg)
+    # --chaos wrapped exactly the targeted sources.
+    for c in (sampler.host, sampler.accel, sampler.k8s, sampler.serving):
+        assert isinstance(c, ChaosCollector)
+
+    async def scenario():
+        await sampler.start()  # live loops, faults active from tick one
+        await server.start()
+        port = server.port
+
+        def serious_titles():
+            return {
+                a["title"] for a in sampler.engine.last.get("serious", [])
+            }
+
+        def health():
+            return sampler.health_json()["sources"]
+
+        # --- degraded phase -------------------------------------------
+        # Failing sources trip their breakers and page as source-down.
+        await wait_until(
+            lambda: DOWN_TITLES <= serious_titles(),
+            f"source-down alerts {DOWN_TITLES}",
+        )
+        h = health()
+        for name in ("host", "accel", "k8s"):
+            assert not h[name]["ok"]
+            assert h[name]["breaker"]["state"] != "closed"
+        assert h["host"]["error"].startswith("deadline exceeded")
+        assert "injected error" in h["accel"]["error"]
+        assert h["host"]["deadline_exceeded"] >= 2
+        # Affected data is stale: the last sample's ts stops advancing.
+        assert time.time() - h["k8s"]["ts"] >= 0  # published, with its age
+        # Serving stays up but slow+corrupt: collected ok, payload marked.
+        assert h["serving"]["ok"]
+        assert any("corrupt" in n for n in h["serving"]["notes"])
+
+        # Every route answers within 2x the sample interval, mid-chaos —
+        # and the API view itself reports the chaos + degraded sources.
+        for path in ROUTES:
+            status, dt = await asyncio.to_thread(fetch_timed, port, path)
+            assert status == 200, path
+            assert dt < ROUTE_BUDGET_S, f"{path} took {dt:.2f}s under chaos"
+        api_health = await asyncio.to_thread(get_json, port, "/api/health")
+        assert api_health["chaos"] == CHAOS_SPEC
+        assert not api_health["sources"]["host"]["ok"]
+        alerts = await asyncio.to_thread(get_json, port, "/api/alerts")
+        assert DOWN_TITLES <= {a["title"] for a in alerts["serious"]}
+        metrics = await asyncio.to_thread(
+            lambda: urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+        )
+        assert 'tpumon_collect_deadline_exceeded_total{source="host"}' in metrics
+        assert 'tpumon_source_breaker_state{source="accel"}' in metrics
+
+        # --- recovery phase -------------------------------------------
+        for c in (sampler.host, sampler.accel, sampler.k8s, sampler.serving):
+            c.set_faults([])
+        await wait_until(
+            lambda: not (DOWN_TITLES & serious_titles()),
+            "source-down alerts to clear",
+        )
+        await wait_until(
+            lambda: all(
+                s["ok"] and s.get("breaker", {}).get("state", "closed") == "closed"
+                for s in health().values()
+            ),
+            "all sources healthy with closed breakers",
+        )
+        for path in ROUTES:
+            status, dt = await asyncio.to_thread(fetch_timed, port, path)
+            assert status == 200 and dt < ROUTE_BUDGET_S, path
+        # The watchdogs saw the whole soak without a swallowed-exception
+        # storm: chaos faults degrade samples, they don't crash loops.
+        loops = sampler.health_json()["loops"]
+        assert loops["fast"]["ticks"] > 0
+        assert loops["fast"]["consecutive_exceptions"] == 0
+
+        await server.stop()
+        await sampler.stop()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        k8s.close()
+        js.close()

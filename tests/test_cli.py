@@ -198,3 +198,28 @@ def test_remote_rejects_flag_shaped_url(capsys):
     rc = cli.main(["--remote", "--watch"])
     assert rc == 2
     assert "requires a tpumon URL" in capsys.readouterr().err
+
+
+def test_render_health_lines_degraded_and_chaos():
+    from tpumon.cli import render_health_lines
+
+    assert render_health_lines(None) == []
+    assert render_health_lines({"sources": {}}) == []
+    # Healthy closed-breaker sources stay silent.
+    health = {
+        "sources": {
+            "host": {"ok": True, "breaker": {"state": "closed"}},
+            "k8s": {
+                "ok": False,
+                "error": "deadline exceeded: k8s.collect() exceeded 10s",
+                "breaker": {"state": "open", "retry_in_s": 42.0},
+            },
+        },
+        "chaos": "hang:k8s:0.5",
+    }
+    lines = render_health_lines(health)
+    assert len(lines) == 2
+    assert "source k8s: DOWN" in lines[0]
+    assert "deadline exceeded" in lines[0]
+    assert "breaker open (retry 42s)" in lines[0]
+    assert lines[1] == "CHAOS ACTIVE: hang:k8s:0.5"

@@ -113,7 +113,7 @@ def test_example_configs_load():
             cfg = load_config(path=os.path.join(examples, name), env={})
             assert cfg.port == 8888
             loaded += 1
-    assert loaded == 5
+    assert loaded == 6  # 5 deployment shapes + the chaos soak
 
 
 def test_topology_map_wired(script):

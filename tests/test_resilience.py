@@ -1,0 +1,664 @@
+"""Resilience layer (tpumon.resilience + tpumon.collectors.chaos +
+crash-safe history): the degraded modes SURVEY §7 promises, now
+exercised — hung collectors bounded by deadlines, repeated failures
+tripping circuit breakers, loop exceptions counted, history surviving
+restarts, and every fault injectable on demand."""
+
+import asyncio
+import json
+import random
+import time
+
+import pytest
+
+from tpumon.collectors import Sample, run_collector
+from tpumon.collectors.chaos import (
+    ChaosCollector,
+    ChaosError,
+    Fault,
+    parse_chaos_spec,
+    wrap_collectors,
+)
+from tpumon.config import load_config
+from tpumon.history import HistorySnapshotter, RingHistory
+from tpumon.resilience import (
+    DEADLINE_ERROR,
+    CircuitBreaker,
+    DeadlineExceeded,
+    LoopWatchdog,
+    collect_bounded,
+)
+from tpumon.sampler import Sampler
+
+
+class FakeCollector:
+    """Scripted collector: hangs, raises, or returns per call."""
+
+    def __init__(self, name="fake", hang_s=0.0, error=None, data=None,
+                 swallow_cancel=False):
+        self.name = name
+        self.hang_s = hang_s
+        self.error = error
+        self.data = data if data is not None else {"v": 1}
+        self.swallow_cancel = swallow_cancel
+        self.calls = 0
+        self.cancelled = 0
+
+    async def collect(self) -> Sample:
+        self.calls += 1
+        if self.hang_s:
+            try:
+                await asyncio.sleep(self.hang_s)
+            except asyncio.CancelledError:
+                self.cancelled += 1
+                if not self.swallow_cancel:
+                    raise
+                await asyncio.sleep(self.hang_s)  # wedged: ignores cancel
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return Sample(source=self.name, ok=True, data=self.data)
+
+
+def sampler_cfg(**env):
+    base = {"TPUMON_COLLECTORS": "host,accel", "TPUMON_K8S_MODE": "none"}
+    base.update(env)
+    return load_config(env=base)
+
+
+# ------------------------------ deadlines ------------------------------
+
+def test_collect_bounded_returns_at_deadline():
+    c = FakeCollector(hang_s=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        asyncio.run(collect_bounded(c, deadline_s=0.05))
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_collect_bounded_unblocks_even_if_cancel_is_swallowed():
+    """bare asyncio.wait_for awaits the cancellation, so a task that
+    swallows CancelledError hangs the caller anyway; collect_bounded
+    must return at the deadline regardless."""
+    c = FakeCollector(hang_s=30.0, swallow_cancel=True)
+
+    async def run():
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            await collect_bounded(c, deadline_s=0.05)
+        return time.monotonic() - t0
+
+    assert asyncio.run(run()) < 1.0
+
+
+def test_collect_bounded_passthrough_and_own_exception():
+    ok = asyncio.run(collect_bounded(FakeCollector(), deadline_s=5.0))
+    assert ok.ok and ok.data == {"v": 1}
+    with pytest.raises(RuntimeError):
+        asyncio.run(collect_bounded(FakeCollector(error="boom"), deadline_s=5.0))
+
+
+def test_run_collector_degrades_on_deadline():
+    c = FakeCollector(name="k8s", hang_s=30.0)
+    s = asyncio.run(run_collector(c, deadline_s=0.05))
+    assert not s.ok and s.source == "k8s"
+    assert s.error.startswith(DEADLINE_ERROR)
+    assert s.latency_ms < 1000
+    # The orphan was cancelled, not leaked to run forever.
+    assert c.cancelled == 1
+
+
+def test_run_collector_without_deadline_unchanged():
+    s = asyncio.run(run_collector(FakeCollector()))
+    assert s.ok
+
+
+def test_collect_bounded_reaps_orphan_when_caller_cancelled():
+    """Sampler shutdown mid-collect: cancelling the caller must also
+    cancel the in-flight collect (asyncio.wait doesn't), or a hung
+    collector outlives the sampler."""
+    c = FakeCollector(hang_s=30.0)
+
+    async def run():
+        caller = asyncio.create_task(collect_bounded(c, deadline_s=10.0))
+        await asyncio.sleep(0.02)  # let the collect start
+        caller.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await caller
+        await asyncio.sleep(0.02)  # let the orphan's cancellation land
+        assert c.cancelled == 1
+
+    asyncio.run(run())
+
+
+def test_hung_collector_does_not_stall_tick_fast_or_other_sources():
+    """The tentpole's core claim: a collect() that never returns degrades
+    within the configured deadline and the OTHER source still samples on
+    this very tick."""
+    cfg = sampler_cfg(TPUMON_COLLECT_DEADLINE_S="0.1")
+    hung = FakeCollector(name="host", hang_s=60.0)
+    fast = FakeCollector(name="accel", data=[])
+    sampler = Sampler(cfg, host=hung, accel=fast)
+
+    async def run():
+        t0 = time.monotonic()
+        await sampler.tick_fast()
+        return time.monotonic() - t0
+
+    elapsed = asyncio.run(run())
+    assert elapsed < 1.0  # deadline 0.1 s + slack, not 60 s
+    assert not sampler.latest["host"].ok
+    assert sampler.latest["host"].error.startswith(DEADLINE_ERROR)
+    assert sampler.latest["accel"].ok and fast.calls == 1
+    assert sampler.stats["host"].deadline_exceeded == 1
+
+
+def test_per_source_deadline_override():
+    cfg = sampler_cfg(
+        TPUMON_COLLECT_DEADLINE_S="30",
+        TPUMON_COLLECT_DEADLINES='{"host": 0.05}',
+    )
+    sampler = Sampler(cfg, host=FakeCollector(name="host", hang_s=60.0))
+    assert sampler._deadline_for("host") == 0.05
+    assert sampler._deadline_for("accel") == 30.0
+    asyncio.run(sampler.tick_fast())
+    assert sampler.latest["host"].error.startswith(DEADLINE_ERROR)
+
+
+def test_wedged_orphan_caps_at_one_outstanding_collect():
+    """Cancellation cannot interrupt a thread wedged in blocking I/O, so
+    each abandoned collect can pin a shared-executor thread. While a
+    source's previous orphan is still alive, new polls are refused — a
+    wedged source holds at most ONE thread (not one per probe) and polls
+    resume once the orphan finally dies."""
+    cfg = sampler_cfg(
+        TPUMON_COLLECT_DEADLINE_S="0.05", TPUMON_BREAKER_FAILURES="0"
+    )
+    wedged = FakeCollector(name="host", hang_s=0.3, swallow_cancel=True)
+    sampler = Sampler(cfg, host=wedged)
+
+    async def run():
+        await sampler.tick_fast()  # deadline hit; orphan still wedged
+        await sampler.tick_fast()  # refused: orphan outstanding
+        assert wedged.calls == 1
+        assert sampler.stats["host"].failures == 2
+        assert "wedged" in sampler.latest["host"].error
+        await asyncio.sleep(0.4)  # the wedged orphan finally dies
+        await sampler.tick_fast()  # orphan reaped: polls resume
+        assert wedged.calls == 2
+
+    asyncio.run(run())
+    assert sampler.latest["host"].error.startswith(DEADLINE_ERROR)
+
+
+# ------------------------------ breaker --------------------------------
+
+def clocked_breaker(**kw):
+    now = [1000.0]
+    kw.setdefault("jitter_frac", 0.0)
+    br = CircuitBreaker(clock=lambda: now[0], **kw)
+    return br, now
+
+
+def test_breaker_full_lifecycle_closed_open_half_open_closed():
+    br, now = clocked_breaker(failure_threshold=3, base_backoff_s=5.0)
+    assert br.state == "closed" and br.allow()
+    for _ in range(2):
+        br.record(False)
+    assert br.state == "closed"  # below threshold
+    br.record(False)
+    assert br.state == "open" and br.opened_count == 1
+    assert not br.allow()
+    assert br.retry_in_s() == pytest.approx(5.0)
+    now[0] += 5.1
+    assert br.allow()  # backoff elapsed: this call is the probe
+    assert br.state == "half_open"
+    assert not br.allow()  # probe outstanding: nothing else admitted
+    br.record(True)
+    assert br.state == "closed" and br.allow()
+    assert br.consecutive_failures == 0
+
+
+def test_breaker_failed_probe_doubles_backoff_to_cap():
+    br, now = clocked_breaker(
+        failure_threshold=1, base_backoff_s=4.0, max_backoff_s=10.0
+    )
+    br.record(False)
+    assert br.state == "open"
+    for expect in (8.0, 10.0, 10.0):  # doubled, then capped
+        now[0] += 60
+        assert br.allow()
+        br.record(False)
+        assert br.state == "open"
+        assert br.retry_in_s() == pytest.approx(expect)
+
+
+def test_breaker_jitter_spreads_probes():
+    rng = random.Random(7)
+    br = CircuitBreaker(failure_threshold=1, base_backoff_s=100.0,
+                        jitter_frac=0.2, clock=lambda: 0.0, rng=rng)
+    br.record(False)
+    retry = br.retry_in_s()
+    assert 80.0 <= retry <= 120.0 and retry != 100.0
+
+
+def test_breaker_json_shape():
+    br, now = clocked_breaker(failure_threshold=1)
+    br.record(False)
+    d = br.to_json()
+    assert d["state"] == "open" and d["opened_count"] == 1
+    assert d["retry_in_s"] >= 0
+
+
+def test_sampler_breaker_skips_polls_while_open():
+    """An open breaker suppresses the poll entirely — the dead collector
+    is not invoked (no deadline budget burned), the skip is counted, and
+    the source recovers once the collector does."""
+    cfg = sampler_cfg(
+        TPUMON_BREAKER_FAILURES="2", TPUMON_BREAKER_BACKOFF_S="60"
+    )
+    bad = FakeCollector(name="host", error="dead")
+    sampler = Sampler(cfg, host=bad)
+
+    async def run():
+        for _ in range(5):
+            await sampler.tick_fast()
+
+    asyncio.run(run())
+    br = sampler.breakers["host"]
+    assert br.state == "open"
+    assert bad.calls == 2  # polls 3..5 suppressed
+    assert sampler.stats["host"].skipped == 3
+    # Backoff elapsed -> half-open probe; collector healthy -> closed.
+    br._next_probe = 0.0
+    bad.error = None
+    asyncio.run(sampler.tick_fast())
+    assert br.state == "closed" and sampler.latest["host"].ok
+
+
+def test_sampler_breaker_disabled_with_zero_failures():
+    cfg = sampler_cfg(TPUMON_BREAKER_FAILURES="0")
+    bad = FakeCollector(name="host", error="dead")
+    sampler = Sampler(cfg, host=bad)
+
+    async def run():
+        for _ in range(4):
+            await sampler.tick_fast()
+
+    asyncio.run(run())
+    assert sampler.breakers == {} and bad.calls == 4
+
+
+# ------------------------- source-down alerting ------------------------
+
+def test_source_down_alert_fires_and_clears():
+    cfg = sampler_cfg(
+        TPUMON_BREAKER_FAILURES="2", TPUMON_BREAKER_BACKOFF_S="60"
+    )
+    bad = FakeCollector(name="host", error="connection refused")
+    sampler = Sampler(cfg, host=bad, accel=FakeCollector(name="accel", data=[]))
+
+    async def ticks(n):
+        for _ in range(n):
+            await sampler.tick_fast()
+
+    asyncio.run(ticks(3))
+    serious = sampler.engine.last["serious"]
+    down = [a for a in serious if a["title"] == "Source host down"]
+    assert len(down) == 1
+    assert "connection refused" in down[0]["desc"]
+    # Recovery: breaker re-probes, closes, and the alert clears.
+    sampler.breakers["host"]._next_probe = 0.0
+    bad.error = None
+    asyncio.run(ticks(1))
+    assert not [
+        a for a in sampler.engine.last["serious"]
+        if a["title"] == "Source host down"
+    ]
+
+
+def test_source_health_shape():
+    cfg = sampler_cfg()
+    sampler = Sampler(cfg, host=FakeCollector(name="host"))
+    asyncio.run(sampler.tick_fast())
+    (h,) = sampler.source_health()
+    assert h == {
+        "source": "host", "ok": True, "error": None,
+        "consecutive_failures": 0, "breaker": "closed",
+    }
+
+
+# ------------------------------ watchdog -------------------------------
+
+def test_loop_watchdog_counts_lag_and_exceptions():
+    wd = LoopWatchdog(name="fast", interval_s=1.0)
+    wd.tick(0.5)
+    wd.tick(1.5)  # overran its interval
+    wd.tick(0.2, error="ValueError: boom")
+    wd.tick(0.2, error="ValueError: again")
+    d = wd.to_json()
+    assert d["ticks"] == 4 and d["lagged_ticks"] == 1
+    assert d["max_lag_s"] == pytest.approx(0.5)
+    assert d["exceptions"] == 2 and d["consecutive_exceptions"] == 2
+    assert d["last_error"] == "ValueError: again"
+    wd.tick(0.2)
+    assert wd.consecutive_exceptions == 0 and wd.exceptions == 2
+
+
+def test_sampler_loop_surfaces_swallowed_exceptions():
+    """The old ``except Exception: pass`` is now accounted: a pipeline
+    bug (not a collector failure) shows in the watchdog."""
+    cfg = sampler_cfg(TPUMON_SAMPLE_INTERVAL_S="0.01")
+    sampler = Sampler(cfg, host=FakeCollector(name="host"))
+    sampler._record_history = lambda ts: (_ for _ in ()).throw(
+        RuntimeError("pipeline bug")
+    )
+
+    async def run():
+        task = asyncio.create_task(
+            sampler._loop(sampler.tick_fast, 0.01, "fast")
+        )
+        for _ in range(200):
+            await asyncio.sleep(0.01)
+            if sampler.watchdogs["fast"].exceptions:
+                break
+        task.cancel()
+
+    asyncio.run(run())
+    wd = sampler.watchdogs["fast"]
+    assert wd.exceptions >= 1
+    assert "pipeline bug" in wd.last_error
+    assert "fast" in sampler.health_json()["loops"]
+
+
+# ------------------------------- chaos ---------------------------------
+
+def test_parse_chaos_spec():
+    spec = parse_chaos_spec("hang:accel:0.1, err:k8s:0.3,slow:host:200")
+    assert spec["accel"] == [Fault("hang", 0.1)]
+    assert spec["k8s"] == [Fault("err", 0.3)]
+    assert spec["host"] == [Fault("slow", 200.0)]
+
+
+@pytest.mark.parametrize("bad", [
+    "hang:accel",            # missing param
+    "explode:accel:0.1",     # unknown mode
+    "err:accel:lots",        # non-numeric param
+    "err:accel:1.5",         # probability > 1
+    "slow:accel:-5",         # negative
+])
+def test_parse_chaos_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_chaos_spec(bad)
+
+
+def test_wrap_collectors_targets_and_rejects_typos():
+    host, accel = FakeCollector(name="host"), FakeCollector(name="accel")
+    out = wrap_collectors(
+        {"host": host, "accel": accel, "k8s": None}, "err:host:1.0"
+    )
+    assert isinstance(out["host"], ChaosCollector)
+    assert out["accel"] is accel and out["k8s"] is None
+    with pytest.raises(ValueError):
+        wrap_collectors({"host": host}, "err:hots:1.0")
+    # A valid source whose collector is disabled (None) must also raise:
+    # the fault would silently inject nothing.
+    with pytest.raises(ValueError, match="disabled"):
+        wrap_collectors({"host": host, "k8s": None}, "err:k8s:1.0")
+
+
+def test_chaos_err_and_slow_faults():
+    inner = FakeCollector(name="host")
+    c = ChaosCollector(inner=inner, faults=[Fault("err", 1.0)], seed=1)
+    with pytest.raises(ChaosError):
+        asyncio.run(c.collect())
+    c.set_faults([Fault("slow", 50.0)])
+    t0 = time.monotonic()
+    s = asyncio.run(c.collect())
+    assert s.ok and time.monotonic() - t0 >= 0.05
+
+
+def test_chaos_hang_degrades_via_deadline():
+    c = ChaosCollector(
+        inner=FakeCollector(name="host"), faults=[Fault("hang", 1.0)]
+    )
+    s = asyncio.run(run_collector(c, deadline_s=0.05))
+    assert not s.ok and s.error.startswith(DEADLINE_ERROR)
+
+
+def test_chaos_corrupt_drops_never_invents():
+    inner = FakeCollector(
+        name="k8s",
+        data=[{"name": f"p{i}", "phase": "Running", "restarts": 0}
+              for i in range(8)],
+    )
+    c = ChaosCollector(inner=inner, faults=[Fault("corrupt", 1.0)], seed=3)
+    s = asyncio.run(c.collect())
+    assert s.ok  # corrupt payloads still report ok: the lie is in data
+    assert "chaos: payload corrupted" in s.notes
+    orig = {json.dumps(d, sort_keys=True) for d in inner.data}
+    for d in s.data:
+        assert set(d) < {"name", "phase", "restarts"} or (
+            json.dumps(d, sort_keys=True) in orig
+        )
+    assert len(s.data) <= len(inner.data)
+
+
+def test_chaos_flap_drives_breaker_open_half_open_closed():
+    """A flapping source exercises the breaker's whole lifecycle: errors
+    trip it open, the half-open probe during a healthy phase closes it."""
+    cfg = sampler_cfg(
+        TPUMON_BREAKER_FAILURES="2", TPUMON_BREAKER_BACKOFF_S="60"
+    )
+    chaos = ChaosCollector(
+        inner=FakeCollector(name="host"), faults=[Fault("flap", 0.3)], seed=11
+    )
+    sampler = Sampler(cfg, host=chaos)
+
+    async def ticks(n):
+        for _ in range(n):
+            await sampler.tick_fast()
+
+    seen = set()
+
+    def settle(deadline_states, n=40):
+        for _ in range(n):
+            asyncio.run(ticks(1))
+            br = sampler.breakers.get("host")
+            if br is None:
+                continue
+            seen.add(br.state)
+            if br.state in deadline_states:
+                return br
+        raise AssertionError(
+            f"breaker never reached {deadline_states}; saw {seen}"
+        )
+
+    settle({"open"})
+    # Force the probe due, then stop flapping: probe succeeds -> closed.
+    sampler.breakers["host"]._next_probe = 0.0
+    chaos.set_faults([])
+    settle({"closed"}, n=5)
+    assert {"open", "closed"} <= seen
+
+
+# ------------------------ crash-safe history ---------------------------
+
+def make_ring(n_fine=20, coarse_pairs=()):
+    ring = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    now = time.time()
+    for i in range(n_fine):
+        ring.record("cpu", 50.0 + i, ts=now - (n_fine - i) * 30)
+        ring.record("mxu", 10.0 + i, ts=now - (n_fine - i) * 30)
+    for t, v in coarse_pairs:
+        ring.restore_coarse("cpu", [(t, v)])
+    return ring
+
+
+def test_history_snapshot_restore_round_trip(tmp_path):
+    path = str(tmp_path / "hist.json")
+    ring = make_ring()
+    assert HistorySnapshotter(ring, path).save()
+
+    fresh = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    snap = HistorySnapshotter(fresh, path)
+    assert snap.restore()
+    assert [v for _, v in fresh.series["cpu"].points] == [
+        v for _, v in ring.series["cpu"].points
+    ]
+    assert [v for _, v in fresh.series["mxu"].points] == [
+        v for _, v in ring.series["mxu"].points
+    ]
+    # The restored ring serves /api/history's ring path.
+    out = fresh.snapshot_series("cpu", step_s=30)
+    assert out["data"]  # non-empty after restart
+
+
+def test_history_snapshot_restores_coarse_tier(tmp_path):
+    path = str(tmp_path / "hist.json")
+    now = time.time()
+    # Coarse points hours old (outside the fine window, inside the long
+    # one) plus fresh fine points.
+    ring = make_ring(coarse_pairs=[(now - 7200, 33.0), (now - 3600, 44.0)])
+    HistorySnapshotter(ring, path).save()
+    fresh = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    assert HistorySnapshotter(fresh, path).restore()
+    assert (pytest.approx(33.0), pytest.approx(44.0)) == tuple(
+        v for _, v in list(fresh.series["cpu"].coarse)[:2]
+    )
+
+
+def test_history_snapshot_rejects_corrupt_missing_stale(tmp_path):
+    ring = RingHistory(window_s=1800)
+    missing = HistorySnapshotter(ring, str(tmp_path / "nope.json"))
+    assert not missing.restore()
+
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    assert not HistorySnapshotter(ring, str(corrupt)).restore()
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({
+        "version": 1, "saved_at": time.time() - 48 * 3600,
+        "points": {"cpu": [[time.time(), 1.0]]}, "coarse": {},
+    }))
+    assert not HistorySnapshotter(ring, str(stale)).restore()
+    assert ring.series == {} or not ring.series.get("cpu")
+
+    wrong_version = tmp_path / "v99.json"
+    wrong_version.write_text(json.dumps(
+        {"version": 99, "saved_at": time.time(), "points": {}, "coarse": {}}
+    ))
+    assert not HistorySnapshotter(ring, str(wrong_version)).restore()
+
+
+def test_history_snapshot_staleness_tracks_long_window(tmp_path):
+    """The staleness cutoff is the ring's configured long window, not a
+    fixed day: a 72 h ring keeps a 30 h-old snapshot's coarse tier."""
+    now = time.time()
+    state = json.dumps({
+        "version": 1, "saved_at": now - 30 * 3600,
+        "points": {},
+        "coarse": {"cpu": [[now - 30 * 3600, 55.0]]},
+    })
+    path = tmp_path / "hist.json"
+    path.write_text(state)
+    wide = RingHistory(window_s=1800, long_window_s=72 * 3600)
+    assert HistorySnapshotter(wide, str(path)).restore()
+    assert list(wide.series["cpu"].coarse)
+    narrow = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    assert not HistorySnapshotter(narrow, str(path)).restore()
+
+
+def test_history_survives_sampler_stop_start_cycle(tmp_path):
+    """Acceptance: restored ring + coarse points are served by
+    /api/history after a monitor restart (app wiring: build ->
+    snapshotter.restore() when no full state snapshot restored)."""
+    from tpumon.app import build
+    from tpumon.history import HistoryService
+
+    path = str(tmp_path / "hist.json")
+    env = {
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+        "TPUMON_COLLECTORS": "host,accel",
+        "TPUMON_HISTORY_SNAPSHOT_PATH": path,
+    }
+
+    async def first_life():
+        sampler, _server = build(load_config(env=env))
+        for _ in range(3):
+            await sampler.tick_fast()
+        snap = HistorySnapshotter(sampler.history, path)
+        await snap.save_async()
+        return dict(sampler.history.dump_points())
+
+    saved = asyncio.run(first_life())
+    assert saved["cpu"] and saved["mxu"]
+
+    async def second_life():
+        sampler, _server = build(load_config(env=env))
+        snap = HistorySnapshotter(sampler.history, path)
+        assert snap.restore()
+        return await HistoryService(sampler.history, None).snapshot()
+
+    out = asyncio.run(second_life())
+    assert out["source"] == "ring"
+    assert out["cpu"]["data"] and out["mxu"]["data"]
+
+
+def test_snapshotter_periodic_loop_and_final_save(tmp_path):
+    path = str(tmp_path / "hist.json")
+    ring = make_ring(n_fine=4)
+    snap = HistorySnapshotter(ring, path, interval_s=0.02)
+
+    async def run():
+        await snap.start()
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            if snap.last_save_ts is not None:
+                break
+        await snap.stop()
+
+    asyncio.run(run())
+    assert snap.last_save_ts is not None and snap.last_error is None
+    with open(path) as f:
+        state = json.load(f)
+    assert state["version"] == 1 and state["points"]["cpu"]
+
+
+# ---------------------------- observability ----------------------------
+
+def test_health_and_exporter_surface_resilience_state():
+    from tpumon.exporter import render_exporter
+
+    cfg = sampler_cfg(
+        TPUMON_BREAKER_FAILURES="2", TPUMON_BREAKER_BACKOFF_S="60",
+        TPUMON_COLLECT_DEADLINE_S="0.05",
+    )
+    sampler = Sampler(
+        cfg,
+        host=FakeCollector(name="host", hang_s=60.0),
+        accel=FakeCollector(name="accel", data=[]),
+    )
+
+    async def run():
+        for _ in range(3):
+            await sampler.tick_fast()
+        sampler.watchdogs["fast"] = LoopWatchdog(name="fast", interval_s=1.0)
+        sampler.watchdogs["fast"].tick(2.0, error="RuntimeError: x")
+
+    asyncio.run(run())
+    health = sampler.health_json()
+    host = health["sources"]["host"]
+    assert host["breaker"]["state"] == "open"
+    assert host["deadline_exceeded"] >= 2
+    assert health["loops"]["fast"]["exceptions"] == 1
+
+    text = render_exporter(sampler)
+    assert 'tpumon_collect_deadline_exceeded_total{source="host"}' in text
+    assert 'tpumon_source_breaker_state{source="host"} 2' in text
+    assert 'tpumon_source_breaker_opened_total{source="host"}' in text
+    assert 'tpumon_loop_exceptions_total{loop="fast"}' in text
+    assert 'tpumon_loop_max_lag_seconds{loop="fast"}' in text

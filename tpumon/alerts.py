@@ -390,6 +390,37 @@ class AlertEngine:
         self._last_pods = current
         return alerts
 
+    # ------------- source-down rule (tpumon.resilience breakers) ----------
+
+    def _source_alerts(self, sources: list[dict] | None) -> list[Alert]:
+        """A monitoring *pipeline* rule: a source whose circuit breaker
+        left CLOSED has failed repeatedly and is being polled on a
+        backoff cadence — its panels are stale, which is itself a
+        page-worthy condition (SURVEY §7: the monitor must be loudest
+        about what it can no longer see)."""
+        alerts: list[Alert] = []
+        for s in sources or []:
+            if s.get("breaker", "closed") == "closed":
+                continue
+            name = s.get("source")
+            err = s.get("error") or "repeated collection failures"
+            alerts.append(
+                Alert(
+                    severity="serious",
+                    title=f"Source {name} down",
+                    desc=f"{s.get('consecutive_failures', 0)} consecutive "
+                    f"failures; polling on backoff "
+                    f"(breaker {s.get('breaker')}): {str(err)[:160]}",
+                    fix="The monitor is flying blind on this source — its "
+                    "panels show the last good data. Check the upstream "
+                    "(kubectl/apiserver reachability, libtpu runtime, "
+                    "serving targets) and the error text; the breaker "
+                    "re-probes and clears this automatically on recovery.",
+                    key=f"source.{name}.down",
+                )
+            )
+        return alerts
+
     # ------------- serving rules (BASELINE config 4) ----------------------
 
     def _serving_alerts(
@@ -473,12 +504,14 @@ class AlertEngine:
         slices: list[SliceView] | None = None,
         pods: list[dict] | None = None,
         serving: list[dict] | None = None,
+        sources: list[dict] | None = None,
         update_pod_state: bool = True,
         now: float | None = None,
     ) -> dict[str, list[dict]]:
         now = time.time() if now is None else now
         alerts: list[Alert] = []
         alerts += self._host_alerts(host)
+        alerts += self._source_alerts(sources)
         # Attribution uses the freshest pod view available: this
         # evaluation's pods, else the last healthy scrape's baseline.
         owner_pods = (

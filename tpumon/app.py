@@ -43,6 +43,16 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         if "serving" in enabled and cfg.serving_targets
         else None
     )
+    if cfg.chaos:
+        from tpumon.collectors.chaos import wrap_collectors
+
+        wrapped = wrap_collectors(
+            {"host": host, "accel": accel, "k8s": k8s, "serving": serving},
+            cfg.chaos,
+            seed=cfg.chaos_seed,
+        )
+        host, accel = wrapped["host"], wrapped["accel"]
+        k8s, serving = wrapped["k8s"], wrapped["serving"]
     ring = RingHistory(
         window_s=cfg.history_window_s,
         long_window_s=cfg.history_long_window_s,
@@ -80,15 +90,37 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
 async def run(cfg: Config) -> None:
     sampler, server = build(cfg)
     store = None
+    state_restored = False
     if cfg.state_path:
         from tpumon.state import StateStore
 
         store = StateStore(cfg.state_path, interval_s=cfg.state_interval_s)
-        if store.restore_into(sampler):
+        state_restored = store.restore_into(sampler)
+        if state_restored:
             print(f"tpumon resumed state from {cfg.state_path}", flush=True)
+    snapshotter = None
+    if cfg.history_snapshot_path:
+        from tpumon.history import HistorySnapshotter
+
+        snapshotter = HistorySnapshotter(
+            sampler.history,
+            cfg.history_snapshot_path,
+            interval_s=cfg.history_snapshot_interval_s,
+        )
+        # A full state restore already replayed history; restoring the
+        # history-only snapshot on top would double every point.
+        if not state_restored and snapshotter.restore():
+            print(
+                f"tpumon resumed history from {cfg.history_snapshot_path}",
+                flush=True,
+            )
+    if cfg.chaos:
+        print(f"tpumon CHAOS ACTIVE: {cfg.chaos}", flush=True)
     await sampler.start()
     if store is not None:
         await store.start(sampler)
+    if snapshotter is not None:
+        await snapshotter.start()
     await server.start()
     print(
         f"tpumon listening on http://{cfg.host}:{server.port} "
@@ -108,6 +140,8 @@ async def run(cfg: Config) -> None:
     await sampler.stop()
     if store is not None:
         await store.stop(sampler)
+    if snapshotter is not None:
+        await snapshotter.stop()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -216,6 +250,12 @@ def main(argv: list[str] | None = None) -> int:
             serve_loadgen = True
         elif arg == "--state":
             overrides["state_path"] = take(arg)
+        elif arg == "--chaos":
+            # Fault injection (tpumon.collectors.chaos): e.g.
+            # --chaos hang:accel:0.1,err:k8s:0.3,slow:host:200
+            overrides["chaos"] = take(arg)
+        elif arg == "--history-snapshot":
+            overrides["history_snapshot_path"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
@@ -227,7 +267,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-kv-dtype compute|int8] "
                 "[--loadgen-paged-attn gather|kernel] "
                 "[--loadgen-spec-source draft|prompt] "
-                "[--state FILE]\n"
+                "[--state FILE] [--history-snapshot FILE] "
+                "[--chaos mode:source:param,...]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

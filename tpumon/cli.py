@@ -108,6 +108,32 @@ def render_runtime_lines(runtime: dict | None) -> list[str]:
     return lines
 
 
+def render_health_lines(health: dict | None) -> list[str]:
+    """Degraded-source lines for the remote view: failing sources and
+    breakers that left closed (tpumon.resilience) — healthy sources stay
+    silent, a quick look only needs the problems."""
+    lines: list[str] = []
+    for name, s in sorted(((health or {}).get("sources") or {}).items()):
+        br = s.get("breaker") or {}
+        state = br.get("state", "closed")
+        if s.get("ok") and state == "closed":
+            continue
+        bits = [f"source {name}: DOWN" if not s.get("ok") else f"source {name}:"]
+        if s.get("error"):
+            bits.append(str(s["error"])[:80])
+        if state != "closed":
+            retry = br.get("retry_in_s")
+            bits.append(
+                f"breaker {state}"
+                + (f" (retry {retry:.0f}s)" if retry is not None else "")
+            )
+        lines.append(" · ".join(bits))
+    chaos = (health or {}).get("chaos")
+    if chaos:
+        lines.append(f"CHAOS ACTIVE: {chaos}")
+    return lines
+
+
 def render_status_lines(alerts: dict | None, serving: dict | None) -> list[str]:
     """Alert/serving/training summary lines for the remote view."""
     lines: list[str] = []
@@ -170,10 +196,10 @@ async def _run_remote(url: str, watch: float | None) -> int:
     first = True
     while True:
         failed.clear()
-        accel, host, alerts, serving = await asyncio.gather(
+        accel, host, alerts, serving, health = await asyncio.gather(
             *(asyncio.to_thread(get, p) for p in (
                 "/api/accel/metrics", "/api/host/metrics",
-                "/api/alerts", "/api/serving",
+                "/api/alerts", "/api/serving", "/api/health",
             ))
         )
         if accel is None and host is None:
@@ -196,6 +222,8 @@ async def _run_remote(url: str, watch: float | None) -> int:
             print(time.strftime("%H:%M:%S"), f"· tpumon info · {base}")
         print(render(chips, host or {}, rates))
         for line in render_runtime_lines((accel or {}).get("runtime")):
+            print(line)
+        for line in render_health_lines(health):
             print(line)
         for line in render_status_lines(alerts, serving):
             print(line)

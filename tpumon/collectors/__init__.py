@@ -19,6 +19,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Protocol, runtime_checkable
 
+from tpumon.resilience import DEADLINE_ERROR, DeadlineExceeded, collect_bounded
+
 
 @dataclass
 class Sample:
@@ -53,13 +55,28 @@ class Collector(Protocol):
     async def collect(self) -> Sample: ...
 
 
-async def run_collector(c: Collector) -> Sample:
+async def run_collector(
+    c: Collector, deadline_s: float | None = None, orphans: dict | None = None
+) -> Sample:
     """Invoke a collector, timing it and converting exceptions to a
     degraded Sample (the reference's silent-degradation contract,
-    monitor_server.js:80,94,113 — but with the error recorded)."""
+    monitor_server.js:80,94,113 — but with the error recorded).
+
+    With ``deadline_s``, the collect is wall-clock bounded
+    (tpumon.resilience.collect_bounded): a hung collector degrades to an
+    ``error="deadline exceeded"`` Sample at the deadline instead of
+    blocking the sampler loop forever, and the orphaned task is
+    cancelled/reaped so it cannot leak. ``orphans`` (caller-owned) caps
+    a wedged source at one outstanding orphan — see collect_bounded.
+    """
     t0 = time.monotonic()
     try:
-        s = await c.collect()
+        if deadline_s is not None and deadline_s > 0:
+            s = await collect_bounded(c, deadline_s, orphans=orphans)
+        else:
+            s = await c.collect()
+    except DeadlineExceeded as e:
+        s = Sample(source=c.name, ok=False, data=None, error=f"{DEADLINE_ERROR}: {e}")
     except Exception as e:  # degrade, never crash the sampler
         s = Sample(source=c.name, ok=False, data=None, error=f"{type(e).__name__}: {e}")
     s.latency_ms = (time.monotonic() - t0) * 1e3

@@ -130,6 +130,33 @@ class Config:
     pods_interval_s: float = 5.0
     serving_interval_s: float = 5.0
 
+    # --- resilience (tpumon.resilience; SURVEY §7 hardened) ---
+    # Wall-clock bound on any one collect(): a hung collector (stuck
+    # kubectl, wedged gRPC channel) degrades to a deadline-exceeded
+    # Sample instead of freezing the sampler loop. 0 disables.
+    collect_deadline_s: float = 10.0
+    # Per-source overrides, e.g. {"k8s": 30, "host": 2}.
+    collect_deadlines: Mapping[str, float] = field(default_factory=dict)
+    # Circuit breaker: after this many consecutive failures a source is
+    # probed on an exponential-backoff cadence (base..max, ±20% jitter)
+    # instead of at full rate. breaker_failures=0 disables breaking.
+    breaker_failures: int = 3
+    breaker_backoff_s: float = 5.0
+    breaker_backoff_max_s: float = 300.0
+    # Chaos fault injection ("mode:source:param,..." —
+    # tpumon.collectors.chaos; "" = no faults). Example:
+    # "hang:accel:0.1,err:k8s:0.3,slow:host:200".
+    chaos: str = ""
+    # Optional seed for reproducible chaos soaks.
+    chaos_seed: int | None = None
+
+    # --- crash-safe history (tpumon.history.HistorySnapshotter) ---
+    # Path for the periodic ring+coarse history snapshot; restored at
+    # startup so a monitor restart doesn't erase the recent past. None
+    # disables (state_path already covers history when configured).
+    history_snapshot_path: str | None = None
+    history_snapshot_interval_s: float = 30.0
+
     # --- collectors ---
     collectors: tuple[str, ...] = ("host", "accel", "k8s", "serving")
     # accel backend: "auto" | "jax" | "fake:<topology>" | "none"
@@ -204,6 +231,14 @@ _SCALAR_FIELDS: dict[str, type] = {
     "k8s_api_url": str,
     "state_path": str,
     "state_interval_s": float,
+    "collect_deadline_s": float,
+    "breaker_failures": int,
+    "breaker_backoff_s": float,
+    "breaker_backoff_max_s": float,
+    "chaos": str,
+    "chaos_seed": int,
+    "history_snapshot_path": str,
+    "history_snapshot_interval_s": float,
     "webhook_min_severity": str,
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
@@ -261,6 +296,8 @@ def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
             cfg_kw[key] = tuple(value)
         elif key == "expected_slice_chips":
             cfg_kw[key] = {str(k): int(v) for k, v in value.items()}
+        elif key == "collect_deadlines":
+            cfg_kw[key] = {str(k): float(v) for k, v in value.items()}
         elif key == "thresholds":
             cfg_kw["_thresholds_raw"] = value
         else:
@@ -294,9 +331,11 @@ def load_config(
         key = env_key[len("TPUMON_") :].lower()
         env_raw[key] = value
     if env_raw:
-        # Env values arrive as strings; expected_slice_chips as JSON.
+        # Env values arrive as strings; mapping-valued keys as JSON.
         if "expected_slice_chips" in env_raw:
             env_raw["expected_slice_chips"] = json.loads(env_raw["expected_slice_chips"])
+        if "collect_deadlines" in env_raw:
+            env_raw["collect_deadlines"] = json.loads(env_raw["collect_deadlines"])
         if "thresholds" in env_raw:
             env_raw["thresholds"] = json.loads(env_raw["thresholds"])
         _apply_mapping(kw, env_raw)

@@ -222,18 +222,64 @@ def render_exporter(sampler: Sampler) -> str:
     # ---- self metrics ----
     samples = w.counter("tpumon_samples_total", "Collection attempts per source")
     failures = w.counter("tpumon_sample_failures_total", "Failed collections")
+    deadline = w.counter(
+        "tpumon_collect_deadline_exceeded_total",
+        "Collections that hit their wall-clock deadline",
+    )
+    skipped = w.counter(
+        "tpumon_collect_skipped_total",
+        "Polls suppressed by an open circuit breaker",
+    )
     lat = w.gauge("tpumon_sample_latency_p50_ms", "Collection latency p50 (ms)")
     ok = w.gauge("tpumon_source_up", "Source healthy (1=ok)")
     for name, st in sorted(sampler.stats.items()):
         labels = {"source": name}
         samples.add(labels, st.samples)
         failures.add(labels, st.failures)
+        deadline.add(labels, st.deadline_exceeded)
+        skipped.add(labels, st.skipped)
         p50 = st.p50_ms()
         if p50 is not None:
             lat.add(labels, round(p50, 3))
         latest = sampler.latest.get(name)
         if latest is not None:
             ok.add(labels, 1.0 if latest.ok else 0.0)
+
+    # ---- resilience (tpumon.resilience) ----
+    if sampler.breakers:
+        state_g = w.gauge(
+            "tpumon_source_breaker_state",
+            "Circuit breaker state per source (0=closed 1=half_open 2=open)",
+        )
+        opened = w.counter(
+            "tpumon_source_breaker_opened_total",
+            "Times the breaker opened (entered backoff) per source",
+        )
+        state_code = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+        for name, br in sorted(sampler.breakers.items()):
+            labels = {"source": name}
+            state_g.add(labels, state_code.get(br.state, 2.0))
+            opened.add(labels, br.opened_count)
+    if sampler.watchdogs:
+        ticks = w.counter("tpumon_loop_ticks_total", "Sampler loop iterations")
+        lagged = w.counter(
+            "tpumon_loop_lagged_ticks_total",
+            "Loop iterations that overran their interval",
+        )
+        excs = w.counter(
+            "tpumon_loop_exceptions_total",
+            "Exceptions swallowed by a sampler loop (pipeline bugs)",
+        )
+        lag_max = w.gauge(
+            "tpumon_loop_max_lag_seconds", "Worst observed tick overrun"
+        )
+        for name, wd in sorted(sampler.watchdogs.items()):
+            labels = {"loop": name}
+            ticks.add(labels, wd.ticks)
+            lagged.add(labels, wd.lagged_ticks)
+            excs.add(labels, wd.exceptions)
+            lag_max.add(labels, round(wd.max_lag_s, 3))
+
     g = w.gauge("tpumon_uptime_seconds", "Monitor uptime")
     g.add({}, round(time.time() - sampler.started_at, 1))
     return w.render()

@@ -22,6 +22,10 @@ from __future__ import annotations
 
 import asyncio
 import bisect
+import contextlib
+import json
+import os
+import tempfile
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -226,6 +230,71 @@ class RingHistory:
             )
         s.coarse.extend((float(t), float(v)) for t, v in points)
 
+    # --------------- crash-safe persistence (dump/load) ----------------
+
+    def dump_points(self) -> dict[str, list[list[float]]]:
+        """Fine-tier raw points per series, JSON-shaped."""
+        return {
+            name: [[round(t, 3), v] for t, v in s.points]
+            for name, s in self.series.items()
+        }
+
+    def dump_coarse(self) -> dict[str, list[list[float]]]:
+        """Coarse-tier (bucket-mean) points per series, JSON-shaped.
+        Series with no coarse data are omitted."""
+        return {
+            name: [[round(t, 3), v] for t, v in s.coarse]
+            for name, s in self.series.items()
+            if s.coarse
+        }
+
+    def load_points(
+        self,
+        points: dict,
+        coarse: dict | None = None,
+        now: float | None = None,
+    ) -> None:
+        """Restore dumped fine + coarse tiers into this (assumed-fresh)
+        ring. Raises TypeError/ValueError/AttributeError on malformed
+        input — callers decide whether a bad snapshot is fatal.
+
+        Window cutoffs are applied against ``now``; replaying fine
+        points through record() re-derives every coarse bucket the fine
+        points touch — including a *partial* re-derivation of the bucket
+        the oldest fine point lands mid-way in — so restored coarse
+        entries stop at that bucket's START boundary, or the seam bucket
+        would appear twice with the partial mean shadowing the correct
+        full-bucket mean.
+        """
+        now = time.time() if now is None else now
+        cutoff = now - self.window_s
+        fine = [
+            (str(name), float(v), float(t))
+            for name, pts in points.items()
+            for t, v in pts
+            if float(t) >= cutoff
+        ]
+        long_cutoff = now - self.long_window_s
+        coarse_ok = {
+            str(name): [
+                (float(t), float(v)) for t, v in pts if float(t) >= long_cutoff
+            ]
+            for name, pts in (coarse or {}).items()
+        }
+        step = self.coarse_step_s
+        oldest_fine: dict[str, float] = {}
+        for name, _value, ts in fine:
+            oldest_fine[name] = min(oldest_fine.get(name, ts), ts)
+        for name, pts in coarse_ok.items():
+            bound = oldest_fine.get(name)
+            bucket_start = None if bound is None else (bound // step) * step
+            self.restore_coarse(
+                name,
+                [p for p in pts if bucket_start is None or p[0] < bucket_start],
+            )
+        for name, value, ts in fine:
+            self.record(name, value, ts=ts)
+
     def snapshot_series(
         self, name: str, step_s: float, window_s: float | None = None
     ) -> dict:
@@ -238,6 +307,138 @@ class RingHistory:
             "labels": [format_label(t, window) for t in grid],
             "data": [round(v, 2) for v in vals],
         }
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """tmp-in-same-dir + fsync + rename: a crash mid-write leaves the
+    previous file intact. Raises OSError on failure."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".tpumon-hist.", suffix=".tmp", dir=directory)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+HISTORY_SNAPSHOT_VERSION = 1
+
+
+class HistorySnapshotter:
+    """Crash-safe ring history: periodic atomic snapshot of the fine +
+    coarse tiers to disk, restore-on-start — a monitor restart no longer
+    erases the cluster's recent past even without Prometheus or a full
+    state_path checkpoint (tpumon.state covers alerts + pods; this is
+    the history-only, always-cheap subset).
+    """
+
+    def __init__(self, ring: RingHistory, path: str, interval_s: float = 30.0):
+        self.ring = ring
+        self.path = path
+        self.interval_s = interval_s
+        self.last_save_ts: float | None = None
+        self.last_error: str | None = None
+        self._task: asyncio.Task | None = None
+
+    def save(self) -> bool:
+        """Snapshot + write in one call. Only safe where nothing is
+        concurrently mutating the ring (tests, shutdown after loops
+        stopped); the live periodic path is save_async()."""
+        return self._write(self._snapshot())
+
+    async def save_async(self) -> bool:
+        """Snapshot on the event loop — the ring is only mutated there,
+        so this never races a tick — then write the frozen dict in a
+        worker thread."""
+        state = self._snapshot()
+        return await asyncio.to_thread(self._write, state)
+
+    def _snapshot(self) -> dict:
+        return {
+            "version": HISTORY_SNAPSHOT_VERSION,
+            "saved_at": time.time(),
+            "points": self.ring.dump_points(),
+            "coarse": self.ring.dump_coarse(),
+        }
+
+    def _write(self, state: dict) -> bool:
+        try:
+            atomic_write_json(self.path, state)
+        except OSError as e:
+            self.last_error = str(e)
+            return False
+        self.last_save_ts = state["saved_at"]
+        self.last_error = None
+        return True
+
+    def restore(self) -> bool:
+        """Best-effort warm start; False (restoring nothing) on a
+        missing, corrupt, wrong-version or stale snapshot."""
+        try:
+            with open(self.path) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            self.last_error = str(e)
+            return False
+        if not isinstance(state, dict) or state.get("version") != HISTORY_SNAPSHOT_VERSION:
+            return False
+        saved_at = state.get("saved_at")
+        now = time.time()
+        # A snapshot older than the ring's long window holds nothing
+        # servable — the cutoff tracks the configured window, not a
+        # fixed day, so a 72 h ring keeps a 30 h-old snapshot.
+        if (
+            not isinstance(saved_at, (int, float))
+            or now - saved_at > self.ring.long_window_s
+        ):
+            return False
+        try:
+            self.ring.load_points(
+                state.get("points") or {}, state.get("coarse") or {}, now=now
+            )
+        except (AttributeError, KeyError, TypeError, ValueError) as e:
+            self.last_error = f"malformed snapshot: {e}"
+            return False
+        return True
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "interval_s": self.interval_s,
+            "last_save_ts": self.last_save_ts,
+            "last_error": self.last_error,
+        }
+
+    # ---------------------------- lifecycle ----------------------------
+
+    async def start(self) -> None:
+        async def loop() -> None:
+            while True:
+                await asyncio.sleep(self.interval_s)
+                try:
+                    await self.save_async()
+                except Exception as e:  # never let the snapshot loop die
+                    self.last_error = str(e)
+
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        try:
+            await self.save_async()  # final snapshot
+        except Exception as e:
+            self.last_error = str(e)
 
 
 class HistoryService:

@@ -561,17 +561,33 @@ def _paged_engine_step_program(cfg, params, pool, last, positions, tables,
     tpumon.loadgen.paged_kv.paged_decode_step, gather or kernel read
     path per cfg.paged_attn) scanned in one dispatch, so the per-call
     tunnel/dispatch latency that dominates the end-to-end engine bench
-    is amortized away and only the step's device time remains."""
+    is amortized away and only the step's device time remains.
+
+    Positions ride the scan carry and advance one row per step, exactly
+    like the production engine's write cursor — a fixed position would
+    rewrite the same (page, offset) every step and never cross a page
+    boundary, hiding the table-walk cost the bench exists to measure.
+    They cycle within the last ``page_size + 1`` rows (a band that
+    always contains one page boundary) so context stays ~max while the
+    scatter keeps switching pages.
+    """
     from tpumon.loadgen.paged_kv import paged_decode_step
 
-    def body(carry, _):
-        pool, last = carry
-        pool, logits = paged_decode_step(
-            cfg, params, pool, last, positions, tables)
-        return (pool, jnp.argmax(logits, -1).astype(jnp.int32)), ()
+    ps = cfg.prefill_len
+    s_max = tables.shape[1] * ps
+    hi = s_max - 2  # last position with a valid next row
+    lo = max(hi - ps, 0)
 
-    (pool, last), _ = jax.lax.scan(body, (pool, last), None, length=steps)
-    return pool, last
+    def body(carry, _):
+        pool, last, pos = carry
+        pool, logits = paged_decode_step(
+            cfg, params, pool, last, pos, tables)
+        pos = jnp.where(pos >= hi, lo, pos + 1)
+        return (pool, jnp.argmax(logits, -1).astype(jnp.int32), pos), ()
+
+    (pool, last, positions), _ = jax.lax.scan(
+        body, (pool, last, positions), None, length=steps)
+    return pool, last, positions
 
 
 def measure_paged_engine_step_ms(cfg, inner_steps: int = 24,
@@ -601,20 +617,23 @@ def measure_paged_engine_step_ms(cfg, inner_steps: int = 24,
     tables = jnp.asarray(
         perm[: cfg.slots * max_pages].reshape(cfg.slots, max_pages),
         jnp.int32)
-    positions = jnp.full((cfg.slots,), m.max_seq - 2, jnp.int32)
     params = init_params(m, jax.random.PRNGKey(0))
 
     state = {
         "pool": init_pool(cfg, num_pages),
         "last": jnp.zeros((cfg.slots,), jnp.int32),
+        "positions": jnp.full((cfg.slots,), m.max_seq - 2, jnp.int32),
     }
 
     def run(n: int):
-        pool, last = _paged_engine_step_program(
-            cfg, params, state["pool"], state["last"], positions, tables, n)
+        pool, last, positions = _paged_engine_step_program(
+            cfg, params, state["pool"], state["last"], state["positions"],
+            tables, n)
         _sync(jnp.sum(last))
-        # The previous pool was donated into the call; carry the new one.
+        # The previous pool was donated into the call; carry the new one
+        # (and the advanced positions, so reps keep walking pages).
         state["pool"], state["last"] = pool, last
+        state["positions"] = positions
 
     # Per step the attention read streams the full table width of KV:
     # slots * max_pages * ps rows * nkv * hd * 2 (K+V) * itemsize,

@@ -241,6 +241,16 @@ def paged_decode_step(cfg, params: dict, pool: dict,
 
     attend = None
     if getattr(cfg, "paged_attn", "gather") == "kernel":
+        if "ks" in pool:
+            # The kernel reads pool["k"]/pool["v"] raw — on an int8 pool
+            # (init_pool with kv_dtype="int8") that means attending over
+            # undequantized pages: garbage logits, no error. The engine
+            # rejects the combination at init; direct callers must fail
+            # just as loudly.
+            raise ValueError(
+                "paged_attn='kernel' cannot read a quantized (int8) pool; "
+                "use the gather path or a compute-dtype pool"
+            )
         from tpumon.ops.paged_attention import paged_attention
 
         # Trace-time backend check: interpret mode on CPU/virtual
@@ -249,7 +259,7 @@ def paged_decode_step(cfg, params: dict, pool: dict,
         lengths = positions + 1  # rows 0..positions inclusive
 
         def attend(li, q, k, v):
-            scatter(li, k, v)  # int8 pools rejected at engine init
+            scatter(li, k, v)  # int8 pools also rejected above
             out = paged_attention(q[:, 0], pool["k"][li], pool["v"][li],
                                   tables, lengths, interpret=interpret)
             return out[:, None]  # [B, 1, nh, hd]

@@ -25,7 +25,9 @@ untrained target makes any proposer's acceptance noise).
 from __future__ import annotations
 
 
-def ngram_propose(context: list[int], g: int, max_n: int = 3) -> list[int]:
+def ngram_propose(
+    context: list[int], g: int, max_n: int = 3, window: int = 1024
+) -> list[int]:
     """Propose ``g`` next tokens for ``context`` by n-gram lookup.
 
     Searches for the most recent PRIOR occurrence of the longest
@@ -37,18 +39,26 @@ def ngram_propose(context: list[int], g: int, max_n: int = 3) -> list[int]:
     fallback is ``g`` repeats of the last token — acceptance then just
     measures how often the target emits runs, and the verify step makes
     any wrong guess harmless.
+
+    The backward scan only visits the last ``window`` tokens (0 = no
+    bound): the proposal runs on the host once per slot per speculative
+    round, so an unbounded scan would grow per-round cost linearly with
+    context length — and for the repetitive workloads this proposer
+    exists for, the recent period carries the signal anyway.
     """
     if g <= 0:
         return []
     if not context:
         return [0] * g
     last = context[-1]
+    lo = max(0, len(context) - window) if window and window > 0 else 0
     for n in range(min(max_n, len(context)), 0, -1):
         tail = context[-n:]
         # Rightmost occurrence strictly before the trailing one, with
-        # at least one continuation token available.
+        # at least one continuation token available; candidates older
+        # than the window are never visited.
         hi = len(context) - n - 1  # last candidate start index
-        for i in range(hi, -1, -1):
+        for i in range(hi, lo - 1, -1):
             if context[i:i + n] == tail:
                 prop = context[i + n:i + n + g]
                 if not prop:

@@ -80,6 +80,12 @@ class ServeConfig:
     # being repetitive continuations. Verify step identical either way,
     # so greedy output stays lossless regardless of proposal quality.
     spec_source: str = "draft"
+    # Prompt-lookup backward-scan bound: only the most recent
+    # spec_ngram_window tokens of each request's context are searched
+    # per round (0 = unbounded), so host-side proposal cost stops
+    # growing with context length. 1024 comfortably covers the periods
+    # of the repetitive workloads the proposer targets.
+    spec_ngram_window: int = 1024
     # Prefix caching: LRU entries of chunk-aligned prompt-prefix K/V;
     # 0 = off. Dense layout snapshots+restores rows with an HBM copy
     # (tpumon.loadgen.prefix_cache); paged layout SHARES the prefix's
@@ -1439,7 +1445,8 @@ class ServingEngine:
                 prop_rows.append([0] * g)
             else:
                 prop_rows.append(
-                    ngram_propose(req.prompt + req.output, g))
+                    ngram_propose(req.prompt + req.output, g,
+                                  window=self.cfg.spec_ngram_window))
         proposed = jnp.asarray(prop_rows, jnp.int32)  # [B, g]
         self._spec_verify_emit(active, proposed, prop_h=prop_rows)
 

@@ -26,6 +26,7 @@ from tpumon.alerts import AlertEngine
 from tpumon.collectors import Collector, Sample, run_collector
 from tpumon.config import Config
 from tpumon.history import RingHistory
+from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
 from tpumon.topology import ChipSample, slice_views
 
 
@@ -34,6 +35,8 @@ class SourceStats:
     samples: int = 0
     failures: int = 0
     consecutive_failures: int = 0
+    deadline_exceeded: int = 0  # failures that were deadline expiries
+    skipped: int = 0  # polls the circuit breaker suppressed
     latencies_ms: deque = field(default_factory=lambda: deque(maxlen=512))
 
     def record(self, s: Sample) -> None:
@@ -44,6 +47,8 @@ class SourceStats:
         else:
             self.failures += 1
             self.consecutive_failures += 1
+            if s.error and s.error.startswith(DEADLINE_ERROR):
+                self.deadline_exceeded += 1
 
     def p50_ms(self) -> float | None:
         return statistics.median(self.latencies_ms) if self.latencies_ms else None
@@ -53,6 +58,8 @@ class SourceStats:
             "samples": self.samples,
             "failures": self.failures,
             "consecutive_failures": self.consecutive_failures,
+            "deadline_exceeded": self.deadline_exceeded,
+            "skipped": self.skipped,
             "latency_p50_ms": round(self.p50_ms() or 0.0, 3),
         }
 
@@ -84,6 +91,18 @@ class Sampler:
 
         self.latest: dict[str, Sample] = {}
         self.stats: dict[str, SourceStats] = {}
+        # Per-source circuit breakers (tpumon.resilience): a repeatedly-
+        # failing source is probed on a backoff cadence instead of paying
+        # a full deadline's worth of tick budget every interval.
+        # breaker_failures=0 disables breaking entirely.
+        self.breakers: dict[str, CircuitBreaker] = {}
+        # Per-loop watchdogs: tick lag/skew + swallowed exceptions.
+        self.watchdogs: dict[str, LoopWatchdog] = {}
+        # Wedged-orphan registry (tpumon.resilience.collect_bounded): a
+        # source whose deadline-abandoned collect is STILL alive (pinned
+        # in a worker thread cancellation can't interrupt) is refused new
+        # polls, so it holds at most one shared-executor thread.
+        self._orphans: dict[str, asyncio.Task] = {}
         self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
         self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
         # Host NIC rates — the DCN-traffic proxy (SURVEY §5.8: ICI
@@ -129,18 +148,53 @@ class Sampler:
                 name: {
                     **(self.latest[name].health_json() if name in self.latest else {}),
                     **(self.stats[name].to_json() if name in self.stats else {}),
+                    **(
+                        {"breaker": self.breakers[name].to_json()}
+                        if name in self.breakers
+                        else {}
+                    ),
                 }
                 for name in ("host", "accel", "k8s", "serving")
                 if name in self.latest or name in self.stats
+            },
+            "loops": {
+                name: wd.to_json() for name, wd in self.watchdogs.items()
             },
         }
 
     # ----------------------------- sampling -------------------------------
 
+    def _deadline_for(self, name: str) -> float | None:
+        d = self.cfg.collect_deadlines.get(name, self.cfg.collect_deadline_s)
+        return d if d and d > 0 else None
+
+    def _breaker_for(self, name: str) -> CircuitBreaker | None:
+        if self.cfg.breaker_failures <= 0:
+            return None
+        br = self.breakers.get(name)
+        if br is None:
+            br = self.breakers[name] = CircuitBreaker(
+                failure_threshold=self.cfg.breaker_failures,
+                base_backoff_s=self.cfg.breaker_backoff_s,
+                max_backoff_s=self.cfg.breaker_backoff_max_s,
+            )
+        return br
+
     async def _run(self, c: Collector | None) -> Sample | None:
         if c is None:
             return None
-        s = await run_collector(c)
+        br = self._breaker_for(c.name)
+        if br is not None and not br.allow():
+            # Open breaker mid-backoff: skip the poll entirely. The last
+            # degraded Sample stays published (its ts shows staleness);
+            # the skip is counted so /api/health shows the reduced rate.
+            self.stats.setdefault(c.name, SourceStats()).skipped += 1
+            return None
+        s = await run_collector(
+            c, deadline_s=self._deadline_for(c.name), orphans=self._orphans
+        )
+        if br is not None:
+            br.record(s.ok)
         self.latest[s.source] = s
         self.stats.setdefault(s.source, SourceStats()).record(s)
         return s
@@ -249,6 +303,27 @@ class Sampler:
             if vals:
                 rec(name, agg(vals), ts)
 
+    def source_health(self) -> list[dict]:
+        """Per-source pipeline health for the ``source-down`` alert rule
+        and /api/health consumers: latest ok/error + breaker state."""
+        out = []
+        for name in ("host", "accel", "k8s", "serving"):
+            s = self.latest.get(name)
+            st = self.stats.get(name)
+            if s is None and st is None:
+                continue
+            br = self.breakers.get(name)
+            out.append(
+                {
+                    "source": name,
+                    "ok": bool(s.ok) if s is not None else False,
+                    "error": s.error if s is not None else None,
+                    "consecutive_failures": st.consecutive_failures if st else 0,
+                    "breaker": br.state if br is not None else "closed",
+                }
+            )
+        return out
+
     def _evaluate_alerts(self) -> None:
         # Pod rules only run on a healthy scrape: a failed scrape must not
         # wipe transition state (restarts/recoveries during the outage
@@ -260,6 +335,7 @@ class Sampler:
             slices=self.slices(),
             pods=self.pods() if (k8s_sample is not None and k8s_sample.ok) else None,
             serving=self.serving_data() or None,
+            sources=self.source_health(),
         )
         self._notify_new_events()
 
@@ -326,29 +402,42 @@ class Sampler:
 
     # ----------------------------- lifecycle -------------------------------
 
-    async def _loop(self, fn, interval_s: float) -> None:
+    async def _loop(self, fn, interval_s: float, name: str) -> None:
+        wd = self.watchdogs.setdefault(
+            name, LoopWatchdog(name=name, interval_s=interval_s)
+        )
         while True:
             t0 = time.monotonic()
+            err = None
             try:
                 await fn()
-            except Exception:
-                pass  # collectors already degrade; never kill the loop
+            except Exception as e:
+                # Collectors already degrade; never kill the loop — but
+                # a swallowed exception here is a *pipeline* bug (alert
+                # evaluation, history recording), so the watchdog counts
+                # it instead of the old silent ``pass``.
+                err = f"{type(e).__name__}: {e}"
+            wd.tick(time.monotonic() - t0, err)
             elapsed = time.monotonic() - t0
             await asyncio.sleep(max(0.05, interval_s - elapsed))
 
     async def start(self) -> None:
         await self.tick_all()  # prime state before serving
         self._tasks = [
-            asyncio.create_task(self._loop(self.tick_fast, self.cfg.sample_interval_s)),
+            asyncio.create_task(
+                self._loop(self.tick_fast, self.cfg.sample_interval_s, "fast")
+            ),
         ]
         if self.k8s is not None:
             self._tasks.append(
-                asyncio.create_task(self._loop(self.tick_pods, self.cfg.pods_interval_s))
+                asyncio.create_task(
+                    self._loop(self.tick_pods, self.cfg.pods_interval_s, "pods")
+                )
             )
         if self.serving is not None:
             self._tasks.append(
                 asyncio.create_task(
-                    self._loop(self.tick_serving, self.cfg.serving_interval_s)
+                    self._loop(self.tick_serving, self.cfg.serving_interval_s, "serving")
                 )
             )
 

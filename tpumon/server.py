@@ -254,6 +254,9 @@ class MonitorServer:
         }
         return {
             **self.sampler.health_json(),
+            # Active fault-injection spec (tpumon.collectors.chaos) — a
+            # soak run must be unmistakable as such in every health view.
+            **({"chaos": self.cfg.chaos} if self.cfg.chaos else {}),
             "http": {
                 "requests": len(lat),
                 "latency_p50_ms": round(statistics.median(lat), 3) if lat else None,

@@ -19,12 +19,10 @@ warm-start cache, never a source of truth.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import json
-import os
-import tempfile
 import time
 
+from tpumon.history import atomic_write_json
 from tpumon.sampler import Sampler
 
 STATE_VERSION = 1
@@ -39,17 +37,10 @@ def snapshot_state(sampler: Sampler) -> dict:
     return {
         "version": STATE_VERSION,
         "saved_at": time.time(),
-        "history": {
-            name: [[round(t, 3), v] for t, v in s.points]
-            for name, s in sampler.history.series.items()
-        },
+        "history": sampler.history.dump_points(),
         # Coarse long-window tier (bucket means) — kept separately so the
         # 24 h view also survives a restart.
-        "history_coarse": {
-            name: [[round(t, 3), v] for t, v in s.coarse]
-            for name, s in sampler.history.series.items()
-            if s.coarse
-        },
+        "history_coarse": sampler.history.dump_coarse(),
         "alerts": sampler.engine.to_state(),
     }
 
@@ -68,18 +59,16 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
     # sampler only after the whole snapshot proved well-formed (a partial
     # restore would leave history without its matching alert baseline).
     try:
-        cutoff = now - sampler.history.window_s
-        points = [
-            (str(name), float(v), float(t))
+        # Probe-parse the history tiers before touching the ring: a
+        # malformed point must not abort mid-restore. The real restore
+        # (window cutoffs + the coarse/fine seam rule) lives in
+        # RingHistory.load_points.
+        points = {
+            str(name): [(float(t), float(v)) for t, v in pts]
             for name, pts in state["history"].items()
-            for t, v in pts
-            if float(t) >= cutoff
-        ]
-        long_cutoff = now - sampler.history.long_window_s
+        }
         coarse = {
-            str(name): [
-                (float(t), float(v)) for t, v in pts if float(t) >= long_cutoff
-            ]
+            str(name): [(float(t), float(v)) for t, v in pts]
             for name, pts in (state.get("history_coarse") or {}).items()
         }
         alerts = state["alerts"]
@@ -91,25 +80,7 @@ def restore_state(sampler: Sampler, state: dict) -> bool:
         }
     except (AttributeError, KeyError, TypeError, ValueError):
         return False
-    # Coarse tiers first: replaying fine points through record() re-derives
-    # every coarse bucket the fine points touch — including a partial
-    # re-derivation of the bucket the oldest fine point lands mid-way in.
-    # Restored coarse entries must therefore stop at that bucket's START
-    # boundary (not the raw fine timestamp), or the seam bucket appears
-    # twice and the partial mean shadows the correct full-bucket mean.
-    step = sampler.history.coarse_step_s
-    oldest_fine: dict[str, float] = {}
-    for name, _value, ts in points:
-        oldest_fine[name] = min(oldest_fine.get(name, ts), ts)
-    for name, pts in coarse.items():
-        bound = oldest_fine.get(name)
-        bucket_start = None if bound is None else (bound // step) * step
-        sampler.history.restore_coarse(
-            name,
-            [p for p in pts if bucket_start is None or p[0] < bucket_start],
-        )
-    for name, value, ts in points:
-        sampler.history.record(name, value, ts=ts)
+    sampler.history.load_points(points, coarse, now=now)
     sampler.engine.load_state(alert_state)
     # Restored timeline events were delivered (or intentionally not) in a
     # previous life — never re-page them through the webhook notifier.
@@ -141,23 +112,11 @@ class StateStore:
         return await asyncio.to_thread(self._write, state)
 
     def _write(self, state: dict) -> bool:
-        """Write a snapshot atomically: tmp file in the same directory,
-        fsync, rename — a crash mid-write leaves the previous snapshot."""
-        directory = os.path.dirname(os.path.abspath(self.path)) or "."
+        """Write a snapshot atomically (tmp + fsync + rename,
+        tpumon.history.atomic_write_json) — a crash mid-write leaves the
+        previous snapshot."""
         try:
-            fd, tmp = tempfile.mkstemp(
-                prefix=".tpumon-state.", suffix=".tmp", dir=directory
-            )
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(state, f, separators=(",", ":"))
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.path)
-            except BaseException:
-                with contextlib.suppress(OSError):
-                    os.unlink(tmp)
-                raise
+            atomic_write_json(self.path, state)
         except OSError as e:
             self.last_error = str(e)
             return False

@@ -519,11 +519,19 @@ function makeDashboard(doc, net, env, mkSurface) {
       for (const name of Object.keys(sources)) {
         const s = sources[name];
         const el = doc.mk("div");
-        el.className = "src " + (s.ok ? "ok" : "bad");
+        // A breaker that left "closed" means the source is polled on a
+        // backoff cadence and its panels are stale — as loud as a
+        // failing scrape even if the last sample happened to succeed.
+        const broken = s.breaker && s.breaker.state !== "closed";
+        el.className = "src " + (s.ok && !broken ? "ok" : "bad");
         const dot = doc.mk("i");
         const label = doc.mk("span");
         label.textContent = `${name} · ${s.latency_p50_ms ?? "?"} ms p50` +
-          (s.ok ? "" : ` · ${(s.error || "down").slice(0, 60)}`);
+          (s.ok ? "" : ` · ${(s.error || "down").slice(0, 60)}`) +
+          (broken ? ` · breaker ${s.breaker.state}` +
+            (s.breaker.retry_in_s != null
+              ? ` (retry ${s.breaker.retry_in_s.toFixed(0)}s)` : "")
+            : "");
         el.append(dot, label);
         // Source caveats (e.g. "temp_c unavailable", "duty/HBM include
         // workload self-reports") — declared, not silently missing.
