@@ -27,8 +27,11 @@ BENCH_r{N}.json (VERDICT round-1 item #2):
   paged_attention_*    paged-decode KV streaming vs XLA fused gather
   train_*              sharded trainer MFU % + tokens/s
   serving_*            in-tree engine end-to-end tokens/s
+  fastpath_* / sse_*   epoch-cached render + delta-SSE wire costs at 64
+                       and 256 fake chips (docs/perf.md)
   federation_*         merged scrape→render p50 + exporter render time
                        for a simulated 8-host × 8-chip (64-chip) fleet
+                       and a 4-peer × v5p-64 (256-chip) fleet
 
 Kernel numbers need the real MXU and are null off-TPU; the rest run
 anywhere (small shapes off-TPU).
@@ -618,13 +621,88 @@ def _bench_serving(on_tpu: bool) -> dict:
     }
 
 
+async def _bench_fastpath(topology: str, iters: int = 30, warmup: int = 5) -> dict:
+    """Data-plane fast path at production chip counts (docs/perf.md):
+    single instance on a fake v5p topology, measuring the epoch-cached
+    render path — realtime scrape→render p50, exporter cold render vs
+    cached re-render (same tick), and the SSE keyframe vs delta frame
+    bytes. Key suffix = chip count, so 64 vs 256 scale per round."""
+    from tpumon.app import build
+    from tpumon.config import load_config
+    from tpumon.exporter import render_exporter
+
+    cfg = load_config(
+        env={
+            "TPUMON_PORT": "0",
+            "TPUMON_HOST": "127.0.0.1",
+            "TPUMON_ACCEL_BACKEND": f"fake:{topology}",
+            "TPUMON_K8S_MODE": "none",
+            "TPUMON_COLLECTORS": "host,accel",
+        }
+    )
+    sampler, server = build(cfg)
+    await sampler.tick_all()
+    await server.start()
+    url = f"http://127.0.0.1:{server.port}/api/accel/metrics"
+
+    def fetch() -> dict:
+        with urllib.request.urlopen(url) as r:
+            return json.loads(r.read())
+
+    try:
+        cycle_ms: list[float] = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            await sampler.tick_fast()
+            data = await asyncio.to_thread(fetch)
+            if i >= warmup:
+                cycle_ms.append((time.perf_counter() - t0) * 1e3)
+        n = len(data["chips"])
+
+        # Exporter: cold render (no cache — every block re-walks its
+        # section) vs cached re-render within one tick (every block is
+        # a version hit; tpumon.snapshot.ExporterCache).
+        cold_ms: list[float] = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            text = render_exporter(sampler)
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+        assert "tpu_mxu_duty_cycle_pct" in text
+        render_exporter(sampler, cache=server.exporter_cache)  # prime
+        cached_ms: list[float] = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            render_exporter(sampler, cache=server.exporter_cache)
+            cached_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # SSE wire: full keyframe vs the steady-state delta frame for
+        # one tick of fake-backend movement (every chip's gauges move
+        # each tick — real clusters delta smaller than this).
+        key_frame, ver, _ = server._sse_frame(-1, True)
+        await sampler.tick_fast()
+        delta_frame, _, was_key = server._sse_frame(ver, False)
+        assert not was_key
+    finally:
+        await server.stop()
+
+    return {
+        f"fastpath_{n}_scrape_to_render_p50_ms": round(_p50(cycle_ms), 3),
+        f"exporter_render_{n}_ms": round(_p50(cold_ms), 3),
+        f"exporter_cached_render_{n}_ms": round(_p50(cached_ms), 3),
+        f"sse_keyframe_bytes_{n}": len(key_frame),
+        f"sse_delta_bytes_{n}": len(delta_frame),
+    }
+
+
 async def _bench_federation(
-    n_peers: int = 8, iters: int = 40, warmup: int = 5
+    n_peers: int = 8, peer_topology: str = "v5e-8",
+    key_prefix: str = "federation", iters: int = 40, warmup: int = 5,
 ) -> dict:
     """Monitor-at-scale: one aggregator federating n_peers in-process
-    tpumon instances, each serving a fake v5e-8 host (n_peers×8 chips —
-    a v5p-64-style fleet). Reports the merged scrape→render p50 through
-    the aggregator's live HTTP server and the exporter render time at
+    tpumon instances, each serving a fake host (default 8×v5e-8 —
+    64 chips, a v5p-64-style fleet; the 256-chip variant federates
+    4×v5p-64). Reports the merged scrape→render p50 through the
+    aggregator's live HTTP server and the exporter render time at
     that chip count (VERDICT round-1 item #7)."""
     from tpumon.app import build
     from tpumon.collectors.accel_peers import PeerFederatedCollector
@@ -639,7 +717,7 @@ async def _bench_federation(
                 env={
                     "TPUMON_PORT": "0",
                     "TPUMON_HOST": "127.0.0.1",
-                    "TPUMON_ACCEL_BACKEND": f"fake:v5e-8@fleet{i}",
+                    "TPUMON_ACCEL_BACKEND": f"fake:{peer_topology}@fleet{i}",
                     "TPUMON_K8S_MODE": "none",
                     "TPUMON_COLLECTORS": "accel",
                 }
@@ -693,9 +771,9 @@ async def _bench_federation(
                 await server.stop()
 
     return {
-        "federation_chips": n_chips,
-        "federation_scrape_to_render_p50_ms": round(_p50(cycle_ms), 3),
-        "federation_exporter_render_ms": round(_p50(render_ms), 3),
+        f"{key_prefix}_chips": n_chips,
+        f"{key_prefix}_scrape_to_render_p50_ms": round(_p50(cycle_ms), 3),
+        f"{key_prefix}_exporter_render_ms": round(_p50(render_ms), 3),
     }
 
 
@@ -710,9 +788,20 @@ _T0 = time.perf_counter()
 # driver). name -> (timeout_s, null-result keys).
 PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
     "scrape": (300, ("metric", "value", "unit", "vs_baseline")),
-    "federation": (120, ("federation_chips",
+    "fastpath": (300, ("fastpath_64_scrape_to_render_p50_ms",
+                       "exporter_render_64_ms",
+                       "exporter_cached_render_64_ms",
+                       "sse_keyframe_bytes_64", "sse_delta_bytes_64",
+                       "fastpath_256_scrape_to_render_p50_ms",
+                       "exporter_render_256_ms",
+                       "exporter_cached_render_256_ms",
+                       "sse_keyframe_bytes_256", "sse_delta_bytes_256")),
+    "federation": (240, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
-                         "federation_exporter_render_ms")),
+                         "federation_exporter_render_ms",
+                         "federation_256_chips",
+                         "federation_256_scrape_to_render_p50_ms",
+                         "federation_256_exporter_render_ms")),
     "kernels": (700, ("mxu_matmul_pallas_tflops", "mxu_matmul_xla_tflops",
                       "mxu_matmul_vs_xla",
                       "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
@@ -763,8 +852,14 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # scrape (driver metric contract: metric/value/unit/vs_baseline)
     "metric", "value", "unit", "vs_baseline",
     "sampler_samples_per_sec", "accel_backend",
+    # fastpath (64 vs 256-chip cached render + delta SSE, docs/perf.md)
+    "fastpath_64_scrape_to_render_p50_ms",
+    "fastpath_256_scrape_to_render_p50_ms",
+    "exporter_render_256_ms", "exporter_cached_render_256_ms",
+    "sse_keyframe_bytes_256", "sse_delta_bytes_256",
     # federation
     "federation_chips", "federation_scrape_to_render_p50_ms",
+    "federation_256_scrape_to_render_p50_ms",
     # kernels
     "mxu_matmul_pallas_tflops", "mxu_matmul_vs_xla",
     "int8_matmul_pallas_tflops", "int8_matmul_vs_xla",
@@ -808,8 +903,24 @@ def _run_phase(name: str, backend: str) -> dict:
     on_tpu = backend == "jax"
     if name == "scrape":
         return asyncio.run(_bench_scrape(backend))
+    if name == "fastpath":
+        async def both():
+            out = await _bench_fastpath("v5p-64")
+            out.update(await _bench_fastpath("v5p-256"))
+            return out
+
+        return asyncio.run(both())
     if name == "federation":
-        return asyncio.run(_bench_federation())
+        async def both_scales():
+            # 64 chips (8×v5e-8, the BENCH_r05-comparable shape) and
+            # 256 chips (4×v5p-64) per round.
+            out = await _bench_federation()
+            out.update(await _bench_federation(
+                n_peers=4, peer_topology="v5p-64",
+                key_prefix="federation_256"))
+            return out
+
+        return asyncio.run(both_scales())
     if name == "kernels":
         if not on_tpu:
             # Keep the documented key set stable off-TPU: explicit nulls,

@@ -357,6 +357,64 @@ def test_stream_frame_updates_cards_and_badges(js, payloads):
     assert len(doc.el("chips")["_children"]) == 8
 
 
+def test_stream_keyframe_plus_delta_matches_full_render(js, payloads):
+    """The delta protocol end to end in the SHIPPED apply code: a
+    keyframe followed by a server-diffed patch must render exactly the
+    same DOM as receiving the final payload whole (tpumon/deltas.py is
+    the diff side; dashboard.js applyDelta is the apply side)."""
+    import copy
+
+    from tpumon.deltas import diff
+
+    base = {"host": payloads["/api/host/metrics"],
+            "accel": payloads["/api/accel/metrics"],
+            "alerts": {"minor": 0.0, "serious": 0.0, "critical": 0.0}}
+    new = copy.deepcopy(base)
+    new["host"]["cpu"]["percent"] = 77.7
+    new["accel"]["chips"][0]["mxu_duty_pct"] = 99.9
+    new["accel"]["chips"][3]["temp_c"] = 13.0
+    new["alerts"]["critical"] = 2.0
+    patch = tojs(diff(base, new))
+    assert patch is not None
+
+    # Dashboard A: keyframe, then the delta.
+    da, doca, _, _, _ = mkdash(js, {})
+    assert da["onStreamFrame"]({"epoch": 5.0, "key": copy.deepcopy(base)}) == "ok"
+    assert da["onStreamFrame"](
+        {"epoch": 6.0, "prev": 5.0, "patch": patch}) == "ok"
+
+    # Dashboard B: the final payload as one keyframe.
+    db, docb, _, _, _ = mkdash(js, {})
+    db["onStreamFrame"]({"epoch": 6.0, "key": copy.deepcopy(new)})
+
+    for el in ("cpu-v", "cpu-s", "mem-v", "mxu-v", "n-critical"):
+        assert doca.el(el)["textContent"] == docb.el(el)["textContent"], el
+    assert all_text(doca.el("chips")) == all_text(docb.el("chips"))
+    assert doca.el("crit-badge")["classList"]["contains"]("active")
+    assert "77.7" in doca.el("cpu-v")["textContent"]
+
+
+def test_stream_gap_detection_and_heartbeat(js, payloads):
+    d, doc, net, env, surf = mkdash(js, {})
+    key = {"host": payloads["/api/host/metrics"],
+           "accel": payloads["/api/accel/metrics"],
+           "alerts": {"minor": 0.0, "serious": 0.0, "critical": 0.0}}
+    assert d["onStreamFrame"]({"epoch": 5.0, "key": key}) == "ok"
+    # Heartbeat (nothing changed): no-op, stays in sync.
+    assert d["onStreamFrame"](
+        {"epoch": 5.0, "prev": 5.0, "patch": None}) == "ok"
+    # A patch chained off an epoch we never saw: the client must NOT
+    # apply it (positional patches against the wrong base corrupt) —
+    # it drops state and asks the bootstrap to reconnect.
+    assert d["onStreamFrame"](
+        {"epoch": 9.0, "prev": 8.0,
+         "patch": {"o": {"alerts": {"s": {"critical": 1.0}}}}}) == "resync"
+    # Chips grid still shows the keyframe's render (patch not applied).
+    assert len(doc.el("chips")["_children"]) == 8
+    # The post-reconnect keyframe resyncs cleanly.
+    assert d["onStreamFrame"]({"epoch": 10.0, "key": key}) == "ok"
+
+
 # ---------------------------------------------------------------- history
 
 

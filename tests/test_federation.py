@@ -30,6 +30,84 @@ def test_chip_json_roundtrip():
         assert mapped in json_keys, f"ChipSample.{f.name} missing from to_json"
 
 
+def test_wire_roundtrip_and_tolerance():
+    """Every ChipSample field survives the columnar wire format, and
+    readers tolerate senders with unknown/missing trailing fields
+    (mixed-version fleets)."""
+    from tpumon.topology import WIRE_FIELDS, chips_from_wire, chips_to_wire
+
+    c = ChipSample(
+        chip_id="h1/chip-2", host="h1", slice_id="s0", index=2, kind="v5p",
+        coords=(1, 0, 0), mxu_duty_pct=33.5, hbm_used=10, hbm_total=100,
+        temp_c=55.0, ici_tx_bytes=999, ici_rx_bytes=900, ici_link_up=True,
+        ici_link_health=7, throttle_score=3, counter_source="fake",
+    )
+    import dataclasses
+    import json
+
+    assert set(WIRE_FIELDS) == {f.name for f in dataclasses.fields(ChipSample)}
+    wire = json.loads(json.dumps(chips_to_wire([c])))  # through real JSON
+    assert chips_from_wire(wire) == [c]
+    # Unknown field from a newer sender: ignored, not fatal — and an
+    # INSERTED (non-trailing) unknown must not shift neighbors (rows
+    # zip against the sender's full field list before filtering).
+    wire["fields"].append("future_field")
+    wire["rows"][0].append(123)
+    assert chips_from_wire(wire) == [c]
+    inserted = {"v": 1,
+                "fields": ["chip_id", "future_field", "host", "slice_id",
+                           "index", "kind"],
+                "rows": [["h2/chip-0", 999, "h2", "s1", 0, "v5e"]]}
+    back = chips_from_wire(inserted)
+    assert back[0].host == "h2" and back[0].kind == "v5e"
+    # Older sender with fewer fields: missing ones default.
+    old = {"v": 1, "fields": ["chip_id", "host", "slice_id", "index", "kind"],
+           "rows": [["h2/chip-0", "h2", "s1", 0, "v5e"]]}
+    back = chips_from_wire(old)
+    assert back[0].chip_id == "h2/chip-0" and back[0].mxu_duty_pct is None
+    # An incompatible wire version fails loudly (the peer collector
+    # falls back to the dict route on this).
+    import pytest
+
+    with pytest.raises(ValueError):
+        chips_from_wire({"v": 2, "fields": [], "rows": []})
+
+
+def test_federation_fetches_wire_and_reuses_on_304():
+    """The aggregator fetches peers over /api/accel/wire and revalidates
+    with the epoch ETag — an unchanged peer costs a 304 and the cached
+    parsed chips are reused (incremental per-peer merge)."""
+    from tpumon.collectors.accel_peers import PeerFederatedCollector
+
+    sampler_a, server_a = serve({"TPUMON_ACCEL_BACKEND": "fake:v5e-4"})
+    sampler_a.accel.host_prefix = "ha"
+
+    async def scenario():
+        await sampler_a.tick_all()
+        await server_a.start()
+        fed = PeerFederatedCollector(
+            local=None, peers=(f"127.0.0.1:{server_a.port}",))
+        s1 = await fed.collect()
+        assert s1.ok and len(s1.data) == 4
+        st = fed._state()
+        url = fed.peers[0]
+        assert st["wire"].get(url, True)  # wire route in use
+        assert st["etags"][url]
+        first_parsed = st["chips"][url]
+        # No tick on the peer: same epoch, so the refetch 304s and the
+        # SAME parsed list comes back (identity, not just equality).
+        s2 = await fed.collect()
+        assert s2.ok and st["chips"][url] is first_parsed
+        assert s2.data == s1.data
+        # Peer ticks: ETag moves, fresh parse.
+        await sampler_a.tick_fast()
+        await fed.collect()
+        assert st["chips"][url] is not first_parsed
+        await server_a.stop()
+
+    asyncio.run(scenario())
+
+
 def test_federation_two_live_instances():
     """Two real servers: instance B federates instance A's chips."""
     # Instance A: 4 fake chips on hosts ha-*.

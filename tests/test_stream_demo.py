@@ -66,6 +66,9 @@ def test_serving_collector_fake_target():
 
 
 def test_sse_stream_delivers_events():
+    """The stream's first frame is a keyframe carrying the full realtime
+    payload; subsequent frames are epoch-keyed deltas/heartbeats
+    (protocol details pinned by tests/test_fastpath.py)."""
     sampler, server = serve()
 
     async def scenario():
@@ -80,17 +83,27 @@ def test_sse_stream_delivers_events():
         assert b"200" in line
         while (await asyncio.wait_for(reader.readline(), 5)) not in (b"\r\n", b""):
             pass
-        # two events
-        events = []
-        while len(events) < 2:
-            line = await asyncio.wait_for(reader.readline(), 10)
-            if line.startswith(b"data: "):
-                events.append(json.loads(line[6:]))
+
+        async def next_event():
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line.startswith(b"data: "):
+                    return json.loads(line[6:])
+
+        events = [await next_event()]
+        # Sampler loops aren't running here — fire the tick the stream
+        # waits on, with fresh data behind it.
+        await sampler.tick_fast()
+        events.append(await next_event())
         writer.close()
         await server.stop()
         return events
 
     events = asyncio.run(scenario())
-    assert len(events[0]["accel"]["chips"]) == 8
-    assert "alerts" in events[0]
-    assert events[0]["host"]["cpu"]["cores"] >= 1
+    key = events[0]["key"]  # first frame is always a full keyframe
+    assert len(key["accel"]["chips"]) == 8
+    assert "alerts" in key
+    assert key["host"]["cpu"]["cores"] >= 1
+    # Second frame chains off the keyframe's epoch (delta or heartbeat).
+    assert events[1]["prev"] == events[0]["epoch"]
+    assert events[1]["epoch"] >= events[1]["prev"]

@@ -62,6 +62,10 @@ _SEV_LABEL = {"minor": "notice", "serious": "high", "critical": "critical"}
 class AlertEngine:
     def __init__(self, thresholds: Thresholds | None = None):
         self.t = thresholds or Thresholds()
+        # Per-chip threshold rules built once per config — the per-tick
+        # loop evaluates closures instead of re-constructing rule
+        # tables per chip (_build_chip_rules).
+        self._chip_rules = self._build_chip_rules()
         # Pod transition state (reference: module-global lastPodStates,
         # monitor_server.js:157 — here private to the engine, which is
         # only driven by the sampler).
@@ -146,6 +150,141 @@ class AlertEngine:
 
     # ------------- per-chip rules (re-keyed monitor_server.js:178-184) ----
 
+    def _build_chip_rules(self) -> list:
+        """Per-chip threshold rules, built ONCE per engine (thresholds
+        are fixed at construction): each rule is a closure over its
+        thresholds/fix text that maps (chip, hbm_pct, pod_note) ->
+        Alert | None. The per-tick loop below is then a flat
+        rules × chips evaluation with no per-chip string/tuple table
+        construction — at 256 chips this keeps alert evaluation linear
+        with a small constant."""
+        t = self.t
+
+        def hbm_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            if hbm is None:
+                return None
+            sev = t.hbm_pct.severity(hbm)
+            if not sev:
+                return None
+            return Alert(
+                severity=sev,
+                title=f"HBM pressure on {c.chip_id}",
+                desc=f"HBM at {hbm:.1f}% "
+                f"({(c.hbm_used or 0) / 2**30:.1f} / "
+                f"{(c.hbm_total or 0) / 2**30:.1f} GiB){pod_note}",
+                fix="Reduce batch size or sequence length, shard the "
+                "model over more chips, or enable rematerialization "
+                "(jax.checkpoint) to trade FLOPs for HBM.",
+                key=f"chip.{c.chip_id}.hbm.{sev}",
+            )
+
+        def temp_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            if c.temp_c is None:
+                return None
+            sev = t.temp_c.severity(c.temp_c)
+            if not sev:
+                return None
+            return Alert(
+                severity=sev,
+                title=f"Temperature {_SEV_LABEL[sev]} on {c.chip_id}",
+                desc=f"Chip at {c.temp_c:.0f}°C "
+                f"(threshold {getattr(t.temp_c, sev)}°C)",
+                fix="Check node cooling/airflow and ambient temp; "
+                "sustained thermal throttling degrades step time "
+                "before it damages hardware.",
+                key=f"chip.{c.chip_id}.temp.{sev}",
+            )
+
+        def stalled_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            # HBM heavily committed but MXU ~idle ⇒ the job holds memory
+            # without computing (wedged collective, host input stall,
+            # deadlock).
+            if (
+                c.mxu_duty_pct is None
+                or hbm is None
+                or hbm <= t.mxu_idle_hbm_gate_pct
+                or c.mxu_duty_pct >= t.mxu_idle_pct
+            ):
+                return None
+            return Alert(
+                severity="serious",
+                title=f"Chip {c.chip_id} stalled",
+                desc=f"HBM {hbm:.0f}% committed but MXU duty cycle only "
+                f"{c.mxu_duty_pct:.1f}%{pod_note}",
+                fix="The job holds memory but isn't computing: look for "
+                "a host-side input bottleneck, a hung collective "
+                "(one host of the slice down?), or a deadlocked step.",
+                key=f"chip.{c.chip_id}.stalled",
+            )
+
+        def link_down_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            # Either the producer says so directly, or the SDK health
+            # score hits 10 ("link is not usable"). The engine owns this
+            # derivation so a producer that sets only the score (e.g. a
+            # fake-backend override) still raises the critical alert.
+            if not (c.ici_link_up is False or c.ici_link_health == 10):
+                return None
+            return Alert(
+                severity="critical",
+                title=f"ICI link down on {c.chip_id}",
+                desc="Inter-chip interconnect link reports down; "
+                f"collectives crossing it will hang or fail.{pod_note}",
+                fix="Drain the slice and file a hardware case; a single "
+                "bad ICI link poisons every collective in the slice.",
+                key=f"chip.{c.chip_id}.ici_down",
+            )
+
+        def ici_health_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            # libtpu SDK 0-10 score (PROBE_libtpu.md): 1-5 transient ->
+            # minor, 6-9 persistent -> serious. Score 10 ("unusable") is
+            # the critical link-down rule above.
+            if c.ici_link_health is None or not 0 < c.ici_link_health < 10:
+                return None
+            sev = t.ici_health_score.severity(c.ici_link_health)
+            if not sev:
+                return None
+            return Alert(
+                severity=sev,
+                title=f"ICI link degraded on {c.chip_id}",
+                desc=f"Worst ICI link health score "
+                f"{c.ici_link_health}/10 "
+                f"({'persistent' if c.ici_link_health > 5 else 'transient'} "
+                f"problem){pod_note}",
+                fix="Watch collective latency on this slice; if the "
+                "score persists above 5, drain the slice and file "
+                "a hardware case before the link fails outright.",
+                key=f"chip.{c.chip_id}.ici_health.{sev}",
+            )
+
+        def throttle_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
+            # libtpu SDK score 0-10 = throttled by 0-100% — the
+            # platform's thermal/power proxy; TPUs expose no direct
+            # temperature metric (PROBE_libtpu.md finding #4).
+            if c.throttle_score is None or c.throttle_score <= 0:
+                return None
+            sev = t.throttle_score.severity(c.throttle_score)
+            if not sev:
+                return None
+            return Alert(
+                severity=sev,
+                title=f"TPU throttled on {c.chip_id}",
+                desc=f"Throttle score {c.throttle_score}/10 "
+                f"(~{c.throttle_score * 10}% throttled){pod_note}",
+                fix="Check node cooling/power; sustained throttling "
+                "stretches step time. If cluster-wide, suspect "
+                "datacenter thermals rather than one node.",
+                key=f"chip.{c.chip_id}.throttle.{sev}",
+            )
+
+        return [
+            hbm_rule,
+            temp_rule,
+            stalled_rule,
+            link_down_rule,
+            ici_health_rule,
+            throttle_rule,
+        ]
+
     def _chip_alerts(
         self, chips: list[ChipSample], owners: dict[str, str] | None = None
     ) -> list[Alert]:
@@ -157,113 +296,10 @@ class AlertEngine:
             pod = owners.get(c.chip_id)
             pod_note = f" — pod {pod}" if pod else ""
             hbm = c.hbm_pct
-            if hbm is not None:
-                sev = self.t.hbm_pct.severity(hbm)
-                if sev:
-                    alerts.append(
-                        Alert(
-                            severity=sev,
-                            title=f"HBM pressure on {c.chip_id}",
-                            desc=f"HBM at {hbm:.1f}% "
-                            f"({(c.hbm_used or 0) / 2**30:.1f} / "
-                            f"{(c.hbm_total or 0) / 2**30:.1f} GiB){pod_note}",
-                            fix="Reduce batch size or sequence length, shard the "
-                            "model over more chips, or enable rematerialization "
-                            "(jax.checkpoint) to trade FLOPs for HBM.",
-                            key=f"chip.{c.chip_id}.hbm.{sev}",
-                        )
-                    )
-            if c.temp_c is not None:
-                sev = self.t.temp_c.severity(c.temp_c)
-                if sev:
-                    alerts.append(
-                        Alert(
-                            severity=sev,
-                            title=f"Temperature {_SEV_LABEL[sev]} on {c.chip_id}",
-                            desc=f"Chip at {c.temp_c:.0f}°C "
-                            f"(threshold {getattr(self.t.temp_c, sev)}°C)",
-                            fix="Check node cooling/airflow and ambient temp; "
-                            "sustained thermal throttling degrades step time "
-                            "before it damages hardware.",
-                            key=f"chip.{c.chip_id}.temp.{sev}",
-                        )
-                    )
-            # Stalled-chip rule: HBM heavily committed but MXU ~idle ⇒ the
-            # job holds memory without computing (wedged collective, host
-            # input stall, deadlock).
-            if (
-                c.mxu_duty_pct is not None
-                and hbm is not None
-                and hbm > self.t.mxu_idle_hbm_gate_pct
-                and c.mxu_duty_pct < self.t.mxu_idle_pct
-            ):
-                alerts.append(
-                    Alert(
-                        severity="serious",
-                        title=f"Chip {c.chip_id} stalled",
-                        desc=f"HBM {hbm:.0f}% committed but MXU duty cycle only "
-                        f"{c.mxu_duty_pct:.1f}%{pod_note}",
-                        fix="The job holds memory but isn't computing: look for "
-                        "a host-side input bottleneck, a hung collective "
-                        "(one host of the slice down?), or a deadlocked step.",
-                        key=f"chip.{c.chip_id}.stalled",
-                    )
-                )
-            # Link down: either the producer says so directly, or the SDK
-            # health score hits 10 ("link is not usable"). The engine owns
-            # this derivation so a producer that sets only the score (e.g.
-            # a fake-backend override) still raises the critical alert.
-            link_down = c.ici_link_up is False or c.ici_link_health == 10
-            if link_down:
-                alerts.append(
-                    Alert(
-                        severity="critical",
-                        title=f"ICI link down on {c.chip_id}",
-                        desc="Inter-chip interconnect link reports down; "
-                        f"collectives crossing it will hang or fail.{pod_note}",
-                        fix="Drain the slice and file a hardware case; a single "
-                        "bad ICI link poisons every collective in the slice.",
-                        key=f"chip.{c.chip_id}.ici_down",
-                    )
-                )
-            # ICI link degradation (libtpu SDK 0-10 score, PROBE_libtpu.md):
-            # 1-5 transient -> minor, 6-9 persistent -> serious. Score 10
-            # ("unusable") is the critical link-down rule above.
-            if c.ici_link_health is not None and 0 < c.ici_link_health < 10:
-                sev = self.t.ici_health_score.severity(c.ici_link_health)
-                if sev:
-                    alerts.append(
-                        Alert(
-                            severity=sev,
-                            title=f"ICI link degraded on {c.chip_id}",
-                            desc=f"Worst ICI link health score "
-                            f"{c.ici_link_health}/10 "
-                            f"({'persistent' if c.ici_link_health > 5 else 'transient'} "
-                            f"problem){pod_note}",
-                            fix="Watch collective latency on this slice; if the "
-                            "score persists above 5, drain the slice and file "
-                            "a hardware case before the link fails outright.",
-                            key=f"chip.{c.chip_id}.ici_health.{sev}",
-                        )
-                    )
-            # Throttling (libtpu SDK score 0-10 = throttled by 0-100%) —
-            # the platform's thermal/power proxy; TPUs expose no direct
-            # temperature metric (PROBE_libtpu.md finding #4).
-            if c.throttle_score is not None and c.throttle_score > 0:
-                sev = self.t.throttle_score.severity(c.throttle_score)
-                if sev:
-                    alerts.append(
-                        Alert(
-                            severity=sev,
-                            title=f"TPU throttled on {c.chip_id}",
-                            desc=f"Throttle score {c.throttle_score}/10 "
-                            f"(~{c.throttle_score * 10}% throttled){pod_note}",
-                            fix="Check node cooling/power; sustained throttling "
-                            "stretches step time. If cluster-wide, suspect "
-                            "datacenter thermals rather than one node.",
-                            key=f"chip.{c.chip_id}.throttle.{sev}",
-                        )
-                    )
+            for rule in self._chip_rules:
+                a = rule(c, hbm, pod_note)
+                if a is not None:
+                    alerts.append(a)
         return alerts
 
     # ------------- slice rules (SURVEY §2.2 TPU re-keying) ----------------

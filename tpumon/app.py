@@ -248,6 +248,15 @@ def main(argv: list[str] | None = None) -> int:
             # --loadgen-spec-len).
             loadgen_spec_source = take(arg)
             serve_loadgen = True
+        elif arg == "--peers":
+            # Comma-separated peer tpumon instances to federate
+            # (docs/perf.md; also TPUMON_PEERS / config "peers").
+            overrides["peers"] = take(arg)
+        elif arg == "--peer-fanout":
+            overrides["peer_fanout"] = take_int(arg)
+        elif arg == "--sse-keyframe-every":
+            # Delta-SSE keyframe cadence (1 = full frame per tick).
+            overrides["sse_keyframe_every"] = take_int(arg)
         elif arg == "--state":
             overrides["state_path"] = take(arg)
         elif arg == "--chaos":
@@ -267,6 +276,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-kv-dtype compute|int8] "
                 "[--loadgen-paged-attn gather|kernel] "
                 "[--loadgen-spec-source draft|prompt] "
+                "[--peers host:port,...] [--peer-fanout N] "
+                "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
                 "[--chaos mode:source:param,...]\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."

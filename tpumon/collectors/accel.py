@@ -54,7 +54,12 @@ def make_accel_collector(cfg: Config) -> Collector:
     if cfg.peers:
         from tpumon.collectors.accel_peers import PeerFederatedCollector
 
-        return PeerFederatedCollector(local=local, peers=cfg.peers)
+        return PeerFederatedCollector(
+            local=local,
+            peers=cfg.peers,
+            timeout_s=cfg.peer_timeout_s,
+            fanout=cfg.peer_fanout,
+        )
     if local is None:
         return NullAccelCollector(reason="accel backend 'none' configured")
     return local

@@ -28,6 +28,11 @@ FAKE_TOPOLOGIES: dict[str, tuple[str, int, int]] = {
     "v5e-8": ("v5e", 1, 8),
     "v5p-8": ("v5p", 2, 4),
     "v5p-64": ("v5p", 16, 4),  # v5p: 4 chips per host VM
+    # Production-scale shapes for the data-plane fast-path benchmarks
+    # (bench.py fastpath/federation phases, docs/perf.md): the render
+    # and delta-SSE costs are O(chips), so these pin 128/256-chip costs.
+    "v5p-128": ("v5p", 32, 4),
+    "v5p-256": ("v5p", 64, 4),
 }
 
 

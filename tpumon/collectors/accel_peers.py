@@ -4,10 +4,21 @@ The reference is strictly single-host for realtime metrics — multi-node
 visibility exists only through Prometheus aggregation of per-node
 exporters (SURVEY §2.5). tpumon keeps that path (PromQL over per-host
 `tpu_*` series) **and** adds a realtime one: an instance configured with
-``peers`` fetches each peer's ``/api/accel/metrics`` in parallel and
-merges their chips with its own, so one dashboard shows a whole v5p
-slice live with per-chip resolution and no Prometheus in the loop
-(BASELINE config 5).
+``peers`` fetches each peer's chip snapshot in parallel and merges their
+chips with its own, so one dashboard shows a whole v5p slice live with
+per-chip resolution and no Prometheus in the loop (BASELINE config 5).
+
+Scaling (docs/perf.md): the fan-out is bounded (``fanout`` worker
+threads in flight at once — a 64-peer fleet must not spawn 64 threads
+per tick), each peer is fetched over the compact columnar wire format
+(``/api/accel/wire``, tpumon.topology.chips_to_wire — positional rows
+instead of per-chip key/value dicts), and the merge is incremental
+per-peer: parsed chips are kept per peer and each tick revalidates them
+with ``If-None-Match`` against the peer's epoch ETag, so a peer whose
+accel section did not change between ticks costs a 304 and zero
+re-parsing instead of a full payload. Peers predating the wire route
+are detected once (404) and fetched via ``/api/accel/metrics`` forever
+after — mixed-version fleets federate fine.
 
 Peer chips keep their original chip_id/host/slice identity; cumulative
 ICI counters survive the merge, so the local sampler computes peer ICI
@@ -20,11 +31,12 @@ from __future__ import annotations
 
 import asyncio
 import json
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 
 from tpumon.collectors import Collector, Sample
-from tpumon.topology import ChipSample
+from tpumon.topology import ChipSample, chips_from_wire
 
 
 def normalize_base_url(url: str) -> str:
@@ -62,29 +74,90 @@ class PeerFederatedCollector:
     peers: tuple[str, ...] = ()
     name: str = "accel"
     timeout_s: float = 3.0
+    # At most this many peer fetches (worker threads) in flight at once
+    # (Config.peer_fanout).
+    fanout: int = 16
     last_peer_status: dict[str, str] = field(default_factory=dict)
 
-    def _fetch_peer(self, url: str) -> list[dict]:
+    def _state(self) -> dict:
+        """Per-peer incremental-merge state, created lazily so tests
+        that build the collector without __init__ still work:
+        etags (last seen epoch ETag), chips (last parsed list, reused
+        verbatim on 304), wire (peer speaks /api/accel/wire)."""
+        st = self.__dict__.get("_peer_state")
+        if st is None:
+            st = self.__dict__["_peer_state"] = {
+                "etags": {},
+                "chips": {},
+                "wire": {},
+            }
+        return st
+
+    def _fetch_peer(self, url: str) -> list[ChipSample]:
+        """Blocking fetch+parse of one peer (runs on a worker thread).
+        304 returns the peer's cached parsed chips untouched."""
         base = normalize_base_url(url)
-        with urllib.request.urlopen(
-            f"{base}/api/accel/metrics", timeout=self.timeout_s
-        ) as r:
-            return json.load(r).get("chips", [])
+        st = self._state()
+        use_wire = st["wire"].get(url, True)
+        path = "/api/accel/wire" if use_wire else "/api/accel/metrics"
+        req = urllib.request.Request(f"{base}{path}")
+        etag = st["etags"].get(url)
+        if etag:
+            req.add_header("If-None-Match", etag)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = json.load(r)
+                new_etag = r.headers.get("ETag")
+        except urllib.error.HTTPError as e:
+            if e.code == 304:
+                return st["chips"].get(url, [])
+            if e.code == 404 and use_wire:
+                # Pre-wire peer: remember and fall back to the dict route.
+                st["wire"][url] = False
+                st["etags"].pop(url, None)
+                return self._fetch_peer(url)
+            raise
+        if use_wire:
+            try:
+                chips = chips_from_wire(payload)
+            except ValueError:
+                # Incompatible WIRE_VERSION from a future peer: fall
+                # back to the stable dict route, like the 404 path.
+                st["wire"][url] = False
+                st["etags"].pop(url, None)
+                return self._fetch_peer(url)
+        else:
+            chips = [chip_from_json(d) for d in payload.get("chips", [])]
+        if new_etag:
+            st["etags"][url] = new_etag
+        st["chips"][url] = chips
+        return chips
 
     async def _peer_chips(self, url: str) -> tuple[str, list[ChipSample] | None]:
         try:
-            raw = await asyncio.to_thread(self._fetch_peer, url)
-            return url, [chip_from_json(d) for d in raw]
+            return url, await asyncio.to_thread(self._fetch_peer, url)
         except Exception as e:
             self.last_peer_status[url] = f"{type(e).__name__}: {e}"
             return url, None
 
     async def collect(self) -> Sample:
-        tasks = [self._peer_chips(u) for u in self.peers]
+        sem = asyncio.Semaphore(max(1, getattr(self, "fanout", 16)))
+
+        async def bounded(url: str) -> tuple[str, list[ChipSample] | None]:
+            async with sem:
+                return await self._peer_chips(url)
+
+        tasks = [asyncio.ensure_future(bounded(u)) for u in self.peers]
         local_sample = None
         if self.local is not None:
             local_sample = await self.local.collect()
-        peer_results = await asyncio.gather(*tasks)
+
+        # Fetch AND parse run inside each worker thread, so peers'
+        # parse work already overlaps; gather just collects the
+        # (url, chips) results.
+        by_url: dict[str, list[ChipSample] | None] = dict(
+            await asyncio.gather(*tasks)
+        )
 
         chips: list[ChipSample] = []
         errors: list[str] = []
@@ -93,7 +166,10 @@ class PeerFederatedCollector:
             if local_sample.error:
                 errors.append(f"local: {local_sample.error}")
         seen = {c.chip_id for c in chips}
-        for url, peer_chips in peer_results:
+        # Assemble in configured peer order (stable chip ordering keeps
+        # the SSE delta stream's positional list patches small).
+        for url in self.peers:
+            peer_chips = by_url.get(url)
             if peer_chips is None:
                 errors.append(f"peer {url}: {self.last_peer_status.get(url)}")
                 continue

@@ -174,6 +174,19 @@ class Config:
     # Peer tpumon instances whose chips are merged into this one's view
     # (realtime multi-host federation, BASELINE config 5)
     peers: tuple[str, ...] = ()
+    # Federation fan-out bound: at most this many peer fetches in
+    # flight at once (a 64-peer fleet must not spawn 64 worker threads
+    # per tick) — see tpumon.collectors.accel_peers.
+    peer_fanout: int = 16
+    # Per-peer HTTP timeout for federation fetches.
+    peer_timeout_s: float = 3.0
+
+    # --- SSE delta stream (tpumon.server, docs/perf.md) ---
+    # The /api/stream push emits delta frames (only changed fields,
+    # keyed by snapshot epoch); a full keyframe recurs every this many
+    # frames so a desynced client is bounded. 1 = keyframe-only (the
+    # pre-delta wire behavior, at full-payload cost per frame).
+    sse_keyframe_every: int = 30
     # Directory where workloads self-report HBM/activity
     # (tpumon.collectors.workload) — the explicitly-labeled fallback
     # counter source when every platform source is dark. "" disables.
@@ -239,6 +252,9 @@ _SCALAR_FIELDS: dict[str, type] = {
     "chaos_seed": int,
     "history_snapshot_path": str,
     "history_snapshot_interval_s": float,
+    "peer_fanout": int,
+    "peer_timeout_s": float,
+    "sse_keyframe_every": int,
     "webhook_min_severity": str,
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),

@@ -12,6 +12,14 @@ publishing:
 
 These are exactly the series tpumon.history.PROM_QUERIES re-keys onto
 (SURVEY §5.8).
+
+Fast path: the render is split into per-section blocks (host / accel /
+pods / serving / self) keyed on the sampler's dirty-section versions
+(tpumon.snapshot.ExporterCache). A scrape between ticks reuses every
+block; a tick that only changed pods re-renders the pods block, not 256
+chips' worth of gauge lines. Within one epoch the text is byte-stable —
+``tpumon_uptime_seconds`` advances at tick granularity, a deliberate
+trade documented in docs/perf.md.
 """
 
 from __future__ import annotations
@@ -20,48 +28,52 @@ import time
 
 from tpumon.metrics_text import MetricsWriter
 from tpumon.sampler import Sampler
+from tpumon.snapshot import ExporterCache
 
 
-def render_exporter(sampler: Sampler) -> str:
+def _render_host(sampler: Sampler) -> str:
     w = MetricsWriter()
-
-    # ---- host (tpumon_host_*) ----
     host = sampler.host_data()
-    if host:
-        cpu = host.get("cpu") or {}
-        mem = host.get("memory") or {}
-        disk = host.get("disk") or {}
-        g = w.gauge("tpumon_host_cpu_pct", "Host CPU utilization percent")
-        if cpu.get("percent") is not None:
-            g.add({}, cpu["percent"])
-        g = w.gauge("tpumon_host_load1", "Host 1-minute load average")
-        if cpu.get("load_1min") is not None:
-            g.add({}, cpu["load_1min"])
-        g = w.gauge("tpumon_host_memory_pct", "Host memory used percent")
-        if mem.get("percent") is not None:
-            g.add({}, mem["percent"])
-        g = w.gauge("tpumon_host_memory_used_bytes", "Host memory used bytes")
-        if mem.get("used") is not None:
-            g.add({}, mem["used"])
-        g = w.gauge("tpumon_host_disk_pct", "Disk used percent per mount")
-        for mount, d in (disk.get("mounts") or {}).items():
-            if d.get("percent") is not None:
-                g.add({"mount": mount}, d["percent"])
-        net = host.get("net") or {}
-        if net.get("interfaces"):
-            rxc = w.counter(
-                "tpumon_host_net_rx_bytes_total",
-                "Cumulative NIC bytes received (DCN-traffic proxy)",
-            )
-            txc = w.counter(
-                "tpumon_host_net_tx_bytes_total",
-                "Cumulative NIC bytes transmitted (DCN-traffic proxy)",
-            )
-            for iface, d in net["interfaces"].items():
-                rxc.add({"iface": iface}, d["rx_bytes"])
-                txc.add({"iface": iface}, d["tx_bytes"])
+    if not host:
+        return ""
+    cpu = host.get("cpu") or {}
+    mem = host.get("memory") or {}
+    disk = host.get("disk") or {}
+    g = w.gauge("tpumon_host_cpu_pct", "Host CPU utilization percent")
+    if cpu.get("percent") is not None:
+        g.add({}, cpu["percent"])
+    g = w.gauge("tpumon_host_load1", "Host 1-minute load average")
+    if cpu.get("load_1min") is not None:
+        g.add({}, cpu["load_1min"])
+    g = w.gauge("tpumon_host_memory_pct", "Host memory used percent")
+    if mem.get("percent") is not None:
+        g.add({}, mem["percent"])
+    g = w.gauge("tpumon_host_memory_used_bytes", "Host memory used bytes")
+    if mem.get("used") is not None:
+        g.add({}, mem["used"])
+    g = w.gauge("tpumon_host_disk_pct", "Disk used percent per mount")
+    for mount, d in (disk.get("mounts") or {}).items():
+        if d.get("percent") is not None:
+            g.add({"mount": mount}, d["percent"])
+    net = host.get("net") or {}
+    if net.get("interfaces"):
+        rxc = w.counter(
+            "tpumon_host_net_rx_bytes_total",
+            "Cumulative NIC bytes received (DCN-traffic proxy)",
+        )
+        txc = w.counter(
+            "tpumon_host_net_tx_bytes_total",
+            "Cumulative NIC bytes transmitted (DCN-traffic proxy)",
+        )
+        for iface, d in net["interfaces"].items():
+            rxc.add({"iface": iface}, d["rx_bytes"])
+            txc.add({"iface": iface}, d["tx_bytes"])
+    return w.render()
 
-    # ---- chips (tpu_*) ----
+
+def _render_accel(sampler: Sampler) -> str:
+    """Chips + libtpu SDK extras + slice rollups — the O(chips) block."""
+    w = MetricsWriter()
     chips = sampler.chips()
     if chips:
         duty = w.gauge("tpu_mxu_duty_cycle_pct", "TensorCore/MXU duty cycle percent")
@@ -157,69 +169,82 @@ def render_exporter(sampler: Sampler) -> str:
             reporting.add(labels, s.reporting_chips)
             if s.expected_chips is not None:
                 expected.add(labels, s.expected_chips)
+    return w.render() if w.families else ""
 
-    # ---- pods ----
+
+def _render_pods(sampler: Sampler) -> str:
+    w = MetricsWriter()
     pods = sampler.pods()
-    if pods:
-        phase_counts: dict[str, int] = {}
-        for p in pods:
-            phase_counts[p.get("status", "Unknown")] = (
-                phase_counts.get(p.get("status", "Unknown"), 0) + 1
-            )
-        g = w.gauge("tpumon_pods_by_phase", "Pod count per phase")
-        for phase, n in sorted(phase_counts.items()):
-            g.add({"phase": phase}, n)
+    if not pods:
+        return ""
+    phase_counts: dict[str, int] = {}
+    for p in pods:
+        phase_counts[p.get("status", "Unknown")] = (
+            phase_counts.get(p.get("status", "Unknown"), 0) + 1
+        )
+    g = w.gauge("tpumon_pods_by_phase", "Pod count per phase")
+    for phase, n in sorted(phase_counts.items()):
+        g.add({"phase": phase}, n)
+    return w.render()
 
-    # ---- serving ----
+
+def _render_serving(sampler: Sampler) -> str:
+    w = MetricsWriter()
     serving = sampler.serving_data()
-    if serving:
-        tps = w.gauge("tpumon_serving_tokens_per_sec", "Generated tokens/sec")
-        ttft = w.gauge("tpumon_serving_ttft_p50_ms", "TTFT p50 in ms")
-        queue = w.gauge("tpumon_serving_queue_depth", "Request queue depth")
-        up = w.gauge("tpumon_serving_up", "Serving target scrape success")
+    if not serving:
+        return ""
+    tps = w.gauge("tpumon_serving_tokens_per_sec", "Generated tokens/sec")
+    ttft = w.gauge("tpumon_serving_ttft_p50_ms", "TTFT p50 in ms")
+    queue = w.gauge("tpumon_serving_queue_depth", "Request queue depth")
+    up = w.gauge("tpumon_serving_up", "Serving target scrape success")
+    for s in serving:
+        labels = {"target": s.get("target", "")}
+        up.add(labels, 1.0 if s.get("ok") else 0.0)
+        if s.get("tokens_per_sec") is not None:
+            tps.add(labels, s["tokens_per_sec"])
+        if s.get("ttft_p50_ms") is not None:
+            ttft.add(labels, s["ttft_p50_ms"])
+        if s.get("queue_depth") is not None:
+            queue.add(labels, s["queue_depth"])
+    # Training targets re-exported (one-stop Prometheus scrape when
+    # Prometheus doesn't reach each trainer directly). Distinct
+    # tpumon_monitor_train_* names: re-using the trainers' own
+    # tpumon_train_* names would double-count in deployments where
+    # Prometheus scrapes both; PROM_QUERIES prefers the direct series
+    # and falls back to these via PromQL `or`.
+    if any(s.get("train_step") is not None for s in serving):
+        step = w.gauge("tpumon_monitor_train_step", "Training step (re-exported)")
+        loss = w.gauge("tpumon_monitor_train_loss", "Training loss (re-exported)")
+        tokens = w.counter(
+            "tpumon_monitor_train_tokens_total", "Trained tokens (re-exported)"
+        )
+        goodput = w.gauge(
+            "tpumon_monitor_train_goodput_pct", "Training goodput percent"
+        )
+        mfu = w.gauge(
+            "tpumon_monitor_train_mfu_pct",
+            "Training model-FLOPs utilization percent",
+        )
         for s in serving:
+            if s.get("train_step") is None:
+                continue
             labels = {"target": s.get("target", "")}
-            up.add(labels, 1.0 if s.get("ok") else 0.0)
-            if s.get("tokens_per_sec") is not None:
-                tps.add(labels, s["tokens_per_sec"])
-            if s.get("ttft_p50_ms") is not None:
-                ttft.add(labels, s["ttft_p50_ms"])
-            if s.get("queue_depth") is not None:
-                queue.add(labels, s["queue_depth"])
-        # Training targets re-exported (one-stop Prometheus scrape when
-        # Prometheus doesn't reach each trainer directly). Distinct
-        # tpumon_monitor_train_* names: re-using the trainers' own
-        # tpumon_train_* names would double-count in deployments where
-        # Prometheus scrapes both; PROM_QUERIES prefers the direct series
-        # and falls back to these via PromQL `or`.
-        if any(s.get("train_step") is not None for s in serving):
-            step = w.gauge("tpumon_monitor_train_step", "Training step (re-exported)")
-            loss = w.gauge("tpumon_monitor_train_loss", "Training loss (re-exported)")
-            tokens = w.counter(
-                "tpumon_monitor_train_tokens_total", "Trained tokens (re-exported)"
-            )
-            goodput = w.gauge(
-                "tpumon_monitor_train_goodput_pct", "Training goodput percent"
-            )
-            mfu = w.gauge(
-                "tpumon_monitor_train_mfu_pct",
-                "Training model-FLOPs utilization percent",
-            )
-            for s in serving:
-                if s.get("train_step") is None:
-                    continue
-                labels = {"target": s.get("target", "")}
-                step.add(labels, s["train_step"])
-                if s.get("train_loss") is not None:
-                    loss.add(labels, s["train_loss"])
-                if s.get("train_tokens_total") is not None:
-                    tokens.add(labels, s["train_tokens_total"])
-                if s.get("train_goodput_pct") is not None:
-                    goodput.add(labels, s["train_goodput_pct"])
-                if s.get("train_mfu_pct") is not None:
-                    mfu.add(labels, s["train_mfu_pct"])
+            step.add(labels, s["train_step"])
+            if s.get("train_loss") is not None:
+                loss.add(labels, s["train_loss"])
+            if s.get("train_tokens_total") is not None:
+                tokens.add(labels, s["train_tokens_total"])
+            if s.get("train_goodput_pct") is not None:
+                goodput.add(labels, s["train_goodput_pct"])
+            if s.get("train_mfu_pct") is not None:
+                mfu.add(labels, s["train_mfu_pct"])
+    return w.render()
 
-    # ---- self metrics ----
+
+def _render_self(sampler: Sampler) -> str:
+    """Self metrics + resilience + uptime — versioned on collection
+    activity ("samples"), so it re-renders whenever any source polled."""
+    w = MetricsWriter()
     samples = w.counter("tpumon_samples_total", "Collection attempts per source")
     failures = w.counter("tpumon_sample_failures_total", "Failed collections")
     deadline = w.counter(
@@ -280,6 +305,44 @@ def render_exporter(sampler: Sampler) -> str:
             excs.add(labels, wd.exceptions)
             lag_max.add(labels, round(wd.max_lag_s, 3))
 
+    g = w.gauge("tpumon_snapshot_epoch", "Monotonic snapshot epoch")
+    g.add({}, sampler.clock.epoch)
     g = w.gauge("tpumon_uptime_seconds", "Monitor uptime")
     g.add({}, round(time.time() - sampler.started_at, 1))
     return w.render()
+
+
+# section name -> (dep sections, renderer). "samples" (a pseudo-section
+# bumped on every poll) keeps activity-derived blocks live even when
+# the data sections are static.
+EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("host", ("host",)),
+    ("accel", ("accel",)),
+    ("pods", ("k8s",)),
+    ("serving", ("serving",)),
+    ("self", ("host", "accel", "k8s", "serving", "alerts", "samples")),
+)
+
+_RENDERERS = {
+    "host": _render_host,
+    "accel": _render_accel,
+    "pods": _render_pods,
+    "serving": _render_serving,
+    "self": _render_self,
+}
+
+
+def render_exporter(sampler: Sampler, cache: ExporterCache | None = None) -> str:
+    """Full exposition text. With ``cache`` (the server's persistent
+    ExporterCache) only sections whose versions moved re-render; without
+    it every block renders fresh (tests, one-shot tools)."""
+    blocks: list[str] = []
+    for name, deps in EXPORTER_SECTIONS:
+        fn = _RENDERERS[name]
+        if cache is not None:
+            text = cache.block(name, deps, lambda fn=fn: fn(sampler))
+        else:
+            text = fn(sampler)
+        if text:
+            blocks.append(text)
+    return "".join(blocks)

@@ -129,11 +129,24 @@ class RingSeries:
             self.coarse.append((mid, self._bucket_sum / self._bucket_n))
         self._bucket_sum, self._bucket_n = 0.0, 0
 
+    def _fine_since(self, start: float) -> list[tuple[float, float]]:
+        """Fine points with ts >= start, O(matched) not O(ring): the
+        deque is time-ordered, so walk from the newest end and stop at
+        the first point before the window — a 30 m query over a 24 h
+        ring no longer scans the whole fine tier."""
+        out: list[tuple[float, float]] = []
+        for p in reversed(self.points):
+            if p[0] < start:
+                break
+            out.append(p)
+        out.reverse()
+        return out
+
     def merged_points(self, window_s: float, end: float) -> list[tuple[float, float]]:
         """Points covering [end - window_s, end]: coarse tier for the span
         older than the fine tier, fine points (raw) for the recent span."""
         start = end - window_s
-        fine = [(t, v) for t, v in self.points if t >= start]
+        fine = self._fine_since(start)
         # No fine points => every coarse point qualifies (an empty fine
         # tier must not mask the newest coarse value).
         fine_start = fine[0][0] if fine else float("inf")
@@ -164,7 +177,7 @@ class RingSeries:
         pts = (
             self.merged_points(window_s, end)
             if window_s > self.window_s
-            else [(t, v) for t, v in self.points if t >= end - window_s]
+            else self._fine_since(end - window_s)
         )
         if not pts:
             return [], []
@@ -494,7 +507,35 @@ class HistoryService:
         self.last_prom_ok = any_ok
         return out if any_ok else None
 
+    def snapshot_ring(self, window_s: float | None = None) -> dict:
+        """Ring-only /api/history payload, synchronously — the fast
+        path the server's epoch render cache serves when no Prometheus
+        is configured (the payload is then a pure function of the ring,
+        so repeated same-tick requests reuse the serialized bytes)."""
+        window = self.clamp_window(window_s) if window_s else self.window_s
+        step = self.step_for(window)
+        out: dict = {"source": "ring", "window_s": window, "step_s": step}
+        for name in PROM_QUERIES:
+            out[name] = self.ring.snapshot_series(name, step, window_s=window)
+        self._add_per_chip(out, step, window)
+        return out
+
+    def _add_per_chip(self, out: dict, step: float, window: float) -> None:
+        # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
+        # drill-down charts; Prometheus equivalents are labelled series the
+        # client can also get via its own PromQL if deployed.
+        per_chip: dict[str, dict] = {}
+        for name in self.ring.series:
+            if name.startswith("chip."):
+                per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
+                    name, step, window_s=window
+                )
+        if per_chip:
+            out["per_chip"] = per_chip
+
     async def snapshot(self, window_s: float | None = None) -> dict:
+        if self.prom is None:
+            return self.snapshot_ring(window_s=window_s)
         window = self.clamp_window(window_s) if window_s else self.window_s
         step = self.step_for(window)
         prom = await self._prom_series(window, step)
@@ -509,15 +550,5 @@ class HistoryService:
                 out[name] = prom[name]
             else:
                 out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
-        # drill-down charts; Prometheus equivalents are labelled series the
-        # client can also get via its own PromQL if deployed.
-        per_chip: dict[str, dict] = {}
-        for name in self.ring.series:
-            if name.startswith("chip."):
-                per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
-                    name, step, window_s=window
-                )
-        if per_chip:
-            out["per_chip"] = per_chip
+        self._add_per_chip(out, step, window)
         return out

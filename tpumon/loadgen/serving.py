@@ -60,6 +60,19 @@ class ServeConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     slots: int = 4  # concurrent decode slots (continuous batching)
     prefill_len: int = 64  # static prompt padding length
+    # MoE prefill-chunk cap. Serving routes MoE layers at FULL capacity
+    # (capacity = the chunk's token count G, decoder_forward) so routing
+    # is shape-independent and every decode mode emits identical tokens
+    # — but _route then materializes [G, E, G] dispatch/combine tensors,
+    # O(G²·E) memory/FLOPs that grow QUADRATICALLY with the prefill
+    # chunk. At the 256-token cap with 8 experts that is ~2 MB f32 per
+    # MoE layer (fine); at prefill_len 2048 it would be ~134 MB per
+    # layer. The engine refuses MoE configs whose prefill_len exceeds
+    # this cap (raise the knob only with the quadratic cost in mind, or
+    # lower prefill_len — long prompts already run as multiple chunks).
+    # Decode paths (step/block/spec-verify) have tiny G and are
+    # unaffected.
+    moe_prefill_max_chunk: int = 256
     # Weight-only quantization: None (compute dtype) or "int8"
     # (tpumon.loadgen.quant — halves decode's HBM weight traffic vs bf16).
     quantize: str | None = None
@@ -597,6 +610,17 @@ class ServingEngine:
                 "paged_attn='kernel' is single-device (the Pallas "
                 "kernel is not pjit-partitionable); use the gather "
                 "path over a mesh")
+        if (
+            self.cfg.model.n_experts
+            and self.cfg.prefill_len > self.cfg.moe_prefill_max_chunk
+        ):
+            raise ValueError(
+                f"MoE serving at prefill_len={self.cfg.prefill_len} would "
+                f"materialize O(G²·E) routing tensors per chunk "
+                f"(full-capacity routing, ServeConfig.moe_prefill_max_chunk "
+                f"doc): cap is {self.cfg.moe_prefill_max_chunk} tokens — "
+                "lower prefill_len (long prompts run as multiple chunks) "
+                "or raise moe_prefill_max_chunk knowingly")
         if self.cfg.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {self.cfg.decode_block}")

@@ -27,6 +27,7 @@ from tpumon.collectors import Collector, Sample, run_collector
 from tpumon.config import Config
 from tpumon.history import RingHistory
 from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
+from tpumon.snapshot import EpochClock
 from tpumon.topology import ChipSample, slice_views
 
 
@@ -111,6 +112,34 @@ class Sampler:
         self._prev_net: tuple[float, int, int] | None = None  # (ts, rx, tx)
         self._tasks: list[asyncio.Task] = []
         self.started_at = time.time()
+        # Snapshot epoch + per-section dirty versions (tpumon.snapshot):
+        # the render caches and the delta SSE stream key off this. A
+        # section only bumps when its published data actually changed,
+        # so consumers of unchanged sections reuse their last render.
+        self.clock = EpochClock()
+        self._alerts_fp: tuple | None = None
+        self._prev_extras: dict[str, dict | None] = {}
+        # Tick broadcast for push consumers (the SSE stream): rotated
+        # and set at the end of every fast tick.
+        self._tick_fired = asyncio.Event()
+
+    @property
+    def epoch(self) -> int:
+        return self.clock.epoch
+
+    async def wait_tick(self, timeout_s: float | None = None) -> bool:
+        """Block until the next fast tick completes (True) or the
+        timeout expires (False). Each caller sees every tick: the event
+        is rotated, not cleared, so there is no missed-wakeup race."""
+        ev = self._tick_fired
+        if timeout_s is None:
+            await ev.wait()
+            return True
+        try:
+            await asyncio.wait_for(ev.wait(), timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     # ------------------------- snapshot accessors -------------------------
 
@@ -139,6 +168,7 @@ class Sampler:
     def health_json(self) -> dict:
         return {
             "uptime_s": round(time.time() - self.started_at, 1),
+            "snapshot": self.clock.to_json(),
             **(
                 {"webhooks": self.notifier.to_json()}
                 if self.notifier is not None
@@ -195,8 +225,32 @@ class Sampler:
         )
         if br is not None:
             br.record(s.ok)
+        prev = self.latest.get(s.source)
         self.latest[s.source] = s
         self.stats.setdefault(s.source, SourceStats()).record(s)
+        # Dirty-section tracking: bump the section's version only when
+        # the published view changed — a k8s poll returning the same
+        # pods leaves every /api/k8s consumer on its cached bytes.
+        # Failures always bump (rare, and their health must propagate).
+        # Collector side-channel extras (accel_jax.last_extras: HLO
+        # queue depth, DCN latency percentiles) are served by the same
+        # cached routes, so they are part of the fingerprint too.
+        extras = getattr(c, "last_extras", None)
+        if (
+            prev is None
+            or not s.ok
+            or not prev.ok
+            or s.data != prev.data
+            or s.error != prev.error
+            or s.notes != prev.notes
+            or extras != self._prev_extras.get(s.source)
+        ):
+            self.clock.bump(s.source)
+        self._prev_extras[s.source] = dict(extras) if extras else extras
+        # Collection activity itself (sample counters, latency stats)
+        # is versioned separately so self-metrics stay live even when
+        # every data section is static.
+        self.clock.bump("samples")
         return s
 
     def _update_ici_rates(self, chips: list[ChipSample], ts: float) -> None:
@@ -338,6 +392,29 @@ class Sampler:
             sources=self.source_health(),
         )
         self._notify_new_events()
+        # Alerts section fingerprint: timeline position, the active set
+        # WITH descs (descs refresh with live values while firing), and
+        # the silence table. ``evaluated_at`` deliberately excluded —
+        # it advances at cache granularity (docs/perf.md).
+        fp = (
+            self.engine._event_seq,
+            tuple(
+                sorted(
+                    (k, a.get("desc"))
+                    for k, a in self.engine._active_keys.items()
+                )
+            ),
+            tuple(sorted(self.engine.silences.items())),
+        )
+        if fp != self._alerts_fp:
+            self._alerts_fp = fp
+            self.clock.bump("alerts")
+
+    def mark_alerts_dirty(self) -> None:
+        """Force the next /api/alerts render (silence POSTs mutate the
+        engine outside the evaluation loop)."""
+        self._alerts_fp = None
+        self.clock.bump("alerts")
 
     def mark_events_notified(self) -> None:
         """Treat every event currently on the timeline as delivered —
@@ -388,6 +465,10 @@ class Sampler:
         self._update_ici_rates(self.chips(), ts)
         self._record_history(ts)
         self._evaluate_alerts()
+        # Broadcast tick completion (rotate-then-set: every waiter on
+        # the old event wakes; new waiters queue on the fresh one).
+        fired, self._tick_fired = self._tick_fired, asyncio.Event()
+        fired.set()
 
     async def tick_pods(self) -> None:
         await self._run(self.k8s)

@@ -56,12 +56,18 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from tpumon.config import Config, parse_duration
+from tpumon.deltas import diff
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.sampler import Sampler
-from tpumon.topology import attribute_pods
+from tpumon.snapshot import ExporterCache, RenderCache
+from tpumon.topology import attribute_pods, chips_to_wire
 
 WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
+
+# Sections the realtime push payload reads — the SSE frame epoch is the
+# version over these, so a frame is only "new" when one of them moved.
+RT_SECTIONS = ("host", "accel", "k8s", "alerts")
 
 
 def parse_query(query: str) -> dict[str, str]:
@@ -78,6 +84,7 @@ class HttpError(Exception):
 _STATUS_TEXT = {
     200: "OK",
     204: "No Content",
+    304: "Not Modified",
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
@@ -122,6 +129,37 @@ class MonitorServer:
             "application/javascript; charset=utf-8",
         )
         self._profiler = None  # built lazily; jax may be absent
+        # Epoch-keyed render caches (tpumon.snapshot): requests between
+        # sampler ticks are served pre-serialized bytes; the version
+        # doubles as a strong ETag for 304s. The exporter cache reuses
+        # unchanged metric-family blocks across ticks.
+        self.cache = RenderCache(sampler.clock)
+        self.exporter_cache = ExporterCache(sampler.clock)
+        # route -> (dep sections, payload builder) for the cacheable
+        # JSON GET routes. /api/health and /api/history are handled
+        # specially (per-request data / query params); /metrics rides
+        # the exporter cache.
+        self._cached_routes: dict = {
+            "/api/host/metrics": (("host",), self._api_host),
+            "/api/accel/metrics": (("accel", "k8s"), self._api_accel),
+            "/api/accel/wire": (("accel",), self._api_accel_wire),
+            "/api/gpu/metrics": (("accel",), self._api_gpu_compat),
+            "/api/k8s/pods": (("k8s", "accel"), self._api_pods),
+            "/api/alerts": (("alerts",), self._api_alerts),
+            "/api/serving": (("serving",), self._api_serving),
+            "/api/topology": (
+                ("accel",),
+                lambda: {"slices": [v.to_json() for v in self.sampler.slices()]},
+            ),
+        }
+        # Shared SSE frame state: the payload/patch for the current
+        # epoch is computed ONCE per tick no matter how many stream
+        # clients are attached (each gets the same bytes).
+        self._sse = {
+            "ver": -1, "payload": None,
+            "prev_ver": -1, "prev_payload": None,
+            "key_bytes": None, "patch_bytes": None,
+        }
 
     # ------------------------------ handlers ------------------------------
 
@@ -212,6 +250,13 @@ class MonitorServer:
             "health": s.health_json() if s else {"ok": False, "error": "not sampled"},
         }
 
+    def _api_accel_wire(self) -> dict:
+        """Compact columnar chip snapshot for peer federation
+        (tpumon.collectors.accel_peers): positional rows instead of
+        per-chip key/value dicts — a fraction of the bytes and parse
+        work of /api/accel/metrics at 256 chips."""
+        return chips_to_wire(self.sampler.chips())
+
     def realtime_payload(self) -> dict:
         """The push payload: everything the dashboard's fast loop needs."""
         return {
@@ -224,8 +269,56 @@ class MonitorServer:
             },
         }
 
+    # ------------------------------ SSE stream -----------------------------
+
+    def _sse_frame(self, client_ver: int, force_key: bool) -> tuple[bytes, int, bool]:
+        """One frame for a client last synced at ``client_ver``.
+
+        Returns (frame bytes sans SSE framing, new client version,
+        was_keyframe). The per-epoch payload, keyframe bytes and delta
+        bytes are shared across every connected client — the tick, not
+        the client count, is the unit of serialization work.
+        """
+        st = self._sse
+        ver = self.sampler.clock.version_of(*RT_SECTIONS)
+        if st["ver"] != ver:
+            st["prev_ver"], st["prev_payload"] = st["ver"], st["payload"]
+            st["ver"], st["payload"] = ver, self.realtime_payload()
+            st["key_bytes"] = None
+            st["patch_bytes"] = None
+        if client_ver == ver and not force_key:
+            # Nothing new since this client's last frame: heartbeat.
+            return (
+                json.dumps({"epoch": ver, "prev": ver, "patch": None}).encode(),
+                ver,
+                False,
+            )
+        if not force_key and client_ver == st["prev_ver"] and st["prev_payload"] is not None:
+            if st["patch_bytes"] is None:
+                patch = diff(st["prev_payload"], st["payload"])
+                st["patch_bytes"] = json.dumps(
+                    {"epoch": ver, "prev": st["prev_ver"], "patch": patch}
+                ).encode()
+            return st["patch_bytes"], ver, False
+        # New client, gap, or scheduled keyframe: full snapshot.
+        if st["key_bytes"] is None:
+            st["key_bytes"] = json.dumps(
+                {"epoch": ver, "key": st["payload"]}
+            ).encode()
+        return st["key_bytes"], ver, True
+
     async def _stream(self, writer: asyncio.StreamWriter) -> None:
-        """SSE loop: one event per sample interval until disconnect."""
+        """SSE loop: delta frames keyed by snapshot epoch.
+
+        Protocol (applied by web/dashboard.js):
+          {"epoch": E, "key": {...}}              keyframe (full payload)
+          {"epoch": E, "prev": P, "patch": node}  delta from epoch P
+          {"epoch": E, "prev": E, "patch": null}  heartbeat (no change)
+        A client whose last epoch isn't the frame's ``prev`` detects the
+        gap and resyncs (reconnect → immediate keyframe); keyframes also
+        recur every ``sse_keyframe_every`` frames so a silently desynced
+        consumer is bounded.
+        """
         head = (
             "HTTP/1.1 200 OK\r\n"
             "Content-Type: text/event-stream\r\n"
@@ -236,11 +329,20 @@ class MonitorServer:
         writer.write(head.encode("latin-1"))
         await writer.drain()
         interval = max(0.25, self.cfg.sample_interval_s)
+        keyframe_every = max(1, self.cfg.sse_keyframe_every)
+        client_ver = -1
+        since_key = keyframe_every  # first frame is always a keyframe
         while True:
-            payload = json.dumps(self.realtime_payload())
-            writer.write(f"data: {payload}\n\n".encode())
+            frame, client_ver, was_key = self._sse_frame(
+                client_ver, force_key=since_key >= keyframe_every
+            )
+            since_key = 1 if was_key else since_key + 1
+            writer.write(b"data: " + frame + b"\n\n")
             await writer.drain()  # raises once the client is gone
-            await asyncio.sleep(interval)
+            # Wake on the next sampler tick; the timeout keeps the
+            # stream heartbeating when the sampler loops aren't running
+            # (primed-only test servers, wedged fast loop).
+            await self.sampler.wait_tick(timeout_s=max(2 * interval, 2.0))
 
     def _api_health(self) -> dict:
         lat = list(self.request_latencies_ms)
@@ -262,6 +364,10 @@ class MonitorServer:
                 "latency_p50_ms": round(statistics.median(lat), 3) if lat else None,
                 "per_path": per_path,
             },
+            # Fast-path health: how much render work the epoch caches
+            # absorbed (tpumon.snapshot; pinned by tests/test_fastpath).
+            "render_cache": self.cache.to_json(),
+            "exporter_cache": self.exporter_cache.to_json(),
         }
 
     async def _api_profile(self, query: str) -> dict:
@@ -305,6 +411,9 @@ class MonitorServer:
                 raise HttpError(400, f"bad duration {data.get('duration')!r}")
             until = self.sampler.engine.silence(key, duration)
             payload = {"silenced": key, "until": until}
+        # The mutation happened outside the sampler's evaluation loop:
+        # invalidate the cached /api/alerts render immediately.
+        self.sampler.mark_alerts_dirty()
         return 200, "application/json", json.dumps(payload).encode()
 
     def _check_auth(self, auth: str | None) -> None:
@@ -333,45 +442,102 @@ class MonitorServer:
         auth: str | None = None,
     ) -> tuple[int, str, bytes]:
         """Route a request; returns (status, content_type, body)."""
+        status, ctype, body, _headers = await self.handle_ex(
+            method, path, query, body, auth=auth
+        )
+        return status, ctype, body
+
+    def _etagged(
+        self, key: str, sections: tuple[str, ...], build, if_none_match: str | None,
+        ctype: str = "application/json", evictable: bool = False,
+    ) -> tuple[int, str, bytes, dict]:
+        """Serve a route from the epoch render cache with ETag/304.
+
+        ``build`` runs only when one of ``sections`` changed since the
+        last render; between ticks every request gets the same bytes,
+        and a client presenting the current ETag gets an empty 304.
+        ``evictable`` marks request-derived keys (history windows) that
+        live under the cache's bounded-eviction cap.
+        """
+        body, etag = self.cache.get(key, sections, build, evictable=evictable)
+        if if_none_match is not None and if_none_match == etag:
+            return 304, ctype, b"", {"ETag": etag}
+        return 200, ctype, body, {"ETag": etag}
+
+    async def handle_ex(
+        self,
+        method: str,
+        path: str,
+        query: str = "",
+        body: bytes = b"",
+        auth: str | None = None,
+        if_none_match: str | None = None,
+    ) -> tuple[int, str, bytes, dict]:
+        """Route a request; returns (status, content_type, body,
+        extra response headers)."""
         if method == "POST":
             self._check_auth(auth)
-            return self._handle_post(path, body)
+            return (*self._handle_post(path, body), {})
         if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
-            return 200, self._dashboard.content_type, self._dashboard.read()
+            return 200, self._dashboard.content_type, self._dashboard.read(), {}
         if path == "/logo.svg":
-            return 200, self._logo.content_type, self._logo.read()
+            return 200, self._logo.content_type, self._logo.read(), {}
         if path == "/chartcore.js":
-            return 200, self._chartcore.content_type, self._chartcore.read()
+            return 200, self._chartcore.content_type, self._chartcore.read(), {}
         if path == "/dashboard.js":
-            return 200, self._dashboard_js.content_type, self._dashboard_js.read()
+            return 200, self._dashboard_js.content_type, self._dashboard_js.read(), {}
         if path == "/metrics":
-            return 200, "text/plain; version=0.0.4; charset=utf-8", render_exporter(
-                self.sampler
-            ).encode()
+            return self._etagged(
+                "/metrics",
+                ("host", "accel", "k8s", "serving", "alerts", "samples"),
+                lambda: render_exporter(self.sampler, cache=self.exporter_cache),
+                if_none_match,
+                ctype="text/plain; version=0.0.4; charset=utf-8",
+            )
+
+        cached = self._cached_routes.get(path)
+        if cached is not None:
+            sections, builder = cached
+            return self._etagged(
+                path,
+                sections,
+                lambda: json.dumps(builder()).encode(),
+                if_none_match,
+            )
 
         payload = None
-        if path == "/api/host/metrics":
-            payload = self._api_host()
-        elif path == "/api/accel/metrics":
-            payload = self._api_accel()
-        elif path == "/api/gpu/metrics":
-            payload = self._api_gpu_compat()
-        elif path == "/api/k8s/pods":
-            payload = self._api_pods()
-        elif path == "/api/history":
+        if path == "/api/history":
             params = parse_query(query)
             window_s = None
             if "window" in params:
                 window_s = parse_duration(params["window"], default=-1.0)
                 if window_s <= 0:
                     raise HttpError(400, f"bad window {params['window']!r}")
+            if self.history.prom is None:
+                # Ring-only mode: the payload is a pure function of the
+                # ring's contents, which only grow when a tick records
+                # ("samples" moves on every poll) — cacheable per window.
+                # Quantize the clamped window to its render-step grid
+                # (step_for targets ~60 points, so windows within one
+                # step render identically anyway): arbitrary ?window=
+                # values collapse onto a few keys instead of cycling
+                # the bounded eviction. The BODY is built from the same
+                # quantized window, so key ⇔ payload stays exact.
+                wq = None
+                if window_s:
+                    w = self.history.clamp_window(window_s)
+                    step = self.history.step_for(w)
+                    wq = max(60.0, round(w / step) * step)
+                return self._etagged(
+                    f"/api/history?w={wq or ''}",
+                    ("samples",),
+                    lambda: json.dumps(
+                        self.history.snapshot_ring(window_s=wq)
+                    ).encode(),
+                    if_none_match,
+                    evictable=True,
+                )
             payload = await self.history.snapshot(window_s=window_s)
-        elif path == "/api/alerts":
-            payload = self._api_alerts()
-        elif path == "/api/serving":
-            payload = self._api_serving()
-        elif path == "/api/topology":
-            payload = {"slices": [v.to_json() for v in self.sampler.slices()]}
         elif path == "/api/health":
             payload = self._api_health()
         elif path == "/api/profile":
@@ -379,7 +545,7 @@ class MonitorServer:
             payload = await self._api_profile(query)
         if payload is None:
             raise HttpError(404, "Not Found")
-        return 200, "application/json", json.dumps(payload).encode()
+        return 200, "application/json", json.dumps(payload).encode(), {}
 
     # ---------------------------- HTTP plumbing ----------------------------
 
@@ -396,7 +562,7 @@ class MonitorServer:
             # Drain headers; Content-Length is the only one routing needs
             # (POST bodies for the silence routes).
             content_length = 0
-            origin = host_hdr = auth_hdr = None
+            origin = host_hdr = auth_hdr = inm_hdr = None
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
@@ -413,6 +579,8 @@ class MonitorServer:
                     host_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
                 elif lower.startswith(b"authorization:"):
                     auth_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+                elif lower.startswith(b"if-none-match:"):
+                    inm_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
             # Query stripped from routing (monitor_server.js:250) but kept
             # for the routes that take parameters (/api/profile).
             path, _, query = target.partition("?")
@@ -458,9 +626,11 @@ class MonitorServer:
                 req_body = await asyncio.wait_for(
                     reader.readexactly(content_length), timeout=10
                 )
+            headers: dict = {}
             try:
-                status, ctype, body = await self.handle(
-                    method, path, query, req_body, auth=auth_hdr
+                status, ctype, body, headers = await self.handle_ex(
+                    method, path, query, req_body, auth=auth_hdr,
+                    if_none_match=inm_hdr,
                 )
             except HttpError as e:
                 status, ctype = e.status, "application/json"
@@ -470,7 +640,7 @@ class MonitorServer:
                 body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
             if method == "HEAD":
                 body = b""
-            await self._respond(writer, status, ctype, body)
+            await self._respond(writer, status, ctype, body, headers)
             ms = (time.monotonic() - t0) * 1e3
             self.request_latencies_ms.append(ms)
             # Per-path stats only for served routes: keying on raw client
@@ -491,12 +661,19 @@ class MonitorServer:
                 pass
 
     async def _respond(
-        self, writer: asyncio.StreamWriter, status: int, ctype: str, body: bytes
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        ctype: str,
+        body: bytes,
+        headers: dict | None = None,
     ) -> None:
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         head = (
             f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}\r\n"
             f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{extra}"
             # CORS parity with the reference (monitor_server.js:244-248)
             "Access-Control-Allow-Origin: *\r\n"
             "Access-Control-Allow-Methods: GET, POST, OPTIONS\r\n"

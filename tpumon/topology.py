@@ -138,6 +138,75 @@ class SliceView:
         }
 
 
+# Columnar federation wire format (tpumon.collectors.accel_peers /
+# /api/accel/wire): field names once, positional rows per chip — at 256
+# chips the repeated per-chip JSON keys of to_json() dominate the
+# payload, so the wire form is a fraction of the bytes and parse work.
+# hbm_pct is derived, never shipped. Order is the contract: append new
+# fields at the END and bump WIRE_VERSION only on incompatible changes
+# (readers zip fields by the *sender's* field list, so old readers
+# ignore unknown trailing fields and old senders simply omit them).
+WIRE_VERSION = 1
+WIRE_FIELDS: tuple[str, ...] = (
+    "chip_id",
+    "host",
+    "slice_id",
+    "index",
+    "kind",
+    "coords",
+    "mxu_duty_pct",
+    "hbm_used",
+    "hbm_total",
+    "temp_c",
+    "ici_tx_bytes",
+    "ici_rx_bytes",
+    "ici_link_up",
+    "ici_link_health",
+    "throttle_score",
+    "counter_source",
+)
+
+
+def chips_to_wire(chips: Iterable[ChipSample]) -> dict:
+    """Compact columnar snapshot: {"v", "fields", "rows"}."""
+    return {
+        "v": WIRE_VERSION,
+        "fields": list(WIRE_FIELDS),
+        "rows": [
+            [
+                list(v) if isinstance(v := getattr(c, f), tuple) else v
+                for f in WIRE_FIELDS
+            ]
+            for c in chips
+        ],
+    }
+
+
+def chips_from_wire(payload: dict) -> list[ChipSample]:
+    """Inverse of chips_to_wire. Tolerant of senders with fewer or more
+    fields than this build knows: rows are zipped against the sender's
+    FULL field list (positions must track the sender's own layout —
+    filtering before the zip would shift values into the wrong fields),
+    then unknown names are dropped. An incompatible ``v`` fails loudly
+    so the WIRE_VERSION escape hatch actually works."""
+    v = payload.get("v")
+    if v != WIRE_VERSION:
+        raise ValueError(f"wire version {v!r} != supported {WIRE_VERSION}")
+    fields = list(payload.get("fields") or ())
+    out: list[ChipSample] = []
+    for row in payload.get("rows") or ():
+        kw = {f: val for f, val in zip(fields, row) if f in _WIRE_FIELD_SET}
+        if "coords" in kw:
+            kw["coords"] = tuple(kw["coords"] or ())
+        if "index" in kw:
+            kw["index"] = int(kw["index"])
+        out.append(ChipSample(**kw))
+    return out
+
+
+_WIRE_FIELD_SET = frozenset(WIRE_FIELDS)
+
+
 def attribute_pods(
     chips: Iterable[ChipSample], pods: Iterable[Mapping] | None
 ) -> dict[str, str]:

@@ -52,6 +52,31 @@ function makeLineChart(surface, series, opts) {
   return { draw: draw, update: update, tipAt: tipAt };
 }
 
+/* --------------------------- delta SSE codec --------------------------- */
+/* Mirror of tpumon/deltas.py apply_delta — the server diffs successive
+   realtime payloads into patch nodes ({"s": replace}, {"o": object
+   merge, "d": dropped keys}, {"l": [[index, node]] list patches}) and
+   the stream carries only what moved. One deviation forced by the
+   dialect (no `delete`): dropped keys are set to undefined instead of
+   removed — invisible to every renderer here (all reads are ?.-guarded)
+   and to Object.keys consumers of the realtime payload (none). */
+function applyDelta(target, node) {
+  if (node == null) return target;
+  if (node.s !== undefined) return node.s;
+  if (node.l !== undefined) {
+    for (const p of node.l) target[p[0]] = applyDelta(target[p[0]], p[1]);
+    return target;
+  }
+  if (node.o !== undefined) {
+    for (const k of Object.keys(node.o))
+      target[k] = applyDelta(target[k], node.o[k]);
+  }
+  if (node.d !== undefined) {
+    for (const k of node.d) target[k] = undefined;
+  }
+  return target;
+}
+
 /* ------------------------------ dashboard ------------------------------ */
 
 function makeDashboard(doc, net, env, mkSurface) {
@@ -229,18 +254,54 @@ function makeDashboard(doc, net, env, mkSurface) {
     });
   }
 
-  /* Live push: one SSE frame (already JSON-parsed; the bootstrap drops
-     malformed frames so polling remains the fallback). */
-  function onStreamFrame(d) {
-    if (!d) return;
-    applyHost(d.host);
-    renderChips(d.accel);
-    if (d.alerts) {
-      $("n-minor").textContent = d.alerts.minor ?? 0;
-      $("n-serious").textContent = d.alerts.serious ?? 0;
-      $("n-critical").textContent = d.alerts.critical ?? 0;
-      $("crit-badge").classList.toggle("active", (d.alerts.critical ?? 0) > 0);
+  /* Live push: delta frames keyed by snapshot epoch (tpumon/server.py
+     _stream docstring has the 3-frame protocol). The bootstrap passes
+     each JSON-parsed frame here; "resync" tells it to reconnect (a
+     fresh connection's first frame is always a keyframe). State: the
+     last full payload, patched in place by delta frames. */
+  let streamEpoch = -1;
+  let streamData = null;
+
+  function renderStream() {
+    if (!streamData) return;
+    applyHost(streamData.host);
+    renderChips(streamData.accel);
+    const al = streamData.alerts;
+    if (al) {
+      $("n-minor").textContent = al.minor ?? 0;
+      $("n-serious").textContent = al.serious ?? 0;
+      $("n-critical").textContent = al.critical ?? 0;
+      $("crit-badge").classList.toggle("active", (al.critical ?? 0) > 0);
     }
+  }
+
+  function onStreamFrame(d) {
+    if (!d) return "ok";  // malformed frames dropped upstream
+    if (d.key !== undefined) {  // keyframe: replace state wholesale
+      streamData = d.key;
+      streamEpoch = d.epoch;
+      renderStream();
+      return "ok";
+    }
+    if (d.prev !== undefined) {  // delta or heartbeat
+      if (d.prev !== streamEpoch || streamData === null) {
+        // Gap: this patch applies to a payload we don't hold (missed
+        // frame, server restart). Drop state and ask for a resync.
+        streamEpoch = -1;
+        streamData = null;
+        return "resync";
+      }
+      streamEpoch = d.epoch;
+      if (d.patch == null) return "ok";  // heartbeat: nothing moved
+      streamData = applyDelta(streamData, d.patch);
+      renderStream();
+      return "ok";
+    }
+    // Legacy full frame (pre-delta wire): render it directly.
+    streamData = d;
+    streamEpoch = -1;
+    renderStream();
+    return "ok";
   }
 
   /* ------------------------------ history ------------------------------ */
