@@ -101,8 +101,13 @@ def _detect_backend() -> str:
     return "fake:v5e-8"
 
 
-async def _bench_scrape(backend: str, iters: int = 50, warmup: int = 5) -> dict:
-    """Headline: scrape→render p50 against the live server."""
+async def _serve_bench_app(backend: str, **extra_env):
+    """Shared bench bring-up: one host+accel instance over ``backend``,
+    primed and listening. Returns (sampler, server, fetch) where
+    ``fetch()`` GETs /api/accel/metrics — the dashboard's render input.
+    Every phase that measures the live server goes through here so the
+    harness can't drift between phases (e.g. the observability phase's
+    on/off comparison must differ ONLY in TPUMON_TRACE_RING)."""
     from tpumon.app import build
     from tpumon.config import load_config
 
@@ -113,6 +118,7 @@ async def _bench_scrape(backend: str, iters: int = 50, warmup: int = 5) -> dict:
             "TPUMON_ACCEL_BACKEND": backend,
             "TPUMON_K8S_MODE": "none",
             "TPUMON_COLLECTORS": "host,accel",
+            **extra_env,
         }
     )
     sampler, server = build(cfg)
@@ -124,6 +130,12 @@ async def _bench_scrape(backend: str, iters: int = 50, warmup: int = 5) -> dict:
         with urllib.request.urlopen(url) as r:
             return json.loads(r.read())
 
+    return sampler, server, fetch
+
+
+async def _bench_scrape(backend: str, iters: int = 50, warmup: int = 5) -> dict:
+    """Headline: scrape→render p50 against the live server."""
+    sampler, server, fetch = await _serve_bench_app(backend)
     stop = threading.Event()
     if backend == "jax":  # fake counters are synthetic; no point burning
         _start_burn(stop)
@@ -627,28 +639,9 @@ async def _bench_fastpath(topology: str, iters: int = 30, warmup: int = 5) -> di
     render path — realtime scrape→render p50, exporter cold render vs
     cached re-render (same tick), and the SSE keyframe vs delta frame
     bytes. Key suffix = chip count, so 64 vs 256 scale per round."""
-    from tpumon.app import build
-    from tpumon.config import load_config
     from tpumon.exporter import render_exporter
 
-    cfg = load_config(
-        env={
-            "TPUMON_PORT": "0",
-            "TPUMON_HOST": "127.0.0.1",
-            "TPUMON_ACCEL_BACKEND": f"fake:{topology}",
-            "TPUMON_K8S_MODE": "none",
-            "TPUMON_COLLECTORS": "host,accel",
-        }
-    )
-    sampler, server = build(cfg)
-    await sampler.tick_all()
-    await server.start()
-    url = f"http://127.0.0.1:{server.port}/api/accel/metrics"
-
-    def fetch() -> dict:
-        with urllib.request.urlopen(url) as r:
-            return json.loads(r.read())
-
+    sampler, server, fetch = await _serve_bench_app(f"fake:{topology}")
     try:
         cycle_ms: list[float] = []
         for i in range(warmup + iters):
@@ -691,6 +684,65 @@ async def _bench_fastpath(topology: str, iters: int = 30, warmup: int = 5) -> di
         f"exporter_cached_render_{n}_ms": round(_p50(cached_ms), 3),
         f"sse_keyframe_bytes_{n}": len(key_frame),
         f"sse_delta_bytes_{n}": len(delta_frame),
+    }
+
+
+async def _bench_observability(
+    topology: str = "v5p-64", iters: int = 40, warmup: int = 5
+) -> dict:
+    """Self-tracing overhead (docs/observability.md): tick p50 and
+    scrape→render p50 with the span ring at its default capacity vs
+    tracing disabled, at a production chip count. The acceptance bar is
+    the ``trace_overhead_scrape_pct`` key staying under ~5% — tracing
+    is always-on, so its cost IS a headline number."""
+    measured: dict[str, tuple[float, float]] = {}
+    spans_recorded = 0
+    # A/B/A/B with per-config min-of-rounds: the two configs are
+    # measured tens of seconds apart, so box-level load drift would
+    # otherwise dominate the sub-5% effect being measured.
+    for _round in range(2):
+        for label, ring in (("on", "4096"), ("off", "0")):
+            sampler, server, fetch = await _serve_bench_app(
+                f"fake:{topology}", TPUMON_TRACE_RING=ring
+            )
+            try:
+                tick_ms: list[float] = []
+                for i in range(warmup + iters):
+                    t0 = time.perf_counter()
+                    await sampler.tick_fast()
+                    if i >= warmup:
+                        tick_ms.append((time.perf_counter() - t0) * 1e3)
+                cycle_ms: list[float] = []
+                for i in range(warmup + iters):
+                    t0 = time.perf_counter()
+                    await sampler.tick_fast()
+                    data = await asyncio.to_thread(fetch)
+                    if i >= warmup:
+                        cycle_ms.append((time.perf_counter() - t0) * 1e3)
+                assert "chips" in data
+                if label == "on":
+                    spans_recorded = sampler.tracer.recorded
+            finally:
+                await server.stop()
+            pair = (_p50(tick_ms), _p50(cycle_ms))
+            prev = measured.get(label)
+            measured[label] = (
+                pair if prev is None
+                else (min(prev[0], pair[0]), min(prev[1], pair[1]))
+            )
+
+    def pct(on: float, off: float) -> float | None:
+        return round(100.0 * (on - off) / off, 2) if off > 0 else None
+
+    (tick_on, scrape_on), (tick_off, scrape_off) = measured["on"], measured["off"]
+    return {
+        "trace_on_tick_p50_ms": round(tick_on, 3),
+        "trace_off_tick_p50_ms": round(tick_off, 3),
+        "trace_overhead_tick_pct": pct(tick_on, tick_off),
+        "trace_on_scrape_to_render_p50_ms": round(scrape_on, 3),
+        "trace_off_scrape_to_render_p50_ms": round(scrape_off, 3),
+        "trace_overhead_scrape_pct": pct(scrape_on, scrape_off),
+        "trace_spans_recorded": spans_recorded,
     }
 
 
@@ -796,6 +848,12 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                        "exporter_render_256_ms",
                        "exporter_cached_render_256_ms",
                        "sse_keyframe_bytes_256", "sse_delta_bytes_256")),
+    "observability": (300, ("trace_on_tick_p50_ms", "trace_off_tick_p50_ms",
+                            "trace_overhead_tick_pct",
+                            "trace_on_scrape_to_render_p50_ms",
+                            "trace_off_scrape_to_render_p50_ms",
+                            "trace_overhead_scrape_pct",
+                            "trace_spans_recorded")),
     "federation": (240, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
                          "federation_exporter_render_ms",
@@ -857,6 +915,8 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "fastpath_256_scrape_to_render_p50_ms",
     "exporter_render_256_ms", "exporter_cached_render_256_ms",
     "sse_keyframe_bytes_256", "sse_delta_bytes_256",
+    # observability (self-trace overhead at v5p-64, docs/observability.md)
+    "trace_overhead_tick_pct", "trace_overhead_scrape_pct",
     # federation
     "federation_chips", "federation_scrape_to_render_p50_ms",
     "federation_256_scrape_to_render_p50_ms",
@@ -910,6 +970,8 @@ def _run_phase(name: str, backend: str) -> dict:
             return out
 
         return asyncio.run(both())
+    if name == "observability":
+        return asyncio.run(_bench_observability())
     if name == "federation":
         async def both_scales():
             # 64 chips (8×v5e-8, the BENCH_r05-comparable shape) and

@@ -45,6 +45,7 @@ GET_ENDPOINTS = [
     ("/api/alerts", ""),
     ("/api/serving", ""),
     ("/api/health", ""),
+    ("/api/trace", ""),
 ]
 
 
@@ -413,6 +414,42 @@ def test_stream_gap_detection_and_heartbeat(js, payloads):
     assert len(doc.el("chips")["_children"]) == 8
     # The post-reconnect keyframe resyncs cleanly.
     assert d["onStreamFrame"]({"epoch": 10.0, "key": key}) == "ok"
+
+
+def test_stream_frame_renders_trace_strip(js, payloads):
+    """The self-trace tick timeline (tpumon/tracing.py last_tick rides
+    the SSE payload): one proportional segment per stage, legend with
+    per-stage ms, hidden again when the payload carries no trace."""
+    d, doc, net, env, surf = mkdash(js, {})
+    trace = {"ts": 1.0, "total_ms": 10.0,
+             "stages": [{"name": "collect.host", "ms": 1.0},
+                        {"name": "collect.accel", "ms": 6.0},
+                        {"name": "history", "ms": 1.0},
+                        {"name": "alerts", "ms": 2.0}]}
+    frame = {"epoch": 1.0,
+             "key": {"host": payloads["/api/host/metrics"],
+                     "accel": payloads["/api/accel/metrics"],
+                     "alerts": {"minor": 0.0, "serious": 0.0, "critical": 0.0},
+                     "trace": tojs(trace)}}
+    assert d["onStreamFrame"](frame) == "ok"
+    assert doc.el("trace-card")["style"]["display"] == ""
+    assert doc.el("trace-tag")["textContent"] == "tick 10.0 ms"
+    segs = doc.el("trace-strip")["_children"]
+    assert len(segs) == 4
+    widths = [s["style"]["width"] for s in segs]
+    assert all(w.endswith("%") for w in widths)
+    assert float(widths[1][:-1]) == 60.0  # 6 of 10 ms -> 60%
+    assert segs[1]["style"]["background"]  # stable per-stage color
+    legend = all_text(doc.el("trace-legend"))
+    assert "collect.accel 6.00 ms" in legend and "alerts 2.00 ms" in legend
+    # A payload without trace (tracing disabled) hides the card.
+    frame2 = {"epoch": 2.0,
+              "key": {"host": payloads["/api/host/metrics"],
+                      "accel": payloads["/api/accel/metrics"],
+                      "alerts": {"minor": 0.0, "serious": 0.0,
+                                 "critical": 0.0}}}
+    assert d["onStreamFrame"](frame2) == "ok"
+    assert doc.el("trace-card")["style"]["display"] == "none"
 
 
 # ---------------------------------------------------------------- history

@@ -1,0 +1,420 @@
+"""Self-tracing data plane (ISSUE 3): the span tracer's bounded ring and
+nesting, the /api/trace summary contract, Chrome-trace/Perfetto export
+schema validation (ph/ts/dur/pid/tid + child-inside-parent intervals),
+bounded-ring behavior under a chaos tick storm, and the genuine
+Prometheus histogram triples (_bucket with le + +Inf, _sum, _count) the
+exporter now emits for stage and HTTP latency."""
+
+import asyncio
+import json
+
+import pytest
+
+from tests.test_server_api import serve
+from tpumon.metrics_text import (
+    histogram_quantile,
+    parse_metrics_text,
+    samples_by_name,
+)
+from tpumon.sampler import SourceStats
+from tpumon.tracing import LatencyHistogram, SpanTracer, quantiles
+
+# ------------------------------------------------------------- unit layer
+
+
+class TestQuantiles:
+    def test_single_pass_p50_p95_max(self):
+        assert quantiles([5.0, 1.0, 3.0, 2.0, 4.0]) == (3.0, 4.0, 5.0)
+        assert quantiles([7.0]) == (7.0, 7.0, 7.0)
+        assert quantiles([]) is None
+
+    def test_source_stats_render_all_three(self):
+        st = SourceStats()
+        for v in (1.0, 9.0, 2.0, 8.0, 3.0):
+            st.latencies_ms.append(v)
+        j = st.to_json()
+        assert j["latency_p50_ms"] <= j["latency_p95_ms"] <= j["latency_max_ms"]
+        assert j["latency_max_ms"] == 9.0
+
+
+class TestLatencyHistogram:
+    def test_cumulative_monotone_and_overflow(self):
+        h = LatencyHistogram()
+        for v in (0.00005, 0.003, 0.003, 7.0, 100.0):
+            h.observe(v)
+        cum = [c for _, c in h.cumulative()]
+        assert cum == sorted(cum)
+        # 100.0 is beyond the last bound: visible only in count (+Inf).
+        assert cum[-1] == 4
+        assert h.count == 5
+        assert h.sum == pytest.approx(107.00605)
+
+
+class TestSpanTracer:
+    def test_ring_bounded_with_drop_accounting(self):
+        tr = SpanTracer(8)
+        for _ in range(20):
+            with tr.span("s"):
+                pass
+        assert tr.recorded == 20
+        assert tr.dropped == 12
+        assert len(tr._spans_newest_last(100)) == 8
+
+    def test_parent_child_nesting(self):
+        tr = SpanTracer(16)
+        with tr.span("parent", cat="tick"):
+            with tr.span("child"):
+                pass
+        child, parent = tr._spans_newest_last(2)  # child closes first
+        assert (child.name, parent.name) == ("child", "parent")
+        assert child.parent == parent.sid
+        assert parent.parent is None
+
+    def test_disabled_tracer_records_nothing(self):
+        tr = SpanTracer(0)
+        with tr.span("x") as sp:
+            sp.tag(a=1)  # must be a no-op, not an AttributeError
+        assert not tr.enabled
+        assert tr.recorded == 0
+        assert tr.to_json()["spans"] == []
+        assert tr.export_chrome()["traceEvents"][0]["ph"] == "M"
+
+    def test_concurrent_tasks_do_not_adopt_each_others_spans(self):
+        tr = SpanTracer(64)
+
+        async def work(name):
+            with tr.span(name, cat="tick"):
+                await asyncio.sleep(0.01)
+                with tr.span(name + ".child"):
+                    await asyncio.sleep(0.01)
+
+        async def both():
+            await asyncio.gather(work("a"), work("b"))
+
+        asyncio.run(both())
+        by = {s.name: s for s in tr._spans_newest_last(10)}
+        assert by["a.child"].parent == by["a"].sid
+        assert by["b.child"].parent == by["b"].sid
+
+    def test_tick_summary_lists_direct_children(self):
+        tr = SpanTracer(32)
+        with tr.span("tick_fast", cat="tick"):
+            with tr.span("collect.host", cat="collect"):
+                pass
+            with tr.span("history"):
+                with tr.span("grandchild"):  # not a DIRECT child
+                    pass
+        names = [s["name"] for s in tr.last_tick["stages"]]
+        assert names == ["collect.host", "history"]
+        assert tr.last_tick["total_ms"] >= 0
+
+
+# --------------------------------------------------------- live data plane
+
+
+def _app(env=None):
+    sampler, server = serve(env)
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(sampler.tick_all())
+    return loop, sampler, server
+
+
+def _get(app, path, inm=None):
+    loop, _, server = app
+    return loop.run_until_complete(
+        server.handle_ex("GET", path, if_none_match=inm)
+    )
+
+
+FULL_ENV = {"TPUMON_K8S_MODE": "fake", "TPUMON_SERVING_TARGETS": "fake:jetstream"}
+
+# The acceptance set: every collector plus the alerts, history, delta
+# and SSE stages must show in the per-stage summary.
+EXPECTED_STAGES = (
+    "tick_fast", "collect.host", "collect.accel", "collect.k8s",
+    "collect.serving", "alerts", "history", "delta", "sse",
+)
+
+
+class TestTraceRoutes:
+    @pytest.fixture()
+    def app(self):
+        loop, sampler, server = _app(FULL_ENV)
+        yield loop, sampler, server
+        loop.close()
+
+    def _drive(self, app):
+        """Exercise the whole data plane: a tick, an SSE keyframe and a
+        chained delta frame."""
+        loop, sampler, server = app
+        _, ver, _ = server._sse_frame(-1, True)
+        loop.run_until_complete(sampler.tick_fast())
+        server._sse_frame(ver, False)
+
+    def test_api_trace_covers_every_stage(self, app):
+        loop, sampler, server = app
+        self._drive(app)
+        status, _, body, _ = _get(app, "/api/trace")
+        assert status == 200
+        t = json.loads(body)
+        assert t["enabled"] and t["capacity"] == 4096
+        for stage in EXPECTED_STAGES:
+            row = t["stages"].get(stage)
+            assert row is not None, f"stage {stage} missing from /api/trace"
+            assert row["count"] >= 1
+            assert row["p50_ms"] <= row["p95_ms"] <= row["max_ms"]
+        # The strip payload: total + per-stage breakdown of the last tick.
+        lt = t["last_tick"]
+        assert lt["total_ms"] > 0
+        names = [s["name"] for s in lt["stages"]]
+        assert "collect.host" in names and "alerts" in names
+        # Collect spans carry their outcome (breaker/deadline tagging).
+        outcomes = [
+            s["tags"].get("outcome")
+            for s in t["spans"]
+            if s["name"].startswith("collect.") and "tags" in s
+        ]
+        assert "ok" in outcomes
+        # The latest device-profile capture is linked (none taken yet).
+        assert t["profile"]["busy"] is False
+        assert t["profile"]["captures"] == 0
+
+    def test_api_trace_served_through_render_cache(self, app):
+        loop, sampler, server = app
+        _, _, body1, h1 = _get(app, "/api/trace")
+        hits0 = server.cache.hits
+        _, _, body2, h2 = _get(app, "/api/trace")
+        assert body1 is body2  # same bytes object between ticks
+        assert server.cache.hits > hits0
+        assert h1["ETag"] == h2["ETag"]
+        status, _, body3, _ = _get(app, "/api/trace", inm=h1["ETag"])
+        assert status == 304 and body3 == b""
+
+    def test_http_spans_summarize_per_route(self, app):
+        _get(app, "/api/accel/metrics")
+        _get(app, "/api/accel/metrics")
+        _, _, body, _ = _get(app, "/api/trace")
+        t = json.loads(body)
+        row = t["http"].get("/api/accel/metrics")
+        assert row is not None and row["count"] >= 2
+        # Second request rode the epoch render cache: tagged as a hit.
+        http_spans = [
+            s for s in t["spans"]
+            if s["name"] == "http"
+            and s.get("tags", {}).get("route") == "/api/accel/metrics"
+        ]
+        assert any(s["tags"].get("cache") == "hit" for s in http_spans)
+        assert all(s["tags"].get("status") == 200 for s in http_spans)
+
+    def test_export_is_wellformed_chrome_trace(self, app):
+        loop, sampler, server = app
+        self._drive(app)
+        _get(app, "/api/health")
+        status, _, body, _ = _get(app, "/api/trace/export")
+        assert status == 200
+        data = json.loads(body)
+        events = data["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs, "no complete events exported"
+        for e in events:
+            assert {"ph", "pid", "tid", "name"} <= set(e), e
+        for e in xs:
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert e["pid"] == 1 and isinstance(e["tid"], int)
+        # Metadata names the process and every track.
+        metas = [e for e in events if e["ph"] == "M"]
+        assert any(e["name"] == "process_name" for e in metas)
+        tracks = {e["args"]["name"] for e in metas if e["name"] == "thread_name"}
+        assert {"sampler", "http"} <= tracks
+        # Child spans nest inside their parent's interval (same
+        # monotonic clock => exact containment modulo the 0.1 µs
+        # rounding the export applies).
+        by_sid = {e["args"]["sid"]: e for e in xs}
+        nested = 0
+        for e in xs:
+            parent = by_sid.get(e["args"].get("parent"))
+            if parent is None:
+                continue
+            assert e["ts"] >= parent["ts"] - 0.2, (e, parent)
+            assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + 0.2
+            nested += 1
+        assert nested >= 4
+        # Every collector span hangs off a tick root.
+        collects = [e for e in xs if e["name"].startswith("collect.")]
+        assert collects
+        for e in collects:
+            parent = by_sid.get(e["args"]["parent"])
+            assert parent is not None and parent["name"].startswith("tick")
+
+    def test_realtime_payload_carries_trace_strip(self, app):
+        loop, sampler, server = app
+        payload = server.realtime_payload()
+        assert payload["trace"]["total_ms"] > 0
+        assert payload["trace"]["stages"]
+
+
+class TestRingBoundedUnderChaosStorm:
+    def test_chaos_tick_storm_stays_bounded(self):
+        """A tiny ring under a fault storm (errors, slowness, breaker
+        flaps) must overwrite, never grow: the tracer is part of the
+        resilience story, not a new leak."""
+        sampler, server = serve({
+            **FULL_ENV,
+            "TPUMON_TRACE_RING": "64",
+            "TPUMON_CHAOS": "err:accel:0.6,slow:host:1,flap:k8s:0.5",
+            "TPUMON_CHAOS_SEED": "7",
+            "TPUMON_COLLECT_DEADLINE_S": "0.5",
+            "TPUMON_BREAKER_FAILURES": "2",
+            "TPUMON_BREAKER_BACKOFF_S": "0.05",
+        })
+        loop = asyncio.new_event_loop()
+        try:
+            for _ in range(40):
+                loop.run_until_complete(sampler.tick_all())
+            tr = sampler.tracer
+            assert tr.recorded > 64
+            assert tr.dropped == tr.recorded - 64
+            status, _, body, _ = loop.run_until_complete(
+                server.handle_ex("GET", "/api/trace")
+            )
+            t = json.loads(body)
+            assert t["dropped"] > 0
+            assert len(t["spans"]) <= 64
+            status, _, body, _ = loop.run_until_complete(
+                server.handle_ex("GET", "/api/trace/export")
+            )
+            xs = [
+                e for e in json.loads(body)["traceEvents"] if e["ph"] == "X"
+            ]
+            assert len(xs) <= 64
+            # Degraded collects are visible as such in the span tags.
+            accel_outcomes = {
+                s["tags"].get("outcome")
+                for s in t["spans"]
+                if s["name"] == "collect.accel" and "tags" in s
+            }
+            assert accel_outcomes & {"error", "skipped"}, accel_outcomes
+        finally:
+            loop.close()
+
+
+class TestDisabledTracing:
+    def test_trace_ring_zero_disables_end_to_end(self):
+        loop, sampler, server = _app({"TPUMON_TRACE_RING": "0"})
+        try:
+            status, _, body, _ = _get((loop, sampler, server), "/api/trace")
+            t = json.loads(body)
+            assert t["enabled"] is False and t["spans"] == []
+            assert server.realtime_payload()["trace"] is None
+            _, _, body, _ = _get((loop, sampler, server), "/metrics")
+            assert b"tpumon_stage_duration_seconds_bucket" not in body
+            # With no per-tick trace in the payload, the SSE epoch must
+            # NOT ride collection activity: unchanged data keeps
+            # producing heartbeats, exactly the pre-trace behavior.
+            assert "samples" not in server._rt_sections
+        finally:
+            loop.close()
+
+    def test_enabled_tracing_versions_sse_on_activity(self):
+        loop, sampler, server = _app()
+        try:
+            assert "samples" in server._rt_sections
+        finally:
+            loop.close()
+
+
+class TestHttpRouteCardinality:
+    def test_error_statuses_on_junk_paths_share_one_key(self):
+        """401s (auth on) and 404s on unregistered paths must not grow
+        the per-route histogram table — a URL scanner would otherwise
+        fill it to its cap and pin junk labels in /metrics forever."""
+        loop, sampler, server = _app({"TPUMON_AUTH_TOKEN": "s3cret"})
+        try:
+            from tpumon.server import HttpError
+
+            for i in range(5):
+                with pytest.raises(HttpError):  # 401: auth precedes routing
+                    loop.run_until_complete(
+                        server.handle_ex("POST", f"/junk-{i}", body=b"{}")
+                    )
+            routes = set(sampler.tracer.http_hist)
+            assert not any(r.startswith("/junk") for r in routes)
+            assert "(unmatched)" in routes
+        finally:
+            loop.close()
+
+
+# ------------------------------------------------------ native histograms
+
+
+class TestMetricsHistograms:
+    def test_exporter_emits_genuine_histogram_triples(self):
+        loop, sampler, server = _app(FULL_ENV)
+        try:
+            app = (loop, sampler, server)
+            _get(app, "/api/health")  # seed the http histogram
+            loop.run_until_complete(sampler.tick_fast())
+            _, _, body, _ = _get(app, "/metrics")
+            by = samples_by_name(parse_metrics_text(body.decode()))
+
+            # Stage histogram: cumulative le-labelled buckets with +Inf,
+            # _sum and _count — the text-format parser must accept it
+            # and quantile estimation must work against it.
+            buckets = [
+                s for s in by["tpumon_stage_duration_seconds_bucket"]
+                if s.labels["stage"] == "tick_fast"
+            ]
+            les = [s.labels["le"] for s in buckets]
+            assert "+Inf" in les
+            cum = [s.value for s in buckets if s.labels["le"] != "+Inf"]
+            assert cum == sorted(cum)
+            count = next(
+                s.value for s in by["tpumon_stage_duration_seconds_count"]
+                if s.labels["stage"] == "tick_fast"
+            )
+            inf = next(s.value for s in buckets if s.labels["le"] == "+Inf")
+            assert inf == count >= 1
+            total = next(
+                s.value for s in by["tpumon_stage_duration_seconds_sum"]
+                if s.labels["stage"] == "tick_fast"
+            )
+            assert total > 0
+            q = histogram_quantile(buckets, 0.5)
+            assert q is not None and q >= 0
+
+            # Per-collector stage series all present.
+            stages = {
+                s.labels["stage"]
+                for s in by["tpumon_stage_duration_seconds_count"]
+            }
+            assert {"collect.host", "collect.accel", "alerts", "history"} <= stages
+
+            # HTTP histogram keyed by route.
+            hb = [
+                s for s in by["tpumon_http_request_duration_seconds_bucket"]
+                if s.labels["route"] == "/api/health"
+            ]
+            assert hb and any(s.labels["le"] == "+Inf" for s in hb)
+
+            # Profiler observability satellites.
+            assert by["tpumon_profile_captures_total"][0].value == 0
+            assert by["tpumon_profile_busy"][0].value == 0
+            # Ring accounting.
+            assert by["tpumon_trace_spans_total"][0].value >= 1
+
+            # p95 joined p50 in the self block (single-pass quantiles).
+            assert "tpumon_sample_latency_p95_ms" in by
+        finally:
+            loop.close()
+
+    def test_health_reports_latency_p95(self):
+        loop, sampler, server = _app()
+        try:
+            _, _, body, _ = _get((loop, sampler, server), "/api/health")
+            h = json.loads(body)
+            for src in h["sources"].values():
+                assert "latency_p95_ms" in src
+            assert "latency_p95_ms" in h["http"]
+        finally:
+            loop.close()

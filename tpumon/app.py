@@ -146,6 +146,12 @@ async def run(cfg: Config) -> None:
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "trace":
+        # ``tpumon trace`` — dump/summarize a running server's span ring
+        # (tpumon.tracing; docs/observability.md).
+        from tpumon.tracing import trace_cli
+
+        return trace_cli(argv[1:])
     path = None
     overrides = {}
     serve_loadgen = False
@@ -259,6 +265,10 @@ def main(argv: list[str] | None = None) -> int:
             overrides["sse_keyframe_every"] = take_int(arg)
         elif arg == "--state":
             overrides["state_path"] = take(arg)
+        elif arg == "--trace-ring":
+            # Span-ring capacity for the always-on data-plane tracer
+            # (/api/trace, docs/observability.md); 0 disables.
+            overrides["trace_ring"] = take_int(arg)
         elif arg == "--chaos":
             # Fault injection (tpumon.collectors.chaos): e.g.
             # --chaos hang:accel:0.1,err:k8s:0.3,slow:host:200
@@ -279,7 +289,11 @@ def main(argv: list[str] | None = None) -> int:
                 "[--peers host:port,...] [--peer-fanout N] "
                 "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
+                "[--trace-ring N] "
                 "[--chaos mode:source:param,...]\n"
+                "       python -m tpumon trace [--url HOST:8888] "
+                "[--export trace.json] [--spans N]   (self-trace of a "
+                "running server)\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

@@ -143,6 +143,13 @@ class Config:
     breaker_failures: int = 3
     breaker_backoff_s: float = 5.0
     breaker_backoff_max_s: float = 300.0
+    # --- self-tracing (tpumon.tracing; docs/observability.md) ---
+    # Bounded span-ring capacity for the always-on data-plane tracer
+    # behind /api/trace, /api/trace/export and the
+    # tpumon_stage_duration_seconds histograms. 0 disables tracing
+    # entirely (the bench's overhead baseline).
+    trace_ring: int = 4096
+
     # Chaos fault injection ("mode:source:param,..." —
     # tpumon.collectors.chaos; "" = no faults). Example:
     # "hang:accel:0.1,err:k8s:0.3,slow:host:200".
@@ -248,6 +255,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "breaker_failures": int,
     "breaker_backoff_s": float,
     "breaker_backoff_max_s": float,
+    "trace_ring": int,
     "chaos": str,
     "chaos_seed": int,
     "history_snapshot_path": str,

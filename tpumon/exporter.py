@@ -256,6 +256,7 @@ def _render_self(sampler: Sampler) -> str:
         "Polls suppressed by an open circuit breaker",
     )
     lat = w.gauge("tpumon_sample_latency_p50_ms", "Collection latency p50 (ms)")
+    lat95 = w.gauge("tpumon_sample_latency_p95_ms", "Collection latency p95 (ms)")
     ok = w.gauge("tpumon_source_up", "Source healthy (1=ok)")
     for name, st in sorted(sampler.stats.items()):
         labels = {"source": name}
@@ -263,9 +264,10 @@ def _render_self(sampler: Sampler) -> str:
         failures.add(labels, st.failures)
         deadline.add(labels, st.deadline_exceeded)
         skipped.add(labels, st.skipped)
-        p50 = st.p50_ms()
-        if p50 is not None:
-            lat.add(labels, round(p50, 3))
+        q = st.latency_summary()  # p50/p95/max in one pass per render
+        if q is not None:
+            lat.add(labels, round(q[0], 3))
+            lat95.add(labels, round(q[1], 3))
         latest = sampler.latest.get(name)
         if latest is not None:
             ok.add(labels, 1.0 if latest.ok else 0.0)
@@ -312,6 +314,53 @@ def _render_self(sampler: Sampler) -> str:
     return w.render()
 
 
+def _render_trace(sampler: Sampler, profiler=None) -> str:
+    """Self-trace block (tpumon.tracing): genuine Prometheus histogram
+    triples — cumulative le-labelled ``_bucket`` + ``_sum`` + ``_count``
+    — per data-plane stage and per HTTP route, replacing gauge-only
+    latency reporting so ``histogram_quantile`` works against the
+    monitor itself. Plus span-ring accounting and the device profiler's
+    capture counters (ISSUE 3 satellites)."""
+    w = MetricsWriter()
+    tracer = getattr(sampler, "tracer", None)
+    if tracer is not None and tracer.enabled:
+        stage = w.histogram(
+            "tpumon_stage_duration_seconds",
+            "Data-plane stage duration (ticks, per-source collects, "
+            "alert eval, history record, SSE delta)",
+        )
+        for name, hist in sorted(tracer.stage_hist.items()):
+            stage.add_histogram(
+                {"stage": name}, hist.cumulative(), hist.count, hist.sum
+            )
+        http = w.histogram(
+            "tpumon_http_request_duration_seconds",
+            "HTTP request duration per route",
+        )
+        for route, hist in sorted(tracer.http_hist.items()):
+            http.add_histogram(
+                {"route": route}, hist.cumulative(), hist.count, hist.sum
+            )
+        g = w.counter("tpumon_trace_spans_total", "Spans recorded by the tracer")
+        g.add({}, tracer.recorded)
+        g = w.counter(
+            "tpumon_trace_spans_dropped_total",
+            "Spans overwritten by the bounded ring",
+        )
+        g.add({}, tracer.dropped)
+    if profiler is not None:
+        g = w.counter(
+            "tpumon_profile_captures_total",
+            "jax.profiler device-trace captures served via /api/profile",
+        )
+        g.add({}, profiler.captures)
+        g = w.gauge(
+            "tpumon_profile_busy", "A profile capture is in progress (1=busy)"
+        )
+        g.add({}, 1.0 if profiler.busy else 0.0)
+    return w.render() if w.families else ""
+
+
 # section name -> (dep sections, renderer). "samples" (a pseudo-section
 # bumped on every poll) keeps activity-derived blocks live even when
 # the data sections are static.
@@ -321,6 +370,7 @@ EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("pods", ("k8s",)),
     ("serving", ("serving",)),
     ("self", ("host", "accel", "k8s", "serving", "alerts", "samples")),
+    ("trace", ("samples",)),
 )
 
 _RENDERERS = {
@@ -332,13 +382,20 @@ _RENDERERS = {
 }
 
 
-def render_exporter(sampler: Sampler, cache: ExporterCache | None = None) -> str:
+def render_exporter(
+    sampler: Sampler, cache: ExporterCache | None = None, profiler=None
+) -> str:
     """Full exposition text. With ``cache`` (the server's persistent
     ExporterCache) only sections whose versions moved re-render; without
-    it every block renders fresh (tests, one-shot tools)."""
+    it every block renders fresh (tests, one-shot tools). ``profiler``
+    (the server's ProfilerService, when wired) adds the
+    tpumon_profile_* series to the trace block."""
     blocks: list[str] = []
     for name, deps in EXPORTER_SECTIONS:
-        fn = _RENDERERS[name]
+        if name == "trace":
+            fn = lambda s: _render_trace(s, profiler)  # noqa: E731
+        else:
+            fn = _RENDERERS[name]
         if cache is not None:
             text = cache.block(name, deps, lambda fn=fn: fn(sampler))
         else:

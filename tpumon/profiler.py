@@ -20,6 +20,7 @@ import asyncio
 import os
 import tempfile
 import time
+from collections import deque
 
 
 class ProfileBusy(Exception):
@@ -27,6 +28,8 @@ class ProfileBusy(Exception):
 
 
 class ProfilerService:
+    HISTORY = 5  # capture summaries kept (newest first in status())
+
     def __init__(self, base_dir: str | None = None, max_seconds: float = 30.0):
         self.base_dir = base_dir or os.path.join(
             tempfile.gettempdir(), "tpumon-profiles"
@@ -34,6 +37,15 @@ class ProfilerService:
         self.max_seconds = max_seconds
         self._busy = False
         self.last: dict | None = None  # last capture summary
+        # Bounded capture history + lifetime counter: observability for
+        # the observability tool (exported as tpumon_profile_captures_
+        # total / tpumon_profile_busy; history rides /api/trace).
+        self.history: deque = deque(maxlen=self.HISTORY)
+        self.captures = 0
+
+    @property
+    def busy(self) -> bool:
+        return self._busy
 
     def _capture_sync(self, seconds: float, log_dir: str) -> dict:
         import jax
@@ -82,6 +94,8 @@ class ProfilerService:
         finally:
             self._busy = False
         self.last = result
+        self.history.appendleft(result)
+        self.captures += 1
         return result
 
     def status(self) -> dict:
@@ -89,5 +103,7 @@ class ProfilerService:
             "busy": self._busy,
             "base_dir": self.base_dir,
             "max_seconds": self.max_seconds,
+            "captures": self.captures,
             "last": self.last,
+            "history": list(self.history),
         }

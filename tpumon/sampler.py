@@ -17,7 +17,6 @@ behind the driver's scrape→render p50 metric.
 from __future__ import annotations
 
 import asyncio
-import statistics
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -29,6 +28,7 @@ from tpumon.history import RingHistory
 from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
 from tpumon.snapshot import EpochClock
 from tpumon.topology import ChipSample, slice_views
+from tpumon.tracing import SpanTracer, quantiles
 
 
 @dataclass
@@ -51,17 +51,27 @@ class SourceStats:
             if s.error and s.error.startswith(DEADLINE_ERROR):
                 self.deadline_exceeded += 1
 
+    def latency_summary(self) -> tuple[float, float, float] | None:
+        """(p50, p95, max) over the window, computed in ONE sorted pass
+        — callers render all three per tick, so sorting the 512-entry
+        deque once replaces three statistics.median-style walks."""
+        return quantiles(self.latencies_ms)
+
     def p50_ms(self) -> float | None:
-        return statistics.median(self.latencies_ms) if self.latencies_ms else None
+        q = self.latency_summary()
+        return q[0] if q else None
 
     def to_json(self) -> dict:
+        q = self.latency_summary()
         return {
             "samples": self.samples,
             "failures": self.failures,
             "consecutive_failures": self.consecutive_failures,
             "deadline_exceeded": self.deadline_exceeded,
             "skipped": self.skipped,
-            "latency_p50_ms": round(self.p50_ms() or 0.0, 3),
+            "latency_p50_ms": round(q[0], 3) if q else 0.0,
+            "latency_p95_ms": round(q[1], 3) if q else 0.0,
+            "latency_max_ms": round(q[2], 3) if q else 0.0,
         }
 
 
@@ -92,6 +102,12 @@ class Sampler:
 
         self.latest: dict[str, Sample] = {}
         self.stats: dict[str, SourceStats] = {}
+        # Always-on span tracer (tpumon.tracing): a bounded ring of
+        # data-plane spans — ticks, per-collector collects, alert/
+        # history stages — behind /api/trace, /api/trace/export and the
+        # tpumon_stage_duration_seconds histograms. trace_ring=0
+        # disables (spans become shared no-ops).
+        self.tracer = SpanTracer(cfg.trace_ring)
         # Per-source circuit breakers (tpumon.resilience): a repeatedly-
         # failing source is probed on a backoff cadence instead of paying
         # a full deadline's worth of tick budget every interval.
@@ -214,17 +230,34 @@ class Sampler:
         if c is None:
             return None
         br = self._breaker_for(c.name)
-        if br is not None and not br.allow():
-            # Open breaker mid-backoff: skip the poll entirely. The last
-            # degraded Sample stays published (its ts shows staleness);
-            # the skip is counted so /api/health shows the reduced rate.
-            self.stats.setdefault(c.name, SourceStats()).skipped += 1
-            return None
-        s = await run_collector(
-            c, deadline_s=self._deadline_for(c.name), orphans=self._orphans
-        )
-        if br is not None:
-            br.record(s.ok)
+        # The collect span brackets exactly what collect_bounded does —
+        # the collection attempt plus breaker accounting — tagged with
+        # the outcome (ok / error / deadline / skipped) and the breaker
+        # state, so a trace answers "which source ate the tick".
+        with self.tracer.span(f"collect.{c.name}", cat="collect") as sp:
+            if br is not None and not br.allow():
+                # Open breaker mid-backoff: skip the poll entirely. The
+                # last degraded Sample stays published (its ts shows
+                # staleness); the skip is counted so /api/health shows
+                # the reduced rate.
+                self.stats.setdefault(c.name, SourceStats()).skipped += 1
+                sp.tag(outcome="skipped", breaker=br.state)
+                return None
+            s = await run_collector(
+                c, deadline_s=self._deadline_for(c.name), orphans=self._orphans
+            )
+            if br is not None:
+                br.record(s.ok)
+            outcome = "ok"
+            if not s.ok:
+                outcome = (
+                    "deadline"
+                    if s.error and s.error.startswith(DEADLINE_ERROR)
+                    else "error"
+                )
+            sp.tag(ok=s.ok, outcome=outcome)
+            if br is not None:
+                sp.tag(breaker=br.state)
         prev = self.latest.get(s.source)
         self.latest[s.source] = s
         self.stats.setdefault(s.source, SourceStats()).record(s)
@@ -460,21 +493,29 @@ class Sampler:
         with the accel source to ever pay that back.
         """
         ts = time.time()
-        await self._run(self.host)
-        await self._run(self.accel)
-        self._update_ici_rates(self.chips(), ts)
-        self._record_history(ts)
-        self._evaluate_alerts()
+        tr = self.tracer
+        with tr.span("tick_fast", cat="tick"):
+            await self._run(self.host)
+            await self._run(self.accel)
+            self._update_ici_rates(self.chips(), ts)
+            with tr.span("history"):
+                self._record_history(ts)
+            with tr.span("alerts"):
+                self._evaluate_alerts()
         # Broadcast tick completion (rotate-then-set: every waiter on
         # the old event wakes; new waiters queue on the fresh one).
+        # Outside the tick span: waiters run after the span closed, so
+        # the SSE payload they build sees this tick's summary.
         fired, self._tick_fired = self._tick_fired, asyncio.Event()
         fired.set()
 
     async def tick_pods(self) -> None:
-        await self._run(self.k8s)
+        with self.tracer.span("tick_pods", cat="tick"):
+            await self._run(self.k8s)
 
     async def tick_serving(self) -> None:
-        await self._run(self.serving)
+        with self.tracer.span("tick_serving", cat="tick"):
+            await self._run(self.serving)
 
     async def tick_all(self) -> None:
         await self.tick_pods()

@@ -24,12 +24,19 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/serving          JetStream/MaxText panels
   /api/topology         slice views
   /api/health           per-source health + self stats
+  /api/accel/wire       compact columnar chip snapshot — the federation
+                        wire format peers fetch (tpumon.topology)
   /api/stream           Server-Sent Events: realtime snapshot pushed on
                         every sampler tick (the dashboard upgrades from
                         5s polling to ~1s push when available)
   /api/profile          GET ?seconds=N: capture a jax.profiler device
                         trace of this process (SURVEY §5.1); without
                         ?seconds returns capture status
+  /api/trace            self-trace: recent data-plane spans + per-stage
+                        p50/p95/max summary (tpumon.tracing,
+                        docs/observability.md)
+  /api/trace/export     the span ring as Chrome trace-event JSON —
+                        loadable in Perfetto / chrome://tracing
   /metrics              in-tree Prometheus exporter
 
 The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
@@ -49,7 +56,6 @@ import asyncio
 import hmac
 import json
 import os
-import statistics
 import time
 import urllib.parse
 from collections import deque
@@ -59,14 +65,20 @@ from tpumon.config import Config, parse_duration
 from tpumon.deltas import diff
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
+from tpumon.profiler import ProfileBusy, ProfilerService
 from tpumon.sampler import Sampler
 from tpumon.snapshot import ExporterCache, RenderCache
 from tpumon.topology import attribute_pods, chips_to_wire
+from tpumon.tracing import quantiles
 
 WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 
 # Sections the realtime push payload reads — the SSE frame epoch is the
 # version over these, so a frame is only "new" when one of them moved.
+# With tracing enabled the server adds "samples" (bumped on every poll)
+# so the per-tick trace timeline the payload carries refreshes even
+# when no data section moved; with tracing off the payload has no
+# per-tick content, so unchanged data must keep producing heartbeats.
 RT_SECTIONS = ("host", "accel", "k8s", "alerts")
 
 
@@ -128,7 +140,10 @@ class MonitorServer:
             os.path.join(WEB_DIR, "dashboard.js"),
             "application/javascript; charset=utf-8",
         )
-        self._profiler = None  # built lazily; jax may be absent
+        # Eager: construction is cheap (no jax import) and /api/trace +
+        # the tpumon_profile_* metrics read its status before any
+        # capture has been requested.
+        self._profiler = ProfilerService()
         # Epoch-keyed render caches (tpumon.snapshot): requests between
         # sampler ticks are served pre-serialized bytes; the version
         # doubles as a strong ETag for 304s. The exporter cache reuses
@@ -151,7 +166,22 @@ class MonitorServer:
                 ("accel",),
                 lambda: {"slices": [v.to_json() for v in self.sampler.slices()]},
             ),
+            # Self-trace summary: the span data changes with collection
+            # activity, so "samples" (bumped every poll) is the honest
+            # version — between ticks every request reuses the render.
+            "/api/trace": (("samples",), self._api_trace),
         }
+        # SSE epoch sections (see RT_SECTIONS): the trace strip rides
+        # the payload only when tracing is on, and only then may the
+        # frame epoch advance with collection activity alone.
+        self._rt_sections = RT_SECTIONS + (
+            ("samples",) if sampler.tracer.enabled else ()
+        )
+        # Known-route set for http-span tagging: error statuses on
+        # unregistered paths must share one histogram key, or a URL
+        # scanner (404s; 401s when auth is on) could grow the per-route
+        # label set to its cap and pin junk there forever.
+        self._route_set = frozenset(self.routes())
         # Shared SSE frame state: the payload/patch for the current
         # epoch is computed ONCE per tick no matter how many stream
         # clients are attached (each gets the same bytes).
@@ -257,6 +287,15 @@ class MonitorServer:
         work of /api/accel/metrics at 256 chips."""
         return chips_to_wire(self.sampler.chips())
 
+    def _api_trace(self) -> dict:
+        """Self-trace view: ring stats, per-stage p50/p95/max, per-route
+        HTTP latency summary, the last tick's stage breakdown, recent
+        spans — plus the device profiler's status (the latest
+        jax.profiler capture is the trace's deep-dive link)."""
+        out = self.sampler.tracer.to_json()
+        out["profile"] = self._profiler.status()
+        return out
+
     def realtime_payload(self) -> dict:
         """The push payload: everything the dashboard's fast loop needs."""
         return {
@@ -267,6 +306,9 @@ class MonitorServer:
                 for sev, items in self.sampler.engine.last.items()
                 if isinstance(items, list)
             },
+            # Last tick's stage timeline (tpumon.tracing) — the
+            # dashboard's self-trace strip; None when tracing is off.
+            "trace": self.sampler.tracer.last_tick,
         }
 
     # ------------------------------ SSE stream -----------------------------
@@ -280,12 +322,16 @@ class MonitorServer:
         the client count, is the unit of serialization work.
         """
         st = self._sse
-        ver = self.sampler.clock.version_of(*RT_SECTIONS)
+        tr = self.sampler.tracer
+        ver = self.sampler.clock.version_of(*self._rt_sections)
         if st["ver"] != ver:
-            st["prev_ver"], st["prev_payload"] = st["ver"], st["payload"]
-            st["ver"], st["payload"] = ver, self.realtime_payload()
-            st["key_bytes"] = None
-            st["patch_bytes"] = None
+            # Per-tick shared work: build the payload once for every
+            # connected client ("sse" span — the fan-out's unit cost).
+            with tr.span("sse", track="sse"):
+                st["prev_ver"], st["prev_payload"] = st["ver"], st["payload"]
+                st["ver"], st["payload"] = ver, self.realtime_payload()
+                st["key_bytes"] = None
+                st["patch_bytes"] = None
         if client_ver == ver and not force_key:
             # Nothing new since this client's last frame: heartbeat.
             return (
@@ -295,16 +341,18 @@ class MonitorServer:
             )
         if not force_key and client_ver == st["prev_ver"] and st["prev_payload"] is not None:
             if st["patch_bytes"] is None:
-                patch = diff(st["prev_payload"], st["payload"])
-                st["patch_bytes"] = json.dumps(
-                    {"epoch": ver, "prev": st["prev_ver"], "patch": patch}
-                ).encode()
+                with tr.span("delta", track="sse"):
+                    patch = diff(st["prev_payload"], st["payload"])
+                    st["patch_bytes"] = json.dumps(
+                        {"epoch": ver, "prev": st["prev_ver"], "patch": patch}
+                    ).encode()
             return st["patch_bytes"], ver, False
         # New client, gap, or scheduled keyframe: full snapshot.
         if st["key_bytes"] is None:
-            st["key_bytes"] = json.dumps(
-                {"epoch": ver, "key": st["payload"]}
-            ).encode()
+            with tr.span("sse", track="sse"):
+                st["key_bytes"] = json.dumps(
+                    {"epoch": ver, "key": st["payload"]}
+                ).encode()
         return st["key_bytes"], ver, True
 
     async def _stream(self, writer: asyncio.StreamWriter) -> None:
@@ -345,23 +393,25 @@ class MonitorServer:
             await self.sampler.wait_tick(timeout_s=max(2 * interval, 2.0))
 
     def _api_health(self) -> dict:
-        lat = list(self.request_latencies_ms)
-        per_path = {
-            path: {
-                "requests": len(d),
-                "latency_p50_ms": round(statistics.median(d), 3),
-            }
-            for path, d in sorted(self.per_path_latencies_ms.items())
-            if d
-        }
+        q_all = quantiles(self.request_latencies_ms)
+        per_path = {}
+        for path, d in sorted(self.per_path_latencies_ms.items()):
+            q = quantiles(d)
+            if q is not None:
+                per_path[path] = {
+                    "requests": len(d),
+                    "latency_p50_ms": round(q[0], 3),
+                    "latency_p95_ms": round(q[1], 3),
+                }
         return {
             **self.sampler.health_json(),
             # Active fault-injection spec (tpumon.collectors.chaos) — a
             # soak run must be unmistakable as such in every health view.
             **({"chaos": self.cfg.chaos} if self.cfg.chaos else {}),
             "http": {
-                "requests": len(lat),
-                "latency_p50_ms": round(statistics.median(lat), 3) if lat else None,
+                "requests": len(self.request_latencies_ms),
+                "latency_p50_ms": round(q_all[0], 3) if q_all else None,
+                "latency_p95_ms": round(q_all[1], 3) if q_all else None,
                 "per_path": per_path,
             },
             # Fast-path health: how much render work the epoch caches
@@ -371,14 +421,10 @@ class MonitorServer:
         }
 
     async def _api_profile(self, query: str) -> dict:
-        from tpumon.profiler import ProfileBusy, ProfilerService
-
         try:
             import jax  # noqa: F401 — capture needs it; fail before starting
         except ImportError:
             raise HttpError(503, "profiling requires jax")
-        if self._profiler is None:
-            self._profiler = ProfilerService()
         params = parse_query(query)
         if "seconds" not in params:
             return self._profiler.status()
@@ -458,11 +504,36 @@ class MonitorServer:
         and a client presenting the current ETag gets an empty 304.
         ``evictable`` marks request-derived keys (history windows) that
         live under the cache's bounded-eviction cap.
+
+        The returned headers carry a private ``X-Tpumon-Cache`` entry
+        (hit/miss for THIS request — derived synchronously around the
+        cache call, so concurrent requests can't cross-attribute) that
+        ``handle_ex`` pops into the http span before responding.
         """
+        renders0 = self.cache.renders
         body, etag = self.cache.get(key, sections, build, evictable=evictable)
+        outcome = "miss" if self.cache.renders > renders0 else "hit"
         if if_none_match is not None and if_none_match == etag:
-            return 304, ctype, b"", {"ETag": etag}
-        return 200, ctype, body, {"ETag": etag}
+            return 304, ctype, b"", {"ETag": etag, "X-Tpumon-Cache": outcome}
+        return 200, ctype, body, {"ETag": etag, "X-Tpumon-Cache": outcome}
+
+    def routes(self) -> tuple[str, ...]:
+        """Every route this server answers — the registry the
+        route-table lint (tests/test_routes_doc.py) checks against the
+        README and this module's docstring, so a new endpoint cannot
+        ship undocumented."""
+        return tuple(
+            sorted(
+                set(self._cached_routes)
+                | {
+                    "/", "/monitor.html", "/index.html", "/dashboard",
+                    "/logo.svg", "/chartcore.js", "/dashboard.js",
+                    "/metrics", "/api/health", "/api/history",
+                    "/api/profile", "/api/stream", "/api/trace/export",
+                    "/api/silence", "/api/unsilence",
+                }
+            )
+        )
 
     async def handle_ex(
         self,
@@ -474,7 +545,56 @@ class MonitorServer:
         if_none_match: str | None = None,
     ) -> tuple[int, str, bytes, dict]:
         """Route a request; returns (status, content_type, body,
-        extra response headers)."""
+        extra response headers). Every request is bracketed by an
+        "http" span tagged with route/status/bytes and whether the
+        epoch render cache absorbed it."""
+        tr = self.sampler.tracer
+        with tr.span("http", cat="http", track="http") as sp:
+            try:
+                status, ctype, rbody, headers = await self._route(
+                    method, path, query, body, auth, if_none_match
+                )
+            except HttpError as e:
+                # Errors on unregistered paths share one histogram key
+                # (this includes pre-routing 401s when auth is on): a
+                # URL scanner must not grow the per-route table.
+                sp.tag(
+                    route=path if path in self._route_set else "(unmatched)",
+                    method=method,
+                    status=e.status,
+                )
+                raise
+            except Exception:
+                # Handler bug: _client turns this into a 500. The span
+                # must still carry route/status or the request would
+                # hide under "(other)" in the very histograms meant to
+                # diagnose it (the span's own error tag records the
+                # exception type).
+                sp.tag(
+                    route=path if path in self._route_set else "(unmatched)",
+                    method=method,
+                    status=500,
+                )
+                raise
+            # Cache attribution comes from THIS request's _etagged call
+            # (a private header popped before the response goes out) —
+            # diffing the global hit/render counters would misattribute
+            # under concurrent requests suspended mid-route.
+            cache_state = headers.pop("X-Tpumon-Cache", None)
+            sp.tag(route=path, method=method, status=status, bytes=len(rbody))
+            if cache_state:
+                sp.tag(cache=cache_state)
+        return status, ctype, rbody, headers
+
+    async def _route(
+        self,
+        method: str,
+        path: str,
+        query: str,
+        body: bytes,
+        auth: str | None,
+        if_none_match: str | None,
+    ) -> tuple[int, str, bytes, dict]:
         if method == "POST":
             self._check_auth(auth)
             return (*self._handle_post(path, body), {})
@@ -490,7 +610,11 @@ class MonitorServer:
             return self._etagged(
                 "/metrics",
                 ("host", "accel", "k8s", "serving", "alerts", "samples"),
-                lambda: render_exporter(self.sampler, cache=self.exporter_cache),
+                lambda: render_exporter(
+                    self.sampler,
+                    cache=self.exporter_cache,
+                    profiler=self._profiler,
+                ),
                 if_none_match,
                 ctype="text/plain; version=0.0.4; charset=utf-8",
             )
@@ -540,6 +664,11 @@ class MonitorServer:
             payload = await self.history.snapshot(window_s=window_s)
         elif path == "/api/health":
             payload = self._api_health()
+        elif path == "/api/trace/export":
+            # Perfetto/chrome://tracing-loadable dump of the span ring.
+            # Not cached: the export is a debugging artifact fetched
+            # rarely, and its value is being exactly current.
+            payload = self.sampler.tracer.export_chrome()
         elif path == "/api/profile":
             self._check_auth(auth)  # capture burns device time; gate it
             payload = await self._api_profile(query)

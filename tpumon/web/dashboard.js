@@ -244,6 +244,60 @@ function makeDashboard(doc, net, env, mkSurface) {
     if (hit) openChipModal(hit.chip.chip);
   }
 
+  /* ----------------------------- self-trace ---------------------------- */
+  /* Per-tick stage timeline (tpumon/tracing.py last_tick, delivered in
+     the SSE realtime payload): one proportional segment per stage —
+     collect.host, collect.accel, history, alerts — so "where did this
+     tick's milliseconds go" is answered at a glance. Stage colors are
+     assigned by first-seen order and stay stable across ticks. */
+  const traceColors = ["#3b82f6", "#36d399", "#fbbf24", "#a78bfa",
+                       "#22d3ee", "#f472b6", "#fb923c", "#f87171"];
+  const traceColorByStage = {};
+  let traceColorsUsed = 0;
+  function traceColor(name) {
+    if (traceColorByStage[name] === undefined) {
+      traceColorByStage[name] = traceColors[traceColorsUsed % traceColors.length];
+      traceColorsUsed += 1;
+    }
+    return traceColorByStage[name];
+  }
+
+  function renderTrace(tr) {
+    const card = $("trace-card");
+    const stages = tr?.stages || [];
+    if (!stages.length) { card.style.display = "none"; return; }
+    card.style.display = "";
+    $("trace-tag").textContent = `tick ${(tr.total_ms ?? 0).toFixed(1)} ms`;
+    const strip = $("trace-strip");
+    const legend = $("trace-legend");
+    strip.replaceChildren();
+    legend.replaceChildren();
+    let total = 0;
+    for (const s of stages) total += s.ms;
+    for (const s of stages) {
+      const seg = doc.mk("i");
+      seg.style.width = (total > 0 ? (100 * s.ms / total) : 0) + "%";
+      seg.style.background = traceColor(s.name);
+      seg.title = `${s.name} · ${s.ms.toFixed(2)} ms`;
+      strip.appendChild(seg);
+      const lab = doc.mk("span");
+      const dot = doc.mk("i");
+      dot.style.background = traceColor(s.name);
+      const txt = doc.mk("span");
+      txt.textContent = `${s.name} ${s.ms.toFixed(2)} ms`;
+      lab.append(dot, txt);
+      legend.appendChild(lab);
+    }
+  }
+
+  /* Polling fallback for the strip: when the SSE stream is down the
+     rest of the page refreshes via fetch loops — the trace card must
+     not freeze on the last streamed tick. /api/trace rides the epoch
+     render cache server-side, so this poll is cached bytes. */
+  function fetchTrace() {
+    net.getJson("/api/trace", t => { if (t) renderTrace(t.last_tick); });
+  }
+
   /* ------------------------------ realtime ---------------------------- */
   function fetchRealtime() {
     net.getJson("/api/host/metrics", host => {
@@ -266,6 +320,7 @@ function makeDashboard(doc, net, env, mkSurface) {
     if (!streamData) return;
     applyHost(streamData.host);
     renderChips(streamData.accel);
+    renderTrace(streamData.trace);
     const al = streamData.alerts;
     if (al) {
       $("n-minor").textContent = al.minor ?? 0;
@@ -612,7 +667,7 @@ function makeDashboard(doc, net, env, mkSurface) {
 
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
-    fetchAlerts(); fetchServing(); fetchHealth();
+    fetchAlerts(); fetchServing(); fetchHealth(); fetchTrace();
     updateTime();
   }
 
@@ -621,8 +676,10 @@ function makeDashboard(doc, net, env, mkSurface) {
     fetchRealtime: fetchRealtime, fetchHistory: fetchHistory,
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
     fetchServing: fetchServing, fetchHealth: fetchHealth,
+    fetchTrace: fetchTrace,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
+    renderTrace: renderTrace,
     openModal: openModal, closeModal: closeModal,
     openChipModal: openChipModal, closeChipModal: closeChipModal,
     topoTipAt: topoTipAt, topoClickAt: topoClickAt,
