@@ -29,6 +29,8 @@ BENCH_r{N}.json (VERDICT round-1 item #2):
   serving_*            in-tree engine end-to-end tokens/s
   fastpath_* / sse_*   epoch-cached render + delta-SSE wire costs at 64
                        and 256 fake chips (docs/perf.md)
+  events_* / anomaly_* journal append p50 and EWMA-detector tick
+                       overhead at v5p-64 (docs/events.md)
   federation_*         merged scrape→render p50 + exporter render time
                        for a simulated 8-host × 8-chip (64-chip) fleet
                        and a 4-peer × v5p-64 (256-chip) fleet
@@ -746,6 +748,69 @@ async def _bench_observability(
     }
 
 
+async def _bench_events(
+    topology: str = "v5p-64", iters: int = 60, warmup: int = 5
+) -> dict:
+    """Event journal + anomaly overhead (docs/events.md): raw journal
+    append p50 (µs — the record() hot path every subsystem calls), and
+    the EWMA detector bank's per-tick cost at a production chip count,
+    measured as tick p50 with anomaly_detect on vs off. Like tracing,
+    the detector is always-on by default, so its cost is a number of
+    record (target <1%)."""
+    from tpumon.events import EventJournal
+
+    # Journal append microbench: alternating kinds/severities so the
+    # counts dict sees its steady-state shape, attrs present like a
+    # real breaker/anomaly event.
+    journal = EventJournal(4096)
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        journal.record(
+            "breaker" if i % 2 else "anomaly",
+            "minor" if i % 3 else "serious",
+            "bench", "synthetic event", state="open", z=3.2,
+        )
+    append_us = (time.perf_counter() - t0) / n * 1e6
+    assert journal.dropped == n - journal.capacity
+
+    # Detector overhead: A/B interleaved min-of-rounds, same harness
+    # discipline as the observability phase (the two configs differ
+    # ONLY in TPUMON_ANOMALY_DETECT). Three rounds: the effect being
+    # measured is ~1% of a ~5 ms tick, well under box-load drift.
+    measured: dict[str, float] = {}
+    for _round in range(3):
+        for label, flag in (("on", "1"), ("off", "0")):
+            sampler, server, fetch = await _serve_bench_app(
+                f"fake:{topology}", TPUMON_ANOMALY_DETECT=flag
+            )
+            try:
+                tick_ms: list[float] = []
+                for i in range(warmup + iters):
+                    t0 = time.perf_counter()
+                    await sampler.tick_fast()
+                    if i >= warmup:
+                        tick_ms.append((time.perf_counter() - t0) * 1e3)
+                if label == "on":
+                    assert sampler.anomaly is not None
+                else:
+                    assert sampler.anomaly is None
+            finally:
+                await server.stop()
+            p = _p50(tick_ms)
+            measured[label] = min(measured.get(label, p), p)
+
+    on, off = measured["on"], measured["off"]
+    return {
+        "events_append_p50_us": round(append_us, 3),
+        "anomaly_on_tick_p50_ms": round(on, 3),
+        "anomaly_off_tick_p50_ms": round(off, 3),
+        "anomaly_overhead_tick_pct": (
+            round(100.0 * (on - off) / off, 2) if off > 0 else None
+        ),
+    }
+
+
 async def _bench_federation(
     n_peers: int = 8, peer_topology: str = "v5e-8",
     key_prefix: str = "federation", iters: int = 40, warmup: int = 5,
@@ -854,6 +919,9 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                             "trace_off_scrape_to_render_p50_ms",
                             "trace_overhead_scrape_pct",
                             "trace_spans_recorded")),
+    "events": (300, ("events_append_p50_us",
+                     "anomaly_on_tick_p50_ms", "anomaly_off_tick_p50_ms",
+                     "anomaly_overhead_tick_pct")),
     "federation": (240, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
                          "federation_exporter_render_ms",
@@ -917,6 +985,8 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "sse_keyframe_bytes_256", "sse_delta_bytes_256",
     # observability (self-trace overhead at v5p-64, docs/observability.md)
     "trace_overhead_tick_pct", "trace_overhead_scrape_pct",
+    # events (journal append + EWMA detector overhead, docs/events.md)
+    "events_append_p50_us", "anomaly_overhead_tick_pct",
     # federation
     "federation_chips", "federation_scrape_to_render_p50_ms",
     "federation_256_scrape_to_render_p50_ms",
@@ -972,6 +1042,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(both())
     if name == "observability":
         return asyncio.run(_bench_observability())
+    if name == "events":
+        return asyncio.run(_bench_events())
     if name == "federation":
         async def both_scales():
             # 64 chips (8×v5e-8, the BENCH_r05-comparable shape) and

@@ -1,0 +1,203 @@
+"""EWMA anomaly detection (ISSUE 4): detector fire/clear with
+hysteresis on synthetic drift — one fired/cleared pair per incident,
+no flapping — plus the journal events, the minor ``anomaly.<series>``
+alert through the engine, and the sampler integration (anomaly/events
+stage spans, config switch, exporter gauge)."""
+
+import asyncio
+import random
+
+from tests.test_server_api import serve
+from tpumon.anomaly import AnomalyBank, AnomalyConfig, EwmaDetector
+from tpumon.events import EventJournal
+
+# ------------------------------------------------------------- detector
+
+
+def drive(det, values, start=0):
+    out = []
+    for i, v in enumerate(values):
+        tr = det.update(v, start + i)
+        if tr:
+            out.append(tr)
+    return out
+
+
+class TestEwmaDetector:
+    def test_no_verdict_during_warmup(self):
+        det = EwmaDetector("hbm")
+        # A wild swing inside the warmup window must not fire.
+        assert drive(det, [50.0] * 10 + [500.0] * 10) == []
+
+    def test_hbm_ramp_fires_then_clears_without_flapping(self):
+        """The acceptance scenario: baseline, ramp, plateau. Exactly one
+        fired, exactly one cleared, nothing else — the plateau becomes
+        the new normal."""
+        det = EwmaDetector("hbm")
+        trs = drive(det, [50.0] * 40)  # settle baseline
+        ramp = [50.0 + 4.0 * k for k in range(1, 11)]  # 54 → 90
+        trs += drive(det, ramp, start=40)
+        trs += drive(det, [90.0] * 300, start=50)
+        assert trs == ["fired", "cleared"]
+        assert det.state == "normal"
+        assert abs(det.mean - 90.0) < 1.0  # converged to the new level
+
+    def test_refires_on_second_excursion(self):
+        det = EwmaDetector("hbm")
+        trs = drive(det, [50.0] * 40)
+        trs += drive(det, [90.0] * 200, start=40)
+        trs += drive(det, [140.0] * 200, start=240)
+        assert trs == ["fired", "cleared", "fired", "cleared"]
+
+    def test_single_spike_rejected_by_fire_hold(self):
+        det = EwmaDetector("tick_ms")
+        values = [5.0] * 60
+        values[45] = 500.0  # one GC pause
+        assert drive(det, values) == []
+        assert det.state == "normal"
+
+    def test_noisy_shift_fires_once(self):
+        rnd = random.Random(3)
+        det = EwmaDetector("duty")
+        drive(det, [50.0 + rnd.uniform(-1, 1) for _ in range(40)])
+        trs = drive(det, [58.0 + rnd.uniform(-4, 4) for _ in range(260)], 40)
+        assert trs.count("fired") == 1
+        assert trs.count("cleared") == 1
+
+    def test_min_sigma_floor_guards_flat_series(self):
+        # A near-constant series with numeric dust must not fire.
+        det = EwmaDetector("duty")
+        assert drive(det, [70.0 + 1e-9 * (i % 3) for i in range(200)]) == []
+
+    def test_to_json_shape(self):
+        det = EwmaDetector("hbm")
+        drive(det, [50.0] * 5)
+        j = det.to_json()
+        assert {"state", "n", "mean", "sigma", "z"} <= set(j)
+
+
+# ----------------------------------------------------------------- bank
+
+
+class TestAnomalyBank:
+    def test_journal_events_on_fire_and_clear(self):
+        journal = EventJournal()
+        bank = AnomalyBank(journal)
+        for i in range(40):
+            bank.observe({"hbm": 50.0}, ts=float(i))
+        for i in range(40, 340):
+            bank.observe({"hbm": 90.0}, ts=float(i))
+        evs = [e for e in journal.events() if e["kind"] == "anomaly"]
+        assert [e["severity"] for e in evs] == ["minor", "info"]
+        assert evs[0]["series"] == "hbm"
+        assert "drifting" in evs[0]["msg"]
+        assert {"z", "value", "mean"} <= set(evs[0])
+
+    def test_active_lists_fired_series_while_anomalous(self):
+        bank = AnomalyBank()
+        for i in range(40):
+            bank.observe({"hbm": 50.0, "duty": 60.0}, ts=float(i))
+        for i in range(40, 46):
+            bank.observe({"hbm": 95.0, "duty": 60.0}, ts=float(i))
+        active = bank.active()
+        assert [a["series"] for a in active] == ["hbm"]
+        assert active[0]["z"] != 0
+        assert bank.to_json()["hbm"]["state"] == "anomalous"
+
+    def test_none_values_skipped(self):
+        bank = AnomalyBank()
+        bank.observe({"hbm": None, "duty": 50.0})
+        assert set(bank.detectors) == {"duty"}
+
+
+# --------------------------------------------------------- engine rule
+
+
+class TestAnomalyAlertRule:
+    def test_minor_alert_fires_and_resolves_with_detector(self):
+        from tpumon.alerts import AlertEngine
+
+        e = AlertEngine()
+        anomaly = {"series": "hbm", "z": 5.2, "value": 91.0, "mean": 50.0}
+        out = e.evaluate(anomalies=[anomaly], now=1000.0)
+        assert [a["key"] for a in out["minor"]] == ["anomaly.hbm"]
+        assert "z=5.2" in out["minor"][0]["desc"]
+        out = e.evaluate(anomalies=None, now=1001.0)
+        assert out["minor"] == []
+        states = [ev["state"] for ev in e.events]
+        assert states == ["fired", "resolved"]
+
+
+# ------------------------------------------------------- sampler wiring
+
+
+class TestSamplerIntegration:
+    def test_anomaly_and_events_stages_traced(self):
+        sampler, server = serve()
+        loop = asyncio.new_event_loop()
+        try:
+            for _ in range(3):
+                loop.run_until_complete(sampler.tick_fast())
+            stages = set(sampler.tracer.stage_hist)
+            assert {"anomaly", "events"} <= stages
+            # The detectors saw this tick's fleet series.
+            assert {"duty", "hbm"} <= set(sampler.anomaly.detectors)
+        finally:
+            loop.close()
+
+    def test_anomaly_detect_off_disables_cleanly(self):
+        sampler, server = serve({"TPUMON_ANOMALY_DETECT": "0"})
+        loop = asyncio.new_event_loop()
+        try:
+            for _ in range(3):
+                loop.run_until_complete(sampler.tick_fast())
+            assert sampler.anomaly is None
+            assert "anomaly" not in sampler.tracer.stage_hist
+            # /api/health omits the anomaly block entirely.
+            assert "anomaly" not in sampler.health_json()
+        finally:
+            loop.close()
+
+    def test_exporter_gauge_per_series(self):
+        import json
+
+        sampler, server = serve()
+        loop = asyncio.new_event_loop()
+        try:
+            for _ in range(3):
+                loop.run_until_complete(sampler.tick_fast())
+            # A detector forced anomalous shows as 1 in /metrics.
+            det = sampler.anomaly.detectors["hbm"]
+            det.state = "anomalous"
+            sampler.journal.record("anomaly", "minor", "hbm", "forced")
+            loop.run_until_complete(sampler.tick_fast())
+            _, _, body, _ = loop.run_until_complete(
+                server.handle_ex("GET", "/metrics")
+            )
+            text = body.decode()
+            assert 'tpumon_anomaly_active{series="hbm"} 1' in text
+            assert 'tpumon_anomaly_active{series="duty"} 0' in text
+            _, _, body, _ = loop.run_until_complete(
+                server.handle_ex("GET", "/api/health")
+            )
+            assert json.loads(body)["anomaly"]["hbm"]["state"] == "anomalous"
+        finally:
+            loop.close()
+
+    def test_config_keys(self):
+        from tpumon.config import load_config
+
+        cfg = load_config(
+            env={
+                "TPUMON_ANOMALY_Z_FIRE": "6",
+                "TPUMON_ANOMALY_WARMUP": "10",
+                "TPUMON_EVENTS_RING": "128",
+                "TPUMON_EVENTS_PATH": "/tmp/ev.jsonl",
+                "TPUMON_EVENTS_INTERVAL_S": "5",
+            }
+        )
+        assert cfg.anomaly_z_fire == 6.0
+        assert cfg.anomaly_warmup == 10
+        assert cfg.events_ring == 128
+        assert cfg.events_path == "/tmp/ev.jsonl"
+        assert cfg.events_interval_s == 5.0

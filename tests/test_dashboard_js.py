@@ -46,6 +46,7 @@ GET_ENDPOINTS = [
     ("/api/serving", ""),
     ("/api/health", ""),
     ("/api/trace", ""),
+    ("/api/events", "limit=20"),
 ]
 
 
@@ -450,6 +451,69 @@ def test_stream_frame_renders_trace_strip(js, payloads):
                                  "critical": 0.0}}}
     assert d["onStreamFrame"](frame2) == "ok"
     assert doc.el("trace-card")["style"]["display"] == "none"
+
+
+def test_stream_frame_renders_event_feed_with_filter(js, payloads):
+    """The journal tail (tpumon/events.py) rides the SSE payload as
+    {seq, recent}: feed rows render newest-first with severity classes,
+    the filter narrows client-side, a delta that grows the journal
+    re-renders, and a payload without events hides the card."""
+    d, doc, net, env, surf = mkdash(js, {})
+    events = {"seq": 7.0, "recent": [
+        {"seq": 7.0, "ts": 1000.0, "kind": "breaker", "severity": "serious",
+         "source": "accel", "msg": "breaker closed → open"},
+        {"seq": 6.0, "ts": 999.0, "kind": "chaos", "severity": "minor",
+         "source": "accel", "msg": "injected collect error"},
+        {"seq": 5.0, "ts": 998.0, "kind": "config", "severity": "info",
+         "source": "sampler", "msg": "monitor configured"},
+    ]}
+    frame = {"epoch": 1.0,
+             "key": {"host": payloads["/api/host/metrics"],
+                     "accel": payloads["/api/accel/metrics"],
+                     "alerts": {"minor": 0.0, "serious": 0.0, "critical": 0.0},
+                     "events": tojs(events)}}
+    assert d["onStreamFrame"](frame) == "ok"
+    assert doc.el("events-card")["style"]["display"] == ""
+    assert doc.el("events-tag")["textContent"] == "seq 7"
+    rows = doc.el("events-feed")["_children"]
+    assert len(rows) == 3
+    assert "sev-serious" in rows[0]["className"]
+    text = all_text(rows[0])
+    assert "breaker" in text and "accel · breaker closed → open" in text
+    # Severity filter narrows client-side (no refetch).
+    d["setEventFilter"]("serious")
+    rows = doc.el("events-feed")["_children"]
+    assert len(rows) == 1 and "breaker" in all_text(rows[0])
+    d["setEventFilter"]("critical")
+    rows = doc.el("events-feed")["_children"]
+    assert "no recent critical events" in all_text(rows[0])
+    d["setEventFilter"]("all")
+    assert len(doc.el("events-feed")["_children"]) == 3
+    # A payload with no events hides the card.
+    frame2 = {"epoch": 2.0,
+              "key": {"host": payloads["/api/host/metrics"],
+                      "accel": payloads["/api/accel/metrics"],
+                      "alerts": {"minor": 0.0, "serious": 0.0,
+                                 "critical": 0.0}}}
+    assert d["onStreamFrame"](frame2) == "ok"
+    assert doc.el("events-card")["style"]["display"] == "none"
+
+
+def test_fetch_events_polling_fallback_renders_feed(js):
+    """/api/events pages ascending; the feed shows newest first."""
+    d, doc, net, env, surf = mkdash(js, {
+        "/api/events": {"seq": 2, "events": [
+            {"seq": 1, "ts": 1.0, "kind": "server", "severity": "info",
+             "source": "server", "msg": "listening"},
+            {"seq": 2, "ts": 2.0, "kind": "alert", "severity": "critical",
+             "source": "alerts", "msg": "CPU critical fired"},
+        ]},
+    })
+    d["fetchEvents"]()
+    rows = doc.el("events-feed")["_children"]
+    assert len(rows) == 2
+    assert "CPU critical fired" in all_text(rows[0])  # newest first
+    assert "listening" in all_text(rows[1])
 
 
 # ---------------------------------------------------------------- history

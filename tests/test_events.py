@@ -1,0 +1,477 @@
+"""Structured event journal (ISSUE 4 tentpole): ring bound + lifetime
+counters, cursor pagination stable across a JSONL restore, crash-safe
+persistence, the /api/events contract (filters, 400s, render-cache
+ETags), the delta-SSE event feed, exporter counters, and the
+acceptance replay: breaker open/close + injected chaos + alert
+fired/resolved from a chaos run, in seq order, surviving a restart."""
+
+import asyncio
+import json
+
+import pytest
+
+from tests.test_server_api import serve
+from tpumon.events import (
+    KINDS,
+    SEVERITIES,
+    EventJournal,
+    EventLog,
+    render_event_line,
+)
+
+# ------------------------------------------------------------- unit layer
+
+
+class TestJournal:
+    def test_ring_bounded_with_lifetime_counts(self):
+        j = EventJournal(16)
+        for i in range(100):
+            j.record("chaos", "minor", "s", f"e{i}")
+        assert len(j.events()) == 16
+        assert j.recorded == 100
+        assert j.dropped == 84
+        assert j.seq == 100
+        # Lifetime counters survive overwrite (the Prometheus family).
+        assert j.counts[("chaos", "minor")] == 100
+        # The ring holds the NEWEST events.
+        assert [e["seq"] for e in j.events()] == list(range(85, 101))
+
+    def test_capacity_clamps_up(self):
+        assert EventJournal(0).capacity == EventJournal.MIN_CAPACITY
+        assert EventJournal(-5).capacity == EventJournal.MIN_CAPACITY
+
+    def test_unknown_kind_and_severity_raise(self):
+        j = EventJournal()
+        with pytest.raises(ValueError):
+            j.record("nonsense", "minor", "s", "m")
+        with pytest.raises(ValueError):
+            j.record("chaos", "loud", "s", "m")
+
+    def test_attrs_ride_flat_and_none_dropped(self):
+        j = EventJournal()
+        ev = j.record("breaker", "serious", "accel", "opened",
+                      state="open", retry=None)
+        assert ev["state"] == "open"
+        assert "retry" not in ev
+        assert {"seq", "ts", "kind", "severity", "source", "msg"} <= set(ev)
+
+    def test_query_filters(self):
+        j = EventJournal()
+        j.record("chaos", "minor", "a", "m1", ts=100.0)
+        j.record("breaker", "serious", "b", "m2", ts=200.0)
+        j.record("breaker", "info", "b", "m3", ts=300.0)
+        assert [e["msg"] for e in j.query(kind="breaker")] == ["m2", "m3"]
+        assert [e["msg"] for e in j.query(severity="serious")] == ["m2"]
+        assert [e["msg"] for e in j.query(since=150.0)] == ["m2", "m3"]
+        assert [e["msg"] for e in j.query(kind="breaker", severity="info")] == ["m3"]
+
+    def test_cursor_pagination_is_stable_and_complete(self):
+        j = EventJournal()
+        for i in range(30):
+            j.record("chaos", "minor", "s", f"e{i}")
+        # Without a cursor: the tail (what a human asks for first).
+        tail = j.query(limit=10)
+        assert [e["seq"] for e in tail] == list(range(21, 31))
+        # Forward pagination from 0 covers everything exactly once.
+        seen, cursor = [], 0
+        while True:
+            page = j.query(after=cursor, limit=7)
+            if not page:
+                break
+            seen.extend(e["seq"] for e in page)
+            cursor = page[-1]["seq"]
+        assert seen == list(range(1, 31))
+
+    def test_after_walks_only_new_events(self):
+        j = EventJournal()
+        for i in range(5):
+            j.record("alert", "minor", "alerts", f"a{i}", state="fired")
+        j.record("chaos", "minor", "s", "noise")
+        new = j.after(3, kind="alert")
+        assert [e["seq"] for e in new] == [4, 5]
+
+    def test_recent_newest_first_with_kind_filter(self):
+        j = EventJournal()
+        j.record("chaos", "minor", "s", "c1")
+        j.record("alert", "serious", "alerts", "a1", state="fired")
+        j.record("chaos", "minor", "s", "c2")
+        assert [e["msg"] for e in j.recent(5)] == ["c2", "a1", "c1"]
+        assert [e["msg"] for e in j.recent(5, kind="alert")] == ["a1"]
+
+    def test_ingest_dedups_orders_and_advances_seq(self):
+        j = EventJournal()
+        j.record("chaos", "minor", "s", "live")  # seq 1
+        added = j.ingest(
+            [
+                {"seq": 3, "ts": 3.0, "kind": "breaker", "severity": "info",
+                 "source": "b", "msg": "late"},
+                {"seq": 1, "ts": 1.0, "kind": "chaos", "severity": "minor",
+                 "source": "s", "msg": "dupe"},  # seq collision: skipped
+                {"seq": 2, "ts": 2.0, "kind": "alert", "severity": "minor",
+                 "source": "alerts", "msg": "mid", "state": "fired"},
+                "garbage",
+                {"no": "seq"},
+            ]
+        )
+        assert added == 2
+        assert [e["seq"] for e in j.events()] == [1, 2, 3]
+        assert j.events()[0]["msg"] == "live"  # the dupe did not replace it
+        assert j.seq == 3
+        j.record("chaos", "minor", "s", "next")
+        assert j.seq == 4
+
+    def test_ingest_accepts_legacy_alert_timeline_shape(self):
+        # Pre-journal alert events (state snapshots) have no kind/source.
+        j = EventJournal()
+        j.ingest([{"seq": 1, "ts": 1.0, "severity": "critical",
+                   "state": "fired", "title": "T", "key": "k"}])
+        ev = j.events()[0]
+        assert ev["kind"] == "alert" and ev["source"] == "alerts"
+        assert ev["title"] == "T"
+
+    def test_render_event_line(self):
+        line = render_event_line(
+            {"ts": 0, "severity": "serious", "kind": "breaker",
+             "source": "accel", "msg": "breaker closed → open"}
+        )
+        assert "breaker" in line and "accel" in line and "→ open" in line
+
+
+# ---------------------------------------------------------- persistence
+
+
+class TestEventLog:
+    def _journal(self, n=10):
+        j = EventJournal()
+        for i in range(n):
+            j.record("chaos" if i % 2 else "breaker", "minor", "s", f"e{i}")
+        return j
+
+    def test_jsonl_round_trip_preserves_seqs_and_cursors(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        a = self._journal(10)
+        page_before = a.query(after=4, limit=3)
+        assert EventLog(a, path).save()
+        # JSONL shape: meta header + one JSON object per line.
+        lines = open(path).read().splitlines()
+        assert json.loads(lines[0])["_journal"] == 1
+        assert len(lines) == 11
+        assert json.loads(lines[1])["seq"] == 1
+
+        b = EventJournal()
+        assert EventLog(b, path).restore()
+        assert [e["seq"] for e in b.events()] == [e["seq"] for e in a.events()]
+        # A cursor handed out before the restart pages identically.
+        assert b.query(after=4, limit=3) == page_before
+        # New events continue the seq space.
+        assert b.record("config", "info", "s", "post-restore")["seq"] == 11
+
+    def test_corrupt_and_missing_files_degrade(self, tmp_path):
+        j = EventJournal()
+        assert not EventLog(j, str(tmp_path / "missing.jsonl")).restore()
+        p = tmp_path / "corrupt.jsonl"
+        p.write_text("{nope")
+        assert not EventLog(j, str(p)).restore()
+        p.write_text(json.dumps({"_journal": 99}) + "\n")
+        assert not EventLog(j, str(p)).restore()
+        assert j.events() == []
+
+    def test_torn_tail_line_skipped(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        a = self._journal(3)
+        EventLog(a, path).save()
+        with open(path, "a") as f:
+            f.write('{"seq": 4, "ts":')  # torn write
+        b = EventJournal()
+        assert EventLog(b, path).restore()
+        assert [e["seq"] for e in b.events()] == [1, 2, 3]
+
+    def test_seq_high_water_mark_survives(self, tmp_path):
+        # Even if the newest events were dropped by the ring, restored
+        # cursors are never re-issued for different events.
+        path = str(tmp_path / "events.jsonl")
+        a = EventJournal(16)
+        for i in range(40):
+            a.record("chaos", "minor", "s", f"e{i}")
+        EventLog(a, path).save()
+        b = EventJournal(16)
+        assert EventLog(b, path).restore()
+        assert b.seq == 40
+        assert b.record("config", "info", "s", "next")["seq"] == 41
+
+
+# ------------------------------------------------------- live data plane
+
+
+CHAOS_ENV = {
+    "TPUMON_CHAOS": "err:accel:1.0",
+    "TPUMON_CHAOS_SEED": "7",
+    "TPUMON_BREAKER_FAILURES": "2",
+    "TPUMON_BREAKER_BACKOFF_S": "0.05",
+    "TPUMON_ANOMALY_DETECT": "0",
+    "TPUMON_COLLECTORS": "host,accel",
+}
+
+
+def _app(env=None):
+    sampler, server = serve(env)
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(sampler.tick_all())
+    return loop, sampler, server
+
+
+def _get(app, path, query="", inm=None):
+    loop, _, server = app
+    return loop.run_until_complete(
+        server.handle_ex("GET", path, query=query, if_none_match=inm)
+    )
+
+
+class TestEventsApi:
+    @pytest.fixture()
+    def app(self):
+        loop, sampler, server = _app()
+        yield loop, sampler, server
+        loop.close()
+
+    def test_contract_and_render_cache(self, app):
+        loop, sampler, server = app
+        sampler.journal.record("config", "info", "t", "hello")
+        sampler.mark_events_dirty()
+        status, _, body, h1 = _get(app, "/api/events")
+        assert status == 200
+        d = json.loads(body)
+        assert {"events", "cursor", "seq", "recorded", "dropped", "capacity"} <= set(d)
+        assert d["cursor"] == d["events"][-1]["seq"]
+        # Between journal changes every request reuses the render + 304s.
+        _, _, body2, h2 = _get(app, "/api/events")
+        assert body2 is body and h1["ETag"] == h2["ETag"]
+        status, _, b304, _ = _get(app, "/api/events", inm=h1["ETag"])
+        assert status == 304 and b304 == b""
+        # A new event invalidates (once published).
+        sampler.journal.record("config", "info", "t", "again")
+        sampler.mark_events_dirty()
+        status, _, _, h3 = _get(app, "/api/events", inm=h1["ETag"])
+        assert status == 200 and h3["ETag"] != h1["ETag"]
+
+    def test_filters_and_cursor_over_http(self, app):
+        loop, sampler, server = app
+        for i in range(5):
+            sampler.journal.record("watchdog", "minor", "fast", f"lag{i}")
+        sampler.journal.record("breaker", "serious", "accel", "opened")
+        sampler.mark_events_dirty()
+        _, _, body, _ = _get(app, "/api/events", query="kind=watchdog&limit=2")
+        d = json.loads(body)
+        assert [e["kind"] for e in d["events"]] == ["watchdog"] * 2
+        _, _, body, _ = _get(app, "/api/events", query="severity=serious")
+        assert all(e["severity"] == "serious" for e in json.loads(body)["events"])
+        # Cursor pages forward.
+        _, _, body, _ = _get(app, "/api/events", query=f"after={d['cursor']}&limit=100")
+        d2 = json.loads(body)
+        assert all(e["seq"] > d["cursor"] for e in d2["events"])
+
+    def test_bad_params_400(self, app):
+        from tpumon.server import HttpError
+
+        loop, _, server = app
+        for query in ("kind=bogus", "severity=loud", "after=x", "since=nope"):
+            with pytest.raises(HttpError) as e:
+                loop.run_until_complete(
+                    server.handle_ex("GET", "/api/events", query=query)
+                )
+            assert e.value.status == 400
+
+    def test_since_duration_and_timestamp(self, app):
+        loop, sampler, server = app
+        sampler.journal.record("config", "info", "t", "old", ts=100.0)
+        sampler.journal.record("config", "info", "t", "new")
+        sampler.mark_events_dirty()
+        _, _, body, _ = _get(app, "/api/events", query="since=1h")
+        msgs = [e["msg"] for e in json.loads(body)["events"]]
+        assert "new" in msgs and "old" not in msgs
+        _, _, body, _ = _get(app, "/api/events", query="since=50")
+        assert "old" in [e["msg"] for e in json.loads(body)["events"]]
+
+    def test_silence_post_is_a_journal_event_and_bumps_section(self, app):
+        loop, sampler, server = app
+        _, _, _, h1 = _get(app, "/api/events")
+        loop.run_until_complete(
+            server.handle_ex(
+                "POST", "/api/silence",
+                body=json.dumps({"key": "host.", "duration": "1h"}).encode(),
+            )
+        )
+        status, _, body, h2 = _get(app, "/api/events", query="kind=silence")
+        assert h2["ETag"] != h1["ETag"]
+        ev = json.loads(body)["events"][-1]
+        assert ev["kind"] == "silence" and ev["key"] == "host."
+        # And the alert timeline stays fired/resolved-only.
+        _, _, body, _ = _get(app, "/api/alerts")
+        assert all(
+            e.get("state") in ("fired", "resolved")
+            for e in json.loads(body)["events"]
+        )
+
+    def test_sse_payload_carries_feed_and_deltas_move(self, app):
+        loop, sampler, server = app
+        payload = server.realtime_payload()
+        assert "events" in payload and "recent" in payload["events"]
+        frame, ver, _ = server._sse_frame(-1, True)
+        # A journal event alone (no data change) must produce a delta,
+        # not a heartbeat: the feed is live over the stream.
+        sampler.journal.record("breaker", "serious", "accel", "opened")
+        loop.run_until_complete(sampler.tick_fast())
+        frame2, ver2, was_key = server._sse_frame(ver, False)
+        assert not was_key and ver2 > ver
+        d = json.loads(frame2)
+        assert d["patch"] is not None
+
+    def test_exporter_emits_event_counters(self, app):
+        loop, sampler, server = app
+        sampler.journal.record("breaker", "serious", "accel", "opened")
+        sampler.mark_events_dirty()
+        _, _, body, _ = _get(app, "/metrics")
+        text = body.decode()
+        assert 'tpumon_events_total{kind="breaker",severity="serious"}' in text
+        assert "tpumon_events_dropped_total" in text
+
+    def test_health_reports_journal_stats(self, app):
+        _, _, body, _ = _get(app, "/api/health")
+        h = json.loads(body)
+        assert {"seq", "recorded", "dropped", "capacity"} <= set(h["events"])
+
+
+# ------------------------------------------ acceptance: chaos replay
+
+
+class TestChaosReplayAndRestart:
+    def _drive_incident(self, loop, sampler):
+        """Ticks until the accel breaker opened and the source-down
+        alert fired (chaos err:accel:1.0, breaker_failures=2)."""
+        for _ in range(8):
+            loop.run_until_complete(sampler.tick_all())
+        assert sampler.breakers["accel"].state != "closed"
+
+    def test_api_events_replays_breaker_chaos_and_alerts_in_order(self, tmp_path):
+        loop, sampler, server = _app(CHAOS_ENV)
+        try:
+            self._drive_incident(loop, sampler)
+            status, _, body, _ = _get(
+                (loop, sampler, server), "/api/events", query="limit=1000"
+            )
+            events = json.loads(body)["events"]
+            kinds = {e["kind"] for e in events}
+            assert {"chaos", "breaker", "alert"} <= kinds
+            # Strictly ordered by seq (the replay contract).
+            seqs = [e["seq"] for e in events]
+            assert seqs == sorted(seqs)
+            # The breaker open and the source-down fire are both there.
+            assert any(
+                e["kind"] == "breaker" and e.get("state") == "open"
+                for e in events
+            )
+            assert any(
+                e["kind"] == "alert"
+                and e.get("state") == "fired"
+                and e.get("key") == "source.accel.down"
+                for e in events
+            )
+
+            # ---- restart: JSONL restore brings the record back ----
+            path = str(tmp_path / "events.jsonl")
+            assert EventLog(sampler.journal, path).save()
+            loop2, sampler2, server2 = _app(CHAOS_ENV)
+            try:
+                log2 = EventLog(sampler2.journal, path)
+                assert log2.restore()
+                sampler2.mark_events_dirty()
+                _, _, body2, _ = _get(
+                    (loop2, sampler2, server2), "/api/events", query="limit=1000"
+                )
+                replayed = json.loads(body2)["events"]
+                restored_seqs = {e["seq"] for e in replayed}
+                assert {e["seq"] for e in events} <= restored_seqs
+            finally:
+                loop2.close()
+        finally:
+            loop.close()
+
+    def test_ring_bound_holds_under_event_storm(self):
+        # breaker_failures=0 disables breaking: every tick injects, so
+        # the journal takes one chaos event per tick — a genuine storm.
+        loop, sampler, server = _app(
+            {**CHAOS_ENV, "TPUMON_EVENTS_RING": "32",
+             "TPUMON_BREAKER_FAILURES": "0"}
+        )
+        try:
+            for _ in range(40):
+                loop.run_until_complete(sampler.tick_all())
+            j = sampler.journal
+            assert j.capacity == 32
+            assert len(j.events()) <= 32
+            assert j.recorded > 32
+            assert j.dropped == j.recorded - len(j.events())
+            # The served page is the newest window, still ordered.
+            _, _, body, _ = _get((loop, sampler, server), "/api/events")
+            seqs = [e["seq"] for e in json.loads(body)["events"]]
+            assert seqs == sorted(seqs)
+        finally:
+            loop.close()
+
+    def test_state_snapshot_restore_does_not_duplicate_journal(self, tmp_path):
+        """events_path restores first, then the state snapshot's alert
+        timeline merges by seq — no incident appears twice."""
+        from tpumon.state import restore_state, snapshot_state
+
+        loop, sampler, server = _app(CHAOS_ENV)
+        try:
+            self._drive_incident(loop, sampler)
+            path = str(tmp_path / "events.jsonl")
+            EventLog(sampler.journal, path).save()
+            state = snapshot_state(sampler)
+
+            loop2, sampler2, server2 = _app(CHAOS_ENV)
+            try:
+                assert EventLog(sampler2.journal, path).restore()
+                n_after_journal = len(sampler2.journal.events())
+                assert restore_state(sampler2, state)
+                alert_seqs = [e["seq"] for e in sampler2.engine.events]
+                assert len(alert_seqs) == len(set(alert_seqs))
+                # State restore added nothing the journal already held.
+                assert len(sampler2.journal.events()) == n_after_journal
+            finally:
+                loop2.close()
+        finally:
+            loop.close()
+
+
+# ------------------------------------------------------- engine timeline
+
+
+class TestAlertTimelineIsJournalView:
+    def test_engine_events_share_the_journal_record(self):
+        from tpumon.alerts import AlertEngine
+
+        j = EventJournal()
+        e = AlertEngine(journal=j)
+        e.evaluate(host={"cpu": {"percent": 97.0}}, now=1000.0)
+        e.evaluate(host={"cpu": {"percent": 5.0}}, now=1001.0)
+        # One record, two views: the engine's timeline is exactly the
+        # journal's alert-kind events.
+        assert [ev["state"] for ev in e.events] == ["fired", "resolved"]
+        assert e.events == [ev for ev in j.events() if ev["kind"] == "alert"]
+        assert e.events[0]["kind"] == "alert"
+        # recent_events (the /api/alerts view) is newest-first.
+        assert [ev["state"] for ev in e.recent_events()] == ["resolved", "fired"]
+
+    def test_bind_journal_migrates_private_timeline(self):
+        from tpumon.alerts import AlertEngine
+
+        e = AlertEngine()
+        e.evaluate(host={"cpu": {"percent": 97.0}}, now=1000.0)
+        shared = EventJournal()
+        e.bind_journal(shared)
+        assert [ev["state"] for ev in e.events] == ["fired"]
+        assert shared.seq >= 1
+        e.evaluate(host={"cpu": {"percent": 5.0}}, now=1001.0)
+        assert [ev["state"] for ev in e.events] == ["fired", "resolved"]

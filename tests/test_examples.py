@@ -41,11 +41,16 @@ def exported_families() -> set[str]:
         "tpumon_monitor_train_tokens_total",
         "tpumon_monitor_train_goodput_pct",
         "tpumon_monitor_train_mfu_pct",
+        # Event-journal families: published once the journal holds any
+        # event / a detector exists (tpumon/exporter.py _render_events).
+        "tpumon_events_total", "tpumon_anomaly_active",
     }
     src = open(os.path.join(EXAMPLES, "..", "tpumon", "exporter.py")).read()
     for extra in names:
         if extra.startswith("tpumon_serving") or extra.startswith(
-                "tpumon_monitor") or extra == "tpumon_pods_by_phase":
+                "tpumon_monitor") or extra in (
+                "tpumon_pods_by_phase", "tpumon_events_total",
+                "tpumon_anomaly_active"):
             assert extra in src, f"{extra} not found in exporter.py"
     # Families the serving ENGINE exports on its own /metrics (scraped
     # directly by Prometheus alongside the monitor).

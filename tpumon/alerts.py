@@ -21,11 +21,11 @@ Deliberate fixes over the reference:
 from __future__ import annotations
 
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
 
 from tpumon.config import Thresholds
+from tpumon.events import EventJournal
 from tpumon.topology import ChipSample, SliceView, attribute_pods
 
 SEVERITIES = ("minor", "serious", "critical")
@@ -60,7 +60,11 @@ _SEV_LABEL = {"minor": "notice", "serious": "high", "critical": "critical"}
 
 
 class AlertEngine:
-    def __init__(self, thresholds: Thresholds | None = None):
+    def __init__(
+        self,
+        thresholds: Thresholds | None = None,
+        journal: EventJournal | None = None,
+    ):
         self.t = thresholds or Thresholds()
         # Per-chip threshold rules built once per config — the per-tick
         # loop evaluates closures instead of re-constructing rule
@@ -72,13 +76,17 @@ class AlertEngine:
         self._last_pods: dict[str, dict] | None = None
         self._last_eval: dict[str, list[dict]] = _bucketize([])
         self._last_eval_ts: float | None = None
-        # Fired/resolved event timeline (the reference keeps no alert
-        # history at all — each poll overwrites the last). Bounded ring.
         self._active_keys: dict[str, dict] = {}
-        self.events: deque = deque(maxlen=500)
-        # Monotonic id per timeline event so consumers (webhook notifier,
-        # SSE clients) can track "what's new" across the bounded ring.
-        self._event_seq = 0
+        # Fired/resolved timeline (the reference keeps no alert history
+        # at all). The engine's old private deque is gone: timeline
+        # events now live in the shared structured journal
+        # (tpumon.events, kind="alert") — /api/alerts, the webhook
+        # notifier and /api/events all read the SAME record. A
+        # standalone engine (tests, tools) gets a private journal.
+        self.journal = journal if journal is not None else EventJournal(512)
+        # Seq of the last ALERT event this engine recorded — the alerts
+        # section fingerprint, insulated from other kinds' traffic.
+        self._timeline_seq = 0
         # Anti-flap hold bookkeeping (Thresholds.fire_hold_s /
         # resolve_hold_s): key -> ts the condition was first seen pending
         # fire / first seen clear pending resolve.
@@ -97,6 +105,68 @@ class AlertEngine:
         # active when its silence ends, a fresh "fired" event re-notifies
         # (Alertmanager re-notifies on silence expiry).
         self._suppressed_fires: set[str] = set()
+
+    # ---------------- timeline (journal-backed) --------------------------
+
+    def bind_journal(self, journal: EventJournal) -> None:
+        """Re-point the timeline at a shared journal (the sampler's),
+        migrating any events recorded against the private one — so an
+        engine built standalone then handed to a Sampler keeps one
+        consistent record. An empty target adopts the private seqs
+        verbatim; a non-empty one re-records (fresh seqs) so private
+        seq numbers can't collide-and-drop against events the shared
+        journal already holds."""
+        if journal is self.journal:
+            return
+        private = self.journal.events()
+        if journal.seq == 0:
+            journal.ingest(private)
+        else:
+            for e in private:
+                attrs = {
+                    k: v
+                    for k, v in e.items()
+                    if k not in ("seq", "ts", "kind", "severity", "source", "msg")
+                }
+                journal.record(
+                    e["kind"], e["severity"], e["source"], e["msg"],
+                    ts=e["ts"], **attrs,
+                )
+        self.journal = journal
+        self._timeline_seq = max(
+            (e["seq"] for e in self.events), default=self._timeline_seq
+        )
+
+    def _emit(self, state: str, alert: dict, now: float, **extra) -> dict:
+        """One timeline event (kind="alert") into the journal. Keeps the
+        legacy event shape (state/title/desc/fix/key ride flat) so the
+        notifier, dashboard timeline and state snapshots are unchanged."""
+        ev = self.journal.record(
+            "alert",
+            alert["severity"],
+            "alerts",
+            f"{alert['title']} {state}",
+            ts=now,
+            state=state,
+            title=alert["title"],
+            desc=alert["desc"],
+            fix=alert["fix"],
+            key=alert["key"],
+            **extra,
+        )
+        self._timeline_seq = ev["seq"]
+        return ev
+
+    @property
+    def events(self) -> list[dict]:
+        """The alert timeline: journal events of kind "alert", oldest
+        first — a filtered view, not separate storage."""
+        return [e for e in self.journal.events() if e.get("kind") == "alert"]
+
+    @property
+    def timeline_seq(self) -> int:
+        """Journal seq of the newest alert event (fingerprint input)."""
+        return self._timeline_seq
 
     # ---------------- host rules (monitor_server.js:162-175) -------------
 
@@ -531,6 +601,31 @@ class AlertEngine:
                     )
         return alerts
 
+    # ------------- anomaly rule (tpumon.anomaly EWMA detectors) -----------
+
+    def _anomaly_alerts(self, anomalies: list[dict] | None) -> list[Alert]:
+        """Early-warning drift rule: each currently-anomalous series
+        (EWMA z-score gate, tpumon.anomaly) is a minor alert — the
+        point is to page a human while the drift is still hours from a
+        hard threshold."""
+        alerts: list[Alert] = []
+        for a in anomalies or []:
+            series = a.get("series", "?")
+            alerts.append(
+                Alert(
+                    severity="minor",
+                    title=f"Anomalous drift in {series}",
+                    desc=f"{series} at {a.get('value', 0):.2f}, EWMA baseline "
+                    f"{a.get('mean', 0):.2f} (z={a.get('z', 0):.1f})",
+                    fix="A slow drift, not yet a threshold breach: check "
+                    "for HBM creep (leaking cache?), duty-cycle sag "
+                    "(input starvation?) or a degrading source before "
+                    "the hard threshold pages. Tuning: docs/events.md.",
+                    key=f"anomaly.{series}",
+                )
+            )
+        return alerts
+
     # ----------------------------------------------------------------------
 
     def evaluate(
@@ -541,6 +636,7 @@ class AlertEngine:
         pods: list[dict] | None = None,
         serving: list[dict] | None = None,
         sources: list[dict] | None = None,
+        anomalies: list[dict] | None = None,
         update_pod_state: bool = True,
         now: float | None = None,
     ) -> dict[str, list[dict]]:
@@ -548,6 +644,7 @@ class AlertEngine:
         alerts: list[Alert] = []
         alerts += self._host_alerts(host)
         alerts += self._source_alerts(sources)
+        alerts += self._anomaly_alerts(anomalies)
         # Attribution uses the freshest pod view available: this
         # evaluation's pods, else the last healthy scrape's baseline.
         owner_pods = (
@@ -572,10 +669,7 @@ class AlertEngine:
             first_seen = self._pending_fire.setdefault(key, now)
             if now - first_seen >= self.t.fire_hold_s:
                 self._active_keys[key] = a
-                self._event_seq += 1
-                self.events.append(
-                    {"seq": self._event_seq, "ts": now, "state": "fired", **a}
-                )
+                self._emit("fired", a, now)
                 if self.is_silenced(key, now):
                     self._suppressed_fires.add(key)
         for key in [
@@ -594,20 +688,16 @@ class AlertEngine:
             if now - first_clear >= self.t.resolve_hold_s:
                 a = self._active_keys.pop(key)
                 del self._pending_resolve[key]
-                self._event_seq += 1
                 # An incident whose fire was suppressed by a silence never
                 # paged — mark its resolution so delivery skips it too
                 # (a "resolved" for an unknown incident is pager noise).
                 suppressed = key in self._suppressed_fires
                 self._suppressed_fires.discard(key)
-                self.events.append(
-                    {
-                        "seq": self._event_seq,
-                        "ts": now,
-                        "state": "resolved",
-                        **{**a, "desc": ""},
-                        **({"suppressed": True} if suppressed else {}),
-                    }
+                self._emit(
+                    "resolved",
+                    {**a, "desc": ""},
+                    now,
+                    **({"suppressed": True} if suppressed else {}),
                 )
 
         # Served buckets are the *held* view: pending-fire alerts aren't
@@ -623,15 +713,7 @@ class AlertEngine:
                 self._suppressed_fires.discard(key)
             elif not self.is_silenced(key, now):
                 self._suppressed_fires.discard(key)
-                self._event_seq += 1
-                self.events.append(
-                    {
-                        "seq": self._event_seq,
-                        "ts": now,
-                        "state": "fired",
-                        **self._active_keys[key],
-                    }
-                )
+                self._emit("fired", self._active_keys[key], now)
         self._last_eval = {s: [] for s in SEVERITIES}
         silenced: list[dict] = []
         for a in self._active_keys.values():
@@ -651,10 +733,25 @@ class AlertEngine:
         now = time.time() if now is None else now
         until = now + max(0.0, duration_s)
         self.silences[key_prefix] = until
+        # A silence mutes the pager — which is exactly why the record
+        # must say who went quiet and until when (kind="silence", so the
+        # alert timeline view stays fired/resolved-only).
+        self.journal.record(
+            "silence", "info", "alerts",
+            f"silenced {key_prefix!r} for {max(0.0, duration_s):.0f}s",
+            ts=now, key=key_prefix, until=round(until, 3),
+        )
         return until
 
-    def unsilence(self, key_prefix: str) -> bool:
-        return self.silences.pop(key_prefix, None) is not None
+    def unsilence(self, key_prefix: str, now: float | None = None) -> bool:
+        existed = self.silences.pop(key_prefix, None) is not None
+        if existed:
+            self.journal.record(
+                "silence", "info", "alerts",
+                f"unsilenced {key_prefix!r}",
+                ts=now, key=key_prefix,
+            )
+        return existed
 
     def is_silenced(self, key: str, now: float | None = None) -> bool:
         now = time.time() if now is None else now
@@ -667,7 +764,7 @@ class AlertEngine:
         return self._last_silenced
 
     def recent_events(self, n: int = 50) -> list[dict]:
-        return list(self.events)[-n:][::-1]  # newest first
+        return self.journal.recent(n, kind="alert")  # newest first
 
     # ------------- checkpoint/resume (tpumon.state, SURVEY §5.4) ----------
 
@@ -679,7 +776,7 @@ class AlertEngine:
         return {
             "last_pods": self._last_pods,
             "active_keys": self._active_keys,
-            "events": list(self.events),
+            "events": self.events,
             "pending_fire": self._pending_fire,
             "pending_resolve": self._pending_resolve,
             "silences": self.silences,
@@ -690,9 +787,13 @@ class AlertEngine:
         last_pods = state.get("last_pods")
         self._last_pods = dict(last_pods) if last_pods is not None else None
         self._active_keys = dict(state.get("active_keys") or {})
-        self.events.extend(state.get("events") or [])
-        self._event_seq = max(
-            (e.get("seq", 0) for e in self.events), default=self._event_seq
+        # Timeline events merge into the journal (dedup by seq): when
+        # the journal's own JSONL restore already replayed them — it
+        # runs first in tpumon.app — this is a no-op, so a deployment
+        # with both state_path and events_path never double-records.
+        self.journal.ingest(state.get("events") or [])
+        self._timeline_seq = max(
+            (e.get("seq", 0) for e in self.events), default=self._timeline_seq
         )
         self._pending_fire = dict(state.get("pending_fire") or {})
         self._pending_resolve = dict(state.get("pending_resolve") or {})

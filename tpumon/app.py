@@ -89,6 +89,20 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
 
 async def run(cfg: Config) -> None:
     sampler, server = build(cfg)
+    journal = sampler.journal
+    # Event-journal persistence restores FIRST: the state snapshot's
+    # alert timeline then merges by seq into the already-replayed
+    # journal (dedup), so a deployment with both files never
+    # double-records an incident.
+    eventlog = None
+    events_restored = False
+    if cfg.events_path:
+        from tpumon.events import EventLog
+
+        eventlog = EventLog(journal, cfg.events_path, interval_s=cfg.events_interval_s)
+        events_restored = eventlog.restore()
+        if events_restored:
+            print(f"tpumon resumed events from {cfg.events_path}", flush=True)
     store = None
     state_restored = False
     if cfg.state_path:
@@ -98,6 +112,26 @@ async def run(cfg: Config) -> None:
         state_restored = store.restore_into(sampler)
         if state_restored:
             print(f"tpumon resumed state from {cfg.state_path}", flush=True)
+    # Restore bookkeeping only AFTER both restores: a fresh record
+    # between them would consume a seq the (fresher) state snapshot may
+    # have assigned to a real alert event, which ingest's dedup-by-seq
+    # would then silently drop. And events replayed from the JSONL were
+    # delivered (or deliberately not) in a previous life — without this,
+    # a journal-only restore would re-page the whole alert history
+    # (restore_state already marks for the state-snapshot path).
+    if events_restored:
+        journal.record(
+            "history", "info", "events",
+            f"restored event journal from {cfg.events_path}",
+            path=cfg.events_path,
+        )
+        sampler.mark_events_notified()
+    if state_restored:
+        journal.record(
+            "history", "info", "state",
+            f"restored monitor state from {cfg.state_path}",
+            path=cfg.state_path,
+        )
     snapshotter = None
     if cfg.history_snapshot_path:
         from tpumon.history import HistorySnapshotter
@@ -106,6 +140,7 @@ async def run(cfg: Config) -> None:
             sampler.history,
             cfg.history_snapshot_path,
             interval_s=cfg.history_snapshot_interval_s,
+            journal=journal,
         )
         # A full state restore already replayed history; restoring the
         # history-only snapshot on top would double every point.
@@ -115,13 +150,28 @@ async def run(cfg: Config) -> None:
                 flush=True,
             )
     if cfg.chaos:
+        journal.record(
+            "chaos", "info", "config", f"chaos injection active: {cfg.chaos}",
+            spec=cfg.chaos,
+        )
         print(f"tpumon CHAOS ACTIVE: {cfg.chaos}", flush=True)
+    journal.record(
+        "config", "info", "sampler",
+        f"monitor configured: collectors={','.join(cfg.collectors)} "
+        f"accel={cfg.accel_backend} interval={cfg.sample_interval_s:g}s",
+    )
     await sampler.start()
     if store is not None:
         await store.start(sampler)
     if snapshotter is not None:
         await snapshotter.start()
+    if eventlog is not None:
+        await eventlog.start()
     await server.start()
+    journal.record(
+        "server", "info", "server",
+        f"listening on http://{cfg.host}:{server.port}", port=server.port,
+    )
     print(
         f"tpumon listening on http://{cfg.host}:{server.port} "
         f"(collectors: {', '.join(cfg.collectors)}; "
@@ -136,12 +186,15 @@ async def run(cfg: Config) -> None:
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     print("tpumon shutting down...", flush=True)
+    journal.record("server", "info", "server", "shutting down")
     await server.stop()
     await sampler.stop()
     if store is not None:
         await store.stop(sampler)
     if snapshotter is not None:
         await snapshotter.stop()
+    if eventlog is not None:
+        await eventlog.stop()  # final save carries the shutdown event
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -152,6 +205,12 @@ def main(argv: list[str] | None = None) -> int:
         from tpumon.tracing import trace_cli
 
         return trace_cli(argv[1:])
+    if argv and argv[0] == "events":
+        # ``tpumon events`` — tail a running server's event journal
+        # (tpumon.events; docs/events.md); --follow rides the SSE stream.
+        from tpumon.events import events_cli
+
+        return events_cli(argv[1:])
     path = None
     overrides = {}
     serve_loadgen = False
@@ -269,6 +328,12 @@ def main(argv: list[str] | None = None) -> int:
             # Span-ring capacity for the always-on data-plane tracer
             # (/api/trace, docs/observability.md); 0 disables.
             overrides["trace_ring"] = take_int(arg)
+        elif arg == "--events-ring":
+            # Event-journal ring capacity (/api/events, docs/events.md).
+            overrides["events_ring"] = take_int(arg)
+        elif arg == "--events-log":
+            # Crash-safe JSONL persistence for the event journal.
+            overrides["events_path"] = take(arg)
         elif arg == "--chaos":
             # Fault injection (tpumon.collectors.chaos): e.g.
             # --chaos hang:accel:0.1,err:k8s:0.3,slow:host:200
@@ -290,10 +355,14 @@ def main(argv: list[str] | None = None) -> int:
                 "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
                 "[--trace-ring N] "
+                "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"
                 "       python -m tpumon trace [--url HOST:8888] "
                 "[--export trace.json] [--spans N]   (self-trace of a "
                 "running server)\n"
+                "       python -m tpumon events [--url HOST:8888] [-n N] "
+                "[--kind K] [--severity S] [--follow] [--json]   (event "
+                "journal tail)\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

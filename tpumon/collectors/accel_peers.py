@@ -78,6 +78,12 @@ class PeerFederatedCollector:
     # (Config.peer_fanout).
     fanout: int = 16
     last_peer_status: dict[str, str] = field(default_factory=dict)
+    # Event journal (tpumon.events), wired by the sampler: peer up/down
+    # and wire-fallback transitions become durable ``peer`` events.
+    journal: object = field(default=None, repr=False)
+
+    def set_journal(self, journal) -> None:
+        self.journal = journal
 
     def _state(self) -> dict:
         """Per-peer incremental-merge state, created lazily so tests
@@ -90,6 +96,10 @@ class PeerFederatedCollector:
                 "etags": {},
                 "chips": {},
                 "wire": {},
+                # journal-transition tracking: last ok/err per peer and
+                # which peers' wire-fallback has already been recorded
+                "ok": {},
+                "wire_logged": set(),
             }
         return st
 
@@ -133,6 +143,31 @@ class PeerFederatedCollector:
         st["chips"][url] = chips
         return chips
 
+    def _journal_peer(self, url: str, ok: bool, st: dict) -> None:
+        """Record peer up/down + wire-fallback TRANSITIONS (never the
+        steady state) — runs on the event loop after the fan-out, so
+        journal appends don't happen from fetch worker threads."""
+        if self.journal is None:
+            st["ok"][url] = ok
+            return
+        was = st["ok"].get(url)
+        if not ok and was is not False:
+            self.journal.record(
+                "peer", "serious", url,
+                f"peer down: {self.last_peer_status.get(url, 'unreachable')}"
+                + (" (its chips drop from the merged view)" if was else ""),
+            )
+        elif ok and was is False:
+            self.journal.record("peer", "info", url, "peer recovered")
+        st["ok"][url] = ok
+        if st["wire"].get(url) is False and url not in st["wire_logged"]:
+            st["wire_logged"].add(url)
+            self.journal.record(
+                "peer", "minor", url,
+                "pre-wire peer: fell back to /api/accel/metrics "
+                "(full-dict fetches from now on)",
+            )
+
     async def _peer_chips(self, url: str) -> tuple[str, list[ChipSample] | None]:
         try:
             return url, await asyncio.to_thread(self._fetch_peer, url)
@@ -166,10 +201,12 @@ class PeerFederatedCollector:
             if local_sample.error:
                 errors.append(f"local: {local_sample.error}")
         seen = {c.chip_id for c in chips}
+        st = self._state()
         # Assemble in configured peer order (stable chip ordering keeps
         # the SSE delta stream's positional list patches small).
         for url in self.peers:
             peer_chips = by_url.get(url)
+            self._journal_peer(url, peer_chips is not None, st)
             if peer_chips is None:
                 errors.append(f"peer {url}: {self.last_peer_status.get(url)}")
                 continue

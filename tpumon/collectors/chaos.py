@@ -108,6 +108,10 @@ class ChaosCollector:
     faults: list[Fault] = field(default_factory=list)
     seed: int | None = None
     rng: random.Random = field(default=None)  # injectable for tests
+    # Event journal (tpumon.events): injections are recorded so a chaos
+    # soak's /api/events replay shows WHAT was injected next to the
+    # degraded samples it caused. Wired by the sampler (set_journal).
+    journal: object = field(default=None, repr=False)
     # flap state: True while the toggle is in its erroring phase
     _flap_down: bool = field(default=False, repr=False)
 
@@ -118,6 +122,16 @@ class ChaosCollector:
     @property
     def name(self) -> str:
         return self.inner.name
+
+    def set_journal(self, journal) -> None:
+        self.journal = journal
+        inner_set = getattr(self.inner, "set_journal", None)
+        if inner_set is not None:  # chaos may wrap a peer federation
+            inner_set(journal)
+
+    def _note(self, msg: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.record("chaos", "minor", self.name, msg, **attrs)
 
     def set_faults(self, faults: list[Fault]) -> None:
         """Replace the active fault set (tests lift faults mid-soak)."""
@@ -134,14 +148,23 @@ class ChaosCollector:
         if f is not None:
             if self.rng.random() < f.param:
                 self._flap_down = not self._flap_down
+                # Journal only the TRANSITION: a flap held down for 30
+                # collects is one event, not 30.
+                self._note(
+                    f"flap toggled {'down' if self._flap_down else 'up'}",
+                    mode="flap",
+                )
             if self._flap_down:
                 raise ChaosError("injected flap error")
         f = self._fault("hang")
         if f is not None and self.rng.random() < f.param:
+            self._note("injected hang (collect will ride out its deadline)",
+                       mode="hang")
             await asyncio.sleep(HANG_S)
             raise ChaosError("injected hang expired")  # un-deadlined runs
         f = self._fault("err")
         if f is not None and self.rng.random() < f.param:
+            self._note("injected collect error", mode="err")
             raise ChaosError("injected error")
         f = self._fault("slow")
         if f is not None:
@@ -149,6 +172,7 @@ class ChaosCollector:
         s = await self.inner.collect()
         f = self._fault("corrupt")
         if f is not None and self.rng.random() < f.param:
+            self._note("injected payload corruption", mode="corrupt")
             s = Sample(
                 source=s.source,
                 ok=s.ok,

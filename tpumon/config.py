@@ -150,6 +150,29 @@ class Config:
     # entirely (the bench's overhead baseline).
     trace_ring: int = 4096
 
+    # --- structured event journal (tpumon.events; docs/events.md) ---
+    # Bounded ring of lifecycle events (alert fired/resolved, breaker
+    # transitions, chaos injections, anomaly fires, ...) behind
+    # /api/events, the SSE event feed and tpumon_events_total. Values
+    # below 16 clamp up — a ring too small for one alert lifecycle
+    # would break the timeline.
+    events_ring: int = 4096
+    # JSONL persistence path for the journal (crash-safe atomic
+    # rewrites on events_interval_s, restored at startup so cursors and
+    # the incident record survive restarts). None disables.
+    events_path: str | None = None
+    events_interval_s: float = 30.0
+
+    # --- EWMA anomaly detection (tpumon.anomaly; docs/events.md) ---
+    # Per-series drift detectors over fleet duty/HBM, tick duration and
+    # per-source scrape p95: z-score gate with hysteresis, emitting
+    # ``anomaly`` journal events and a minor ``anomaly.<series>`` alert.
+    anomaly_detect: bool = True
+    anomaly_alpha: float = 0.05
+    anomaly_z_fire: float = 4.0
+    anomaly_z_clear: float = 1.5
+    anomaly_warmup: int = 30
+
     # Chaos fault injection ("mode:source:param,..." —
     # tpumon.collectors.chaos; "" = no faults). Example:
     # "hang:accel:0.1,err:k8s:0.3,slow:host:200".
@@ -256,6 +279,14 @@ _SCALAR_FIELDS: dict[str, type] = {
     "breaker_backoff_s": float,
     "breaker_backoff_max_s": float,
     "trace_ring": int,
+    "events_ring": int,
+    "events_path": str,
+    "events_interval_s": float,
+    "anomaly_detect": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "anomaly_alpha": float,
+    "anomaly_z_fire": float,
+    "anomaly_z_clear": float,
+    "anomaly_warmup": int,
     "chaos": str,
     "chaos_seed": int,
     "history_snapshot_path": str,

@@ -361,6 +361,39 @@ def _render_trace(sampler: Sampler, profiler=None) -> str:
     return w.render() if w.families else ""
 
 
+def _render_events(sampler: Sampler) -> str:
+    """Event journal + anomaly detector block (tpumon.events /
+    tpumon.anomaly): lifetime per-(kind, severity) event counters —
+    ``increase(tpumon_events_total{severity="serious"}[5m])`` is the
+    Grafana annotations query — ring-overwrite accounting, and the
+    per-series anomaly state gauge."""
+    journal = getattr(sampler, "journal", None)
+    if journal is None:
+        return ""
+    w = MetricsWriter()
+    if journal.counts:
+        c = w.counter(
+            "tpumon_events_total",
+            "Structured journal events recorded, by kind and severity",
+        )
+        for (kind, sev), n in sorted(journal.counts.items()):
+            c.add({"kind": kind, "severity": sev}, n)
+        d = w.counter(
+            "tpumon_events_dropped_total",
+            "Journal events overwritten by the bounded ring",
+        )
+        d.add({}, journal.dropped)
+    bank = getattr(sampler, "anomaly", None)
+    if bank is not None and bank.detectors:
+        g = w.gauge(
+            "tpumon_anomaly_active",
+            "EWMA anomaly detector state per series (1=anomalous)",
+        )
+        for name, det in sorted(bank.detectors.items()):
+            g.add({"series": name}, 1.0 if det.state == "anomalous" else 0.0)
+    return w.render() if w.families else ""
+
+
 # section name -> (dep sections, renderer). "samples" (a pseudo-section
 # bumped on every poll) keeps activity-derived blocks live even when
 # the data sections are static.
@@ -371,6 +404,8 @@ EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("serving", ("serving",)),
     ("self", ("host", "accel", "k8s", "serving", "alerts", "samples")),
     ("trace", ("samples",)),
+    # Journal counters + anomaly gauges move only when the journal does.
+    ("events", ("events",)),
 )
 
 _RENDERERS = {
@@ -379,6 +414,7 @@ _RENDERERS = {
     "pods": _render_pods,
     "serving": _render_serving,
     "self": _render_self,
+    "events": _render_events,
 }
 
 

@@ -322,14 +322,16 @@ class RingHistory:
         }
 
 
-def atomic_write_json(path: str, obj: dict) -> None:
+def atomic_write_text(path: str, text: str) -> None:
     """tmp-in-same-dir + fsync + rename: a crash mid-write leaves the
-    previous file intact. Raises OSError on failure."""
+    previous file intact. Raises OSError on failure. Shared by the
+    JSON state snapshots here/tpumon.state and the JSONL event journal
+    (tpumon.events.EventLog)."""
     directory = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(prefix=".tpumon-hist.", suffix=".tmp", dir=directory)
     try:
         with os.fdopen(fd, "w") as f:
-            json.dump(obj, f, separators=(",", ":"))
+            f.write(text)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -337,6 +339,11 @@ def atomic_write_json(path: str, obj: dict) -> None:
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """Atomic JSON dump (see atomic_write_text)."""
+    atomic_write_text(path, json.dumps(obj, separators=(",", ":")))
 
 
 HISTORY_SNAPSHOT_VERSION = 1
@@ -350,10 +357,19 @@ class HistorySnapshotter:
     the history-only, always-cheap subset).
     """
 
-    def __init__(self, ring: RingHistory, path: str, interval_s: float = 30.0):
+    def __init__(
+        self,
+        ring: RingHistory,
+        path: str,
+        interval_s: float = 30.0,
+        journal=None,
+    ):
         self.ring = ring
         self.path = path
         self.interval_s = interval_s
+        # Optional event journal (tpumon.events): restore success and
+        # save-failure transitions are lifecycle moments worth keeping.
+        self.journal = journal
         self.last_save_ts: float | None = None
         self.last_error: str | None = None
         self._task: asyncio.Task | None = None
@@ -383,6 +399,13 @@ class HistorySnapshotter:
         try:
             atomic_write_json(self.path, state)
         except OSError as e:
+            # Journal only the TRANSITION into failure — a full disk
+            # must not generate one event per 30 s cadence forever.
+            if self.journal is not None and self.last_error is None:
+                self.journal.record(
+                    "history", "serious", "history",
+                    f"history snapshot write failing: {e}", path=self.path,
+                )
             self.last_error = str(e)
             return False
         self.last_save_ts = state["saved_at"]
@@ -417,6 +440,13 @@ class HistorySnapshotter:
         except (AttributeError, KeyError, TypeError, ValueError) as e:
             self.last_error = f"malformed snapshot: {e}"
             return False
+        if self.journal is not None:
+            self.journal.record(
+                "history", "info", "history",
+                f"restored {len(state.get('points') or {})} history series "
+                f"from {self.path}",
+                path=self.path,
+            )
         return True
 
     def to_json(self) -> dict:

@@ -30,11 +30,19 @@ class ProfileBusy(Exception):
 class ProfilerService:
     HISTORY = 5  # capture summaries kept (newest first in status())
 
-    def __init__(self, base_dir: str | None = None, max_seconds: float = 30.0):
+    def __init__(
+        self,
+        base_dir: str | None = None,
+        max_seconds: float = 30.0,
+        journal=None,
+    ):
         self.base_dir = base_dir or os.path.join(
             tempfile.gettempdir(), "tpumon-profiles"
         )
         self.max_seconds = max_seconds
+        # Optional event journal (tpumon.events): each capture is a
+        # lifecycle moment worth a durable record.
+        self.journal = journal
         self._busy = False
         self.last: dict | None = None  # last capture summary
         # Bounded capture history + lifetime counter: observability for
@@ -96,6 +104,13 @@ class ProfilerService:
         self.last = result
         self.history.appendleft(result)
         self.captures += 1
+        if self.journal is not None:
+            self.journal.record(
+                "profile", "info", "profiler",
+                f"captured {result['seconds']:.1f}s device trace "
+                f"({result['total_bytes']} bytes) -> {result['dir']}",
+                dir=result["dir"], bytes=result["total_bytes"],
+            )
         return result
 
     def status(self) -> dict:

@@ -22,8 +22,10 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from tpumon.alerts import AlertEngine
+from tpumon.anomaly import AnomalyBank, AnomalyConfig
 from tpumon.collectors import Collector, Sample, run_collector
 from tpumon.config import Config
+from tpumon.events import EventJournal
 from tpumon.history import RingHistory
 from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
 from tpumon.snapshot import EpochClock
@@ -93,7 +95,34 @@ class Sampler:
         self.k8s = k8s
         self.serving = serving
         self.history = history if history is not None else RingHistory(cfg.history_window_s)
-        self.engine = engine or AlertEngine(cfg.thresholds)
+        # Structured event journal (tpumon.events): the single
+        # append-only record every subsystem's lifecycle moments land in
+        # — alert fired/resolved, breaker transitions, watchdog overruns,
+        # chaos injections, peer up/down, anomaly fires. Bounded ring
+        # (--events-ring); /api/events, the SSE feed and
+        # tpumon_events_total all read it.
+        self.journal = EventJournal(cfg.events_ring)
+        self._published_events_seq = 0
+        self.engine = engine or AlertEngine(cfg.thresholds, journal=self.journal)
+        if self.engine.journal is not self.journal:
+            # Pre-built engine (tpumon.app.build, tests): its timeline
+            # must land in the shared journal, not a private one.
+            self.engine.bind_journal(self.journal)
+        # EWMA drift detectors (tpumon.anomaly) over fleet-level series:
+        # mean duty, mean HBM%, tick duration, per-source scrape p95.
+        self.anomaly: AnomalyBank | None = (
+            AnomalyBank(
+                self.journal,
+                AnomalyConfig(
+                    alpha=cfg.anomaly_alpha,
+                    z_fire=cfg.anomaly_z_fire,
+                    z_clear=cfg.anomaly_z_clear,
+                    warmup=cfg.anomaly_warmup,
+                ),
+            )
+            if cfg.anomaly_detect
+            else None
+        )
         # Webhook sink (tpumon.notify.WebhookNotifier or None). The
         # sampler is the single dispatcher: events restored from a state
         # snapshot are marked already-notified so restarts don't re-page.
@@ -138,6 +167,18 @@ class Sampler:
         # Tick broadcast for push consumers (the SSE stream): rotated
         # and set at the end of every fast tick.
         self._tick_fired = asyncio.Event()
+        # Previous fast-tick duration — the anomaly detector's tick_ms
+        # series (a tick can't observe its own total mid-flight) — and
+        # the fleet means _record_history stashes for it per tick.
+        self._last_tick_ms: float | None = None
+        self._fleet_duty: float | None = None
+        self._fleet_hbm: float | None = None
+        # Chaos wrappers and peer federations record their own journal
+        # events; hand them the shared journal (duck-typed so the
+        # collector layer stays import-free of the sampler).
+        for c in (host, accel, k8s, serving):
+            if c is not None and hasattr(c, "set_journal"):
+                c.set_journal(self.journal)
 
     @property
     def epoch(self) -> int:
@@ -185,6 +226,12 @@ class Sampler:
         return {
             "uptime_s": round(time.time() - self.started_at, 1),
             "snapshot": self.clock.to_json(),
+            "events": self.journal.to_json(),
+            **(
+                {"anomaly": self.anomaly.to_json()}
+                if self.anomaly is not None and self.anomaly.detectors
+                else {}
+            ),
             **(
                 {"webhooks": self.notifier.to_json()}
                 if self.notifier is not None
@@ -226,10 +273,30 @@ class Sampler:
             )
         return br
 
+    def _journal_breaker(self, name: str, prev: str, br: CircuitBreaker) -> None:
+        """One breaker state transition -> one journal event. Severity
+        tracks the direction: open = the monitor just went blind on a
+        source (serious); half-open probe minor; close info."""
+        sev = {"open": "serious", "half_open": "minor", "closed": "info"}.get(
+            br.state, "minor"
+        )
+        detail = (
+            f" after {br.consecutive_failures} consecutive failures"
+            if br.state == "open"
+            else ""
+        )
+        self.journal.record(
+            "breaker", sev, name,
+            f"breaker {prev} → {br.state}{detail}",
+            state=br.state,
+            consecutive_failures=br.consecutive_failures or None,
+        )
+
     async def _run(self, c: Collector | None) -> Sample | None:
         if c is None:
             return None
         br = self._breaker_for(c.name)
+        prev_breaker = br.state if br is not None else None
         # The collect span brackets exactly what collect_bounded does —
         # the collection attempt plus breaker accounting — tagged with
         # the outcome (ok / error / deadline / skipped) and the breaker
@@ -258,6 +325,8 @@ class Sampler:
             sp.tag(ok=s.ok, outcome=outcome)
             if br is not None:
                 sp.tag(breaker=br.state)
+        if br is not None and br.state != prev_breaker:
+            self._journal_breaker(c.name, prev_breaker, br)
         prev = self.latest.get(s.source)
         self.latest[s.source] = s
         self.stats.setdefault(s.source, SourceStats()).record(s)
@@ -337,14 +406,19 @@ class Sampler:
             if self.net_rates:
                 rec("dcn", self.net_rates["tx_bps"], ts)
         chips = self.chips()
+        self._fleet_duty = self._fleet_hbm = None
         if chips:
             duty = [c.mxu_duty_pct for c in chips if c.mxu_duty_pct is not None]
             hbm = [c.hbm_pct for c in chips if c.hbm_pct is not None]
             temp = [c.temp_c for c in chips if c.temp_c is not None]
             if duty:
-                rec("mxu", sum(duty) / len(duty), ts)
+                # Stashed for the anomaly detectors: _anomaly_series
+                # reuses this tick's means instead of re-walking chips.
+                self._fleet_duty = sum(duty) / len(duty)
+                rec("mxu", self._fleet_duty, ts)
             if hbm:
-                rec("hbm", sum(hbm) / len(hbm), ts)
+                self._fleet_hbm = sum(hbm) / len(hbm)
+                rec("hbm", self._fleet_hbm, ts)
             if temp:
                 rec("temp", sum(temp) / len(temp), ts)
             tx_total = sum(r["tx_bps"] for r in self.ici_rates.values())
@@ -423,6 +497,7 @@ class Sampler:
             pods=self.pods() if (k8s_sample is not None and k8s_sample.ok) else None,
             serving=self.serving_data() or None,
             sources=self.source_health(),
+            anomalies=self.anomaly.active() if self.anomaly is not None else None,
         )
         self._notify_new_events()
         # Alerts section fingerprint: timeline position, the active set
@@ -430,7 +505,7 @@ class Sampler:
         # the silence table. ``evaluated_at`` deliberately excluded —
         # it advances at cache granularity (docs/perf.md).
         fp = (
-            self.engine._event_seq,
+            self.engine.timeline_seq,
             tuple(
                 sorted(
                     (k, a.get("desc"))
@@ -449,19 +524,49 @@ class Sampler:
         self._alerts_fp = None
         self.clock.bump("alerts")
 
+    def mark_events_dirty(self) -> None:
+        """Bump the "events" section immediately (journal mutations that
+        happen outside the tick loop: silence POSTs, profiler captures)
+        so the next /api/events render and SSE frame see them."""
+        self._published_events_seq = self.journal.seq
+        self.clock.bump("events")
+
+    def _publish_events(self) -> None:
+        """Per-tick journal publish: one section bump per tick no matter
+        how many events the tick recorded — cache- and delta-friendly,
+        and the "events" stage span brackets exactly this cost."""
+        if self.journal.seq != self._published_events_seq:
+            self.mark_events_dirty()
+
+    def _anomaly_series(self) -> dict[str, float | None]:
+        """The EWMA detectors' inputs for this tick: the fleet-mean duty
+        and HBM% _record_history just computed (stashed, not re-walked),
+        the previous tick's duration, and each source's recent scrape
+        p95 (last 64 samples via one C-speed list copy + bounded sort —
+        the detector must stay sub-percent of the tick; bench.py's
+        ``events`` phase pins it)."""
+        series: dict[str, float | None] = {
+            "duty": self._fleet_duty,
+            "hbm": self._fleet_hbm,
+            "tick_ms": self._last_tick_ms,
+        }
+        for name, st in self.stats.items():
+            lat = st.latencies_ms
+            if len(lat) >= 8:
+                q = quantiles(list(lat)[-64:])
+                if q is not None:
+                    series[f"scrape_p95.{name}"] = q[1]
+        return series
+
     def mark_events_notified(self) -> None:
         """Treat every event currently on the timeline as delivered —
         called after a state restore so historical events don't re-page."""
-        self._notified_seq = max(
-            (e.get("seq", 0) for e in self.engine.events), default=0
-        )
+        self._notified_seq = self.journal.seq
 
     def _notify_new_events(self) -> None:
         if self.notifier is None:
             return
-        new = [
-            e for e in self.engine.events if e.get("seq", 0) > self._notified_seq
-        ]
+        new = self.journal.after(self._notified_seq, kind="alert")
         if not new:
             return
         self._notified_seq = max(e.get("seq", 0) for e in new)
@@ -493,6 +598,7 @@ class Sampler:
         with the accel source to ever pay that back.
         """
         ts = time.time()
+        t0 = time.perf_counter()
         tr = self.tracer
         with tr.span("tick_fast", cat="tick"):
             await self._run(self.host)
@@ -500,8 +606,20 @@ class Sampler:
             self._update_ici_rates(self.chips(), ts)
             with tr.span("history"):
                 self._record_history(ts)
+            # Drift detection BEFORE alert evaluation: an anomaly that
+            # fires this tick alerts this tick.
+            if self.anomaly is not None:
+                with tr.span("anomaly"):
+                    self.anomaly.observe(self._anomaly_series(), ts)
             with tr.span("alerts"):
                 self._evaluate_alerts()
+            # Journal publish: everything the tick recorded (breaker
+            # transitions, anomaly fires, alert timeline) becomes
+            # visible to /api/events, the SSE feed and the exporter in
+            # one section bump.
+            with tr.span("events"):
+                self._publish_events()
+        self._last_tick_ms = (time.perf_counter() - t0) * 1e3
         # Broadcast tick completion (rotate-then-set: every waiter on
         # the old event wakes; new waiters queue on the fresh one).
         # Outside the tick span: waiters run after the span closed, so
@@ -512,10 +630,12 @@ class Sampler:
     async def tick_pods(self) -> None:
         with self.tracer.span("tick_pods", cat="tick"):
             await self._run(self.k8s)
+        self._publish_events()  # breaker events from the slow loop
 
     async def tick_serving(self) -> None:
         with self.tracer.span("tick_serving", cat="tick"):
             await self._run(self.serving)
+        self._publish_events()
 
     async def tick_all(self) -> None:
         await self.tick_pods()
@@ -528,6 +648,7 @@ class Sampler:
         wd = self.watchdogs.setdefault(
             name, LoopWatchdog(name=name, interval_s=interval_s)
         )
+        overrun_logged = False
         while True:
             t0 = time.monotonic()
             err = None
@@ -539,8 +660,31 @@ class Sampler:
                 # evaluation, history recording), so the watchdog counts
                 # it instead of the old silent ``pass``.
                 err = f"{type(e).__name__}: {e}"
-            wd.tick(time.monotonic() - t0, err)
             elapsed = time.monotonic() - t0
+            wd.tick(elapsed, err)
+            # Lifecycle moments worth a durable record: a swallowed
+            # pipeline exception always; tick overrun (past 50% of the
+            # interval) only on ENTERING the overrun state — a
+            # persistently slow loop is one incident, not an event per
+            # tick flooding alert history out of the shared ring (the
+            # journal keeps incidents, not noise; mild/ongoing lag is
+            # the watchdog counters' job). Recovery re-arms the log.
+            if err is not None:
+                self.journal.record(
+                    "watchdog", "serious", name,
+                    f"{name} loop swallowed exception: {err}", error=err,
+                )
+            elif elapsed > interval_s * 1.5:
+                if not overrun_logged:
+                    overrun_logged = True
+                    self.journal.record(
+                        "watchdog", "minor", name,
+                        f"{name} tick overran: {elapsed * 1e3:.0f}ms against "
+                        f"a {interval_s * 1e3:.0f}ms interval",
+                        lag_ms=round((elapsed - interval_s) * 1e3, 1),
+                    )
+            elif elapsed <= interval_s:
+                overrun_logged = False
             await asyncio.sleep(max(0.05, interval_s - elapsed))
 
     async def start(self) -> None:

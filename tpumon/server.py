@@ -37,6 +37,11 @@ Route map (SURVEY §2.3, re-keyed for TPU):
                         docs/observability.md)
   /api/trace/export     the span ring as Chrome trace-event JSON —
                         loadable in Perfetto / chrome://tracing
+  /api/events           structured event journal (tpumon.events,
+                        docs/events.md): alert fired/resolved, breaker
+                        transitions, chaos injections, anomaly fires,
+                        peer up/down — ?after=<cursor>&kind=&severity=
+                        &since=&limit= filters, cursor-paginated
   /metrics              in-tree Prometheus exporter
 
 The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
@@ -63,6 +68,7 @@ from dataclasses import dataclass, field
 
 from tpumon.config import Config, parse_duration
 from tpumon.deltas import diff
+from tpumon.events import KINDS, SEVERITIES
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.profiler import ProfileBusy, ProfilerService
@@ -75,11 +81,14 @@ WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 
 # Sections the realtime push payload reads — the SSE frame epoch is the
 # version over these, so a frame is only "new" when one of them moved.
+# "events" rides along: the payload carries the journal's recent tail,
+# so a breaker transition or anomaly fire reaches the dashboard's event
+# feed as a delta frame on the very next tick.
 # With tracing enabled the server adds "samples" (bumped on every poll)
 # so the per-tick trace timeline the payload carries refreshes even
 # when no data section moved; with tracing off the payload has no
 # per-tick content, so unchanged data must keep producing heartbeats.
-RT_SECTIONS = ("host", "accel", "k8s", "alerts")
+RT_SECTIONS = ("host", "accel", "k8s", "alerts", "events")
 
 
 def parse_query(query: str) -> dict[str, str]:
@@ -142,8 +151,8 @@ class MonitorServer:
         )
         # Eager: construction is cheap (no jax import) and /api/trace +
         # the tpumon_profile_* metrics read its status before any
-        # capture has been requested.
-        self._profiler = ProfilerService()
+        # capture has been requested. Captures are journal events.
+        self._profiler = ProfilerService(journal=sampler.journal)
         # Epoch-keyed render caches (tpumon.snapshot): requests between
         # sampler ticks are served pre-serialized bytes; the version
         # doubles as a strong ETag for 304s. The exporter cache reuses
@@ -296,6 +305,61 @@ class MonitorServer:
         out["profile"] = self._profiler.status()
         return out
 
+    def _events_request(
+        self, query: str, if_none_match: str | None
+    ) -> tuple[int, str, bytes, dict]:
+        """GET /api/events: cursor-paginated, filtered journal page,
+        served through the epoch render cache on the "events" section —
+        between journal changes every request (incl. a pollling CLI)
+        reuses the same bytes. Query-derived cache keys are evictable;
+        a relative ``since`` quantizes to a 10 s grid so a polling
+        client doesn't cycle the eviction cap."""
+        params = parse_query(query)
+        try:
+            after = int(params["after"]) if "after" in params else None
+            limit = min(1000, max(1, int(params.get("limit", "100"))))
+        except ValueError:
+            raise HttpError(400, "after/limit want integers")
+        kind = params.get("kind")
+        if kind is not None and kind not in KINDS:
+            raise HttpError(400, f"unknown kind {kind!r}; known: {list(KINDS)}")
+        severity = params.get("severity")
+        if severity is not None and severity not in SEVERITIES:
+            raise HttpError(
+                400, f"unknown severity {severity!r}; known: {list(SEVERITIES)}"
+            )
+        since = None
+        if "since" in params:
+            raw = params["since"]
+            try:
+                since = float(raw)  # absolute unix timestamp
+            except ValueError:
+                dur = parse_duration(raw, default=-1.0)
+                if dur <= 0:
+                    raise HttpError(400, f"bad since {raw!r} (ts or '10m')")
+                since = round((time.time() - dur) / 10.0) * 10.0
+        journal = self.sampler.journal
+
+        def build() -> bytes:
+            events = journal.query(
+                after=after, kind=kind, severity=severity,
+                since=since, limit=limit,
+            )
+            cursor = (
+                events[-1]["seq"]
+                if events
+                else (after if after is not None else journal.seq)
+            )
+            return json.dumps(
+                {"events": events, "cursor": cursor, **journal.to_json()}
+            ).encode()
+
+        key = (
+            f"/api/events?a={after}&k={kind}&s={severity}"
+            f"&t={since or ''}&n={limit}"
+        )
+        return self._etagged(key, ("events",), build, if_none_match, evictable=True)
+
     def realtime_payload(self) -> dict:
         """The push payload: everything the dashboard's fast loop needs."""
         return {
@@ -309,6 +373,12 @@ class MonitorServer:
             # Last tick's stage timeline (tpumon.tracing) — the
             # dashboard's self-trace strip; None when tracing is off.
             "trace": self.sampler.tracer.last_tick,
+            # Journal tail for the live event feed: bounded, so the
+            # steady-state delta is one shifted 20-row window at most.
+            "events": {
+                "seq": self.sampler.journal.seq,
+                "recent": self.sampler.journal.recent(20),
+            },
         }
 
     # ------------------------------ SSE stream -----------------------------
@@ -458,8 +528,10 @@ class MonitorServer:
             until = self.sampler.engine.silence(key, duration)
             payload = {"silenced": key, "until": until}
         # The mutation happened outside the sampler's evaluation loop:
-        # invalidate the cached /api/alerts render immediately.
+        # invalidate the cached /api/alerts render immediately — and the
+        # events section too (silence/unsilence are journal events).
         self.sampler.mark_alerts_dirty()
+        self.sampler.mark_events_dirty()
         return 200, "application/json", json.dumps(payload).encode()
 
     def _check_auth(self, auth: str | None) -> None:
@@ -529,6 +601,7 @@ class MonitorServer:
                     "/", "/monitor.html", "/index.html", "/dashboard",
                     "/logo.svg", "/chartcore.js", "/dashboard.js",
                     "/metrics", "/api/health", "/api/history",
+                    "/api/events",
                     "/api/profile", "/api/stream", "/api/trace/export",
                     "/api/silence", "/api/unsilence",
                 }
@@ -628,6 +701,9 @@ class MonitorServer:
                 lambda: json.dumps(builder()).encode(),
                 if_none_match,
             )
+
+        if path == "/api/events":
+            return self._events_request(query, if_none_match)
 
         payload = None
         if path == "/api/history":

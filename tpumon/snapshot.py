@@ -34,7 +34,10 @@ from dataclasses import dataclass
 # pseudo-section bumped on every publish regardless of data equality —
 # it versions things that move with collection activity itself
 # (tpumon_samples_total, latency stats) rather than with the data.
-SECTIONS = ("host", "accel", "k8s", "serving", "alerts", "samples")
+# "events" versions the structured event journal (tpumon.events):
+# bumped once per tick when the journal grew, plus immediately on
+# out-of-tick mutations (silence POSTs, profiler captures).
+SECTIONS = ("host", "accel", "k8s", "serving", "alerts", "samples", "events")
 
 
 class EpochClock:

@@ -298,6 +298,65 @@ function makeDashboard(doc, net, env, mkSurface) {
     net.getJson("/api/trace", t => { if (t) renderTrace(t.last_tick); });
   }
 
+  /* ---------------------------- event feed ---------------------------- */
+  /* Live journal tail (tpumon/events.py): the SSE payload carries the
+     last 20 events ({seq, recent}), newest first; /api/events is the
+     polling fallback. A severity filter narrows the feed client-side
+     (the full window is already on hand — no refetch per click). */
+  let eventFilter = "all";
+  let lastEvents = null;  // latest {seq, recent} view rendered
+
+  function renderEvents(ev) {
+    const card = $("events-card");
+    const recent = ev?.recent || [];
+    if (!recent.length) { card.style.display = "none"; return; }
+    lastEvents = ev;
+    card.style.display = "";
+    $("events-tag").textContent = `seq ${ev.seq ?? "?"}`;
+    const feed = $("events-feed");
+    feed.replaceChildren();
+    const shown = recent.filter(
+      e => eventFilter === "all" || e.severity === eventFilter);
+    if (!shown.length) {
+      const empty = doc.mk("div");
+      empty.className = "event-line";
+      empty.textContent = `no recent ${eventFilter} events`;
+      feed.appendChild(empty);
+      return;
+    }
+    for (const e of shown) {
+      const row = doc.mk("div");
+      row.className = "event-line sev-" + (e.severity || "info");
+      const when = doc.mk("span");
+      when.className = "ev-t";
+      when.textContent = env.localeTime((e.ts || 0) * 1000);
+      const kind = doc.mk("span");
+      kind.className = "ev-k";
+      kind.textContent = e.kind || "?";
+      const msg = doc.mk("span");
+      msg.className = "ev-m";
+      msg.textContent =
+        (e.source ? e.source + " · " : "") + (e.msg ?? e.title ?? "");
+      row.append(when, kind, msg);
+      feed.appendChild(row);
+    }
+  }
+
+  function setEventFilter(sev) {
+    eventFilter = sev;
+    for (const b of doc.queryAll(".evbtn"))
+      b.classList.toggle("on", b.dataset.sev === sev);
+    if (lastEvents) renderEvents(lastEvents);
+  }
+
+  function fetchEvents() {
+    net.getJson("/api/events?limit=20", d => {
+      if (!d) return;
+      // /api/events pages ascending; the feed wants newest first.
+      renderEvents({ seq: d.seq, recent: (d.events || []).slice().reverse() });
+    });
+  }
+
   /* ------------------------------ realtime ---------------------------- */
   function fetchRealtime() {
     net.getJson("/api/host/metrics", host => {
@@ -321,6 +380,7 @@ function makeDashboard(doc, net, env, mkSurface) {
     applyHost(streamData.host);
     renderChips(streamData.accel);
     renderTrace(streamData.trace);
+    renderEvents(streamData.events);
     const al = streamData.alerts;
     if (al) {
       $("n-minor").textContent = al.minor ?? 0;
@@ -668,6 +728,7 @@ function makeDashboard(doc, net, env, mkSurface) {
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
     fetchAlerts(); fetchServing(); fetchHealth(); fetchTrace();
+    fetchEvents();
     updateTime();
   }
 
@@ -676,10 +737,11 @@ function makeDashboard(doc, net, env, mkSurface) {
     fetchRealtime: fetchRealtime, fetchHistory: fetchHistory,
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
     fetchServing: fetchServing, fetchHealth: fetchHealth,
-    fetchTrace: fetchTrace,
+    fetchTrace: fetchTrace, fetchEvents: fetchEvents,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
-    renderTrace: renderTrace,
+    renderTrace: renderTrace, renderEvents: renderEvents,
+    setEventFilter: setEventFilter,
     openModal: openModal, closeModal: closeModal,
     openChipModal: openChipModal, closeChipModal: closeChipModal,
     topoTipAt: topoTipAt, topoClickAt: topoClickAt,
