@@ -31,6 +31,10 @@ BENCH_r{N}.json (VERDICT round-1 item #2):
                        and 256 fake chips (docs/perf.md)
   events_* / anomaly_* journal append p50 and EWMA-detector tick
                        overhead at v5p-64 (docs/events.md)
+  history_*            columnar history engine: record/query p50,
+                       resident bytes/point vs the tuple-deque layout,
+                       binary snapshot write/restore, per-chip
+                       recording at v5p-256 (docs/perf.md)
   federation_*         merged scrape→render p50 + exporter render time
                        for a simulated 8-host × 8-chip (64-chip) fleet
                        and a 4-peer × v5p-64 (256-chip) fleet
@@ -811,6 +815,113 @@ async def _bench_events(
     }
 
 
+def _bench_history() -> dict:
+    """Columnar history engine (docs/perf.md "history engine"): record
+    p50 (µs/point) through the live RingHistory.record path, the 30 m
+    fleet-query p50 (ms) with a tick landing between queries (so the
+    resample memo can't serve stale bytes), resident bytes/point vs a
+    tuple-deque holding the same stream (the ≥4x claim of record),
+    binary-vs-json snapshot write + restore (ms), and per-chip
+    recording at v5p-256 scale (256 chips × 4 metrics per tick)."""
+    import os
+    import tempfile
+    from collections import deque
+
+    from tpumon.history import (
+        PROM_QUERIES,
+        HistoryService,
+        HistorySnapshotter,
+        RingHistory,
+    )
+
+    base = 1_700_000_000.0
+
+    # Record hot path: batched appends through record() (dict lookup +
+    # columnar append + downsample accumulators + retention).
+    ring = RingHistory()
+    batch, per_point_us, ts = 200, [], base
+    for _ in range(60):
+        t0 = time.perf_counter()
+        for i in range(batch):
+            ring.record("cpu", 50.0 + (i % 40) * 0.5, ts=ts)
+            ts += 1.0
+        per_point_us.append((time.perf_counter() - t0) / batch * 1e6)
+
+    # Fleet-shaped ring: every /api/history series at 1 Hz for 30 min.
+    fleet = RingHistory()
+    names = list(PROM_QUERIES)
+    for i in range(1800):
+        for n in names:
+            fleet.record(n, 30.0 + (i % 60) * 0.7, ts=base + i)
+    svc = HistoryService(fleet, prometheus_url=None)
+    q_ms = []
+    for i in range(40):
+        for n in names:  # the tick between queries
+            fleet.record(n, 42.0, ts=base + 1800 + i)
+        t0 = time.perf_counter()
+        out = svc.snapshot_ring()
+        q_ms.append((time.perf_counter() - t0) * 1e3)
+    assert out["cpu"]["data"]
+
+    # Resident bytes/point vs the pre-tentpole tuple-deque layout
+    # holding the same stream (tuple header + two boxed floats + slot).
+    col_bpp = fleet.resident_bytes() / max(1, fleet.count_points())
+    dq = deque((base + i, 30.0 + (i % 60) * 0.7) for i in range(1800))
+    dq_bytes = sys.getsizeof(dq) + sum(
+        sys.getsizeof(p) + sys.getsizeof(p[0]) + sys.getsizeof(p[1]) for p in dq
+    )
+    deque_bpp = dq_bytes / len(dq)
+
+    # Snapshot write/restore: v2 binary (chunks verbatim) with the v1
+    # JSON writer alongside for the measured-speedup record.
+    with tempfile.TemporaryDirectory() as td:
+        bpath = os.path.join(td, "hist.bin")
+        jpath = os.path.join(td, "hist.json")
+        wr_ms, wr_json_ms, rd_ms = [], [], []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            assert HistorySnapshotter(fleet, bpath).save()
+            wr_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            assert HistorySnapshotter(fleet, jpath, fmt="json").save()
+            wr_json_ms.append((time.perf_counter() - t0) * 1e3)
+            fresh = RingHistory()
+            t0 = time.perf_counter()
+            assert HistorySnapshotter(fresh, bpath).restore()
+            rd_ms.append((time.perf_counter() - t0) * 1e3)
+        snap_bytes = os.path.getsize(bpath)
+        snap_json_bytes = os.path.getsize(jpath)
+
+    # Per-chip recording at v5p-256: 256 chips × 4 metrics per tick.
+    pc = RingHistory()
+    chip_ids = [f"host-{h}/chip-{c}" for h in range(64) for c in range(4)]
+    pc_us = []
+    for tick in range(30):
+        tsx = base + tick
+        t0 = time.perf_counter()
+        for cid in chip_ids:
+            pc.record(f"chip.{cid}.mxu", 50.0 + tick, ts=tsx)
+            pc.record(f"chip.{cid}.hbm", 60.0, ts=tsx)
+            pc.record(f"chip.{cid}.temp", 40.5, ts=tsx)
+            pc.record(f"chip.{cid}.link", 0.0, ts=tsx)
+        pc_us.append((time.perf_counter() - t0) / (len(chip_ids) * 4) * 1e6)
+
+    return {
+        "history_record_p50_us": round(_p50(per_point_us), 3),
+        "history_query_30m_p50_ms": round(_p50(q_ms), 3),
+        "history_resident_bytes_per_point": round(col_bpp, 2),
+        "history_deque_bytes_per_point": round(deque_bpp, 2),
+        "history_bytes_vs_deque": round(deque_bpp / col_bpp, 2),
+        "history_snapshot_write_ms": round(_p50(wr_ms), 3),
+        "history_snapshot_json_write_ms": round(_p50(wr_json_ms), 3),
+        "history_snapshot_bytes": snap_bytes,
+        "history_snapshot_json_bytes": snap_json_bytes,
+        "history_restore_ms": round(_p50(rd_ms), 3),
+        "history_perchip_256_record_p50_us": round(_p50(pc_us), 3),
+        "history_perchip_256_series": len(pc.series),
+    }
+
+
 async def _bench_federation(
     n_peers: int = 8, peer_topology: str = "v5e-8",
     key_prefix: str = "federation", iters: int = 40, warmup: int = 5,
@@ -922,6 +1033,16 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
     "events": (300, ("events_append_p50_us",
                      "anomaly_on_tick_p50_ms", "anomaly_off_tick_p50_ms",
                      "anomaly_overhead_tick_pct")),
+    "history": (300, ("history_record_p50_us", "history_query_30m_p50_ms",
+                      "history_resident_bytes_per_point",
+                      "history_deque_bytes_per_point",
+                      "history_bytes_vs_deque",
+                      "history_snapshot_write_ms",
+                      "history_snapshot_json_write_ms",
+                      "history_snapshot_bytes", "history_snapshot_json_bytes",
+                      "history_restore_ms",
+                      "history_perchip_256_record_p50_us",
+                      "history_perchip_256_series")),
     "federation": (240, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
                          "federation_exporter_render_ms",
@@ -987,6 +1108,13 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "trace_overhead_tick_pct", "trace_overhead_scrape_pct",
     # events (journal append + EWMA detector overhead, docs/events.md)
     "events_append_p50_us", "anomaly_overhead_tick_pct",
+    # history engine (columnar store, docs/perf.md history section;
+    # the vs-deque ratio and json-write comparison live in the full
+    # results file — the summary line's byte budget is pinned)
+    "history_record_p50_us", "history_query_30m_p50_ms",
+    "history_resident_bytes_per_point",
+    "history_snapshot_write_ms", "history_restore_ms",
+    "history_perchip_256_record_p50_us",
     # federation
     "federation_chips", "federation_scrape_to_render_p50_ms",
     "federation_256_scrape_to_render_p50_ms",
@@ -1044,6 +1172,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(_bench_observability())
     if name == "events":
         return asyncio.run(_bench_events())
+    if name == "history":
+        return _bench_history()
     if name == "federation":
         async def both_scales():
             # 64 chips (8×v5e-8, the BENCH_r05-comparable shape) and

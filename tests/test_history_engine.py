@@ -1,0 +1,463 @@
+"""Columnar history engine (ISSUE 5): golden parity with the
+pre-tentpole tuple-deque implementation, snapshot-format compatibility
+(v1 JSON fixture restores; corrupt v2 refuses cleanly), the
+``?series=`` filter, the resample memo, the snapshotter's idle-skip,
+and the bounded per-chip recording path."""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import json
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import pytest
+
+from tpumon import tsdb
+from tpumon.config import load_config
+from tpumon.events import EventJournal
+from tpumon.history import (
+    PROM_QUERIES,
+    HistoryService,
+    HistorySnapshotter,
+    RingHistory,
+    RingSeries,
+    format_label,
+)
+from tpumon.sampler import Sampler
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+# ------------------- the legacy (deque) implementation -----------------
+# Verbatim copy of the pre-tentpole RingSeries: the golden reference the
+# columnar engine must match point-for-point at fine resolution and
+# shape-for-shape everywhere.
+
+
+@dataclass
+class LegacyRingSeries:
+    window_s: float
+    long_window_s: float = 0.0
+    coarse_step_s: float = 60.0
+    points: deque = field(default_factory=deque)
+    coarse: deque = field(default_factory=deque)
+    _bucket: int | None = field(default=None, repr=False)
+    _bucket_sum: float = field(default=0.0, repr=False)
+    _bucket_n: int = field(default=0, repr=False)
+
+    def add(self, ts, value):
+        self.points.append((ts, value))
+        cutoff = ts - self.window_s
+        while self.points and self.points[0][0] < cutoff:
+            self.points.popleft()
+        if self.long_window_s > self.window_s:
+            b = int(ts // self.coarse_step_s)
+            if self._bucket is not None and b != self._bucket:
+                self._flush_bucket()
+            self._bucket = b
+            self._bucket_sum += value
+            self._bucket_n += 1
+            long_cutoff = ts - self.long_window_s
+            while self.coarse and self.coarse[0][0] < long_cutoff:
+                self.coarse.popleft()
+
+    def _flush_bucket(self):
+        if self._bucket is not None and self._bucket_n:
+            mid = (self._bucket + 0.5) * self.coarse_step_s
+            self.coarse.append((mid, self._bucket_sum / self._bucket_n))
+        self._bucket_sum, self._bucket_n = 0.0, 0
+
+    def _fine_since(self, start):
+        out = []
+        for p in reversed(self.points):
+            if p[0] < start:
+                break
+            out.append(p)
+        out.reverse()
+        return out
+
+    def merged_points(self, window_s, end):
+        start = end - window_s
+        fine = self._fine_since(start)
+        fine_start = fine[0][0] if fine else float("inf")
+        out = [(t, v) for t, v in self.coarse if start <= t < fine_start]
+        if self._bucket is not None and self._bucket_n:
+            mid = (self._bucket + 0.5) * self.coarse_step_s
+            if start <= mid < fine_start:
+                out.append((mid, self._bucket_sum / self._bucket_n))
+        out.extend(fine)
+        return out
+
+    def resample(self, step_s, end=None, window_s=None):
+        window_s = window_s if window_s is not None else self.window_s
+        if end is None:
+            last_fine = self.points[-1][0] if self.points else None
+            last_coarse = self.coarse[-1][0] if self.coarse else None
+            candidates = [t for t in (last_fine, last_coarse) if t is not None]
+            if not candidates:
+                return [], []
+            end = max(candidates)
+        pts = (
+            self.merged_points(window_s, end)
+            if window_s > self.window_s
+            else self._fine_since(end - window_s)
+        )
+        if not pts:
+            return [], []
+        start = max(pts[0][0], end - window_s)
+        times = [t for t, _ in pts]
+        grid, vals = [], []
+        t = start
+        while t <= end + 1e-9:
+            i = bisect.bisect_right(times, t) - 1
+            if i >= 0:
+                grid.append(t)
+                vals.append(pts[i][1])
+            t += step_s
+        if grid and end - grid[-1] > 1e-9:
+            grid.append(end)
+            vals.append(pts[-1][1])
+        return grid, vals
+
+
+def legacy_snapshot(s: LegacyRingSeries, step_s, window_s) -> dict:
+    grid, vals = s.resample(step_s, window_s=window_s)
+    return {
+        "labels": [format_label(t, window_s) for t in grid],
+        "data": [round(v, 2) for v in vals],
+    }
+
+
+# ----------------------------- golden parity ---------------------------
+
+
+def feed_both(mid=False, hours=26, step=1.0):
+    """Identical 1 Hz-ish stream into a legacy series and a columnar
+    one (values 2-decimal, percent-scale: round(f32, 2) is exact)."""
+    legacy = LegacyRingSeries(window_s=1800, long_window_s=24 * 3600)
+    new = RingSeries(
+        window_s=1800,
+        long_window_s=24 * 3600,
+        coarse_step_s=60.0,
+        mid_step_s=30.0 if mid else 0.0,
+        mid_window_s=6 * 3600 if mid else 0.0,
+    )
+    t0 = 1_754_000_000.0
+    n = int(hours * 3600 / step)
+    for i in range(n):
+        ts = t0 + i * step
+        v = round(50.0 + 40.0 * ((i % 600) / 600.0), 2)
+        legacy.add(ts, v)
+        new.add(ts, v)
+    return legacy, new
+
+
+def test_golden_fine_window_identical_to_deque_impl():
+    """Acceptance: fine-resolution renders are identical — same labels,
+    same point counts, same (rounded) values — to the deque engine."""
+    legacy, new = feed_both(mid=True, hours=2)
+    for step, window in ((30, 1800.0), (30, 600.0), (30, 120.0)):
+        want = legacy_snapshot(legacy, step, window)
+        got_grid, got_vals = new.resample(step, window_s=window)
+        got = {
+            "labels": [format_label(t, window) for t in got_grid],
+            "data": [round(v, 2) for v in got_vals],
+        }
+        assert got["labels"] == want["labels"]
+        assert got["data"] == want["data"]
+
+
+def test_golden_long_windows_same_shape_without_mid_tier():
+    """With the mid tier off, the long-window render (coarse + fine
+    merge) is also value-identical to the deque engine."""
+    legacy, new = feed_both(mid=False, hours=26, step=5.0)
+    for window in (3 * 3600.0, 12 * 3600.0, 24 * 3600.0):
+        step = max(30.0, round(window / 60.0))
+        want = legacy_snapshot(legacy, step, window)
+        got_grid, got_vals = new.resample(step, window_s=window)
+        assert [format_label(t, window) for t in got_grid] == want["labels"]
+        assert [round(v, 2) for v in got_vals] == want["data"]
+
+
+def test_golden_long_windows_shape_with_mid_tier():
+    """With the mid tier on, long windows render on the SAME grid
+    (labels + counts) — values inside the mid span come from 30 s
+    means instead of 60 s ones, which is the tier's point."""
+    legacy, new = feed_both(mid=True, hours=7, step=5.0)
+    for window in (3 * 3600.0, 6 * 3600.0):
+        step = max(30.0, round(window / 60.0))
+        want = legacy_snapshot(legacy, step, window)
+        got_grid, _ = new.resample(step, window_s=window)
+        assert [format_label(t, window) for t in got_grid] == want["labels"]
+
+
+def test_api_history_payload_keys_unchanged():
+    """The /api/history contract: every pre-tentpole key present with
+    labels/data pairs of equal length, per_chip intact."""
+    ring = RingHistory(window_s=1800)
+    now = time.time()
+    for i in range(20):
+        ring.record("cpu", 40.0 + i, ts=now - 600 + i * 30)
+        ring.record("chip.h0/chip-0.mxu", 50.0, ts=now - 600 + i * 30)
+    out = asyncio.run(HistoryService(ring, prometheus_url=None).snapshot())
+    assert out["source"] == "ring"
+    for key in PROM_QUERIES:
+        assert key in out
+        assert len(out[key]["labels"]) == len(out[key]["data"])
+    assert out["per_chip"]["h0/chip-0.mxu"]["data"]
+
+
+# ------------------------- ?series= filter -----------------------------
+
+
+def make_service():
+    ring = RingHistory(window_s=1800)
+    now = time.time()
+    for i in range(10):
+        ts = now - 300 + i * 30
+        ring.record("cpu", 10.0 + i, ts=ts)
+        ring.record("mxu", 60.0, ts=ts)
+        ring.record("chip.h0/chip-0.mxu", 61.0, ts=ts)
+        ring.record("chip.h0/chip-1.mxu", 62.0, ts=ts)
+    return HistoryService(ring, prometheus_url=None)
+
+
+def test_series_glob_filters_fleet_and_per_chip():
+    svc = make_service()
+    out = svc.snapshot_ring(series="chip.*")
+    assert out["series"] == "chip.*"
+    assert "cpu" not in out and "mxu" not in out
+    assert set(out["per_chip"]) == {"h0/chip-0.mxu", "h0/chip-1.mxu"}
+    one = svc.snapshot_ring(series="chip.h0/chip-0.*")
+    assert set(one["per_chip"]) == {"h0/chip-0.mxu"}
+    fleet = svc.snapshot_ring(series="cpu")
+    assert fleet["cpu"]["data"] and "per_chip" not in fleet
+    # No filter: everything, and no "series" echo key (exact old shape).
+    full = svc.snapshot_ring()
+    assert "series" not in full and "cpu" in full and "per_chip" in full
+
+
+def test_series_param_served_and_validated_by_route():
+    from tests.test_server_api import serve
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(sampler.tick_all())
+        loop.run_until_complete(sampler.tick_all())
+        status, _, body, _ = loop.run_until_complete(
+            server.handle_ex("GET", "/api/history", query="series=chip.*")
+        )
+        assert status == 200
+        d = json.loads(body)
+        assert "cpu" not in d and d["per_chip"]
+        assert all(k.endswith((".mxu", ".hbm", ".temp", ".link"))
+                   for k in d["per_chip"])
+        from tpumon.server import HttpError
+
+        with pytest.raises(HttpError) as err:
+            loop.run_until_complete(
+                server.handle_ex(
+                    "GET", "/api/history", query="series=%0abad%20glob!"
+                )
+            )
+        assert err.value.status == 400
+    finally:
+        loop.close()
+
+
+# --------------------------- resample memo -----------------------------
+
+
+def test_snapshot_series_memoized_until_series_moves():
+    ring = RingHistory(window_s=1800)
+    ring.record("cpu", 50.0, ts=1000.0)
+    ring.record("mxu", 60.0, ts=1000.0)
+    a = ring.snapshot_series("cpu", 30)
+    assert ring.snapshot_series("cpu", 30) is a  # memo hit: same object
+    ring.record("mxu", 61.0, ts=1030.0)  # another series moving...
+    assert ring.snapshot_series("cpu", 30) is a  # ...doesn't invalidate
+    ring.record("cpu", 51.0, ts=1030.0)
+    b = ring.snapshot_series("cpu", 30)
+    assert b is not a and b["data"][-1] == 51.0
+    # Distinct windows are distinct memo entries.
+    assert ring.snapshot_series("cpu", 30, window_s=600.0) is not b
+
+
+# ----------------------- snapshot compatibility ------------------------
+
+
+def shifted_v1_fixture(tmp_path) -> str:
+    """The checked-in pre-tentpole v1 JSON snapshot, time-shifted so
+    its points land inside the live windows (the file shape is exactly
+    what the old code wrote)."""
+    with open(os.path.join(FIXTURES, "history_snapshot_v1.json")) as f:
+        state = json.load(f)
+    delta = time.time() - state["saved_at"]
+    state["saved_at"] += delta
+    for table in ("points", "coarse"):
+        for pts in state[table].values():
+            for p in pts:
+                p[0] += delta
+    path = str(tmp_path / "v1.json")
+    with open(path, "w") as f:
+        json.dump(state, f)
+    return path
+
+
+def test_v1_json_fixture_restores_into_columnar_store(tmp_path):
+    path = shifted_v1_fixture(tmp_path)
+    ring = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    journal = EventJournal(64)
+    snap = HistorySnapshotter(ring, path, journal=journal)
+    assert snap.restore()
+    # Values and the cursor high-water (newest point) are intact.
+    cpu = ring.series["cpu"]
+    assert [v for _, v in cpu.points] == [40.0 + i for i in range(30)]
+    assert cpu.points[-1][0] == pytest.approx(time.time() - 30 * 30 + 29 * 30, abs=5)
+    assert ring.series["chip.host-0/chip-0.mxu"].points[-1][1] == 61.25
+    # Old coarse entries survive ahead of the replayed fine span.
+    assert list(cpu.coarse)[0][1] == 33.0
+    assert any(e["kind"] == "history" for e in journal.recent(10))
+    # And the restored store round-trips through the NEW binary format.
+    out = str(tmp_path / "v2.bin")
+    assert HistorySnapshotter(ring, out).save()
+    fresh = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    assert HistorySnapshotter(fresh, out).restore()
+    assert [v for _, v in fresh.series["cpu"].points] == [
+        v for _, v in cpu.points
+    ]
+
+
+def test_binary_roundtrip_preserves_all_tiers(tmp_path):
+    ring = RingHistory(window_s=600, long_window_s=24 * 3600)
+    # Stream ends slightly in the future so the restore's retention
+    # pass (cut against wall-clock now) can't outrun the writer's own
+    # eviction bound and drop boundary points mid-test.
+    now = time.time() + 30
+    for i in range(2000):
+        ring.record("cpu", round(30.0 + (i % 50) * 0.5, 2), ts=now - 8000 + i * 4)
+    path = str(tmp_path / "hist.bin")
+    assert HistorySnapshotter(ring, path).save()
+    fresh = RingHistory(window_s=600, long_window_s=24 * 3600)
+    assert HistorySnapshotter(fresh, path).restore()
+    a, b = ring.series["cpu"], fresh.series["cpu"]
+    assert list(a.points) == list(b.points)
+    assert list(a.coarse) == list(b.coarse)
+    # Renders (incl. mid-tier-backed long windows) identical.
+    assert a.resample(30, window_s=7200.0) == b.resample(30, window_s=7200.0)
+
+
+def test_corrupt_or_truncated_binary_refuses_cleanly(tmp_path):
+    ring = RingHistory(window_s=1800)
+    now = time.time()
+    for i in range(500):
+        ring.record("cpu", float(i % 9), ts=now - 500 + i)
+    path = str(tmp_path / "hist.bin")
+    assert HistorySnapshotter(ring, path).save()
+    with open(path, "rb") as f:
+        blob = f.read()
+    for bad in (blob[: len(blob) // 2], blob[:-3], blob[: len(tsdb.MAGIC) + 2]):
+        p = str(tmp_path / "bad.bin")
+        with open(p, "wb") as f:
+            f.write(bad)
+        fresh = RingHistory(window_s=1800)
+        journal = EventJournal(64)
+        snap = HistorySnapshotter(fresh, p, journal=journal)
+        assert not snap.restore()  # refused, not raised
+        assert fresh.series == {}  # ring untouched (fresh start)
+        assert snap.last_error
+        events = journal.recent(5)
+        assert any(
+            e["kind"] == "history" and e["severity"] == "serious" for e in events
+        )
+
+
+def test_snapshotter_skips_idle_saves_and_health_reports_it(tmp_path):
+    ring = RingHistory(window_s=1800)
+    ring.record("cpu", 1.0, ts=time.time())
+    path = str(tmp_path / "h.bin")
+    snap = HistorySnapshotter(ring, path)
+
+    async def run():
+        assert await snap.save_async()  # first: dirty -> writes
+        assert await snap.save_async()  # unchanged -> skipped
+        assert await snap.save_async()
+        ring.record("cpu", 2.0, ts=time.time())
+        assert await snap.save_async()  # dirty again -> writes
+
+    asyncio.run(run())
+    assert snap.saves == 2 and snap.skipped_unchanged == 2
+    j = snap.to_json()
+    assert j["saves"] == 2 and j["skipped_unchanged"] == 2
+    assert j["format"] == "binary"
+
+
+def test_health_route_exposes_snapshotter_and_history_stats():
+    from tests.test_server_api import serve
+
+    sampler, server = serve()
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(sampler.tick_all())
+        snap = HistorySnapshotter(sampler.history, "/tmp/unused.bin")
+        server.snapshotter = snap  # what app.run wires
+        status, _, body, _ = loop.run_until_complete(
+            server.handle_ex("GET", "/api/health")
+        )
+        assert status == 200
+        h = json.loads(body)
+        assert h["history_snapshot"]["format"] == "binary"
+        hist = h["history"]
+        assert hist["series"] > 0 and hist["resident_bytes"] > 0
+        assert hist["per_chip_cap"] == 256
+        assert hist["per_chip_tracked"] == 8  # fake v5e-8
+    finally:
+        loop.close()
+
+
+# ------------------------ per-chip gating ------------------------------
+
+
+def perchip_sampler(cap: int) -> Sampler:
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+
+    cfg = load_config(
+        env={
+            "TPUMON_COLLECTORS": "accel",
+            "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+            "TPUMON_HISTORY_PER_CHIP": str(cap),
+        }
+    )
+    return Sampler(cfg, accel=FakeTpuCollector(topology="v5e-8"))
+
+
+def test_per_chip_cap_bounds_series_and_counts_skips():
+    sampler = perchip_sampler(cap=2)
+    asyncio.run(sampler.tick_fast())
+    asyncio.run(sampler.tick_fast())
+    chip_series = {n for n in sampler.history.series if n.startswith("chip.")}
+    chips = {n.split(".")[1] for n in chip_series}
+    assert len(chips) == 2  # bounded
+    assert len(sampler._perchip_skipped) == 6
+    h = sampler.health_json()["history"]
+    assert h["per_chip_tracked"] == 2 and h["per_chip_skipped"] == 6
+    # Tracked set is stable across ticks (first seen wins).
+    asyncio.run(sampler.tick_fast())
+    assert {n.split(".")[1] for n in sampler.history.series
+            if n.startswith("chip.")} == chips
+
+
+def test_per_chip_zero_disables_and_temp_series_recorded():
+    off = perchip_sampler(cap=0)
+    asyncio.run(off.tick_fast())
+    assert not any(n.startswith("chip.") for n in off.history.series)
+    on = perchip_sampler(cap=256)
+    asyncio.run(on.tick_fast())
+    suffixes = {n.rsplit(".", 1)[1] for n in on.history.series
+                if n.startswith("chip.")}
+    assert {"mxu", "hbm", "temp"} <= suffixes

@@ -623,9 +623,18 @@ def test_snapshotter_periodic_loop_and_final_save(tmp_path):
 
     asyncio.run(run())
     assert snap.last_save_ts is not None and snap.last_error is None
-    with open(path) as f:
-        state = json.load(f)
-    assert state["version"] == 1 and state["points"]["cpu"]
+    # v2 binary format on disk (magic header), restorable round-trip.
+    from tpumon import tsdb
+
+    with open(path, "rb") as f:
+        assert f.read(len(tsdb.MAGIC)) == tsdb.MAGIC
+    fresh = RingHistory(window_s=1800, long_window_s=24 * 3600)
+    assert HistorySnapshotter(fresh, path).restore()
+    assert [v for _, v in fresh.series["cpu"].points] == [
+        v for _, v in ring.series["cpu"].points
+    ]
+    # The idle loop skipped rewrites once the ring stopped changing.
+    assert snap.saves >= 1 and snap.skipped_unchanged >= 1
 
 
 # ---------------------------- observability ----------------------------

@@ -1,0 +1,223 @@
+"""Columnar time-series core (tpumon.tsdb): chunk-codec round-trips
+over adversarial streams (ISSUE 5 satellite), tier retention/query
+semantics, and the v2 binary snapshot codec's refuse-on-corruption
+guarantees."""
+
+import json
+import math
+import os
+import random
+import struct
+
+import pytest
+
+from tpumon import tsdb
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def f32(v: float) -> float:
+    """The store's value dtype: float32 quantization."""
+    return struct.unpack("<f", struct.pack("<f", v))[0]
+
+
+def assert_roundtrip(ts_ms, values):
+    bits = [tsdb.f32bits(v) for v in values]
+    blob = tsdb.encode_chunk(list(ts_ms), bits)
+    ts2, bits2 = tsdb.decode_chunk(blob)
+    assert ts2 == list(ts_ms)
+    # Bit-exact: stronger than float32 tolerance, and the only
+    # comparison that works for NaN payloads.
+    assert bits2 == bits
+    return blob
+
+
+# ------------------------- codec round-trips ---------------------------
+
+
+def test_constant_stream_compresses_to_about_two_bytes_per_point():
+    ts = [1_700_000_000_000 + i * 1000 for i in range(1000)]
+    blob = assert_roundtrip(ts, [73.25] * 1000)
+    assert len(blob) / 1000 < 2.5  # dod=0 (1B) + xor=0 (1B) steady state
+
+
+def test_random_streams_roundtrip_property():
+    rng = random.Random(20250803)
+    for _ in range(50):
+        n = rng.randint(1, 400)
+        t = rng.randint(0, 2**41)
+        ts, vals = [], []
+        for _ in range(n):
+            t += rng.choice([0, 1, 997, 1000, 1003, 60_000, -500])
+            ts.append(t)
+            vals.append(
+                rng.choice(
+                    [0.0, 1.0, -1.0, rng.uniform(-1e9, 1e9), rng.uniform(-1, 1)]
+                )
+            )
+        assert_roundtrip(ts, vals)
+
+
+def test_nan_inf_and_signed_zero_roundtrip():
+    vals = [0.0, -0.0, float("nan"), float("inf"), float("-inf"), 1e-40, 3.4e38]
+    ts = [i * 1000 for i in range(len(vals))]
+    bits = [tsdb.f32bits(v) for v in vals]
+    _, bits2 = tsdb.decode_chunk(tsdb.encode_chunk(ts, bits))
+    out = [tsdb.bits_to_f32(b) for b in bits2]
+    assert math.isnan(out[2]) and out[3] == math.inf and out[4] == -math.inf
+    assert struct.pack("<f", out[1]) == struct.pack("<f", -0.0)  # -0.0 kept
+
+
+def test_monotonic_reversed_and_duplicate_ts_roundtrip():
+    up = list(range(0, 300_000, 1000))
+    assert_roundtrip(up, [float(i) for i in range(300)])
+    assert_roundtrip(list(reversed(up)), [float(i) for i in range(300)])
+    assert_roundtrip([7_000] * 300, [0.5] * 300)
+
+
+def test_fuzz_seed_corpus_roundtrips():
+    """The checked-in adversarial corpus (tests/fixtures/tsdb_fuzz.json):
+    every stream must encode→decode bit-identically."""
+    with open(os.path.join(FIXTURES, "tsdb_fuzz.json")) as f:
+        corpus = json.load(f)
+    assert len(corpus) >= 8
+    for stream in corpus:
+        vals = [float(v) for v in stream["values"]]  # "nan"/"inf" markers
+        assert_roundtrip(stream["ts_ms"], vals)
+
+
+def test_truncated_chunk_raises_not_garbage():
+    ts = [i * 1000 for i in range(100)]
+    blob = tsdb.encode_chunk(ts, [tsdb.f32bits(float(i)) for i in range(100)])
+    for cut in range(len(blob) - 1):
+        with pytest.raises(ValueError):
+            tsdb.decode_chunk(blob[:cut])
+
+
+# ------------------------------ tiers ----------------------------------
+
+
+def test_tier_seal_and_query_across_chunks():
+    tier = tsdb.Tier(window_s=1e9, seal_points=32)
+    for i in range(100):
+        tier.append(float(i), f32(i * 0.5))
+    assert len(tier.chunks) == 3 and len(tier.head_ts) == 4
+    assert len(tier) == 100
+    pts = tier.since(40.0)
+    assert [t for t, _ in pts] == [float(i) for i in range(40, 100)]
+    assert pts[0][1] == f32(20.0)
+    assert tier.first() == (0.0, 0.0) and tier.last() == (99.0, f32(49.5))
+
+
+def test_tier_eviction_masks_partially_expired_chunk():
+    tier = tsdb.Tier(window_s=50.0, seal_points=32)
+    for i in range(100):
+        tier.append(float(i), 1.0)
+    # Whole chunks older than the window dropped; the seam chunk stays
+    # resident but its expired points never surface.
+    assert tier.first()[0] >= 99 - 50
+    assert len(tier) == 51
+    assert all(t >= 49.0 for t, _ in tier.since(None))
+
+
+def test_tier_out_of_order_insert_keeps_sorted_order():
+    tier = tsdb.Tier(window_s=1e9, seal_points=16)
+    for i in range(40):
+        tier.append(1000.0 + i, float(i))
+    tier.append(500.0, 7.0)  # restore-path style late point
+    pts = tier.since(None)
+    assert [t for t, _ in pts] == sorted(t for t, _ in pts)
+    assert pts[0] == (500.0, 7.0)
+    # Ring still appends normally afterwards.
+    tier.append(2000.0, 9.0)
+    assert tier.last() == (2000.0, 9.0)
+
+
+def test_points_view_sequence_protocol():
+    tier = tsdb.Tier(window_s=1e9, seal_points=8)
+    writes = []
+    view = tsdb.PointsView(tier, on_write=lambda: writes.append(1))
+    assert not view and len(view) == 0
+    view.extend([(float(i), float(i * 2)) for i in range(20)])
+    assert len(writes) == 20
+    assert view and len(view) == 20
+    assert view[0] == (0.0, 0.0) and view[-1] == (19.0, 38.0)
+    assert view[3] == (3.0, 6.0)
+    assert list(view) == list(reversed(list(reversed(view))))
+    with pytest.raises(IndexError):
+        view[99]
+
+
+def test_resident_bytes_vastly_under_tuple_deque():
+    """The tentpole's memory claim at unit scale: a sealed columnar
+    series resides in a small fraction of the tuple-deque bytes."""
+    import sys
+    from collections import deque
+
+    tier = tsdb.Tier(window_s=1e9, seal_points=256)
+    dq = deque()
+    for i in range(5000):
+        ts, v = 1_700_000_000.0 + i, 50.0 + (i % 7)
+        tier.append(ts, f32(v))
+        dq.append((ts, v))
+    deque_bytes = sum(
+        sys.getsizeof(p) + sys.getsizeof(p[0]) + sys.getsizeof(p[1]) for p in dq
+    ) + sys.getsizeof(dq)
+    assert tier.resident_bytes() * 4 < deque_bytes
+
+
+# ----------------------- binary snapshot codec -------------------------
+
+
+class _Series:
+    """Duck-typed series (fine + down) as dump_snapshot expects."""
+
+    def __init__(self):
+        self.fine = tsdb.Tier(window_s=1e9, seal_points=16)
+        self.down = [tsdb.Downsample(60.0, 1e9)]
+
+
+def _make_series(n=50):
+    s = _Series()
+    for i in range(n):
+        ts, v = 1000.0 + i, f32(10.0 + i * 0.5)
+        s.fine.append(ts, v)
+        s.down[0].observe(ts, v)
+    return s
+
+
+def test_snapshot_roundtrip_chunks_verbatim():
+    s = _make_series()
+    blob = tsdb.dump_snapshot({"cpu": s}, saved_at=123.0)
+    saved_at, dumps = tsdb.load_snapshot(blob)
+    assert saved_at == 123.0 and len(dumps) == 1
+    d = dumps[0]
+    assert d["name"] == "cpu"
+    # Chunk bytes round-trip verbatim — no re-encode on either side.
+    assert [c.data for c in d["fine"]["chunks"]] == [
+        c.data for c in s.fine.chunks
+    ]
+    assert list(d["fine"]["head_ts"]) == list(s.fine.head_ts)
+    # The live downsample bucket's accumulator survives.
+    assert d["down"][0]["bn"] == s.down[0].bn
+    assert tsdb.tier_points(d["fine"]) == s.fine.since(None)
+
+
+def test_snapshot_refuses_truncation_everywhere():
+    blob = tsdb.dump_snapshot({"cpu": _make_series(), "mxu": _make_series()}, 1.0)
+    # Every proper prefix must raise ValueError — never return garbage,
+    # never throw anything a caller wouldn't catch.
+    for cut in range(len(blob)):
+        with pytest.raises(ValueError):
+            tsdb.load_snapshot(blob[:cut])
+
+
+def test_snapshot_refuses_bad_magic_and_corrupt_index():
+    blob = tsdb.dump_snapshot({"cpu": _make_series()}, 1.0)
+    with pytest.raises(ValueError):
+        tsdb.load_snapshot(b"NOTHIST!" + blob[8:])
+    # Flip a byte inside the JSON index.
+    mangled = bytearray(blob)
+    mangled[len(tsdb.MAGIC) + 4 + 2] = 0xFF
+    with pytest.raises(ValueError):
+        tsdb.load_snapshot(bytes(mangled))

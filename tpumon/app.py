@@ -57,6 +57,8 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         window_s=cfg.history_window_s,
         long_window_s=cfg.history_long_window_s,
         coarse_step_s=cfg.history_coarse_step_s,
+        mid_step_s=cfg.history_mid_step_s,
+        mid_window_s=cfg.history_mid_window_s,
     )
     notifier = None
     if cfg.alert_webhooks:
@@ -141,7 +143,9 @@ async def run(cfg: Config) -> None:
             cfg.history_snapshot_path,
             interval_s=cfg.history_snapshot_interval_s,
             journal=journal,
+            fmt=cfg.history_snapshot_format,
         )
+        server.snapshotter = snapshotter  # /api/health save/skip counters
         # A full state restore already replayed history; restoring the
         # history-only snapshot on top would double every point.
         if not state_restored and snapshotter.restore():
@@ -340,6 +344,12 @@ def main(argv: list[str] | None = None) -> int:
             overrides["chaos"] = take(arg)
         elif arg == "--history-snapshot":
             overrides["history_snapshot_path"] = take(arg)
+        elif arg == "--history-snapshot-format":
+            # "binary" (v2 chunk-verbatim, default) | "json" (v1).
+            overrides["history_snapshot_format"] = take(arg)
+        elif arg == "--history-per-chip":
+            # Max chips with per-chip drill-down ring series; 0 disables.
+            overrides["history_per_chip"] = take_int(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
@@ -354,6 +364,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--peers host:port,...] [--peer-fanout N] "
                 "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
+                "[--history-snapshot-format binary|json] "
+                "[--history-per-chip N] "
                 "[--trace-ring N] "
                 "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"

@@ -124,6 +124,21 @@ class Config:
     # coarse (bucket-mean) ring data when Prometheus is absent.
     history_long_window_s: float = 24 * 3600
     history_coarse_step_s: float = 60
+    # Mid retention tier (tpumon.tsdb): bucket means between the fine
+    # ring and the coarse tier, so multi-hour windows render at 30 s
+    # resolution instead of the coarse step. 0 disables.
+    history_mid_step_s: float = 30
+    history_mid_window_s: float = 6 * 3600
+    # Per-chip history: the sampler records chip.<id>.{mxu,hbm,temp,link}
+    # series for up to this many chips (drill-down curves via
+    # /api/history?series=chip.* — holds at v5p-256 thanks to the
+    # columnar store). 0 disables per-chip history entirely; chips
+    # beyond the cap are counted, not silently dropped (/api/health).
+    history_per_chip: int = 256
+    # On-disk format for history_snapshot_path writes: "binary" (the v2
+    # chunk-verbatim format, ~10x cheaper) or "json" (the v1 format).
+    # Restore reads either, whatever this is set to.
+    history_snapshot_format: str = "binary"
 
     # --- sampling (replaces per-request execSync collection, SURVEY §3.2) ---
     sample_interval_s: float = 1.0
@@ -291,6 +306,8 @@ _SCALAR_FIELDS: dict[str, type] = {
     "chaos_seed": int,
     "history_snapshot_path": str,
     "history_snapshot_interval_s": float,
+    "history_snapshot_format": str,
+    "history_per_chip": int,
     "peer_fanout": int,
     "peer_timeout_s": float,
     "sse_keyframe_every": int,
@@ -307,6 +324,8 @@ _DURATION_KEYS = {
     "history_step": "history_step_s",
     "history_long_window": "history_long_window_s",
     "history_coarse_step": "history_coarse_step_s",
+    "history_mid_step": "history_mid_step_s",
+    "history_mid_window": "history_mid_window_s",
 }
 _LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers", "alert_webhooks"}
 

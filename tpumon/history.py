@@ -16,6 +16,12 @@ Differences (deliberate, SURVEY §3.3 + §5.8):
   (monitor_server.js:134 vs monitor.html:523-526); here temperature is a
   first-class rendered series.
 - Values are numbers, not toFixed(1) strings (SURVEY §2.1 quirk, fixed).
+- Storage is the columnar time-series core (tpumon.tsdb): typed-array
+  head columns + Gorilla-style compressed chunks in three retention
+  tiers (fine / mid / coarse), ~8-20x smaller resident history than
+  the tuple-deque rings it replaced — which is what lets the sampler
+  keep per-chip series at the 256-chip federation scale (docs/perf.md
+  "History engine").
 """
 
 from __future__ import annotations
@@ -23,13 +29,13 @@ from __future__ import annotations
 import asyncio
 import bisect
 import contextlib
+import fnmatch
 import json
 import os
 import tempfile
 import time
-from collections import deque
-from dataclasses import dataclass, field
 
+from tpumon import tsdb
 from tpumon.collectors.prometheus import PrometheusClient
 
 # PromQL re-keying (SURVEY §5.8): all queries ride tpumon's own exporter.
@@ -91,73 +97,104 @@ def format_label(ts: float, window_s: float) -> str:
     return format_hhmm(ts)
 
 
-@dataclass
 class RingSeries:
-    """One bounded time series: a fine tier of raw (ts, value) points over
-    ``window_s``, plus an optional coarse tier of ``coarse_step_s``-bucket
-    means retained for ``long_window_s`` — long-range charts without
-    keeping every 1 s sample for a day."""
+    """One bounded time series over the columnar core (tpumon.tsdb):
+    a fine tier of raw ms-quantized points in typed-array columns +
+    compressed sealed chunks, an optional mid tier of ``mid_step_s``
+    bucket means, and an optional coarse tier of ``coarse_step_s``
+    bucket means retained for ``long_window_s`` — long-range charts
+    without keeping every 1 s sample for a day, at ~2-12 resident
+    bytes/point instead of the old tuple-deque's ~120.
 
-    window_s: float
-    long_window_s: float = 0.0  # 0 => fine tier only
-    coarse_step_s: float = 60.0
-    points: deque = field(default_factory=deque)  # fine: (ts, value)
-    coarse: deque = field(default_factory=deque)  # (bucket_mid_ts, mean)
-    _bucket: int | None = field(default=None, repr=False)
-    _bucket_sum: float = field(default=0.0, repr=False)
-    _bucket_n: int = field(default=0, repr=False)
+    ``points`` and ``coarse`` keep their deque-shaped API (len/iter/
+    index/extend) as views over the tiers; ``version`` bumps on every
+    mutation and keys the render memo (RingHistory.snapshot_series).
+    """
+
+    __slots__ = (
+        "window_s", "long_window_s", "coarse_step_s", "fine", "down",
+        "_mid", "_coarse", "version",
+    )
+
+    def __init__(
+        self,
+        window_s: float,
+        long_window_s: float = 0.0,  # <= window_s => fine tier only
+        coarse_step_s: float = 60.0,
+        mid_step_s: float = 0.0,  # 0 => no mid tier
+        mid_window_s: float = 0.0,
+    ):
+        self.window_s = window_s
+        self.long_window_s = long_window_s
+        self.coarse_step_s = coarse_step_s
+        self.fine = tsdb.Tier(window_s)
+        self.down: list[tsdb.Downsample] = []  # finest -> coarsest
+        self._mid = None
+        if mid_step_s > 0 and mid_window_s > window_s:
+            self._mid = tsdb.Downsample(mid_step_s, mid_window_s)
+            self.down.append(self._mid)
+        # The coarse tier exists even when disabled for accumulation
+        # (long_window_s <= window_s): restore paths may extend it
+        # directly, and merged_points must then still serve it.
+        self._coarse = tsdb.Downsample(
+            coarse_step_s, max(long_window_s, window_s)
+        )
+        self.down.append(self._coarse)
+        self.version = 0
+
+    def __repr__(self) -> str:
+        return (
+            f"RingSeries(window_s={self.window_s}, "
+            f"long_window_s={self.long_window_s}, points={len(self.fine)})"
+        )
+
+    @property
+    def points(self) -> tsdb.PointsView:
+        return tsdb.PointsView(self.fine, on_write=self._bump)
+
+    @property
+    def coarse(self) -> tsdb.PointsView:
+        return tsdb.PointsView(self._coarse.tier, on_write=self._bump)
+
+    def _bump(self) -> None:
+        self.version += 1
 
     def add(self, ts: float, value: float) -> None:
-        self.points.append((ts, value))
-        cutoff = ts - self.window_s
-        while self.points and self.points[0][0] < cutoff:
-            self.points.popleft()
+        ts = tsdb.quantize_ts(ts)
+        value = tsdb.quantize_val(value)
+        self.fine.append(ts, value)
+        if self._mid is not None:
+            self._mid.observe(ts, value)
         if self.long_window_s > self.window_s:
-            b = int(ts // self.coarse_step_s)
-            if self._bucket is not None and b != self._bucket:
-                self._flush_bucket()
-            self._bucket = b
-            self._bucket_sum += value
-            self._bucket_n += 1
-            long_cutoff = ts - self.long_window_s
-            while self.coarse and self.coarse[0][0] < long_cutoff:
-                self.coarse.popleft()
-
-    def _flush_bucket(self) -> None:
-        if self._bucket is not None and self._bucket_n:
-            mid = (self._bucket + 0.5) * self.coarse_step_s
-            self.coarse.append((mid, self._bucket_sum / self._bucket_n))
-        self._bucket_sum, self._bucket_n = 0.0, 0
+            self._coarse.observe(ts, value)
+        self.version += 1
 
     def _fine_since(self, start: float) -> list[tuple[float, float]]:
-        """Fine points with ts >= start, O(matched) not O(ring): the
-        deque is time-ordered, so walk from the newest end and stop at
-        the first point before the window — a 30 m query over a 24 h
-        ring no longer scans the whole fine tier."""
-        out: list[tuple[float, float]] = []
-        for p in reversed(self.points):
-            if p[0] < start:
-                break
-            out.append(p)
-        out.reverse()
-        return out
+        """Fine points with ts >= start — O(log chunks + matched):
+        bisect over the sealed-chunk time index, decode only the
+        overlap (tsdb.Tier.since)."""
+        return self.fine.since(start)
 
     def merged_points(self, window_s: float, end: float) -> list[tuple[float, float]]:
-        """Points covering [end - window_s, end]: coarse tier for the span
-        older than the fine tier, fine points (raw) for the recent span."""
-        start = end - window_s
-        fine = self._fine_since(start)
-        # No fine points => every coarse point qualifies (an empty fine
-        # tier must not mask the newest coarse value).
-        fine_start = fine[0][0] if fine else float("inf")
-        out = [(t, v) for t, v in self.coarse if start <= t < fine_start]
-        # The live (unflushed) bucket only matters when it predates fine.
-        if self._bucket is not None and self._bucket_n:
-            mid = (self._bucket + 0.5) * self.coarse_step_s
-            if start <= mid < fine_start:
-                out.append((mid, self._bucket_sum / self._bucket_n))
-        out.extend(fine)
-        return out
+        """Points covering [end - window_s, end]: downsampled tiers for
+        the span older than the fine tier, fine points (raw) for the
+        recent span (tsdb.merged)."""
+        return tsdb.merged(self.fine, self.down, window_s, end)
+
+    def last_ts(self) -> float | None:
+        candidates = [self.fine.last_ts()] + [d.tier.last_ts() for d in self.down]
+        ts = [c for c in candidates if c is not None]
+        return max(ts) if ts else None
+
+    def resident_bytes(self) -> int:
+        return self.fine.resident_bytes() + sum(
+            d.tier.resident_bytes() for d in self.down
+        )
+
+    def count_points(self) -> int:
+        return self.fine.approx_len() + sum(
+            d.tier.approx_len() for d in self.down
+        )
 
     def resample(
         self,
@@ -168,12 +205,9 @@ class RingSeries:
         """Downsample to a fixed step grid (last-value-wins per bucket)."""
         window_s = window_s if window_s is not None else self.window_s
         if end is None:
-            last_fine = self.points[-1][0] if self.points else None
-            last_coarse = self.coarse[-1][0] if self.coarse else None
-            candidates = [t for t in (last_fine, last_coarse) if t is not None]
-            if not candidates:
+            end = self.last_ts()
+            if end is None:
                 return [], []
-            end = max(candidates)
         pts = (
             self.merged_points(window_s, end)
             if window_s > self.window_s
@@ -202,18 +236,43 @@ class RingSeries:
 
 
 class RingHistory:
-    """Named ring-buffer series, fed by the sampler each tick."""
+    """Named ring-buffer series, fed by the sampler each tick.
+
+    ``mutations`` counts every write — the history snapshotter's dirty
+    check (an idle cadence skips the disk write entirely), and the
+    per-series ``version`` keys a bounded resample memo so an epoch
+    render-cache miss on one window does not re-walk series that did
+    not move (tpumon.server serves multiple clamped windows per tick).
+    """
+
+    _MEMO_CAP = 4096  # (name, step, window) keys; cleared when full
 
     def __init__(
         self,
         window_s: float = 1800,
         long_window_s: float = 24 * 3600,
         coarse_step_s: float = 60.0,
+        mid_step_s: float = 30.0,
+        mid_window_s: float = 6 * 3600,
     ):
         self.window_s = window_s
         self.long_window_s = max(long_window_s, window_s)
         self.coarse_step_s = coarse_step_s
+        self.mid_step_s = mid_step_s
+        # The mid tier never outlives the coarse one.
+        self.mid_window_s = min(mid_window_s, self.long_window_s)
         self.series: dict[str, RingSeries] = {}
+        self.mutations = 0
+        self._memo: dict[tuple, tuple[int, dict]] = {}
+
+    def _make_series(self) -> RingSeries:
+        return RingSeries(
+            window_s=self.window_s,
+            long_window_s=self.long_window_s,
+            coarse_step_s=self.coarse_step_s,
+            mid_step_s=self.mid_step_s,
+            mid_window_s=self.mid_window_s,
+        )
 
     def record(self, name: str, value: float | None, ts: float | None = None) -> None:
         if value is None:
@@ -221,12 +280,15 @@ class RingHistory:
         ts = time.time() if ts is None else ts
         s = self.series.get(name)
         if s is None:
-            s = self.series[name] = RingSeries(
-                window_s=self.window_s,
-                long_window_s=self.long_window_s,
-                coarse_step_s=self.coarse_step_s,
-            )
+            s = self.series[name] = self._make_series()
         s.add(ts, float(value))
+        self.mutations += 1
+
+    def resident_bytes(self) -> int:
+        return sum(s.resident_bytes() for s in self.series.values())
+
+    def count_points(self) -> int:
+        return sum(s.count_points() for s in self.series.values())
 
     def restore_coarse(self, name: str, points: list[tuple[float, float]]) -> None:
         """Seed a series' coarse tier from a state snapshot (tpumon.state).
@@ -236,30 +298,30 @@ class RingHistory:
             return
         s = self.series.get(name)
         if s is None:
-            s = self.series[name] = RingSeries(
-                window_s=self.window_s,
-                long_window_s=self.long_window_s,
-                coarse_step_s=self.coarse_step_s,
-            )
+            s = self.series[name] = self._make_series()
         s.coarse.extend((float(t), float(v)) for t, v in points)
+        self.mutations += 1
 
     # --------------- crash-safe persistence (dump/load) ----------------
 
     def dump_points(self) -> dict[str, list[list[float]]]:
-        """Fine-tier raw points per series, JSON-shaped."""
+        """Fine-tier raw points per series, JSON-shaped. Decodes via
+        Tier.dump (cache-bypassing): the state checkpoint walks every
+        series every save and must not pin decoded chunks resident."""
         return {
-            name: [[round(t, 3), v] for t, v in s.points]
+            name: [[round(t, 3), v] for t, v in s.fine.dump()]
             for name, s in self.series.items()
         }
 
     def dump_coarse(self) -> dict[str, list[list[float]]]:
         """Coarse-tier (bucket-mean) points per series, JSON-shaped.
         Series with no coarse data are omitted."""
-        return {
-            name: [[round(t, 3), v] for t, v in s.coarse]
-            for name, s in self.series.items()
-            if s.coarse
-        }
+        out = {}
+        for name, s in self.series.items():
+            pts = s._coarse.tier.dump()
+            if pts:
+                out[name] = [[round(t, 3), v] for t, v in pts]
+        return out
 
     def load_points(
         self,
@@ -315,11 +377,24 @@ class RingHistory:
         if s is None:
             return {"labels": [], "data": []}
         window = window_s if window_s is not None else self.window_s
+        # Resample memo keyed on the series' own version: a request
+        # that misses the epoch render cache (new window, or another
+        # section ticked) re-renders ONLY the series that moved since
+        # their last resample at this (step, window). Callers treat the
+        # payload as immutable (it goes straight to json.dumps).
+        key = (name, step_s, window)
+        hit = self._memo.get(key)
+        if hit is not None and hit[0] == s.version:
+            return hit[1]
         grid, vals = s.resample(step_s, window_s=window)
-        return {
+        out = {
             "labels": [format_label(t, window) for t in grid],
             "data": [round(v, 2) for v in vals],
         }
+        if len(self._memo) >= self._MEMO_CAP:
+            self._memo.clear()
+        self._memo[key] = (s.version, out)
+        return out
 
 
 def atomic_write_text(path: str, text: str) -> None:
@@ -346,15 +421,41 @@ def atomic_write_json(path: str, obj: dict) -> None:
     atomic_write_text(path, json.dumps(obj, separators=(",", ":")))
 
 
-HISTORY_SNAPSHOT_VERSION = 1
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Atomic binary write (see atomic_write_text) — the v2 history
+    snapshot format (tpumon.tsdb.dump_snapshot) rides this."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".tpumon-hist.", suffix=".tmp", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+HISTORY_SNAPSHOT_VERSION = 1  # the legacy full-JSON format (read-only path)
 
 
 class HistorySnapshotter:
-    """Crash-safe ring history: periodic atomic snapshot of the fine +
-    coarse tiers to disk, restore-on-start — a monitor restart no longer
-    erases the cluster's recent past even without Prometheus or a full
-    state_path checkpoint (tpumon.state covers alerts + pods; this is
-    the history-only, always-cheap subset).
+    """Crash-safe ring history: periodic atomic snapshot of all tiers
+    to disk, restore-on-start — a monitor restart no longer erases the
+    cluster's recent past even without Prometheus or a full state_path
+    checkpoint (tpumon.state covers alerts + pods; this is the
+    history-only, always-cheap subset).
+
+    The default on-disk format is the v2 binary one
+    (tpumon.tsdb.dump_snapshot): magic + version header, sealed chunks
+    written verbatim — ~10x cheaper to write and restore than the v1
+    full-JSON dump, which remains readable (``restore`` sniffs the
+    magic) so pre-existing snapshot files warm-start the new store.
+    A mutation ("dirty") check skips the periodic write entirely when
+    nothing was recorded since the last save; skips are counted and
+    surfaced in /api/health.
     """
 
     def __init__(
@@ -363,41 +464,66 @@ class HistorySnapshotter:
         path: str,
         interval_s: float = 30.0,
         journal=None,
+        fmt: str = "binary",
     ):
+        if fmt not in ("binary", "json"):
+            raise ValueError(f"unknown history snapshot format {fmt!r}")
         self.ring = ring
         self.path = path
         self.interval_s = interval_s
+        self.format = fmt
         # Optional event journal (tpumon.events): restore success and
         # save-failure transitions are lifecycle moments worth keeping.
         self.journal = journal
         self.last_save_ts: float | None = None
         self.last_error: str | None = None
+        self.saves = 0
+        self.skipped_unchanged = 0
+        self._saved_mutations: int | None = None
         self._task: asyncio.Task | None = None
 
     def save(self) -> bool:
-        """Snapshot + write in one call. Only safe where nothing is
-        concurrently mutating the ring (tests, shutdown after loops
-        stopped); the live periodic path is save_async()."""
-        return self._write(self._snapshot())
+        """Snapshot + write in one call, unconditionally. Only safe
+        where nothing is concurrently mutating the ring (tests,
+        shutdown after loops stopped); the live periodic path is
+        save_async()."""
+        return self._write(*self._snapshot())
 
     async def save_async(self) -> bool:
         """Snapshot on the event loop — the ring is only mutated there,
-        so this never races a tick — then write the frozen dict in a
-        worker thread."""
-        state = self._snapshot()
-        return await asyncio.to_thread(self._write, state)
+        so this never races a tick — then write the frozen blob in a
+        worker thread. An unchanged ring (no record() since the last
+        save) skips the write: idle clusters stop rewriting the same
+        bytes every cadence."""
+        if self._saved_mutations == self.ring.mutations:
+            self.skipped_unchanged += 1
+            return True
+        blob, saved_at, mutations = self._snapshot()
+        ok = await asyncio.to_thread(self._write, blob, saved_at, mutations)
+        return ok
 
-    def _snapshot(self) -> dict:
-        return {
-            "version": HISTORY_SNAPSHOT_VERSION,
-            "saved_at": time.time(),
-            "points": self.ring.dump_points(),
-            "coarse": self.ring.dump_coarse(),
-        }
+    def _snapshot(self) -> tuple[bytes | dict, float, int]:
+        saved_at = time.time()
+        mutations = self.ring.mutations
+        if self.format == "binary":
+            return tsdb.dump_snapshot(self.ring.series, saved_at), saved_at, mutations
+        return (
+            {
+                "version": HISTORY_SNAPSHOT_VERSION,
+                "saved_at": saved_at,
+                "points": self.ring.dump_points(),
+                "coarse": self.ring.dump_coarse(),
+            },
+            saved_at,
+            mutations,
+        )
 
-    def _write(self, state: dict) -> bool:
+    def _write(self, state: bytes | dict, saved_at: float, mutations: int) -> bool:
         try:
-            atomic_write_json(self.path, state)
+            if isinstance(state, bytes):
+                atomic_write_bytes(self.path, state)
+            else:
+                atomic_write_json(self.path, state)
         except OSError as e:
             # Journal only the TRANSITION into failure — a full disk
             # must not generate one event per 30 s cadence forever.
@@ -408,30 +534,124 @@ class HistorySnapshotter:
                 )
             self.last_error = str(e)
             return False
-        self.last_save_ts = state["saved_at"]
+        self.last_save_ts = saved_at
         self.last_error = None
+        self.saves += 1
+        self._saved_mutations = mutations
         return True
+
+    def _refuse(self, why: str) -> bool:
+        """A snapshot file that exists but cannot be used: record why
+        (journal + last_error) and start fresh — never crash the
+        server over a torn restore file."""
+        self.last_error = why
+        if self.journal is not None:
+            self.journal.record(
+                "history", "serious", "history",
+                f"history snapshot refused: {why}", path=self.path,
+            )
+        return False
 
     def restore(self) -> bool:
         """Best-effort warm start; False (restoring nothing) on a
-        missing, corrupt, wrong-version or stale snapshot."""
+        missing, corrupt, wrong-version or stale snapshot. Binary (v2)
+        and legacy JSON (v1) files are both readable; the ring is only
+        mutated after the whole file parsed clean."""
         try:
-            with open(self.path) as f:
-                state = json.load(f)
-        except (OSError, json.JSONDecodeError) as e:
+            with open(self.path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            self.last_error = str(e)
+            return False
+        if raw[: len(tsdb.MAGIC)] == tsdb.MAGIC:
+            return self._restore_binary(raw)
+        return self._restore_json(raw)
+
+    def _stale(self, saved_at: float, now: float) -> bool:
+        # A snapshot older than the ring's long window holds nothing
+        # servable — the cutoff tracks the configured window, not a
+        # fixed day, so a 72 h ring keeps a 30 h-old snapshot.
+        return now - saved_at > self.ring.long_window_s
+
+    def _restore_binary(self, raw: bytes) -> bool:
+        now = time.time()
+        try:
+            saved_at, dumps = tsdb.load_snapshot(raw)
+        except ValueError as e:
+            return self._refuse(f"corrupt binary snapshot: {e}")
+        if self._stale(saved_at, now):
+            return False
+        ring = self.ring
+        replay_fine: dict[str, list] = {}
+        replay_coarse: dict[str, list] = {}
+        for d in dumps:
+            s = ring._make_series()
+            if self._adoptable(s, d):
+                self._adopt(s, d, now)
+                if s.count_points() or any(x.bn for x in s.down):
+                    ring.series[d["name"]] = s
+            else:
+                # Tier geometry changed since the file was written
+                # (config edit): decode and replay instead of adopting.
+                replay_fine[d["name"]] = tsdb.tier_points(d["fine"])
+                if d["down"]:
+                    replay_coarse[d["name"]] = tsdb.tier_points(
+                        d["down"][-1]["tier"]
+                    )
+        if replay_fine or replay_coarse:
+            ring.load_points(replay_fine, replay_coarse, now=now)
+        ring.mutations += 1
+        ring._memo.clear()
+        if self.journal is not None:
+            self.journal.record(
+                "history", "info", "history",
+                f"restored {len(dumps)} history series from {self.path}",
+                path=self.path,
+            )
+        return True
+
+    @staticmethod
+    def _adoptable(s: RingSeries, d: dict) -> bool:
+        if s.fine.window_s != d["fine"]["window_s"]:
+            return False
+        if len(s.down) != len(d["down"]):
+            return False
+        return all(
+            ds.step_s == dd["step_s"] and ds.tier.window_s == dd["tier"]["window_s"]
+            for ds, dd in zip(s.down, d["down"])
+        )
+
+    @staticmethod
+    def _adopt(s: RingSeries, d: dict, now: float) -> None:
+        """Move a parsed tier dump into a fresh series verbatim (chunks
+        stay compressed), then apply retention against ``now``."""
+
+        def fill(tier: tsdb.Tier, td: dict) -> None:
+            tier.chunks = td["chunks"]
+            tier.head_ts = td["head_ts"]
+            tier.head_val = td["head_val"]
+            tier.sync_last()
+            tier.evict(now)
+
+        fill(s.fine, d["fine"])
+        for ds, dd in zip(s.down, d["down"]):
+            fill(ds.tier, dd["tier"])
+            ds.bucket = dd["bucket"]
+            ds.bsum = dd["bsum"]
+            ds.bn = dd["bn"]
+        s.version += 1
+
+    def _restore_json(self, raw: bytes) -> bool:
+        try:
+            state = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
             self.last_error = str(e)
             return False
         if not isinstance(state, dict) or state.get("version") != HISTORY_SNAPSHOT_VERSION:
             return False
         saved_at = state.get("saved_at")
         now = time.time()
-        # A snapshot older than the ring's long window holds nothing
-        # servable — the cutoff tracks the configured window, not a
-        # fixed day, so a 72 h ring keeps a 30 h-old snapshot.
-        if (
-            not isinstance(saved_at, (int, float))
-            or now - saved_at > self.ring.long_window_s
-        ):
+        if not isinstance(saved_at, (int, float)) or self._stale(saved_at, now):
             return False
         try:
             self.ring.load_points(
@@ -452,9 +672,12 @@ class HistorySnapshotter:
     def to_json(self) -> dict:
         return {
             "path": self.path,
+            "format": self.format,
             "interval_s": self.interval_s,
             "last_save_ts": self.last_save_ts,
             "last_error": self.last_error,
+            "saves": self.saves,
+            "skipped_unchanged": self.skipped_unchanged,
         }
 
     # ---------------------------- lifecycle ----------------------------
@@ -511,12 +734,22 @@ class HistoryService:
             return self.step_s
         return max(self.step_s, round(window_s / 60.0))
 
+    @staticmethod
+    def _matches(name: str, series: str | None) -> bool:
+        """``?series=`` glob filter (fnmatch: * ? [..]); None => all.
+        Matched against the full internal series name — fleet series
+        ("cpu", "mxu") and per-chip ("chip.<id>.<metric>") alike, so
+        ``series=chip.*`` selects the drill-down curves only."""
+        return series is None or fnmatch.fnmatchcase(name, series)
+
     async def _prom_series(
-        self, window_s: float, step_s: float
+        self, window_s: float, step_s: float, series: str | None = None
     ) -> dict[str, dict] | None:
         if self.prom is None:
             return None
-        names = list(PROM_QUERIES)
+        names = [n for n in PROM_QUERIES if self._matches(n, series)]
+        if not names:
+            return None
         results = await asyncio.gather(
             *(
                 self.prom.query_range(PROM_QUERIES[n], window_s, step_s)
@@ -537,48 +770,64 @@ class HistoryService:
         self.last_prom_ok = any_ok
         return out if any_ok else None
 
-    def snapshot_ring(self, window_s: float | None = None) -> dict:
+    def snapshot_ring(
+        self, window_s: float | None = None, series: str | None = None
+    ) -> dict:
         """Ring-only /api/history payload, synchronously — the fast
         path the server's epoch render cache serves when no Prometheus
         is configured (the payload is then a pure function of the ring,
-        so repeated same-tick requests reuse the serialized bytes)."""
+        so repeated same-tick requests reuse the serialized bytes).
+        ``series`` (a glob) restricts to matching series — the per-chip
+        drill-down fetch at 256 chips asks for ``chip.<id>.*`` instead
+        of the whole fleet payload."""
         window = self.clamp_window(window_s) if window_s else self.window_s
         step = self.step_for(window)
         out: dict = {"source": "ring", "window_s": window, "step_s": step}
+        if series is not None:
+            out["series"] = series
         for name in PROM_QUERIES:
-            out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        self._add_per_chip(out, step, window)
+            if self._matches(name, series):
+                out[name] = self.ring.snapshot_series(name, step, window_s=window)
+        self._add_per_chip(out, step, window, series)
         return out
 
-    def _add_per_chip(self, out: dict, step: float, window: float) -> None:
+    def _add_per_chip(
+        self, out: dict, step: float, window: float, series: str | None = None
+    ) -> None:
         # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
         # drill-down charts; Prometheus equivalents are labelled series the
         # client can also get via its own PromQL if deployed.
         per_chip: dict[str, dict] = {}
         for name in self.ring.series:
-            if name.startswith("chip."):
+            if name.startswith("chip.") and self._matches(name, series):
                 per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
                     name, step, window_s=window
                 )
         if per_chip:
             out["per_chip"] = per_chip
 
-    async def snapshot(self, window_s: float | None = None) -> dict:
+    async def snapshot(
+        self, window_s: float | None = None, series: str | None = None
+    ) -> dict:
         if self.prom is None:
-            return self.snapshot_ring(window_s=window_s)
+            return self.snapshot_ring(window_s=window_s, series=series)
         window = self.clamp_window(window_s) if window_s else self.window_s
         step = self.step_for(window)
-        prom = await self._prom_series(window, step)
+        prom = await self._prom_series(window, step, series)
         out: dict = {
             "source": "prometheus" if prom else "ring",
             "window_s": window,
             "step_s": step,
         }
+        if series is not None:
+            out["series"] = series
         # Per-series fallback: Prometheus result wins, ring fills gaps.
         for name in PROM_QUERIES:
+            if not self._matches(name, series):
+                continue
             if prom and name in prom:
                 out[name] = prom[name]
             else:
                 out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        self._add_per_chip(out, step, window)
+        self._add_per_chip(out, step, window, series)
         return out

@@ -149,6 +149,10 @@ class Sampler:
         # in a worker thread cancellation can't interrupt) is refused new
         # polls, so it holds at most one shared-executor thread.
         self._orphans: dict[str, asyncio.Task] = {}
+        # Per-chip history bookkeeping (--history-per-chip): which chips
+        # hold chip.<id>.* ring series, and which the cap refused.
+        self._perchip_tracked: set[str] = set()
+        self._perchip_skipped: set[str] = set()
         self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
         self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
         # Host NIC rates — the DCN-traffic proxy (SURVEY §5.8: ICI
@@ -227,6 +231,16 @@ class Sampler:
             "uptime_s": round(time.time() - self.started_at, 1),
             "snapshot": self.clock.to_json(),
             "events": self.journal.to_json(),
+            # Columnar history store health (tpumon.tsdb): series/point
+            # counts, resident bytes, and the per-chip cap's effect.
+            "history": {
+                "series": len(self.history.series),
+                "points": self.history.count_points(),
+                "resident_bytes": self.history.resident_bytes(),
+                "per_chip_cap": self.cfg.history_per_chip,
+                "per_chip_tracked": len(self._perchip_tracked),
+                "per_chip_skipped": len(self._perchip_skipped),
+            },
             **(
                 {"anomaly": self.anomaly.to_json()}
                 if self.anomaly is not None and self.anomaly.detectors
@@ -438,13 +452,7 @@ class Sampler:
             ]
             if throttle:
                 rec("throttle_max", max(throttle), ts)
-            for c in chips:
-                rec(f"chip.{c.chip_id}.mxu", c.mxu_duty_pct, ts)
-                rec(f"chip.{c.chip_id}.hbm", c.hbm_pct, ts)
-                # SDK health score (x10 so the drill-down shares the
-                # 0-100% chart scale: 70 = score 7).
-                if c.ici_link_health is not None:
-                    rec(f"chip.{c.chip_id}.link", c.ici_link_health * 10, ts)
+            self._record_per_chip(chips, ts)
         serving = self.serving_data()
 
         def mean(vals):
@@ -463,6 +471,34 @@ class Sampler:
             vals = [s[key] for s in serving if s.get(key) is not None]
             if vals:
                 rec(name, agg(vals), ts)
+
+    def _record_per_chip(self, chips: list[ChipSample], ts: float) -> None:
+        """Per-chip drill-down series (chip.<id>.{mxu,hbm,temp,link}),
+        bounded: at most ``history_per_chip`` chips get series (first
+        seen wins — stable across ticks), the rest are counted so the
+        cap is visible in /api/health instead of silently eating data.
+        The columnar store (tpumon.tsdb) is what makes this affordable
+        at v5p-256: 1024 series cost ~KB-scale resident bytes per
+        series, not deque-of-tuples megabytes."""
+        cap = self.cfg.history_per_chip
+        if cap <= 0:
+            return
+        rec = self.history.record
+        tracked = self._perchip_tracked
+        for c in chips:
+            cid = c.chip_id
+            if cid not in tracked:
+                if len(tracked) >= cap:
+                    self._perchip_skipped.add(cid)
+                    continue
+                tracked.add(cid)
+            rec(f"chip.{cid}.mxu", c.mxu_duty_pct, ts)
+            rec(f"chip.{cid}.hbm", c.hbm_pct, ts)
+            rec(f"chip.{cid}.temp", c.temp_c, ts)
+            # SDK health score (x10 so the drill-down shares the
+            # 0-100% chart scale: 70 = score 7).
+            if c.ici_link_health is not None:
+                rec(f"chip.{cid}.link", c.ici_link_health * 10, ts)
 
     def source_health(self) -> list[dict]:
         """Per-source pipeline health for the ``source-down`` alert rule

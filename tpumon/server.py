@@ -14,7 +14,10 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/gpu/metrics      reference-shaped compat view over the same chips
   /api/k8s/pods         pod table
   /api/history          curves (Prometheus or ring buffer); ?window=30m|3h|24h
-                        selects the span (coarse ring tier beyond 30 min)
+                        selects the span (mid/coarse ring tiers beyond
+                        30 min); ?series=<glob> restricts to matching
+                        series (e.g. series=chip.* for the per-chip
+                        drill-down curves at 256 chips)
   /api/alerts           last alert evaluation (sampler-owned, not
                         recomputed per request — fixes SURVEY §5.2),
                         + silenced list and active silences
@@ -153,6 +156,10 @@ class MonitorServer:
         # the tpumon_profile_* metrics read its status before any
         # capture has been requested. Captures are journal events.
         self._profiler = ProfilerService(journal=sampler.journal)
+        # Crash-safe history snapshotter (tpumon.history), attached by
+        # app.run when --history-snapshot is configured so /api/health
+        # can report save/skip counters and the active format.
+        self.snapshotter = None
         # Epoch-keyed render caches (tpumon.snapshot): requests between
         # sampler ticks are served pre-serialized bytes; the version
         # doubles as a strong ETag for 304s. The exporter cache reuses
@@ -488,6 +495,13 @@ class MonitorServer:
             # absorbed (tpumon.snapshot; pinned by tests/test_fastpath).
             "render_cache": self.cache.to_json(),
             "exporter_cache": self.exporter_cache.to_json(),
+            # Crash-safe history snapshot state incl. the idle-skip
+            # counter (saves skipped because nothing was recorded).
+            **(
+                {"history_snapshot": self.snapshotter.to_json()}
+                if self.snapshotter is not None
+                else {}
+            ),
         }
 
     async def _api_profile(self, query: str) -> dict:
@@ -713,6 +727,13 @@ class MonitorServer:
                 window_s = parse_duration(params["window"], default=-1.0)
                 if window_s <= 0:
                     raise HttpError(400, f"bad window {params['window']!r}")
+            series = params.get("series")
+            if series is not None:
+                series = urllib.parse.unquote(series)
+                if not series or len(series) > 120 or not all(
+                    ch.isalnum() or ch in "._*?[]-/:" for ch in series
+                ):
+                    raise HttpError(400, f"bad series glob {series!r}")
             if self.history.prom is None:
                 # Ring-only mode: the payload is a pure function of the
                 # ring's contents, which only grow when a tick records
@@ -729,15 +750,15 @@ class MonitorServer:
                     step = self.history.step_for(w)
                     wq = max(60.0, round(w / step) * step)
                 return self._etagged(
-                    f"/api/history?w={wq or ''}",
+                    f"/api/history?w={wq or ''}&s={series or ''}",
                     ("samples",),
                     lambda: json.dumps(
-                        self.history.snapshot_ring(window_s=wq)
+                        self.history.snapshot_ring(window_s=wq, series=series)
                     ).encode(),
                     if_none_match,
                     evictable=True,
                 )
-            payload = await self.history.snapshot(window_s=window_s)
+            payload = await self.history.snapshot(window_s=window_s, series=series)
         elif path == "/api/health":
             payload = self._api_health()
         elif path == "/api/trace/export":

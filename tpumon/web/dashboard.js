@@ -436,7 +436,9 @@ function makeDashboard(doc, net, env, mkSurface) {
     if (!h || win !== histWindow) return;
     lastHistory = h;
     // Keep an open chip drill-down live (its empty state promises that
-    // samples accumulate — so re-render it as they do).
+    // samples accumulate — so re-render it as they do). A fresh fleet
+    // payload re-arms the per-chip series= fallback fetch too.
+    chipSeriesFetched = null;
     if (currentChipId !== null) openChipModal(currentChipId);
     charts.cpu.update(h.cpu?.labels, [h.cpu?.data]);
     charts.mem.update(h.memory?.labels, [h.memory?.data]);
@@ -471,10 +473,13 @@ function makeDashboard(doc, net, env, mkSurface) {
   }
 
   /* ------------------------ per-chip drill-down ------------------------ */
-  /* The server records chip.<id>.mxu/.hbm/.link ring series and ships
-     them as /api/history per_chip — the reference collected per-device
-     history it never drew (SURVEY §2.1 gpuTemp); here every chip is
-     clickable. */
+  /* The server records chip.<id>.mxu/.hbm/.temp/.link ring series and
+     ships them as /api/history per_chip — the reference collected
+     per-device history it never drew (SURVEY §2.1 gpuTemp); here every
+     chip is clickable. When the fleet payload doesn't carry this
+     chip's curves yet, fetch just them via the series= glob (cheap and
+     epoch-cached server-side — the 256-chip path). */
+  let chipSeriesFetched = null;  // chip a filtered fetch already ran for
   function openChipModal(chipId) {
     currentChipId = chipId;
     $("chip-modal-title").textContent = chipId;
@@ -492,6 +497,22 @@ function makeDashboard(doc, net, env, mkSurface) {
     $("c-chip").style.display = has ? "" : "none";
     chipChart.update((mxu?.labels?.length ? mxu.labels : hbm?.labels) || [],
                      [mxu?.data, hbm?.data, link?.data]);
+    if (!has && chipSeriesFetched !== chipId) {
+      chipSeriesFetched = chipId;  // once per chip until history refreshes
+      const win = histWindow;  // a stale-window response must not merge
+      net.getJson("/api/history?window=" + win +
+                  "&series=chip." + chipId + ".*", h => {
+        if (!h || !h.per_chip || currentChipId !== chipId ||
+            win !== histWindow) return;
+        if (!lastHistory) lastHistory = h;
+        else {
+          if (!lastHistory.per_chip) lastHistory.per_chip = {};
+          for (const k of Object.keys(h.per_chip))
+            lastHistory.per_chip[k] = h.per_chip[k];
+        }
+        openChipModal(chipId);
+      });
+    }
   }
   function closeChipModal() {
     currentChipId = null;
