@@ -836,16 +836,27 @@ def _bench_history() -> dict:
 
     base = 1_700_000_000.0
 
-    # Record hot path: batched appends through record() (dict lookup +
-    # columnar append + downsample accumulators + retention).
+    # Record hot path: the batch ingest spine (record_series — one
+    # quantize pass + columnar extend + per-batch downsample, native
+    # kernel when built; docs/perf.md "ingest spine"). The per-point
+    # record() shim is measured alongside into the full results.
     ring = RingHistory()
     batch, per_point_us, ts = 200, [], base
     for _ in range(60):
+        ts_col = [ts + i for i in range(batch)]
+        val_col = [50.0 + (i % 40) * 0.5 for i in range(batch)]
+        ts += batch
+        t0 = time.perf_counter()
+        ring.record_series("cpu", ts_col, val_col)
+        per_point_us.append((time.perf_counter() - t0) / batch * 1e6)
+    shim = RingHistory()
+    point_us, ts2 = [], base
+    for _ in range(20):
         t0 = time.perf_counter()
         for i in range(batch):
-            ring.record("cpu", 50.0 + (i % 40) * 0.5, ts=ts)
-            ts += 1.0
-        per_point_us.append((time.perf_counter() - t0) / batch * 1e6)
+            shim.record("cpu", 50.0 + (i % 40) * 0.5, ts=ts2)
+            ts2 += 1.0
+        point_us.append((time.perf_counter() - t0) / batch * 1e6)
 
     # Fleet-shaped ring: every /api/history series at 1 Hz for 30 min.
     fleet = RingHistory()
@@ -892,22 +903,36 @@ def _bench_history() -> dict:
         snap_bytes = os.path.getsize(bpath)
         snap_json_bytes = os.path.getsize(jpath)
 
-    # Per-chip recording at v5p-256: 256 chips × 4 metrics per tick.
+    # Per-chip recording at v5p-256: 256 chips × 4 metrics per tick,
+    # through the sampler-shaped path — cached handles + ONE
+    # record_batch per tick (the accum_many kernel call).
     pc = RingHistory()
     chip_ids = [f"host-{h}/chip-{c}" for h in range(64) for c in range(4)]
+    handles = [
+        (
+            pc.handle(f"chip.{cid}.mxu"),
+            pc.handle(f"chip.{cid}.hbm"),
+            pc.handle(f"chip.{cid}.temp"),
+            pc.handle(f"chip.{cid}.link"),
+        )
+        for cid in chip_ids
+    ]
     pc_us = []
     for tick in range(30):
         tsx = base + tick
+        pairs = []
+        for hs in handles:
+            pairs.append((hs[0], 50.0 + tick))
+            pairs.append((hs[1], 60.0))
+            pairs.append((hs[2], 40.5))
+            pairs.append((hs[3], 0.0))
         t0 = time.perf_counter()
-        for cid in chip_ids:
-            pc.record(f"chip.{cid}.mxu", 50.0 + tick, ts=tsx)
-            pc.record(f"chip.{cid}.hbm", 60.0, ts=tsx)
-            pc.record(f"chip.{cid}.temp", 40.5, ts=tsx)
-            pc.record(f"chip.{cid}.link", 0.0, ts=tsx)
+        pc.record_batch(pairs, ts=tsx)
         pc_us.append((time.perf_counter() - t0) / (len(chip_ids) * 4) * 1e6)
 
     return {
         "history_record_p50_us": round(_p50(per_point_us), 3),
+        "history_record_point_p50_us": round(_p50(point_us), 3),
         "history_query_30m_p50_ms": round(_p50(q_ms), 3),
         "history_resident_bytes_per_point": round(col_bpp, 2),
         "history_deque_bytes_per_point": round(deque_bpp, 2),
@@ -919,6 +944,104 @@ def _bench_history() -> dict:
         "history_restore_ms": round(_p50(rd_ms), 3),
         "history_perchip_256_record_p50_us": round(_p50(pc_us), 3),
         "history_perchip_256_series": len(pc.series),
+    }
+
+
+def _bench_ingest_sync() -> dict:
+    """Ingest spine (docs/perf.md): single-series batch append p50
+    (µs/point, kernel vs forced-Python fallback) and the binary peer
+    wire codec vs JSON at 256 chips (decode µs + encoded bytes)."""
+    import json as _json
+
+    from tpumon import tsdb
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.history import RingHistory
+    from tpumon.protowire import decode_wire_frame, encode_wire_frame
+    from tpumon.topology import chips_from_columns, chips_from_wire, chips_to_wire
+
+    base = 1_700_000_000.0
+    batch = 256
+
+    def batch_us(iters: int = 60) -> float:
+        ring = RingHistory()
+        ts, out = base, []
+        for _ in range(iters):
+            ts_col = [ts + i for i in range(batch)]
+            val_col = [50.0 + (i % 64) * 0.4 for i in range(batch)]
+            ts += batch
+            t0 = time.perf_counter()
+            ring.record_series("mxu", ts_col, val_col)
+            out.append((time.perf_counter() - t0) / batch * 1e6)
+        return _p50(out)
+
+    kern_us = batch_us()
+    kernel_active = tsdb.kernel() is not None
+    tsdb.set_kernel_enabled(False)
+    try:
+        py_us = batch_us()
+    finally:
+        tsdb.set_kernel_enabled(True)
+
+    # Peer wire: binary frame vs JSON for a 256-chip snapshot — decode
+    # to columns/payload, decode all the way to ChipSamples, and bytes.
+    chips = FakeTpuCollector(topology="v5p-256").chips()
+    w = chips_to_wire(chips)
+    blob = encode_wire_frame(w["v"], w["fields"], w["rows"])
+    jblob = _json.dumps(w).encode()
+
+    def best_us(fn, iters: int = 30, rounds: int = 4) -> float:
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best * 1e6
+
+    bin_us = best_us(lambda: decode_wire_frame(blob))
+    json_us = best_us(lambda: _json.loads(jblob))
+    bin_chips_us = best_us(
+        lambda: chips_from_columns(*decode_wire_frame(blob)[1:])
+    )
+    json_chips_us = best_us(lambda: chips_from_wire(_json.loads(jblob)))
+    assert chips_from_columns(*decode_wire_frame(blob)[1:]) == chips
+
+    return {
+        "ingest_batch_p50_us": round(kern_us, 3),
+        "ingest_batch_py_p50_us": round(py_us, 3),
+        "ingest_kernel_active": kernel_active,
+        "wire_binary_decode_p50_us": round(bin_us, 1),
+        "wire_json_decode_p50_us": round(json_us, 1),
+        "wire_binary_chips_p50_us": round(bin_chips_us, 1),
+        "wire_json_chips_p50_us": round(json_chips_us, 1),
+        "wire_binary_bytes": len(blob),
+        "wire_json_bytes": len(jblob),
+    }
+
+
+async def _bench_ingest_tick(iters: int = 40, warmup: int = 8) -> dict:
+    """The tick-shaped ingest number: a live sampler on fake v5p-256
+    with --history-per-chip 256 (1024 per-chip series + fleet series,
+    one record_batch per tick). Reports the tick's history-stage p50
+    (the ingest spine's share — what this phase exists to pin) plus the
+    full tick p50 for context."""
+    sampler, server, fetch = await _serve_bench_app(
+        "fake:v5p-256", TPUMON_HISTORY_PER_CHIP="256"
+    )
+    try:
+        for _ in range(warmup):
+            await sampler.tick_fast()
+        for _ in range(iters):
+            await sampler.tick_fast()
+        stages = sampler.tracer.to_json().get("stages", {})
+        hist = stages.get("history", {})
+        tick = stages.get("tick_fast", {})
+    finally:
+        await server.stop()
+    return {
+        "ingest_tick_256_p50_ms": hist.get("p50_ms"),
+        "ingest_tick_256_full_p50_ms": tick.get("p50_ms"),
+        "ingest_tick_256_series": len(sampler.history.series),
     }
 
 
@@ -1043,6 +1166,13 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "history_restore_ms",
                       "history_perchip_256_record_p50_us",
                       "history_perchip_256_series")),
+    "ingest": (300, ("ingest_batch_p50_us", "ingest_batch_py_p50_us",
+                     "ingest_kernel_active",
+                     "ingest_tick_256_p50_ms", "ingest_tick_256_full_p50_ms",
+                     "ingest_tick_256_series",
+                     "wire_binary_decode_p50_us", "wire_json_decode_p50_us",
+                     "wire_binary_chips_p50_us", "wire_json_chips_p50_us",
+                     "wire_binary_bytes", "wire_json_bytes")),
     "federation": (240, ("federation_chips",
                          "federation_scrape_to_render_p50_ms",
                          "federation_exporter_render_ms",
@@ -1099,11 +1229,12 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # scrape (driver metric contract: metric/value/unit/vs_baseline)
     "metric", "value", "unit", "vs_baseline",
     "sampler_samples_per_sec", "accel_backend",
-    # fastpath (64 vs 256-chip cached render + delta SSE, docs/perf.md)
+    # fastpath (64 vs 256-chip cached render + delta SSE, docs/perf.md;
+    # the cold exporter render and keyframe bytes live in full results —
+    # the cached render and steady-state delta are the numbers of record)
     "fastpath_64_scrape_to_render_p50_ms",
     "fastpath_256_scrape_to_render_p50_ms",
-    "exporter_render_256_ms", "exporter_cached_render_256_ms",
-    "sse_keyframe_bytes_256", "sse_delta_bytes_256",
+    "exporter_cached_render_256_ms", "sse_delta_bytes_256",
     # observability (self-trace overhead at v5p-64, docs/observability.md)
     "trace_overhead_tick_pct", "trace_overhead_scrape_pct",
     # events (journal append + EWMA detector overhead, docs/events.md)
@@ -1114,7 +1245,12 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "history_record_p50_us", "history_query_30m_p50_ms",
     "history_resident_bytes_per_point",
     "history_snapshot_write_ms", "history_restore_ms",
-    "history_perchip_256_record_p50_us",
+    # ingest spine (batch append + native kernel + binary peer wire,
+    # docs/perf.md; py-fallback, bytes comparisons and the per-chip
+    # micro-record number — superseded by ingest_tick_256_p50_ms, the
+    # live-sampler version of the same story — live in full results)
+    "ingest_batch_p50_us", "ingest_tick_256_p50_ms",
+    "wire_binary_decode_p50_us",
     # federation
     "federation_chips", "federation_scrape_to_render_p50_ms",
     "federation_256_scrape_to_render_p50_ms",
@@ -1174,6 +1310,13 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(_bench_events())
     if name == "history":
         return _bench_history()
+    if name == "ingest":
+        async def both_ingest():
+            out = _bench_ingest_sync()
+            out.update(await _bench_ingest_tick())
+            return out
+
+        return asyncio.run(both_ingest())
     if name == "federation":
         async def both_scales():
             # 64 chips (8×v5e-8, the BENCH_r05-comparable shape) and

@@ -186,6 +186,111 @@ def test_federation_ici_rates_for_peer_chips():
     asyncio.run(scenario())
 
 
+def test_wire_binary_frame_roundtrip_exact():
+    """The columnar binary frame round-trips chips_to_wire data exactly
+    — values AND types (ints stay ints, floats floats, None None) —
+    including int64 extremes, null-heavy columns and variable coords."""
+    import json
+
+    from tpumon.protowire import decode_wire_frame, encode_wire_frame
+    from tpumon.topology import chips_from_columns, chips_to_wire
+
+    chips = [
+        ChipSample(
+            chip_id=f"h{i // 4}/chip-{i % 4}", host=f"h{i // 4}",
+            slice_id="s0", index=i % 4, kind="v5p",
+            coords=(i % 4, i // 4, 0) if i != 7 else (),
+            mxu_duty_pct=None if i % 3 == 0 else 12.5 + i,
+            hbm_used=2**50 + i, hbm_total=2**53,
+            temp_c=None,
+            ici_tx_bytes=2**63 - 1 - i, ici_rx_bytes=i,
+            ici_link_up=(None, True, False)[i % 3],
+            ici_link_health=i % 11, throttle_score=None,
+            counter_source="fake" if i % 2 else None,
+        )
+        for i in range(12)
+    ]
+    w = chips_to_wire(chips)
+    blob = encode_wire_frame(w["v"], w["fields"], w["rows"])
+    v, fields, cols = decode_wire_frame(blob)
+    assert v == w["v"] and fields == w["fields"]
+    back = chips_from_columns(fields, cols)
+    assert back == chips
+    for a, b in zip(back, chips):
+        for f in w["fields"]:
+            va, vb = getattr(a, f), getattr(b, f)
+            assert type(va) is type(vb), (f, va, vb)
+    # And it really is a different (smaller) representation than JSON.
+    assert len(blob) < len(json.dumps(w).encode())
+    # Corruption fails loudly at every truncation point.
+    import pytest
+
+    for cut in range(0, len(blob), 9):
+        with pytest.raises(ValueError):
+            decode_wire_frame(blob[:cut])
+
+
+def test_wire_binary_negotiated_by_accept():
+    """/api/accel/wire serves the binary frame ONLY to clients that ask
+    for it (Accept: application/x-tpumon-wire); plain requests keep
+    getting JSON, and both representations carry the same chips with
+    their own strong ETags."""
+    import json
+
+    from tpumon.protowire import WIRE_FRAME_CTYPE, WIRE_FRAME_MAGIC, decode_wire_frame
+    from tpumon.topology import chips_from_columns, chips_from_wire
+
+    sampler, server = serve({"TPUMON_ACCEL_BACKEND": "fake:v5e-4"})
+
+    async def scenario():
+        await sampler.tick_all()
+        st, ct, body, headers = await server.handle_ex(
+            "GET", "/api/accel/wire", accept=WIRE_FRAME_CTYPE
+        )
+        assert st == 200 and ct == WIRE_FRAME_CTYPE
+        assert body[: len(WIRE_FRAME_MAGIC)] == WIRE_FRAME_MAGIC
+        bin_chips = chips_from_columns(*decode_wire_frame(body)[1:])
+        st2, ct2, jbody, jheaders = await server.handle_ex("GET", "/api/accel/wire")
+        assert st2 == 200 and ct2 == "application/json"
+        assert chips_from_wire(json.loads(jbody)) == bin_chips
+        assert headers["ETag"] != jheaders["ETag"]  # per-representation
+        # Conditional revalidation works on the binary representation.
+        st3, _, body3, _ = await server.handle_ex(
+            "GET", "/api/accel/wire", accept=WIRE_FRAME_CTYPE,
+            if_none_match=headers["ETag"],
+        )
+        assert st3 == 304 and body3 == b""
+
+    asyncio.run(scenario())
+
+
+def test_wire_binary_off_falls_back_to_json():
+    """A JSON-only peer (wire_binary off — the pre-binary server
+    behavior) still federates: the fetcher sniffs the response body and
+    parses JSON when the Accept request was ignored."""
+    sampler_a, server_a = serve(
+        {"TPUMON_ACCEL_BACKEND": "fake:v5e-4", "TPUMON_WIRE_BINARY": "0"}
+    )
+
+    async def scenario():
+        await sampler_a.tick_all()
+        await server_a.start()
+        fed = PeerFederatedCollector(
+            local=None, peers=(f"127.0.0.1:{server_a.port}",)
+        )
+        assert fed.wire_binary  # asks for binary...
+        s = await fed.collect()
+        assert s.ok and len(s.data) == 4  # ...and JSON still federates
+        # 304 reuse still applies across the fallback.
+        st = fed._state()
+        first = st["chips"][fed.peers[0]]
+        s2 = await fed.collect()
+        assert s2.ok and st["chips"][fed.peers[0]] is first
+        await server_a.stop()
+
+    asyncio.run(scenario())
+
+
 def test_fake_backend_host_prefix_spec():
     """fake:<topo>@<prefix> disambiguates chip ids for federated fakes."""
     from tpumon.collectors.accel import make_accel_collector

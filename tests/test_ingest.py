@@ -1,0 +1,361 @@
+"""Ingest spine (docs/perf.md): batch append into the columnar TSDB,
+native kernel vs pure-Python parity, and the sampler's batched per-chip
+recording.
+
+The load-bearing guarantee: the C kernel (tpumon/native/tsdbkern.cpp)
+and the pure-Python fallback produce BIT-EXACT state — same head column
+bytes, same sealed chunk bytes, same downsample accumulators — so a
+deployment without the .so differs only in speed. The golden test
+drives both over the checked-in fuzz corpus (tests/fixtures/
+tsdb_fuzz.json) and compares raw bytes.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+
+import pytest
+
+from tpumon import native, tsdb
+from tpumon.history import RingHistory, RingSeries
+
+FUZZ = os.path.join(os.path.dirname(__file__), "fixtures", "tsdb_fuzz.json")
+
+kernel_available = pytest.mark.skipif(
+    shutil.which("g++") is None and native.load_tsdb(auto_build=False) is None,
+    reason="no g++ and no prebuilt tsdb kernel",
+)
+
+
+@pytest.fixture
+def force_python():
+    tsdb.set_kernel_enabled(False)
+    yield
+    tsdb.set_kernel_enabled(True)
+
+
+def series_state(s: RingSeries) -> tuple:
+    """Everything observable about a series' storage, as raw bytes
+    (bsum packed so a NaN accumulator compares bit-wise, not by the
+    NaN != NaN rule)."""
+    import struct
+
+    def tier_state(t: tsdb.Tier) -> tuple:
+        return (
+            t.head_ts.tobytes(),
+            t.head_val.tobytes(),
+            tuple((c.start_ms, c.end_ms, c.count, c.data) for c in t.chunks),
+        )
+
+    return (
+        tier_state(s.fine),
+        tuple(
+            (tier_state(d.tier), d.bucket, struct.pack("<d", d.bsum), d.bn)
+            for d in s.down
+        ),
+    )
+
+
+def make_series() -> RingSeries:
+    # Small seal size so the corpus crosses many chunk boundaries; both
+    # downsample tiers active.
+    s = RingSeries(
+        window_s=3600, long_window_s=24 * 3600, coarse_step_s=60.0,
+        mid_step_s=30.0, mid_window_s=6 * 3600,
+    )
+    s.fine.seal_points = 64
+    return s
+
+
+def corpus():
+    with open(FUZZ) as f:
+        data = json.load(f)
+    for entry in data:
+        ts = [t / 1000.0 for t in entry["ts_ms"]]
+        # nan/inf ride as strings in the JSON corpus.
+        yield entry["name"], ts, [float(v) for v in entry["values"]]
+
+
+@kernel_available
+def test_kernel_python_parity_golden():
+    """C kernel and Python fallback land bit-identical state over the
+    fuzz corpus, fed in mixed batch sizes (1, 7, 64, 200)."""
+    assert native.load_tsdb(auto_build=True) is not None
+    sizes = [1, 7, 64, 200]
+    for name, ts, vals in corpus():
+        tsdb.set_kernel_enabled(True)
+        assert tsdb.kernel() is not None, "kernel failed to load"
+        a = make_series()
+        i = k = 0
+        while i < len(ts):
+            n = sizes[k % len(sizes)]
+            k += 1
+            a.add_batch(ts[i : i + n], vals[i : i + n])
+            i += n
+        tsdb.set_kernel_enabled(False)
+        try:
+            b = make_series()
+            i = k = 0
+            while i < len(ts):
+                n = sizes[k % len(sizes)]
+                k += 1
+                b.add_batch(ts[i : i + n], vals[i : i + n])
+                i += n
+        finally:
+            tsdb.set_kernel_enabled(True)
+        assert series_state(a) == series_state(b), f"divergence in {name!r}"
+
+
+def test_batch_matches_per_point(force_python):
+    """One big add_batch == the same stream through add(), bit-exact:
+    same chunk boundaries (seals trigger at identical counts), same
+    accumulators. Pure-Python both sides; the golden test above pins
+    C==Python, so transitivity covers C==per-point."""
+    for name, ts, vals in corpus():
+        a = make_series()
+        a.add_batch(ts, vals)
+        b = make_series()
+        for t, v in zip(ts, vals):
+            b.add(t, v)
+        assert series_state(a) == series_state(b), f"divergence in {name!r}"
+
+
+def test_batch_matches_per_point_with_kernel():
+    """Same equivalence on whatever path this environment actually runs
+    (kernel if built): the contract is path-independent."""
+    name, ts, vals = next(corpus())
+    a = make_series()
+    a.add_batch(ts, vals)
+    b = make_series()
+    for t, v in zip(ts, vals):
+        b.add(t, v)
+    assert series_state(a) == series_state(b)
+
+
+def test_out_of_order_batch_falls_back_sorted():
+    """A batch with a backwards timestamp takes the per-point path:
+    add_batch returns False, data still lands sorted, and the tier's
+    out_of_order counter records the slow-path hits."""
+    s = make_series()
+    ts = [1000.0, 1001.0, 1000.5, 1002.0]
+    assert s.add_batch(ts, [1.0, 2.0, 3.0, 4.0]) is False
+    pts = s.fine.since(None)
+    assert [t for t, _ in pts] == sorted(t for t in ts)
+    assert s.fine.out_of_order == 1
+
+    ring = RingHistory()
+    ring.record_series("x", ts, [1.0, 2.0, 3.0, 4.0])
+    assert ring.out_of_order == 1
+    # record() counts too
+    ring.record("x", 9.0, ts=999.0)
+    assert ring.out_of_order == 2
+
+
+def test_record_batch_multi_series_and_mutations():
+    """record_batch: one point lands per series (None skipped), the
+    ring's mutation counter bumps ONCE per batch (the snapshotter's
+    dirty-skip sees ticks, not series), and each touched series'
+    version moves so the resample memo invalidates."""
+    ring = RingHistory()
+    h_a = ring.handle("a")
+    h_b = ring.handle("b")
+    m0 = ring.mutations
+    ring.record_batch([(h_a, 1.0), (h_b, 2.0), ("c", 3.0), ("d", None)], ts=1000.0)
+    assert ring.mutations == m0 + 1
+    assert set(ring.series) == {"a", "b", "c"}  # None never creates "d"
+    assert ring.handle("a") is h_a  # stable handle
+    assert [v for _, v in h_a.fine.since(None)] == [1.0]
+    assert [v for _, v in ring.series["c"].fine.since(None)] == [3.0]
+
+    # Memo correctness: a cached render must invalidate when the batch
+    # path appends (versions bump per touched series per batch).
+    ring.record_batch([(h_a, 5.0)], ts=1030.0)
+    out1 = ring.snapshot_series("a", step_s=30.0)
+    assert ring.snapshot_series("a", step_s=30.0) is out1  # memo hit
+    ring.record_batch([(h_a, 7.0)], ts=1060.0)
+    out2 = ring.snapshot_series("a", step_s=30.0)
+    assert out2 is not out1 and out2["data"][-1] == 7.0
+
+    # An all-None batch records nothing and stays clean for dirty-skip.
+    m1 = ring.mutations
+    ring.record_batch([(h_a, None), ("zz", None)], ts=1090.0)
+    assert ring.mutations == m1 and "zz" not in ring.series
+
+
+def test_record_batch_matches_record(force_python):
+    """The batched sampler shape (many series, one shared ts per tick)
+    lands the same state as per-point record() calls."""
+    names = [f"chip.c{i}.mxu" for i in range(17)] + ["cpu", "mxu"]
+    a, b = RingHistory(), RingHistory()
+    for tick in range(200):
+        ts = 1_700_000_000.0 + tick
+        pairs = [(n, (i * 7 + tick) % 100 + 0.25) for i, n in enumerate(names)]
+        a.record_batch(pairs, ts=ts)
+        for n, v in pairs:
+            b.record(n, v, ts=ts)
+    for n in names:
+        sa, sb = a.series[n], b.series[n]
+        assert sa.fine.since(None) == sb.fine.since(None), n
+        for da, db in zip(sa.down, sb.down):
+            assert (da.bucket, da.bsum, da.bn) == (db.bucket, db.bsum, db.bn)
+            assert da.tier.since(None) == db.tier.since(None), n
+
+
+@kernel_available
+def test_record_batch_kernel_matches_python():
+    """accum_many (the one-call-per-tick downsample path) is bit-exact
+    across kernel and fallback, including bucket flushes for series
+    that skip ticks."""
+    def run() -> RingHistory:
+        ring = RingHistory()
+        names = [f"s{i}" for i in range(9)]
+        for tick in range(150):
+            ts = 1_700_000_000.0 + tick
+            pairs = [
+                (n, None if (tick + i) % 5 == 0 else float(i) + tick * 0.01)
+                for i, n in enumerate(names)
+            ]
+            ring.record_batch(pairs, ts=ts)
+        return ring
+
+    tsdb.set_kernel_enabled(True)
+    assert tsdb.kernel() is not None
+    a = run()
+    tsdb.set_kernel_enabled(False)
+    try:
+        b = run()
+    finally:
+        tsdb.set_kernel_enabled(True)
+    for n in a.series:
+        assert series_state(a.series[n]) == series_state(b.series[n]), n
+
+
+def test_snapshot_roundtrip_after_batch(tmp_path):
+    """Binary history snapshots round-trip batch-written state,
+    including the slot-backed downsample accumulators."""
+    from tpumon.history import HistorySnapshotter
+
+    import time as _time
+
+    ring = RingHistory()
+    base = _time.time() - 700  # recent: restore retention must keep it
+    for tick in range(700):
+        ring.record_batch(
+            [("cpu", 50.0 + tick % 13), ("mxu", 70.0)], ts=base + tick
+        )
+    path = str(tmp_path / "hist.bin")
+    assert HistorySnapshotter(ring, path).save()
+    fresh = RingHistory()
+    assert HistorySnapshotter(fresh, path).restore()
+    for n in ("cpu", "mxu"):
+        assert fresh.series[n].fine.since(None) == ring.series[n].fine.since(None)
+        for da, db in zip(fresh.series[n].down, ring.series[n].down):
+            assert (da.bucket, da.bsum, da.bn) == (db.bucket, db.bsum, db.bn)
+    # Restore bumped the generation: stale handles must be re-resolved.
+    assert fresh.generation > 0
+
+
+def test_sampler_perchip_handles_cached_and_health():
+    """The sampler resolves per-chip series once (cached name tuples +
+    handles), reuses them every tick, and surfaces ingest-spine health
+    (kernel flag + out-of-order count)."""
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+
+    cfg = load_config(env={"TPUMON_COLLECTORS": "accel", "TPUMON_HISTORY_PER_CHIP": "8"})
+    sampler = Sampler(cfg, accel=FakeTpuCollector(topology="v5e-4"))
+
+    async def scenario():
+        await sampler.tick_fast()
+        entry = sampler._perchip_handles[sampler.chips()[0].chip_id]
+        handle0 = entry[1][0]
+        assert handle0 is not None
+        await sampler.tick_fast()
+        assert sampler._perchip_handles[sampler.chips()[0].chip_id][1][0] is handle0
+        h = sampler.health_json()["history"]
+        assert h["out_of_order_appends"] == 0
+        assert isinstance(h["ingest_kernel"], bool)
+        assert h["per_chip_tracked"] == 4
+
+    asyncio.run(scenario())
+
+
+def test_sampler_out_of_order_journals_once():
+    """A backwards clock produces ONE 'history' journal event (plus the
+    running counter) — not one per tick."""
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+
+    cfg = load_config(env={"TPUMON_COLLECTORS": "accel"})
+    sampler = Sampler(cfg, accel=FakeTpuCollector(topology="v5e-4"))
+
+    async def scenario():
+        await sampler.tick_fast()  # baseline established, clean
+        t0 = 2_000_000_000.0
+        sampler._record_history(t0)
+        sampler._record_history(t0 - 60.0)  # clock jumped backwards
+        sampler._record_history(t0 - 120.0)
+        assert sampler.history.out_of_order > 0
+        events = [
+            e for e in sampler.journal.after(0, kind="history")
+            if "out-of-order" in e["msg"]
+        ]
+        assert len(events) == 1
+
+    asyncio.run(scenario())
+
+
+def test_load_points_replays_through_batch(force_python):
+    """v1-style point dumps restore through the batch path and match a
+    per-point replay (the seam-bucket rule still holds)."""
+    src = RingHistory()
+    for tick in range(500):
+        src.record("cpu", 40.0 + tick % 7, ts=1_700_000_000.0 + tick)
+    now = 1_700_000_000.0 + 500
+    dumped = src.dump_points()
+    coarse = src.dump_coarse()
+    a, b = RingHistory(), RingHistory()
+    a.load_points(dumped, coarse, now=now)
+    # Reference: the old per-point restore semantics.
+    for name, pts in coarse.items():
+        bound = min(t for t, _ in dumped[name]) if dumped.get(name) else None
+        bstart = None if bound is None else (bound // 60.0) * 60.0
+        b.restore_coarse(name, [p for p in pts if bstart is None or p[0] < bstart])
+    for name, pts in dumped.items():
+        for t, v in pts:
+            b.record(name, v, ts=t)
+    assert a.series["cpu"].fine.since(None) == b.series["cpu"].fine.since(None)
+    assert (
+        a.series["cpu"]._coarse.tier.since(None)
+        == b.series["cpu"]._coarse.tier.since(None)
+    )
+    assert a.generation == 1
+
+
+def test_evict_pacing_keeps_retention_for_windowed_reads():
+    """The batch path's paced eviction never leaks expired points into
+    windowed reads (readers pass explicit starts), and resident overhang
+    stays bounded near window/16."""
+    s = RingSeries(window_s=100.0)
+    base = 1000.0
+    ring = RingHistory(window_s=100.0, long_window_s=100.0, mid_step_s=0)
+    h = ring.handle("x")
+    for tick in range(400):
+        ring.record_batch([(h, float(tick))], ts=base + tick)
+    pts = h.fine.since(base + 400 - 100.0)
+    assert pts[0][0] >= base + 300 and pts[-1][1] == 399.0
+    # Resident data is bounded: window + seal/pacing slack, not 400s.
+    resident = h.fine.dump()
+    assert resident[0][0] >= base + 400 - 100.0 - 32.0
+
+
+@kernel_available
+def test_native_build_covers_tsdb_kernel():
+    """python -m tpumon.native build compiles BOTH shared libraries and
+    the kernel passes its ABI gate."""
+    assert native.build()
+    assert os.path.exists(native.TSDB_SO_PATH)
+    assert native.load_tsdb(auto_build=False) is not None

@@ -26,6 +26,12 @@ from tpumon.server import MonitorServer
 
 def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
     """Construct the collector/sampler/server graph for a config."""
+    from tpumon import tsdb
+
+    # Ingest-spine kernel policy is process-wide (the store's batch
+    # paths consult tpumon.tsdb.kernel()); the pure-Python fallback is
+    # bit-exact, so this is purely a performance switch.
+    tsdb.set_kernel_enabled(cfg.ingest_kernel)
     enabled = set(cfg.collectors)
     host = (
         HostCollector(cpu_count=cfg.cpu_count, disk_mounts=cfg.disk_mounts)
@@ -350,6 +356,14 @@ def main(argv: list[str] | None = None) -> int:
         elif arg == "--history-per-chip":
             # Max chips with per-chip drill-down ring series; 0 disables.
             overrides["history_per_chip"] = take_int(arg)
+        elif arg == "--wire-binary":
+            # Binary federation wire frames on /api/accel/wire
+            # (Accept-negotiated; "off" = JSON-only both ways).
+            overrides["wire_binary"] = take(arg)
+        elif arg == "--ingest-kernel":
+            # Native TSDB append/downsample kernel ("off" forces the
+            # bit-exact pure-Python ingest path).
+            overrides["ingest_kernel"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
@@ -366,6 +380,7 @@ def main(argv: list[str] | None = None) -> int:
                 "[--state FILE] [--history-snapshot FILE] "
                 "[--history-snapshot-format binary|json] "
                 "[--history-per-chip N] "
+                "[--wire-binary on|off] [--ingest-kernel on|off] "
                 "[--trace-ring N] "
                 "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"

@@ -59,6 +59,7 @@ def make_accel_collector(cfg: Config) -> Collector:
             peers=cfg.peers,
             timeout_s=cfg.peer_timeout_s,
             fanout=cfg.peer_fanout,
+            wire_binary=cfg.wire_binary,
         )
     if local is None:
         return NullAccelCollector(reason="accel backend 'none' configured")

@@ -36,7 +36,17 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from tpumon.collectors import Collector, Sample
-from tpumon.topology import ChipSample, chips_from_wire
+from tpumon.protowire import (
+    WIRE_FRAME_CTYPE,
+    WIRE_FRAME_MAGIC,
+    decode_wire_frame,
+)
+from tpumon.topology import (
+    WIRE_VERSION,
+    ChipSample,
+    chips_from_columns,
+    wire_columns,
+)
 
 
 def normalize_base_url(url: str) -> str:
@@ -77,6 +87,11 @@ class PeerFederatedCollector:
     # At most this many peer fetches (worker threads) in flight at once
     # (Config.peer_fanout).
     fanout: int = 16
+    # Ask peers for the columnar binary frame (Accept:
+    # application/x-tpumon-wire). The response is sniffed, not assumed:
+    # a pre-binary peer ignores the Accept header and answers JSON,
+    # which parses exactly as before — negotiation costs nothing.
+    wire_binary: bool = True
     last_peer_status: dict[str, str] = field(default_factory=dict)
     # Event journal (tpumon.events), wired by the sampler: peer up/down
     # and wire-fallback transitions become durable ``peer`` events.
@@ -105,7 +120,11 @@ class PeerFederatedCollector:
 
     def _fetch_peer(self, url: str) -> list[ChipSample]:
         """Blocking fetch+parse of one peer (runs on a worker thread).
-        304 returns the peer's cached parsed chips untouched."""
+        304 returns the peer's cached parsed chips untouched. Wire
+        fetches ask for the binary frame via Accept and sniff the
+        response — binary-speaking peers answer the columnar frame
+        (decoded straight to columns, zero per-chip dicts), JSON-only
+        peers answer JSON and parse exactly as before."""
         base = normalize_base_url(url)
         st = self._state()
         use_wire = st["wire"].get(url, True)
@@ -114,9 +133,11 @@ class PeerFederatedCollector:
         etag = st["etags"].get(url)
         if etag:
             req.add_header("If-None-Match", etag)
+        if use_wire and self.wire_binary:
+            req.add_header("Accept", WIRE_FRAME_CTYPE)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                payload = json.load(r)
+                body = r.read()
                 new_etag = r.headers.get("ETag")
         except urllib.error.HTTPError as e:
             if e.code == 304:
@@ -129,7 +150,13 @@ class PeerFederatedCollector:
             raise
         if use_wire:
             try:
-                chips = chips_from_wire(payload)
+                if body[: len(WIRE_FRAME_MAGIC)] == WIRE_FRAME_MAGIC:
+                    v, fields, cols = decode_wire_frame(body)
+                    if v != WIRE_VERSION:
+                        raise ValueError(f"wire version {v}")
+                    chips = chips_from_columns(fields, cols)
+                else:
+                    chips = chips_from_columns(*wire_columns(json.loads(body)))
             except ValueError:
                 # Incompatible WIRE_VERSION from a future peer: fall
                 # back to the stable dict route, like the 404 path.
@@ -137,7 +164,9 @@ class PeerFederatedCollector:
                 st["etags"].pop(url, None)
                 return self._fetch_peer(url)
         else:
-            chips = [chip_from_json(d) for d in payload.get("chips", [])]
+            chips = [
+                chip_from_json(d) for d in json.loads(body).get("chips", [])
+            ]
         if new_etag:
             st["etags"][url] = new_etag
         st["chips"][url] = chips

@@ -225,6 +225,15 @@ class Config:
     peer_fanout: int = 16
     # Per-peer HTTP timeout for federation fetches.
     peer_timeout_s: float = 3.0
+    # Binary peer wire (docs/perf.md "ingest spine"): serve and request
+    # the columnar binary frame on /api/accel/wire (negotiated by
+    # Accept header; JSON remains the default representation so
+    # pre-binary peers keep federating). Off = JSON-only, both ways.
+    wire_binary: bool = True
+    # Native TSDB append/downsample kernel (tpumon/native/tsdbkern.cpp):
+    # off forces the bit-exact pure-Python ingest path even when the
+    # shared library is built.
+    ingest_kernel: bool = True
 
     # --- SSE delta stream (tpumon.server, docs/perf.md) ---
     # The /api/stream push emits delta frames (only changed fields,
@@ -310,6 +319,8 @@ _SCALAR_FIELDS: dict[str, type] = {
     "history_per_chip": int,
     "peer_fanout": int,
     "peer_timeout_s": float,
+    "wire_binary": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "ingest_kernel": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "sse_keyframe_every": int,
     "webhook_min_severity": str,
     "webhook_timeout_s": float,

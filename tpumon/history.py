@@ -34,6 +34,7 @@ import json
 import os
 import tempfile
 import time
+from array import array
 
 from tpumon import tsdb
 from tpumon.collectors.prometheus import PrometheusClient
@@ -113,7 +114,7 @@ class RingSeries:
 
     __slots__ = (
         "window_s", "long_window_s", "coarse_step_s", "fine", "down",
-        "_mid", "_coarse", "version",
+        "_mid", "_coarse", "version", "slot",
     )
 
     def __init__(
@@ -123,21 +124,37 @@ class RingSeries:
         coarse_step_s: float = 60.0,
         mid_step_s: float = 0.0,  # 0 => no mid tier
         mid_window_s: float = 0.0,
+        slot_stores: tuple | None = None,  # (slot, mid store, coarse store)
     ):
         self.window_s = window_s
         self.long_window_s = long_window_s
         self.coarse_step_s = coarse_step_s
         self.fine = tsdb.Tier(window_s)
         self.down: list[tsdb.Downsample] = []  # finest -> coarsest
+        # Ring-owned series are slot-backed: their downsample
+        # accumulators live in the ring's contiguous AccumStore columns
+        # so RingHistory.record_batch updates every series' buckets in
+        # one kernel call per tick. Standalone series (slot is None)
+        # keep plain object-held accumulators.
+        self.slot = slot_stores[0] if slot_stores else None
+        mid_store = slot_stores[1] if slot_stores else None
+        coarse_store = slot_stores[2] if slot_stores else None
         self._mid = None
         if mid_step_s > 0 and mid_window_s > window_s:
-            self._mid = tsdb.Downsample(mid_step_s, mid_window_s)
+            self._mid = (
+                tsdb.SlotDownsample(mid_store, self.slot, mid_window_s)
+                if mid_store is not None
+                else tsdb.Downsample(mid_step_s, mid_window_s)
+            )
             self.down.append(self._mid)
         # The coarse tier exists even when disabled for accumulation
         # (long_window_s <= window_s): restore paths may extend it
         # directly, and merged_points must then still serve it.
-        self._coarse = tsdb.Downsample(
-            coarse_step_s, max(long_window_s, window_s)
+        coarse_window = max(long_window_s, window_s)
+        self._coarse = (
+            tsdb.SlotDownsample(coarse_store, self.slot, coarse_window)
+            if coarse_store is not None
+            else tsdb.Downsample(coarse_step_s, coarse_window)
         )
         self.down.append(self._coarse)
         self.version = 0
@@ -168,6 +185,32 @@ class RingSeries:
         if self.long_window_s > self.window_s:
             self._coarse.observe(ts, value)
         self.version += 1
+
+    def add_batch(self, ts_list, values) -> bool:
+        """Append N (ts, value) pairs in one call: one quantize pass,
+        slice-extend into the head columns, downsample accumulation per
+        batch — the per-point interpreter work of add() amortizes to
+        near zero (native kernel) or a few C-array ops (fallback).
+        Returns True on the batch path; False when the batch was out of
+        order and fell back to per-point sorted inserts (same end state,
+        O(tier) cost — callers count it)."""
+        n = len(ts_list)
+        if not n:
+            return True
+        ts_q, val_q, ordered = tsdb.quantize_batch(
+            ts_list, values, self.fine.last_ts()
+        )
+        if not ordered:
+            for t, v in zip(ts_list, values):
+                self.add(t, float(v))
+            return False
+        self.fine.append_batch(ts_q, val_q)
+        if self._mid is not None:
+            self._mid.observe_batch(ts_q, val_q)
+        if self.long_window_s > self.window_s:
+            self._coarse.observe_batch(ts_q, val_q)
+        self.version += 1
+        return True
 
     def _fine_since(self, start: float) -> list[tuple[float, float]]:
         """Fine points with ts >= start — O(log chunks + matched):
@@ -263,25 +306,164 @@ class RingHistory:
         self.mid_window_s = min(mid_window_s, self.long_window_s)
         self.series: dict[str, RingSeries] = {}
         self.mutations = 0
+        # Live-path out-of-order appends (a backwards clock): counted
+        # here (surfaced in /api/health history stats + a one-shot
+        # journal event via the sampler) — restore paths replay ordered
+        # dumps and never bump this.
+        self.out_of_order = 0
+        # Bumped whenever series OBJECTS are replaced (snapshot restore)
+        # so callers holding resolved series handles (the sampler's
+        # per-chip cache) know to re-resolve.
+        self.generation = 0
+        # Slot-backed downsample accumulator columns shared by every
+        # ring-owned series: RingHistory.record_batch updates all open
+        # buckets in one accum_many call per tick (tpumon.tsdb).
+        self._mid_enabled = mid_step_s > 0 and self.mid_window_s > window_s
+        self._mid_store = (
+            tsdb.AccumStore(mid_step_s) if self._mid_enabled else None
+        )
+        self._coarse_store = tsdb.AccumStore(coarse_step_s)
+        self._slot_series: list[RingSeries] = []
         self._memo: dict[tuple, tuple[int, dict]] = {}
 
     def _make_series(self) -> RingSeries:
-        return RingSeries(
+        if self._mid_store is not None:
+            slot = self._mid_store.add_slot()
+            assert self._coarse_store.add_slot() == slot
+        else:
+            slot = self._coarse_store.add_slot()
+        s = RingSeries(
             window_s=self.window_s,
             long_window_s=self.long_window_s,
             coarse_step_s=self.coarse_step_s,
             mid_step_s=self.mid_step_s,
             mid_window_s=self.mid_window_s,
+            slot_stores=(slot, self._mid_store, self._coarse_store),
         )
+        self._slot_series.append(s)
+        return s
 
-    def record(self, name: str, value: float | None, ts: float | None = None) -> None:
-        if value is None:
-            return
-        ts = time.time() if ts is None else ts
+    def handle(self, name: str) -> RingSeries:
+        """Resolve (creating if absent) a series once; callers on the
+        per-tick hot path keep the handle and pass it to record_batch
+        instead of paying a dict lookup per series per tick. Handles go
+        stale when ``generation`` moves (snapshot restore replaced the
+        series objects) — re-resolve then."""
         s = self.series.get(name)
         if s is None:
             s = self.series[name] = self._make_series()
+        return s
+
+    def record(self, name: str, value: float | None, ts: float | None = None) -> None:
+        """Record one point — the thin per-point shim over the batch
+        machinery (same quantization, same ordering fallback), kept for
+        callers without a batch to amortize."""
+        if value is None:
+            return
+        ts = time.time() if ts is None else ts
+        s = self.handle(name)
+        lt = s.fine.last_ts()
+        if lt is not None and tsdb.quantize_ts(ts) < lt:
+            self.out_of_order += 1
         s.add(ts, float(value))
+        self.mutations += 1
+
+    def record_batch(self, points, ts: float | None = None) -> None:
+        """Record one point for MANY series at a shared timestamp — the
+        sampler's per-tick shape (fleet aggregates + 4 series × every
+        tracked chip). ``points`` holds (name-or-handle, value) pairs;
+        None values are skipped (same contract as record()).
+
+        The hot loop touches each series only for its two head-column
+        appends and a seal check; value quantization is one vectorized
+        pass, downsample bucket accumulation is one accum_many call per
+        tier level (native kernel when built), and eviction is paced
+        (Tier.maybe_evict) instead of per point. ``mutations`` bumps
+        ONCE per batch — the snapshotter's dirty-skip sees "a tick
+        happened", not one bump per series — while each touched series'
+        ``version`` still bumps so the per-series resample memo stays
+        correct."""
+        ts = time.time() if ts is None else ts
+        tsq = tsdb.quantize_ts(ts)
+        get = self.series.get
+        fast: list[RingSeries] = []
+        vals: list[float] = []
+        slow: list[tuple[RingSeries, float]] = []
+        fast_append = fast.append
+        vals_append = vals.append
+        touched = False
+        # Single pass: the head-column appends happen inline (array('f')
+        # applies the f32 quantization itself, identically to
+        # quantize_val), values are collected raw for the one vectorized
+        # accum_many pass below. ~10 bytecodes of per-series work — the
+        # rest of the per-point cost lives in C.
+        for name, v in points:
+            if v is None:
+                continue
+            if type(name) is str:
+                # get() first: the hot path is an existing series, and
+                # handle() is only needed to create missing ones.
+                s = get(name)
+                if s is None:
+                    s = self.handle(name)
+            else:
+                s = name
+            f = s.fine
+            lt = f._last_ts
+            if (lt is None or tsq >= lt) and s.slot is not None:
+                f._last_ts = tsq
+                f.head_ts.append(tsq)
+                f.head_val.append(v)
+                if len(f.head_ts) >= f.seal_points:
+                    f.seal()
+                    f.evict(tsq)
+                else:
+                    due = f._evict_due
+                    if due is None or tsq >= due:
+                        f.evict(tsq)
+                        f._evict_due = tsq + f.window_s * 0.0625
+                s.version += 1
+                fast_append(s)
+                vals_append(v)
+                continue
+            if lt is not None and tsq < lt:
+                self.out_of_order += 1
+            slow.append((s, float(v)))
+        if fast:
+            self._accum_many(tsq, array("f", vals), fast)
+            touched = True
+        for s, v in slow:
+            s.add(ts, v)
+            touched = True
+        if touched:
+            self.mutations += 1
+
+    def _accum_many(self, tsq: float, val_q, series_list) -> None:
+        """Per-batch downsample accumulation for slot-backed series:
+        one accum_many call per tier level over the shared state
+        columns, closed buckets appended through each series' own
+        downsample tier (f32-quantized exactly like Downsample.flush)."""
+        levels: list[tuple[tsdb.AccumStore, str]] = []
+        if self._mid_store is not None:
+            levels.append((self._mid_store, "_mid"))
+        if self.long_window_s > self.window_s:
+            levels.append((self._coarse_store, "_coarse"))
+        if not levels:
+            return
+        slots = array("i", [s.slot for s in series_list])
+        by_slot = self._slot_series
+        for store, attr in levels:
+            for slot, fts, fmean in tsdb.accum_many(tsq, val_q, slots, store):
+                d = getattr(by_slot[slot], attr)
+                d.tier.append(fts, tsdb.quantize_val(fmean))
+
+    def record_series(self, name: str, ts_list, values) -> None:
+        """Record N (ts, value) pairs into ONE series in a single call
+        (RingSeries.add_batch): the bulk shape — replaying a restore,
+        ingesting a peer's backlog, the bench's ingest phase."""
+        s = self.handle(name)
+        if not s.add_batch(ts_list, values):
+            self.out_of_order += 1
         self.mutations += 1
 
     def resident_bytes(self) -> int:
@@ -343,12 +525,19 @@ class RingHistory:
         """
         now = time.time() if now is None else now
         cutoff = now - self.window_s
-        fine = [
-            (str(name), float(v), float(t))
-            for name, pts in points.items()
-            for t, v in pts
-            if float(t) >= cutoff
-        ]
+        # Per-series (ts, value) columns: the replay below feeds each
+        # series through the batch ingest path in one call instead of a
+        # record() per point — dump files are time-ordered per series,
+        # so the ordered fast path applies (and a disordered file still
+        # restores via add_batch's per-point fallback).
+        fine: dict[str, tuple[list[float], list[float]]] = {}
+        for name, pts in points.items():
+            ts_col, val_col = fine.setdefault(str(name), ([], []))
+            for t, v in pts:
+                t = float(t)
+                if t >= cutoff:
+                    ts_col.append(t)
+                    val_col.append(float(v))
         long_cutoff = now - self.long_window_s
         coarse_ok = {
             str(name): [
@@ -357,9 +546,9 @@ class RingHistory:
             for name, pts in (coarse or {}).items()
         }
         step = self.coarse_step_s
-        oldest_fine: dict[str, float] = {}
-        for name, _value, ts in fine:
-            oldest_fine[name] = min(oldest_fine.get(name, ts), ts)
+        oldest_fine = {
+            name: min(ts_col) for name, (ts_col, _) in fine.items() if ts_col
+        }
         for name, pts in coarse_ok.items():
             bound = oldest_fine.get(name)
             bucket_start = None if bound is None else (bound // step) * step
@@ -367,8 +556,12 @@ class RingHistory:
                 name,
                 [p for p in pts if bucket_start is None or p[0] < bucket_start],
             )
-        for name, value, ts in fine:
-            self.record(name, value, ts=ts)
+        for name, (ts_col, val_col) in fine.items():
+            if not ts_col:
+                continue
+            self.handle(name).add_batch(ts_col, val_col)
+            self.mutations += 1
+        self.generation += 1
 
     def snapshot_series(
         self, name: str, step_s: float, window_s: float | None = None
@@ -601,6 +794,9 @@ class HistorySnapshotter:
         if replay_fine or replay_coarse:
             ring.load_points(replay_fine, replay_coarse, now=now)
         ring.mutations += 1
+        # Series objects were replaced wholesale: handles cached by the
+        # sampler's batch path must re-resolve.
+        ring.generation += 1
         ring._memo.clear()
         if self.journal is not None:
             self.journal.record(

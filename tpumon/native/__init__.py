@@ -1,9 +1,13 @@
-"""ctypes bindings for the native host sampler (hostmon.cpp).
+"""ctypes bindings for the native layer (hostmon.cpp, tsdbkern.cpp).
 
-Optional fast path: if the shared library is present (``make -C
+Optional fast paths: if the shared libraries are present (``make -C
 tpumon/native`` or ``python -m tpumon.native build``) the host collector
-samples through it; otherwise the pure-Python reader is used. Bindings are
-ctypes over a C ABI — no pybind11 (not available in this environment).
+samples through libtpumon_host.so and the columnar TSDB's ingest spine
+(tpumon.tsdb batch append / downsample / seal) runs through
+libtpumon_tsdb.so; otherwise bit-exact pure-Python implementations are
+used — every native piece degrades independently (docs/resilience.md).
+Bindings are ctypes over a C ABI — no pybind11 (not available in this
+environment).
 """
 
 from __future__ import annotations
@@ -11,10 +15,13 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+from array import array
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SO_PATH = os.path.join(_DIR, "libtpumon_host.so")
+TSDB_SO_PATH = os.path.join(_DIR, "libtpumon_tsdb.so")
 ABI_VERSION = 1
+TSDB_ABI_VERSION = 1
 
 OK_CPU, OK_MEM, OK_DISK = 1, 2, 4
 
@@ -34,14 +41,14 @@ class HostSampleStruct(ctypes.Structure):
 
 
 def build(quiet: bool = True) -> bool:
-    """Compile the shared library in-tree; returns success."""
+    """Compile the shared libraries in-tree; returns success (both)."""
     try:
         subprocess.run(
             ["make", "-C", _DIR],
             check=True,
             capture_output=quiet,
         )
-        return os.path.exists(SO_PATH)
+        return os.path.exists(SO_PATH) and os.path.exists(TSDB_SO_PATH)
     except (subprocess.CalledProcessError, FileNotFoundError):
         return False
 
@@ -100,3 +107,143 @@ def make_reader(
 ) -> NativeHostReader | None:
     lib = load(auto_build=auto_build)
     return NativeHostReader(lib, proc_root, mount) if lib else None
+
+
+# ------------------------- TSDB ingest kernel --------------------------
+
+_PD = ctypes.POINTER(ctypes.c_double)
+_PF = ctypes.POINTER(ctypes.c_float)
+_PI32 = ctypes.POINTER(ctypes.c_int32)
+
+
+def _pd(a: array) -> _PD:
+    """array('d') -> double* (the array outlives every call here)."""
+    return ctypes.cast(a.buffer_info()[0], _PD)
+
+
+def _pf(a: array) -> _PF:
+    return ctypes.cast(a.buffer_info()[0], _PF)
+
+
+class TsdbKernel:
+    """The native append/downsample kernel (tsdbkern.cpp) behind the
+    columnar store's batch ingest path (tpumon.tsdb). Stateless: every
+    call transforms caller-owned buffers; the Python store keeps all
+    state, which is what lets the pure-Python fallback stay bit-exact
+    (tests/test_ingest.py drives both over the same fuzz corpus)."""
+
+    __slots__ = ("_lib",)
+
+    def __init__(self, lib):
+        lib.tpumon_tsdb_quantize.argtypes = [
+            ctypes.c_int64, _PD, _PD, ctypes.c_double, _PD, _PF,
+        ]
+        lib.tpumon_tsdb_quantize.restype = ctypes.c_int32
+        lib.tpumon_tsdb_accum.argtypes = [
+            ctypes.c_int64, _PD, _PF, ctypes.c_double, _PD, _PD, _PD,
+        ]
+        lib.tpumon_tsdb_accum.restype = ctypes.c_int64
+        lib.tpumon_tsdb_accum_many.argtypes = [
+            ctypes.c_int64, ctypes.c_double, _PF, _PI32, ctypes.c_double,
+            _PD, _PD, _PD, _PI32, _PD, _PD,
+        ]
+        lib.tpumon_tsdb_accum_many.restype = ctypes.c_int64
+        lib.tpumon_tsdb_seal_encode.argtypes = [
+            ctypes.c_int64, _PD, _PF, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.tpumon_tsdb_seal_encode.restype = ctypes.c_int64
+        self._lib = lib
+
+    def quantize(
+        self, ts: array, vals: array, last_ts: float | None
+    ) -> tuple[array, array, bool]:
+        """(raw f64 ts, raw f64 vals) -> (ms-quantized f64 ts, f32 vals,
+        in-order?) — tsdb.quantize_batch's kernel half."""
+        n = len(ts)
+        ts_q = array("d", bytes(8 * n))
+        val_q = array("f", bytes(4 * n))
+        ordered = self._lib.tpumon_tsdb_quantize(
+            n, _pd(ts), _pd(vals),
+            float("nan") if last_ts is None else last_ts,
+            _pd(ts_q), _pf(val_q),
+        )
+        return ts_q, val_q, bool(ordered)
+
+    def accum(
+        self, ts_q: array, val_q: array, step: float, down
+    ) -> list[tuple[float, float]]:
+        """Run a Downsample's bucket accumulation over a batch; updates
+        down.bucket/bsum/bn in place, returns closed buckets as
+        (mid_ts, raw mean) pairs."""
+        n = len(ts_q)
+        state = (ctypes.c_double * 3)(
+            float("nan") if down.bucket is None else float(down.bucket),
+            down.bsum,
+            float(down.bn),
+        )
+        flush_ts = array("d", bytes(8 * n))
+        flush_mean = array("d", bytes(8 * n))
+        nf = self._lib.tpumon_tsdb_accum(
+            n, _pd(ts_q), _pf(val_q), step, state, _pd(flush_ts), _pd(flush_mean)
+        )
+        b = state[0]
+        down.bucket = None if b != b else int(b)
+        down.bsum = state[1]
+        down.bn = int(state[2])
+        return [(flush_ts[i], flush_mean[i]) for i in range(nf)]
+
+    def accum_many(
+        self, ts_q: float, val_q: array, slots: array, store
+    ) -> list[tuple[int, float, float]]:
+        """One point per series at a shared timestamp, accumulated into
+        an AccumStore's (bucket, bsum, bn) columns; returns closed
+        buckets as (slot, mid_ts, raw mean)."""
+        n = len(slots)
+        flush_slot = array("i", bytes(4 * n))
+        flush_ts = array("d", bytes(8 * n))
+        flush_mean = array("d", bytes(8 * n))
+        nf = self._lib.tpumon_tsdb_accum_many(
+            n, ts_q, _pf(val_q),
+            ctypes.cast(slots.buffer_info()[0], _PI32), store.step_s,
+            _pd(store.bucket), _pd(store.bsum), _pd(store.bn),
+            ctypes.cast(flush_slot.buffer_info()[0], _PI32),
+            _pd(flush_ts), _pd(flush_mean),
+        )
+        return [(flush_slot[i], flush_ts[i], flush_mean[i]) for i in range(nf)]
+
+    def seal_encode(
+        self, head_ts: array, head_val: array
+    ) -> tuple[int, int, bytes]:
+        """Encode the head columns into one sealed chunk; returns
+        (first_ms, last_ms, chunk bytes) — byte-identical to
+        tsdb.encode_chunk over the same head."""
+        n = len(head_ts)
+        cap = 16 + 15 * n
+        buf = ctypes.create_string_buffer(cap)
+        first = ctypes.c_int64()
+        last = ctypes.c_int64()
+        ln = self._lib.tpumon_tsdb_seal_encode(
+            n, _pd(head_ts), _pf(head_val), buf, cap,
+            ctypes.byref(first), ctypes.byref(last),
+        )
+        if ln < 0:  # pragma: no cover - cap is sized to make this impossible
+            raise ValueError("seal encode overflow")
+        return first.value, last.value, buf.raw[:ln]
+
+
+def load_tsdb(auto_build: bool = True) -> TsdbKernel | None:
+    """Load the TSDB ingest kernel; None when unavailable (the store
+    then runs its bit-exact pure-Python path — same degrade-independently
+    contract as the host sampler above)."""
+    if not os.path.exists(TSDB_SO_PATH):
+        if not (auto_build and build()):
+            return None
+    try:
+        lib = ctypes.CDLL(TSDB_SO_PATH)
+        lib.tpumon_tsdbkern_abi_version.restype = ctypes.c_int
+        if lib.tpumon_tsdbkern_abi_version() != TSDB_ABI_VERSION:
+            return None
+        return TsdbKernel(lib)
+    except (OSError, AttributeError):
+        return None

@@ -1,12 +1,20 @@
-"""``python -m tpumon.native build`` — compile the native host sampler."""
+"""``python -m tpumon.native build`` — compile the native fast paths
+(host sampler + TSDB ingest kernel)."""
 
+import os
 import sys
 
-from tpumon.native import SO_PATH, build, load
+from tpumon.native import SO_PATH, TSDB_SO_PATH, build, load, load_tsdb
 
 if len(sys.argv) > 1 and sys.argv[1] == "build":
     ok = build(quiet=False)
-    print(f"{'built' if ok else 'FAILED to build'} {SO_PATH}")
+    for path in (SO_PATH, TSDB_SO_PATH):
+        print(f"{'built' if os.path.exists(path) else 'FAILED to build'} {path}")
     sys.exit(0 if ok else 1)
 lib = load()
 print(f"native host sampler: {'available' if lib else 'not built'} ({SO_PATH})")
+kern = load_tsdb(auto_build=False)
+print(
+    f"native tsdb ingest kernel: {'available' if kern else 'not built'} "
+    f"({TSDB_SO_PATH})"
+)

@@ -125,6 +125,324 @@ def _try_decode_submessage(raw: bytes) -> Message | None:
         return None
 
 
+# ---------------------- columnar wire frames ---------------------------
+#
+# Binary representation of the federation chip snapshot
+# (tpumon.topology.chips_to_wire's {"v", "fields", "rows"}), negotiated
+# by Accept header on /api/accel/wire (tpumon.server) — JSON stays the
+# default so pre-binary peers keep federating. Layout is COLUMNAR and
+# built for DECODE speed: homogeneous numeric columns ride as packed
+# little-endian f64/i64 blocks and string/int-list columns as
+# dictionary/fixed-stride blocks, so the decoder reads whole columns
+# through array.frombytes (C speed) instead of a value-at-a-time parse
+# — that is what lets the peer path beat json.loads while also shipping
+# ~40% fewer bytes (strings dict-coded, ints 8B instead of digit runs).
+#
+#   TPWF <u8 frame-version>
+#   varint wire-version (topology.WIRE_VERSION — the schema contract)
+#   varint ncols; per col: varint len + utf-8 name
+#   varint nrows
+#   per col: u8 ctype + payload
+#
+# Nullable numeric columns carry a presence bitmap (bit i set = row i
+# non-null) followed by the packed non-null values.
+
+WIRE_FRAME_MAGIC = b"TPWF"
+WIRE_FRAME_VERSION = 1
+WIRE_FRAME_CTYPE = "application/x-tpumon-wire"
+
+_CT_NONE = 0  # every value None; no payload
+_CT_F64 = 1  # bitmap + packed <f64 (mixed int/float rides here too)
+_CT_I64 = 2  # bitmap + packed <i64 (exact for every int64)
+_CT_VARINT = 3  # bitmap + zigzag varints (ints beyond int64)
+_CT_STR = 4  # dict: nuniq + strings, then <u16 indices (0=None)
+_CT_BOOL = 5  # per-row byte: 0=None 1=False 2=True
+_CT_INTLIST_FIXED = 6  # varint m + bitmap + packed <i32 (m per non-null row)
+_CT_INTLIST = 7  # per-row varint (0=None else m+1) + m zigzag varints
+
+_I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+
+
+def _zigzag64(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+
+
+def _unzigzag64(u: int) -> int:
+    return (u >> 1) ^ -(u & 1)
+
+
+def _null_bitmap(col: list) -> bytes:
+    bm = bytearray((len(col) + 7) // 8)
+    for i, v in enumerate(col):
+        if v is not None:
+            bm[i >> 3] |= 1 << (i & 7)
+    return bytes(bm)
+
+
+def _classify(col: list) -> int:
+    saw_float = saw_int = saw_big = False
+    intlist_m = None
+    intlist_ok = saw_list = False
+    for v in col:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return _CT_BOOL
+        if isinstance(v, int):
+            saw_int = True
+            if not _I64_MIN <= v <= _I64_MAX:
+                saw_big = True
+        elif isinstance(v, float):
+            saw_float = True
+        elif isinstance(v, str):
+            return _CT_STR
+        elif isinstance(v, (list, tuple)):
+            saw_list = True
+            if intlist_m is None:
+                intlist_m = len(v)
+                intlist_ok = True
+            if intlist_ok and (
+                len(v) != intlist_m
+                or not all(
+                    isinstance(n, int) and _I32_MIN <= n <= _I32_MAX for n in v
+                )
+            ):
+                intlist_ok = False
+        else:
+            raise ValueError(f"unencodable wire value {v!r}")
+    if saw_list:
+        return _CT_INTLIST_FIXED if intlist_ok and intlist_m else _CT_INTLIST
+    if saw_float:
+        # Mixed int/float columns ride as f64 (the ints come back
+        # float-typed — numerically equal, which is what the federation
+        # merge compares); only a mix of floats and >2**53 ints would
+        # lose precision, and no wire field produces one.
+        return _CT_F64
+    if saw_int:
+        return _CT_VARINT if saw_big else _CT_I64
+    return _CT_NONE
+
+
+def encode_wire_frame(v: int, fields: list[str], rows: list[list]) -> bytes:
+    """Serialize a chips_to_wire payload as a columnar binary frame."""
+    out = bytearray(WIRE_FRAME_MAGIC)
+    out.append(WIRE_FRAME_VERSION)
+    out += encode_varint(v)
+    out += encode_varint(len(fields))
+    for name in fields:
+        raw = name.encode("utf-8")
+        out += encode_varint(len(raw)) + raw
+    out += encode_varint(len(rows))
+    for ci in range(len(fields)):
+        col = [row[ci] for row in rows]
+        ctype = _classify(col)
+        out.append(ctype)
+        if ctype == _CT_NONE:
+            continue
+        if ctype == _CT_F64:
+            present = [float(x) for x in col if x is not None]
+            out += _null_bitmap(col)
+            out += struct.pack(f"<{len(present)}d", *present)
+        elif ctype == _CT_I64:
+            present = [x for x in col if x is not None]
+            out += _null_bitmap(col)
+            out += struct.pack(f"<{len(present)}q", *present)
+        elif ctype == _CT_VARINT:
+            out += _null_bitmap(col)
+            for x in col:
+                if x is not None:
+                    out += encode_varint(_zigzag64(x))
+        elif ctype == _CT_STR:
+            uniq: dict[str, int] = {}
+            for x in col:
+                if x is not None and x not in uniq:
+                    uniq[x] = len(uniq)
+            if len(uniq) > 0xFFFE:
+                raise ValueError("string dictionary overflow")
+            out += encode_varint(len(uniq))
+            for s in uniq:
+                raw = s.encode("utf-8")
+                out += encode_varint(len(raw)) + raw
+            out += struct.pack(
+                f"<{len(col)}H",
+                *(0 if x is None else uniq[x] + 1 for x in col),
+            )
+        elif ctype == _CT_BOOL:
+            out += bytes(0 if x is None else (2 if x else 1) for x in col)
+        elif ctype == _CT_INTLIST_FIXED:
+            flat: list[int] = []
+            m = 0
+            for x in col:
+                if x is not None:
+                    m = len(x)
+                    flat.extend(x)
+            out += encode_varint(m)
+            out += _null_bitmap(col)
+            out += struct.pack(f"<{len(flat)}i", *flat)
+        elif ctype == _CT_INTLIST:
+            for x in col:
+                if x is None:
+                    out += encode_varint(0)
+                else:
+                    out += encode_varint(len(x) + 1)
+                    for n in x:
+                        out += encode_varint(_zigzag64(int(n)))
+    return bytes(out)
+
+
+def _weave(vals, bm: bytes, nrows: int) -> list:
+    """Spread packed non-null values back over a presence bitmap."""
+    it = iter(vals)
+    return [
+        next(it) if bm[i >> 3] & (1 << (i & 7)) else None for i in range(nrows)
+    ]
+
+
+def _packed(blob: bytes, pos: int, nrows: int, fmt: str, size: int):
+    """Read a bitmap'd packed numeric column; returns (values, pos).
+    The no-nulls common case is one struct.unpack (C speed)."""
+    nbm = (nrows + 7) // 8
+    bm = blob[pos : pos + nbm]
+    if len(bm) < nbm:
+        raise ValueError("truncated null bitmap")
+    pos += nbm
+    k = sum(_POPCOUNT[b] for b in bm)
+    if pos + size * k > len(blob):
+        raise ValueError("truncated packed column")
+    vals = struct.unpack_from(f"<{k}{fmt}", blob, pos)
+    pos += size * k
+    if k == nrows:
+        return list(vals), pos
+    return _weave(vals, bm, nrows), pos
+
+
+_POPCOUNT = [bin(i).count("1") for i in range(256)]
+
+
+def decode_wire_frame(blob: bytes) -> tuple[int, list[str], list[list]]:
+    """Inverse of encode_wire_frame: (wire version, fields, per-field
+    value columns). Raises ValueError on anything malformed/truncated —
+    the peer collector treats that like an incompatible wire version and
+    falls back to JSON."""
+    if blob[: len(WIRE_FRAME_MAGIC)] != WIRE_FRAME_MAGIC:
+        raise ValueError("bad wire frame magic")
+    if len(blob) < 5:
+        raise ValueError("truncated wire frame header")
+    if blob[4] != WIRE_FRAME_VERSION:
+        raise ValueError(f"unsupported wire frame version {blob[4]}")
+    pos = 5
+    v, pos = decode_varint(blob, pos)
+    ncols, pos = decode_varint(blob, pos)
+    if ncols > 4096:
+        raise ValueError("implausible column count")
+    fields: list[str] = []
+    for _ in range(ncols):
+        ln, pos = decode_varint(blob, pos)
+        if pos + ln > len(blob):
+            raise ValueError("truncated field name")
+        fields.append(blob[pos : pos + ln].decode("utf-8"))
+        pos += ln
+    nrows, pos = decode_varint(blob, pos)
+    if nrows > 1_000_000:
+        raise ValueError("implausible row count")
+    cols: list[list] = []
+    for _ in range(ncols):
+        if pos >= len(blob):
+            raise ValueError("truncated column")
+        ctype = blob[pos]
+        pos += 1
+        if ctype == _CT_NONE:
+            cols.append([None] * nrows)
+        elif ctype == _CT_F64:
+            col, pos = _packed(blob, pos, nrows, "d", 8)
+            cols.append(col)
+        elif ctype == _CT_I64:
+            col, pos = _packed(blob, pos, nrows, "q", 8)
+            cols.append(col)
+        elif ctype == _CT_VARINT:
+            nbm = (nrows + 7) // 8
+            bm = blob[pos : pos + nbm]
+            if len(bm) < nbm:
+                raise ValueError("truncated null bitmap")
+            pos += nbm
+            col = []
+            for i in range(nrows):
+                if bm[i >> 3] & (1 << (i & 7)):
+                    u, pos = decode_varint(blob, pos)
+                    col.append(_unzigzag64(u))
+                else:
+                    col.append(None)
+            cols.append(col)
+        elif ctype == _CT_STR:
+            nuniq, pos = decode_varint(blob, pos)
+            if nuniq > 0xFFFE:
+                raise ValueError("implausible string dictionary")
+            # Index 0 = None, i+1 = uniq[i]: prepending None makes the
+            # per-row step one list index over the C-decoded u16 block.
+            uniq: list = [None]
+            for _ in range(nuniq):
+                ln, pos = decode_varint(blob, pos)
+                if pos + ln > len(blob):
+                    raise ValueError("truncated string")
+                uniq.append(blob[pos : pos + ln].decode("utf-8"))
+                pos += ln
+            if pos + 2 * nrows > len(blob):
+                raise ValueError("truncated string indices")
+            idx = struct.unpack_from(f"<{nrows}H", blob, pos)
+            pos += 2 * nrows
+            try:
+                cols.append([uniq[i] for i in idx])
+            except IndexError:
+                raise ValueError("string index out of range")
+        elif ctype == _CT_BOOL:
+            if pos + nrows > len(blob):
+                raise ValueError("truncated bool column")
+            seg = blob[pos : pos + nrows]
+            pos += nrows
+            cols.append([None if b == 0 else b == 2 for b in seg])
+        elif ctype == _CT_INTLIST_FIXED:
+            m, pos = decode_varint(blob, pos)
+            if not 0 < m <= 64:
+                raise ValueError("implausible int-list stride")
+            nbm = (nrows + 7) // 8
+            bm = blob[pos : pos + nbm]
+            if len(bm) < nbm:
+                raise ValueError("truncated null bitmap")
+            pos += nbm
+            k = sum(_POPCOUNT[b] for b in bm)
+            if pos + 4 * m * k > len(blob):
+                raise ValueError("truncated int-list column")
+            flat = struct.unpack_from(f"<{m * k}i", blob, pos)
+            pos += 4 * m * k
+            lists = [
+                list(flat[i : i + m]) for i in range(0, m * k, m)
+            ]
+            if k == nrows:
+                cols.append(lists)
+            else:
+                cols.append(_weave(lists, bm, nrows))
+        elif ctype == _CT_INTLIST:
+            # Ragged/oversized int lists — the rare fallback when
+            # _CT_INTLIST_FIXED's uniform stride doesn't hold, so plain
+            # varint calls are fine here.
+            col = []
+            for _ in range(nrows):
+                m, pos = decode_varint(blob, pos)
+                if m == 0:
+                    col.append(None)
+                else:
+                    xs = []
+                    for _ in range(m - 1):
+                        u, pos = decode_varint(blob, pos)
+                        xs.append(_unzigzag64(u))
+                    col.append(xs)
+            cols.append(col)
+        else:
+            raise ValueError(f"unknown wire column type {ctype}")
+    return v, fields, cols
+
+
 def decode_message(buf: bytes, max_depth: int = 16) -> Message:
     """Decode protobuf bytes into a Message tree.
 

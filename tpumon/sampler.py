@@ -21,6 +21,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from tpumon import tsdb
 from tpumon.alerts import AlertEngine
 from tpumon.anomaly import AnomalyBank, AnomalyConfig
 from tpumon.collectors import Collector, Sample, run_collector
@@ -153,6 +154,23 @@ class Sampler:
         # hold chip.<id>.* ring series, and which the cap refused.
         self._perchip_tracked: set[str] = set()
         self._perchip_skipped: set[str] = set()
+        # Batch-ingest handle caches (ROADMAP item 5 / docs/perf.md
+        # "ingest spine"): series are resolved ONCE — per-chip series
+        # names are formatted once per chip ever (not 4 f-strings per
+        # chip per tick) and the resolved RingSeries handles feed
+        # history.record_batch directly. Invalidated when the ring's
+        # generation moves (snapshot restore replaced series objects).
+        self._hist_gen: int | None = None
+        # chip_id -> [names tuple, [handle-or-None x4]] (handles resolve
+        # lazily per metric so a never-reporting metric never creates an
+        # empty series — same behavior as the old record(None) skip).
+        self._perchip_handles: dict[str, list] = {}
+        self._fleet_handles: dict = {}
+        # One-shot out-of-order journalling (a misbehaving clock must
+        # show up, not silently degrade append cost). No baseline
+        # needed: restore paths never bump the ring's counter, so any
+        # nonzero count is live-tick disorder.
+        self._ooo_logged = False
         self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
         self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
         # Host NIC rates — the DCN-traffic proxy (SURVEY §5.8: ICI
@@ -240,6 +258,14 @@ class Sampler:
                 "per_chip_cap": self.cfg.history_per_chip,
                 "per_chip_tracked": len(self._perchip_tracked),
                 "per_chip_skipped": len(self._perchip_skipped),
+                # Ingest spine health (docs/perf.md): whether the native
+                # append/downsample kernel is active (False = bit-exact
+                # Python fallback), and how many live appends arrived
+                # with a backwards timestamp (each one degrades that
+                # append to an O(series) sorted insert — see the
+                # one-shot "history" journal event).
+                "ingest_kernel": tsdb.kernel() is not None,
+                "out_of_order_appends": self.history.out_of_order,
             },
             **(
                 {"anomaly": self.anomaly.to_json()}
@@ -409,16 +435,42 @@ class Sampler:
                 }
         self._prev_net = (ts, rx, tx)
 
+    def _fleet_handle(self, name: str):
+        h = self._fleet_handles.get(name)
+        if h is None:
+            h = self._fleet_handles[name] = self.history.handle(name)
+        return h
+
     def _record_history(self, ts: float) -> None:
+        """Build the tick's history batch — fleet aggregates, serving
+        aggregates and per-chip drill-down series — and land it in ONE
+        record_batch call (docs/perf.md "ingest spine"): series handles
+        are cached across ticks, value quantization and downsample
+        accumulation amortize per batch, and the ring's mutation counter
+        moves once per tick (the snapshotter's dirty-skip granularity)."""
+        if self.history.generation != self._hist_gen:
+            # Snapshot restore replaced the series objects: re-resolve.
+            self._hist_gen = self.history.generation
+            self._perchip_handles.clear()
+            self._fleet_handles.clear()
+        batch: list = []
+        add = batch.append
+        handle = self._fleet_handle
         host = self.host_data()
-        rec = self.history.record
         if host:
-            rec("cpu", (host.get("cpu") or {}).get("percent"), ts)
-            rec("memory", (host.get("memory") or {}).get("percent"), ts)
-            rec("disk", (host.get("disk") or {}).get("percent"), ts)
+            # Resolve handles only for present values: a source that
+            # never reports a metric must not grow an empty series
+            # (record(None) never created one either).
+            for name, v in (
+                ("cpu", (host.get("cpu") or {}).get("percent")),
+                ("memory", (host.get("memory") or {}).get("percent")),
+                ("disk", (host.get("disk") or {}).get("percent")),
+            ):
+                if v is not None:
+                    add((handle(name), v))
             self._update_net_rates(host, ts)
             if self.net_rates:
-                rec("dcn", self.net_rates["tx_bps"], ts)
+                add((handle("dcn"), self.net_rates["tx_bps"]))
         chips = self.chips()
         self._fleet_duty = self._fleet_hbm = None
         if chips:
@@ -429,15 +481,15 @@ class Sampler:
                 # Stashed for the anomaly detectors: _anomaly_series
                 # reuses this tick's means instead of re-walking chips.
                 self._fleet_duty = sum(duty) / len(duty)
-                rec("mxu", self._fleet_duty, ts)
+                add((handle("mxu"), self._fleet_duty))
             if hbm:
                 self._fleet_hbm = sum(hbm) / len(hbm)
-                rec("hbm", self._fleet_hbm, ts)
+                add((handle("hbm"), self._fleet_hbm))
             if temp:
-                rec("temp", sum(temp) / len(temp), ts)
-            tx_total = sum(r["tx_bps"] for r in self.ici_rates.values())
+                add((handle("temp"), sum(temp) / len(temp)))
             if self.ici_rates:
-                rec("ici", tx_total, ts)
+                tx_total = sum(r["tx_bps"] for r in self.ici_rates.values())
+                add((handle("ici"), tx_total))
             # Worst-of-fleet SDK scores (0-10): a single degrading link /
             # throttling chip must show in the fleet curve, so max, not
             # mean.
@@ -446,13 +498,13 @@ class Sampler:
                 if c.ici_link_health is not None
             ]
             if health:
-                rec("ici_health_max", max(health), ts)
+                add((handle("ici_health_max"), max(health)))
             throttle = [
                 c.throttle_score for c in chips if c.throttle_score is not None
             ]
             if throttle:
-                rec("throttle_max", max(throttle), ts)
-            self._record_per_chip(chips, ts)
+                add((handle("throttle_max"), max(throttle)))
+            self._record_per_chip(chips, batch)
         serving = self.serving_data()
 
         def mean(vals):
@@ -470,21 +522,46 @@ class Sampler:
         ):
             vals = [s[key] for s in serving if s.get(key) is not None]
             if vals:
-                rec(name, agg(vals), ts)
+                add((handle(name), agg(vals)))
+        if batch:
+            self.history.record_batch(batch, ts=ts)
+        self._journal_out_of_order()
 
-    def _record_per_chip(self, chips: list[ChipSample], ts: float) -> None:
+    def _journal_out_of_order(self) -> None:
+        """One journal event the FIRST time the ring records an
+        out-of-order timestamp (restore paths never bump the counter,
+        so any nonzero count is live disorder): a backwards clock
+        degrades append to the O(series) sorted-insert path, which must
+        be an incident, not a silent slowdown. The running count stays
+        in /api/health."""
+        ooo = self.history.out_of_order
+        if ooo and not self._ooo_logged:
+            self._ooo_logged = True
+            self.journal.record(
+                "history", "minor", "history",
+                f"out-of-order history timestamps detected ({ooo} so "
+                f"far): check the host clock — appends degrade to "
+                f"sorted inserts",
+                count=ooo,
+            )
+
+    def _record_per_chip(self, chips: list[ChipSample], batch: list) -> None:
         """Per-chip drill-down series (chip.<id>.{mxu,hbm,temp,link}),
         bounded: at most ``history_per_chip`` chips get series (first
         seen wins — stable across ticks), the rest are counted so the
         cap is visible in /api/health instead of silently eating data.
-        The columnar store (tpumon.tsdb) is what makes this affordable
-        at v5p-256: 1024 series cost ~KB-scale resident bytes per
-        series, not deque-of-tuples megabytes."""
+        Series names are formatted and resolved once per chip EVER
+        (cached handle tuples — not 4 f-strings per chip per tick); the
+        values ride the tick's shared record_batch, whose one-kernel-
+        call downsample accumulation is what holds this sub-ms at
+        v5p-256 (4 × 256 series per tick)."""
         cap = self.cfg.history_per_chip
         if cap <= 0:
             return
-        rec = self.history.record
         tracked = self._perchip_tracked
+        handles = self._perchip_handles
+        hist_handle = self.history.handle
+        add = batch.append
         for c in chips:
             cid = c.chip_id
             if cid not in tracked:
@@ -492,13 +569,46 @@ class Sampler:
                     self._perchip_skipped.add(cid)
                     continue
                 tracked.add(cid)
-            rec(f"chip.{cid}.mxu", c.mxu_duty_pct, ts)
-            rec(f"chip.{cid}.hbm", c.hbm_pct, ts)
-            rec(f"chip.{cid}.temp", c.temp_c, ts)
+            entry = handles.get(cid)
+            if entry is None:
+                entry = handles[cid] = [
+                    (
+                        f"chip.{cid}.mxu",
+                        f"chip.{cid}.hbm",
+                        f"chip.{cid}.temp",
+                        f"chip.{cid}.link",
+                    ),
+                    [None, None, None, None],
+                ]
+            names, hs = entry
+            # Handles resolve lazily per metric so a metric the backend
+            # never reports never creates an empty series.
+            v = c.mxu_duty_pct
+            if v is not None:
+                h = hs[0]
+                if h is None:
+                    h = hs[0] = hist_handle(names[0])
+                add((h, v))
+            v = c.hbm_pct
+            if v is not None:
+                h = hs[1]
+                if h is None:
+                    h = hs[1] = hist_handle(names[1])
+                add((h, v))
+            v = c.temp_c
+            if v is not None:
+                h = hs[2]
+                if h is None:
+                    h = hs[2] = hist_handle(names[2])
+                add((h, v))
             # SDK health score (x10 so the drill-down shares the
             # 0-100% chart scale: 70 = score 7).
-            if c.ici_link_health is not None:
-                rec(f"chip.{cid}.link", c.ici_link_health * 10, ts)
+            v = c.ici_link_health
+            if v is not None:
+                h = hs[3]
+                if h is None:
+                    h = hs[3] = hist_handle(names[3])
+                add((h, v * 10))
 
     def source_health(self) -> list[dict]:
         """Per-source pipeline health for the ``source-down`` alert rule

@@ -28,7 +28,11 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/topology         slice views
   /api/health           per-source health + self stats
   /api/accel/wire       compact columnar chip snapshot — the federation
-                        wire format peers fetch (tpumon.topology)
+                        wire format peers fetch (tpumon.topology); with
+                        ``Accept: application/x-tpumon-wire`` the same
+                        columns are served as the binary frame
+                        (tpumon.protowire, docs/perf.md "ingest spine")
+                        — JSON stays the default for pre-binary peers
   /api/stream           Server-Sent Events: realtime snapshot pushed on
                         every sampler tick (the dashboard upgrades from
                         5s polling to ~1s push when available)
@@ -75,6 +79,7 @@ from tpumon.events import KINDS, SEVERITIES
 from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.profiler import ProfileBusy, ProfilerService
+from tpumon.protowire import WIRE_FRAME_CTYPE, encode_wire_frame
 from tpumon.sampler import Sampler
 from tpumon.snapshot import ExporterCache, RenderCache
 from tpumon.topology import attribute_pods, chips_to_wire
@@ -572,10 +577,11 @@ class MonitorServer:
         query: str = "",
         body: bytes = b"",
         auth: str | None = None,
+        accept: str | None = None,
     ) -> tuple[int, str, bytes]:
         """Route a request; returns (status, content_type, body)."""
         status, ctype, body, _headers = await self.handle_ex(
-            method, path, query, body, auth=auth
+            method, path, query, body, auth=auth, accept=accept
         )
         return status, ctype, body
 
@@ -630,6 +636,7 @@ class MonitorServer:
         body: bytes = b"",
         auth: str | None = None,
         if_none_match: str | None = None,
+        accept: str | None = None,
     ) -> tuple[int, str, bytes, dict]:
         """Route a request; returns (status, content_type, body,
         extra response headers). Every request is bracketed by an
@@ -639,7 +646,7 @@ class MonitorServer:
         with tr.span("http", cat="http", track="http") as sp:
             try:
                 status, ctype, rbody, headers = await self._route(
-                    method, path, query, body, auth, if_none_match
+                    method, path, query, body, auth, if_none_match, accept
                 )
             except HttpError as e:
                 # Errors on unregistered paths share one histogram key
@@ -681,10 +688,30 @@ class MonitorServer:
         body: bytes,
         auth: str | None,
         if_none_match: str | None,
+        accept: str | None = None,
     ) -> tuple[int, str, bytes, dict]:
         if method == "POST":
             self._check_auth(auth)
             return (*self._handle_post(path, body), {})
+        if (
+            path == "/api/accel/wire"
+            and self.cfg.wire_binary
+            and accept is not None
+            and WIRE_FRAME_CTYPE in accept
+        ):
+            # Binary representation of the federation wire (negotiated,
+            # never the default: a client that didn't ask gets JSON).
+            # Its own cache key — the bytes differ per representation —
+            # and the key is baked into the ETag, so a client switching
+            # representations can't get a wrong 304.
+            def build() -> bytes:
+                w = chips_to_wire(self.sampler.chips())
+                return encode_wire_frame(w["v"], w["fields"], w["rows"])
+
+            return self._etagged(
+                "/api/accel/wire#bin", ("accel",), build, if_none_match,
+                ctype=WIRE_FRAME_CTYPE,
+            )
         if path in ("/", "/monitor.html", "/index.html", "/dashboard"):
             return 200, self._dashboard.content_type, self._dashboard.read(), {}
         if path == "/logo.svg":
@@ -788,7 +815,7 @@ class MonitorServer:
             # Drain headers; Content-Length is the only one routing needs
             # (POST bodies for the silence routes).
             content_length = 0
-            origin = host_hdr = auth_hdr = inm_hdr = None
+            origin = host_hdr = auth_hdr = inm_hdr = accept_hdr = None
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
@@ -807,6 +834,8 @@ class MonitorServer:
                     auth_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
                 elif lower.startswith(b"if-none-match:"):
                     inm_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+                elif lower.startswith(b"accept:"):
+                    accept_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
             # Query stripped from routing (monitor_server.js:250) but kept
             # for the routes that take parameters (/api/profile).
             path, _, query = target.partition("?")
@@ -856,7 +885,7 @@ class MonitorServer:
             try:
                 status, ctype, body, headers = await self.handle_ex(
                     method, path, query, req_body, auth=auth_hdr,
-                    if_none_match=inm_hdr,
+                    if_none_match=inm_hdr, accept=accept_hdr,
                 )
             except HttpError as e:
                 status, ctype = e.status, "application/json"

@@ -182,19 +182,41 @@ def chips_to_wire(chips: Iterable[ChipSample]) -> dict:
     }
 
 
-def chips_from_wire(payload: dict) -> list[ChipSample]:
-    """Inverse of chips_to_wire. Tolerant of senders with fewer or more
-    fields than this build knows: rows are zipped against the sender's
-    FULL field list (positions must track the sender's own layout —
-    filtering before the zip would shift values into the wrong fields),
-    then unknown names are dropped. An incompatible ``v`` fails loudly
-    so the WIRE_VERSION escape hatch actually works."""
+def wire_columns(payload: dict) -> tuple[list[str], list[list]]:
+    """Columns-out variant of chips_from_wire: the sender's field list
+    plus one value column per field — no per-chip dicts, no ChipSample
+    construction. The zero-parse federation path (accel_peers) ingests
+    these columns directly; chips_from_columns materializes samples
+    when the merged view needs them. Raises ValueError on an
+    incompatible ``v`` (same contract as chips_from_wire)."""
     v = payload.get("v")
     if v != WIRE_VERSION:
         raise ValueError(f"wire version {v!r} != supported {WIRE_VERSION}")
     fields = list(payload.get("fields") or ())
+    rows = payload.get("rows") or ()
+    if not rows:
+        return fields, [[] for _ in fields]
+    return fields, [list(col) for col in zip(*rows)]
+
+
+def chips_from_columns(fields: list[str], cols: list[list]) -> list[ChipSample]:
+    """Materialize ChipSamples from per-field columns. The common case
+    (sender speaks exactly this build's WIRE_FIELDS) constructs
+    positionally — no per-chip kwargs dict; mixed-version senders take
+    the tolerant path: unknown names dropped, missing fields defaulted,
+    positions always tracking the SENDER's layout."""
+    if not cols or not cols[0]:
+        return []
+    if fields == list(WIRE_FIELDS):
+        return [
+            ChipSample(
+                row[0], row[1], row[2], int(row[3]), row[4],
+                tuple(row[5] or ()), *row[6:],
+            )
+            for row in zip(*cols)
+        ]
     out: list[ChipSample] = []
-    for row in payload.get("rows") or ():
+    for row in zip(*cols):
         kw = {f: val for f, val in zip(fields, row) if f in _WIRE_FIELD_SET}
         if "coords" in kw:
             kw["coords"] = tuple(kw["coords"] or ())
@@ -202,6 +224,16 @@ def chips_from_wire(payload: dict) -> list[ChipSample]:
             kw["index"] = int(kw["index"])
         out.append(ChipSample(**kw))
     return out
+
+
+def chips_from_wire(payload: dict) -> list[ChipSample]:
+    """Inverse of chips_to_wire. Tolerant of senders with fewer or more
+    fields than this build knows: rows are zipped against the sender's
+    FULL field list (positions must track the sender's own layout —
+    filtering before the zip would shift values into the wrong fields),
+    then unknown names are dropped. An incompatible ``v`` fails loudly
+    so the WIRE_VERSION escape hatch actually works."""
+    return chips_from_columns(*wire_columns(payload))
 
 
 _WIRE_FIELD_SET = frozenset(WIRE_FIELDS)

@@ -48,6 +48,49 @@ from array import array
 from bisect import bisect_left, bisect_right
 from dataclasses import dataclass
 
+# --------------------------- ingest kernel ----------------------------
+#
+# The write side has a native fast path (tpumon/native/tsdbkern.cpp,
+# bound in tpumon.native.load_tsdb): batch quantization, downsample
+# bucket accumulation and sealed-chunk encoding run as one C call per
+# batch instead of interpreted per-point work. The kernel is stateless
+# (all state stays in these Python objects) and the pure-Python code
+# below is its bit-exact fallback — tests/test_ingest.py drives both
+# over the same fuzz corpus and compares raw bytes. The switch is
+# module-global (one process, one policy): config ``ingest_kernel``
+# lands in set_kernel_enabled(), and a missing .so simply leaves
+# kernel() returning None.
+
+_KERNEL = None
+_KERNEL_TRIED = False
+_KERNEL_ENABLED = True
+
+
+def set_kernel_enabled(on: bool) -> None:
+    """Process-wide kernel policy (config ``ingest_kernel``); the pure
+    Python path is always available and bit-exact, so flipping this is
+    a pure performance decision."""
+    global _KERNEL_ENABLED
+    _KERNEL_ENABLED = bool(on)
+
+
+def kernel():
+    """The loaded native ingest kernel, or None (disabled / not built).
+    Loading is lazy and attempted once per process."""
+    global _KERNEL, _KERNEL_TRIED
+    if not _KERNEL_ENABLED:
+        return None
+    if not _KERNEL_TRIED:
+        _KERNEL_TRIED = True
+        try:
+            from tpumon import native
+
+            _KERNEL = native.load_tsdb(auto_build=True)
+        except Exception:
+            _KERNEL = None
+    return _KERNEL
+
+
 # ----------------------------- varints --------------------------------
 
 
@@ -171,7 +214,7 @@ class Tier:
 
     __slots__ = (
         "window_s", "seal_points", "chunks", "head_ts", "head_val",
-        "_cutoff_ms", "_decoded", "_last_ts",
+        "_cutoff_ms", "_decoded", "_last_ts", "out_of_order", "_evict_due",
     )
 
     def __init__(self, window_s: float, seal_points: int = SEAL_POINTS):
@@ -183,6 +226,16 @@ class Tier:
         # High-water timestamp: append's ordering check must not cost a
         # chunk decode (the head is empty right after every seal).
         self._last_ts: float | None = None
+        # Times the out-of-order sorted-rebuild slow path ran — a
+        # misbehaving clock degrades append from O(1) to O(tier), which
+        # must be visible (/api/health history stats), not silent.
+        self.out_of_order = 0
+        # Batch-path eviction pacing: the per-tick batch ingest loop
+        # (tpumon.history.RingHistory.record_batch) evicts a tier only
+        # when ``now`` crosses this instead of per point — readers pass
+        # explicit window starts, so the overhang is invisible to them
+        # and bounded to window_s/16 of extra resident points.
+        self._evict_due: float | None = None
         self._cutoff_ms = None  # logical eviction bound (ms) or None
         # Decode cache: {id(chunk): (ts_s list, val list)}. Sized to
         # hold a full window's worth of sealed chunks (a 30 min fine
@@ -212,11 +265,38 @@ class Tier:
             self.seal()
         self.evict(ts)
 
+    def append_batch(self, ts_q: array, val_q: array) -> None:
+        """Bulk append of pre-quantized, time-ordered columns (see
+        quantize_batch — the caller checked ordering against last_ts).
+        Bit-identical end state to appending the points one by one:
+        seals trigger at exactly the same chunk boundaries, and the one
+        trailing evict subsumes the per-point evicts it replaces (the
+        final cutoff is the largest). The per-point cost collapses to
+        an array-slice memcpy plus one encode per sealed chunk."""
+        n = len(ts_q)
+        if not n:
+            return
+        i = 0
+        while i < n:
+            room = self.seal_points - len(self.head_ts)
+            if room <= 0:
+                self.seal()
+                continue
+            take = room if room < n - i else n - i
+            self.head_ts.extend(ts_q[i : i + take])
+            self.head_val.extend(val_q[i : i + take])
+            i += take
+            if len(self.head_ts) >= self.seal_points:
+                self.seal()
+        self._last_ts = ts_q[n - 1]
+        self.evict(self._last_ts)
+
     def _insert_sorted(self, ts: float, value: float) -> None:
         """Out-of-order insert: decode everything, insert at the sorted
         position, rebuild as one open head (future appends re-seal).
         O(tier) — fine for the restore paths that hit it, never the
         sampler's append path."""
+        self.out_of_order += 1
         pts = self.since(None)
         i = bisect_right([t for t, _ in pts], ts)
         pts.insert(i, (ts, value))
@@ -233,11 +313,16 @@ class Tier:
     def seal(self) -> None:
         if not self.head_ts:
             return
-        ts_ms = [int(round(t * 1000.0)) for t in self.head_ts]
-        bits = [f32bits(v) for v in self.head_val]
-        self.chunks.append(
-            Chunk(ts_ms[0], ts_ms[-1], len(ts_ms), encode_chunk(ts_ms, bits))
-        )
+        k = kernel()
+        if k is not None:
+            first_ms, last_ms, data = k.seal_encode(self.head_ts, self.head_val)
+            self.chunks.append(Chunk(first_ms, last_ms, len(self.head_ts), data))
+        else:
+            ts_ms = [int(round(t * 1000.0)) for t in self.head_ts]
+            bits = [f32bits(v) for v in self.head_val]
+            self.chunks.append(
+                Chunk(ts_ms[0], ts_ms[-1], len(ts_ms), encode_chunk(ts_ms, bits))
+            )
         del self.head_ts[:], self.head_val[:]
 
     def evict(self, now: float) -> None:
@@ -389,6 +474,32 @@ def quantize_val(v: float) -> float:
     return _F32.unpack(_F32.pack(v))[0]
 
 
+def quantize_batch(
+    ts_list, values, last_ts: float | None
+) -> tuple[array, array, bool]:
+    """Quantize a batch of raw (ts, value) columns in one step:
+    timestamps onto the ms grid, values through float32 — plus the
+    in-order check against ``last_ts`` (the tier's high water). Returns
+    (ts_q, val_q, ordered); an unordered batch is handed back for the
+    caller's per-point slow path. One C call when the kernel is loaded;
+    the Python fallback leans on array('f')'s C-speed float32 casts."""
+    k = kernel()
+    if k is not None:
+        tsa = ts_list if isinstance(ts_list, array) else array("d", ts_list)
+        va = values if isinstance(values, array) else array("d", values)
+        return k.quantize(tsa, va, last_ts)
+    ts_q = array("d", [round(t * 1000.0) / 1000.0 for t in ts_list])
+    val_q = array("f", values)
+    ordered = True
+    prev = last_ts
+    for t in ts_q:
+        if prev is not None and t < prev:
+            ordered = False
+            break
+        prev = t
+    return ts_q, val_q, ordered
+
+
 # ----------------------------- views ----------------------------------
 
 
@@ -470,6 +581,38 @@ class Downsample:
         self.bn += 1
         self.tier.evict(ts)
 
+    def observe_batch(self, ts_q: array, val_q: array) -> None:
+        """Accumulate an ordered, quantized batch: bucket sums advance
+        per point (same add order as observe — bit-exact), but closed
+        buckets are collected and appended in one pass and the tier is
+        evicted once at the end instead of per point. One C call when
+        the kernel is loaded."""
+        n = len(ts_q)
+        if not n:
+            return
+        k = kernel()
+        if k is not None:
+            flushes = k.accum(ts_q, val_q, self.step_s, self)
+        else:
+            flushes = []
+            step = self.step_s
+            bucket, bsum, bn = self.bucket, self.bsum, self.bn
+            for i in range(n):
+                b = int(ts_q[i] // step)
+                if bucket is not None and b != bucket:
+                    if bn:
+                        flushes.append(
+                            (quantize_ts((bucket + 0.5) * step), bsum / bn)
+                        )
+                    bsum, bn = 0.0, 0
+                bucket = b
+                bsum += val_q[i]
+                bn += 1
+            self.bucket, self.bsum, self.bn = bucket, bsum, bn
+        for fts, fmean in flushes:
+            self.tier.append(fts, quantize_val(fmean))
+        self.tier.evict(ts_q[-1])
+
     def flush(self) -> None:
         if self.bucket is not None and self.bn:
             mid = quantize_ts((self.bucket + 0.5) * self.step_s)
@@ -481,6 +624,108 @@ class Downsample:
         if self.bucket is None or not self.bn:
             return None
         return quantize_ts((self.bucket + 0.5) * self.step_s), self.bsum / self.bn
+
+
+class AccumStore:
+    """Contiguous (bucket, bsum, bn) columns for a family of same-step
+    downsample accumulators — the layout the native ``accum_many``
+    kernel updates in ONE call per tick for every per-chip series at
+    once (tpumon.history.RingHistory.record_batch). ``bucket`` uses NaN
+    for "no open bucket"; ``bn`` rides as float64 (counts are tiny, and
+    Python's ``bsum / int(bn)`` and C's ``bsum / (double)bn`` divide the
+    same doubles either way)."""
+
+    __slots__ = ("step_s", "bucket", "bsum", "bn")
+
+    def __init__(self, step_s: float):
+        self.step_s = step_s
+        self.bucket = array("d")
+        self.bsum = array("d")
+        self.bn = array("d")
+
+    def add_slot(self) -> int:
+        self.bucket.append(float("nan"))
+        self.bsum.append(0.0)
+        self.bn.append(0.0)
+        return len(self.bucket) - 1
+
+    def __len__(self) -> int:
+        return len(self.bucket)
+
+
+class SlotDownsample(Downsample):
+    """A Downsample whose accumulator state lives in an AccumStore slot:
+    ``bucket``/``bsum``/``bn`` become views over the store's columns so
+    the batch kernel can update thousands of accumulators in one call,
+    while every existing consumer (observe, flush, live_point, the
+    snapshot codec, tests poking attributes) keeps working unchanged —
+    only the storage moved."""
+
+    __slots__ = ("_store", "_slot")
+
+    def __init__(self, store: AccumStore, slot: int, window_s: float):
+        # Deliberately NOT calling Downsample.__init__: the accumulator
+        # writes it does would route through the properties below before
+        # _store is bound.
+        self._store = store
+        self._slot = slot
+        self.step_s = store.step_s
+        self.tier = Tier(window_s)
+
+    @property
+    def bucket(self) -> int | None:
+        b = self._store.bucket[self._slot]
+        return None if b != b else int(b)
+
+    @bucket.setter
+    def bucket(self, v) -> None:
+        self._store.bucket[self._slot] = float("nan") if v is None else float(v)
+
+    @property
+    def bsum(self) -> float:
+        return self._store.bsum[self._slot]
+
+    @bsum.setter
+    def bsum(self, v: float) -> None:
+        self._store.bsum[self._slot] = v
+
+    @property
+    def bn(self) -> int:
+        return int(self._store.bn[self._slot])
+
+    @bn.setter
+    def bn(self, v: int) -> None:
+        self._store.bn[self._slot] = float(v)
+
+
+def accum_many(
+    ts_q: float, val_q: array, slots: array, store: AccumStore
+) -> list[tuple[int, float, float]]:
+    """One point per series at a shared quantized timestamp, accumulated
+    into ``store``'s columns; returns closed buckets as (slot, mid_ts,
+    raw mean) — the multi-series mirror of Downsample.observe_batch.
+    One C call when the kernel is loaded."""
+    k = kernel()
+    if k is not None:
+        return k.accum_many(ts_q, val_q, slots, store)
+    step = store.step_s
+    bnew = int(ts_q // step)
+    bnew_f = float(bnew)
+    bucket_col, bsum_col, bn_col = store.bucket, store.bsum, store.bn
+    flushes: list[tuple[int, float, float]] = []
+    for i, s in enumerate(slots):
+        b = bucket_col[s]
+        if b == b and b != bnew_f:
+            if bn_col[s]:
+                flushes.append(
+                    (s, quantize_ts((b + 0.5) * step), bsum_col[s] / bn_col[s])
+                )
+            bsum_col[s] = 0.0
+            bn_col[s] = 0.0
+        bucket_col[s] = bnew_f
+        bsum_col[s] += val_q[i]
+        bn_col[s] += 1.0
+    return flushes
 
 
 def merged(
