@@ -1128,6 +1128,142 @@ async def _bench_federation(
     }
 
 
+async def _bench_federation_tree(
+    n_leaves: int = 8, leaf_topology: str = "v5p-256", n_aggs: int = 2,
+    iters: int = 30, warmup: int = 5,
+) -> dict:
+    """Pod-of-pods scale (ROADMAP item 2 / docs/federation.md): a fake
+    v5p-2048 as 8×v5p-256 leaf monitors PUSHING delta frames to 2 slice
+    aggregators, which push slice rollups to a fleet root — all real
+    servers in-process. Numbers of record:
+
+      federation_2048_root_scrape_p50_ms  root tick + GET /api/federation
+                                          (the fleet view's scrape→render;
+                                          acceptance: <= 2x the flat
+                                          federation_256 number)
+      federation_delta_bytes_per_tick     mean steady-state upstream bytes
+                                          per leaf tick (acceptance: <= 25%
+                                          of a binary keyframe)
+      federation_resync_ms                forced uplink reconnect -> fresh
+                                          keyframe landed at the aggregator
+    """
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    def mk(**env):
+        base = {
+            "TPUMON_PORT": "0", "TPUMON_HOST": "127.0.0.1",
+            "TPUMON_K8S_MODE": "none", "TPUMON_COLLECTORS": "accel",
+            "TPUMON_HISTORY_PER_CHIP": "0",
+            "TPUMON_FEDERATION_DARK_AFTER_S": "30",
+        }
+        base.update(env)
+        return build(load_config(env=base))
+
+    nodes = []  # (sampler, server) for teardown
+    try:
+        root_s, root_srv = mk(
+            TPUMON_ACCEL_BACKEND="none", TPUMON_FEDERATION_ROLE="root",
+            TPUMON_FEDERATION_NODE="root",
+        )
+        await root_s.tick_fast()
+        await root_srv.start()
+        nodes.append((root_s, root_srv))
+        aggs = []
+        for a in range(n_aggs):
+            agg_s, agg_srv = mk(
+                TPUMON_ACCEL_BACKEND="none",
+                TPUMON_FEDERATION_ROLE="aggregator",
+                TPUMON_FEDERATION_NODE=f"agg{a}",
+                TPUMON_FEDERATE_UP=f"http://127.0.0.1:{root_srv.port}",
+            )
+            await agg_s.tick_fast()
+            await agg_srv.start()
+            await agg_s.uplink.start()
+            aggs.append(agg_s)
+            nodes.append((agg_s, agg_srv))
+        leaves = []
+        for i in range(n_leaves):
+            agg_port = nodes[1 + i * n_aggs // n_leaves][1].port
+            leaf_s, leaf_srv = mk(
+                TPUMON_ACCEL_BACKEND=f"fake:{leaf_topology}@leaf{i}",
+                TPUMON_FEDERATION_NODE=f"leaf{i}",
+                TPUMON_FEDERATE_UP=f"http://127.0.0.1:{agg_port}",
+            )
+            await leaf_s.tick_fast()
+            await leaf_s.uplink.start()
+            leaves.append(leaf_s)
+            nodes.append((leaf_s, leaf_srv))
+
+        url = f"http://127.0.0.1:{root_srv.port}/api/federation"
+
+        def fetch() -> dict:
+            with urllib.request.urlopen(url) as r:
+                return json.loads(r.read())
+
+        async def settle():
+            # Let uplink tasks wake on the tick event, push, and the
+            # ingest tasks land the frames (same event loop).
+            for _ in range(4):
+                await asyncio.sleep(0.005)
+
+        cycle_ms: list[float] = []
+        data: dict = {}
+        for i in range(warmup + iters):
+            await asyncio.gather(*(lf.tick_fast() for lf in leaves))
+            await settle()
+            await asyncio.gather(*(ag.tick_fast() for ag in aggs))
+            await settle()
+            t0 = time.perf_counter()
+            await root_s.tick_fast()
+            data = await asyncio.to_thread(fetch)
+            dt = (time.perf_counter() - t0) * 1e3
+            if i >= warmup:
+                cycle_ms.append(dt)
+        n_chips = data["fleet"]["chips"]
+        assert n_chips == n_leaves * 256, data["fleet"]
+        assert data["fleet"]["dark_slices"] == 0
+
+        # Steady-state wire cost, averaged over every leaf uplink.
+        delta_bytes = [
+            lf.uplink.enc.stats["delta_bytes"] / lf.uplink.enc.stats["delta_frames"]
+            for lf in leaves
+            if lf.uplink.enc.stats["delta_frames"]
+        ]
+        key_bytes = max(lf.uplink.enc.stats["keyframe_bytes"] for lf in leaves)
+        mean_delta = sum(delta_bytes) / len(delta_bytes)
+
+        # Resync: force-drop leaf0's uplink, measure until a fresh
+        # keyframe from it lands at its aggregator.
+        leaf0 = leaves[0]
+        agg0 = aggs[0]
+        ns = agg0.federation.nodes["leaf0"]
+        keyframes0 = ns.keyframes
+        t0 = time.perf_counter()
+        leaf0.uplink.resync()
+        while ns.keyframes == keyframes0:
+            if time.perf_counter() - t0 > 30:
+                raise RuntimeError("resync never completed")
+            await leaf0.tick_fast()
+            await asyncio.sleep(0.01)
+        resync_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        for sampler, server in nodes:
+            with contextlib.suppress(Exception):
+                await sampler.stop()
+            with contextlib.suppress(Exception):
+                await server.stop()
+
+    return {
+        "federation_2048_root_scrape_p50_ms": round(_p50(cycle_ms), 3),
+        "federation_2048_chips": n_chips,
+        "federation_delta_bytes_per_tick": round(mean_delta, 1),
+        "federation_keyframe_bytes": key_bytes,
+        "federation_delta_vs_keyframe_pct": round(100 * mean_delta / key_bytes, 1),
+        "federation_resync_ms": round(resync_ms, 1),
+    }
+
+
 def _note(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}", file=sys.stderr)
 
@@ -1179,6 +1315,12 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                          "federation_256_chips",
                          "federation_256_scrape_to_render_p50_ms",
                          "federation_256_exporter_render_ms")),
+    "federation_tree": (300, ("federation_2048_root_scrape_p50_ms",
+                              "federation_2048_chips",
+                              "federation_delta_bytes_per_tick",
+                              "federation_keyframe_bytes",
+                              "federation_delta_vs_keyframe_pct",
+                              "federation_resync_ms")),
     "kernels": (700, ("mxu_matmul_pallas_tflops", "mxu_matmul_xla_tflops",
                       "mxu_matmul_vs_xla",
                       "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
@@ -1234,26 +1376,31 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # the cached render and steady-state delta are the numbers of record)
     "fastpath_64_scrape_to_render_p50_ms",
     "fastpath_256_scrape_to_render_p50_ms",
-    "exporter_cached_render_256_ms", "sse_delta_bytes_256",
+    "sse_delta_bytes_256",
     # observability (self-trace overhead at v5p-64, docs/observability.md)
     "trace_overhead_tick_pct", "trace_overhead_scrape_pct",
     # events (journal append + EWMA detector overhead, docs/events.md)
     "events_append_p50_us", "anomaly_overhead_tick_pct",
     # history engine (columnar store, docs/perf.md history section;
-    # the vs-deque ratio and json-write comparison live in the full
-    # results file — the summary line's byte budget is pinned)
+    # the vs-deque ratio, json-write comparison and the snapshot
+    # write/restore times live in the full results file — the summary
+    # line's byte budget is pinned)
     "history_record_p50_us", "history_query_30m_p50_ms",
     "history_resident_bytes_per_point",
-    "history_snapshot_write_ms", "history_restore_ms",
     # ingest spine (batch append + native kernel + binary peer wire,
     # docs/perf.md; py-fallback, bytes comparisons and the per-chip
     # micro-record number — superseded by ingest_tick_256_p50_ms, the
     # live-sampler version of the same story — live in full results)
     "ingest_batch_p50_us", "ingest_tick_256_p50_ms",
     "wire_binary_decode_p50_us",
-    # federation
-    "federation_chips", "federation_scrape_to_render_p50_ms",
+    # federation (flat peer fan-out + the push-based aggregator tree,
+    # docs/federation.md; keyframe bytes, chip counts and the
+    # delta-vs-keyframe ratio live in full results)
+    "federation_scrape_to_render_p50_ms",
     "federation_256_scrape_to_render_p50_ms",
+    "federation_2048_root_scrape_p50_ms",
+    "federation_delta_bytes_per_tick",
+    "federation_resync_ms",
     # kernels
     "mxu_matmul_pallas_tflops", "mxu_matmul_vs_xla",
     "int8_matmul_pallas_tflops", "int8_matmul_vs_xla",
@@ -1328,6 +1475,8 @@ def _run_phase(name: str, backend: str) -> dict:
             return out
 
         return asyncio.run(both_scales())
+    if name == "federation_tree":
+        return asyncio.run(_bench_federation_tree())
     if name == "kernels":
         if not on_tpu:
             # Keep the documented key set stable off-TPU: explicit nulls,

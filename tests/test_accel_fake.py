@@ -6,12 +6,35 @@ from tpumon.collectors.accel_fake import FAKE_TOPOLOGIES, FakeTpuCollector
 
 
 def test_topologies_shapes():
-    for topo, (kind, hosts, per_host) in FAKE_TOPOLOGIES.items():
+    for topo, (kind, hosts, per_host, hosts_per_slice) in FAKE_TOPOLOGIES.items():
         c = FakeTpuCollector(topology=topo, clock=lambda: 1000.0)
         chips = c.chips()
         assert len(chips) == hosts * per_host, topo
         assert all(ch.kind == kind for ch in chips)
         assert len({ch.chip_id for ch in chips}) == len(chips)  # unique ids
+        n_slices = -(-hosts // hosts_per_slice)
+        assert len({ch.slice_id for ch in chips}) == n_slices, topo
+
+
+def test_pod_of_pods_slice_labels():
+    """v5p-512/v5p-2048 are pod-of-pods: every chip carries a per-slice
+    label (the federation rollup key), slices are 256 chips each, and
+    a host never straddles two slices."""
+    for topo, n_slices in (("v5p-512", 2), ("v5p-2048", 8)):
+        chips = FakeTpuCollector(topology=topo, clock=lambda: 1000.0).chips()
+        by_slice: dict = {}
+        for ch in chips:
+            by_slice.setdefault(ch.slice_id, []).append(ch)
+        assert len(by_slice) == n_slices, topo
+        assert all(len(v) == 256 for v in by_slice.values())
+        for sid, group in by_slice.items():
+            hosts = {c.host for c in group}
+            for other, og in by_slice.items():
+                if other != sid:
+                    assert hosts.isdisjoint({c.host for c in og})
+    # Single-slice shapes keep the configured slice_id verbatim.
+    c = FakeTpuCollector(topology="v5e-8", slice_id="mypod")
+    assert {ch.slice_id for ch in c.chips()} == {"mypod"}
 
 
 def test_v5e8_values_in_range():

@@ -113,8 +113,9 @@ def test_example_configs_load():
             cfg = load_config(path=os.path.join(examples, name), env={})
             assert cfg.port == 8888
             loaded += 1
-    # 5 deployment shapes + the chaos soak + the v5p-256 federation shape
-    assert loaded == 7
+    # 5 deployment shapes + the chaos soak + the v5p-256 federation
+    # shape + the v5p-2048 aggregator-tree shape
+    assert loaded == 8
 
 
 def test_topology_map_wired(script):

@@ -68,3 +68,181 @@ def test_metric_request_shape():
     req = encode_metric_request("tpu.runtime.tensorcore.dutycycle.percent")
     msg = pw.decode_message(req)
     assert msg.first(1) == "tpu.runtime.tensorcore.dutycycle.percent"
+
+
+# ---------------------- delta stream frames (federation wire) -----------
+
+
+def _evolving_table(t: int) -> list[list]:
+    """A chips_to_wire-shaped table exercising every column coder AND
+    ctype churn: nulls toggling, strings changing, an int column that
+    flips to floats and back, variable-length coords."""
+    rows = []
+    for i in range(10):
+        rows.append([
+            f"h{i // 4}/c{i % 4}",                       # str (stable)
+            f"h{i // 4}",                                # str dict
+            None if (i + t) % 5 == 0 else 10.5 + i + t,  # f64 w/ nulls
+            2**40 + i * t,                               # i64
+            [i % 4, i // 4, 0] if i != 7 else [],        # intlists
+            (None, True, False)[(i + t) % 3],            # bool w/ nulls
+            "fake" if (i + t) % 2 else None,             # str w/ nulls
+            2**63 - 1 - t,                               # i64 extreme
+        ])
+    if t % 4 == 3:
+        for r in rows:
+            r[2] = 7  # whole column becomes int: ctype change
+    return rows
+
+
+_DELTA_FIELDS = ["id", "host", "duty", "hbm", "coords", "flag", "src", "ctr"]
+
+
+def test_delta_stream_replay_bit_exact():
+    """Keyframe + deltas replay EXACTLY (values and types) what a full
+    frame of each tick's table decodes to — including across ctype
+    changes, null toggles and the periodic keyframe cadence."""
+    enc = pw.DeltaStreamEncoder(keyframe_every=6)
+    dec = pw.DeltaStreamDecoder()
+    keys = 0
+    for t in range(20):
+        rows = _evolving_table(t)
+        frame, was_key = enc.encode(1, _DELTA_FIELDS, rows, ts=1000.0 + t)
+        keys += was_key
+        res = dec.apply(frame)
+        assert res["ts"] == 1000.0 + t and res["key"] == was_key
+        _, _, ref = pw.decode_wire_frame(
+            pw.encode_wire_frame(1, _DELTA_FIELDS, rows)
+        )
+        assert res["cols"] == ref
+        for got, want in zip(res["cols"], ref):
+            for a, b in zip(got, want):
+                assert type(a) is type(b), (t, a, b)
+    # Cadence: first frame + every 6th (20 frames => 1 + 3 rescheduled).
+    assert keys == 4 and dec.keyframes == 4
+    # This table deliberately churns almost every cell; even so a delta
+    # never exceeds its keyframe.
+    st = enc.stats
+    assert st["delta_bytes"] / st["delta_frames"] < st["keyframe_bytes"]
+
+
+def test_delta_stream_steady_state_is_small():
+    """On a realistic chip table — identity/topology columns stable,
+    only the duty column moving — steady-state deltas are <= 25% of a
+    keyframe (the federation bench's per-tick upstream-bytes claim)."""
+    fields = ["id", "host", "slice", "kind", "coords", "duty", "hbm_total"]
+    def rows_at(t):
+        return [
+            [f"h{i // 4}/c{i % 4}", f"h{i // 4}", f"s{i // 32}", "v5p",
+             [i % 4, i // 4, 0], 50.0 + ((i * 7 + t * 13) % 100) / 10.0,
+             95 * 2**30]
+            for i in range(64)
+        ]
+    enc = pw.DeltaStreamEncoder(keyframe_every=10_000)
+    dec = pw.DeltaStreamDecoder()
+    dec.apply(enc.encode(1, fields, rows_at(0), ts=1.0)[0])
+    for t in range(1, 12):
+        dec.apply(enc.encode(1, fields, rows_at(t), ts=1.0 + t)[0])
+    st = enc.stats
+    assert st["delta_bytes"] / st["delta_frames"] <= 0.25 * st["keyframe_bytes"]
+    _, _, ref = pw.decode_wire_frame(pw.encode_wire_frame(1, fields, rows_at(11)))
+    assert dec.cols == ref
+
+
+def test_delta_stream_shape_changes_force_keyframe():
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    dec = pw.DeltaStreamDecoder()
+    rows = _evolving_table(0)
+    dec.apply(enc.encode(1, _DELTA_FIELDS, rows, ts=1.0)[0])
+    # Row count change (chip arrived/left) => keyframe, not a diff.
+    frame, was_key = enc.encode(1, _DELTA_FIELDS, rows[:-1], ts=2.0)
+    assert was_key
+    dec.apply(frame)
+    # Field-list change => keyframe.
+    f2 = _DELTA_FIELDS + ["extra"]
+    rows2 = [r + [1] for r in rows[:-1]]
+    frame, was_key = enc.encode(1, f2, rows2, ts=3.0)
+    assert was_key and dec.apply(frame)["fields"] == f2
+    # reset() (transport reconnect) => keyframe resync.
+    enc.reset()
+    frame, was_key = enc.encode(1, f2, rows2, ts=4.0)
+    assert was_key
+
+
+def test_delta_stream_gap_and_desync_raise():
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    dec = pw.DeltaStreamDecoder()
+    k, _ = enc.encode(1, _DELTA_FIELDS, _evolving_table(0), ts=1.0)
+    d1, _ = enc.encode(1, _DELTA_FIELDS, _evolving_table(1), ts=2.0)
+    d2, _ = enc.encode(1, _DELTA_FIELDS, _evolving_table(2), ts=3.0)
+    # Delta before any keyframe: refused.
+    with pytest.raises(ValueError):
+        pw.DeltaStreamDecoder().apply(d1)
+    dec.apply(k)
+    # Skipping d1 is a sequence gap: refused (transport resyncs).
+    with pytest.raises(ValueError):
+        dec.apply(d2)
+    # The failed apply did not corrupt state: d1 then d2 still work.
+    dec.apply(d1)
+    dec.apply(d2)
+    # Junk magic is refused too.
+    with pytest.raises(ValueError):
+        dec.apply(b"XXXX" + d1[4:])
+
+
+def test_delta_stream_truncation_raises_at_every_prefix():
+    """Same harness as the PR 6 wire tests: EVERY truncation prefix of
+    a keyframe and of a delta frame must raise ValueError — and must
+    raise BEFORE mutating decoder state (two-phase apply)."""
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    key, _ = enc.encode(1, _DELTA_FIELDS, _evolving_table(0), ts=1.0)
+    delta, was_key = enc.encode(1, _DELTA_FIELDS, _evolving_table(1), ts=2.0)
+    assert not was_key
+    for blob in (key, delta):
+        for cut in range(len(blob)):
+            dec = pw.DeltaStreamDecoder()
+            dec.apply(key)
+            before = [list(c) for c in dec.cols]
+            with pytest.raises(ValueError):
+                dec.apply(blob[:cut])
+            assert dec.cols == before  # atomic: no half-applied state
+            # ...and the stream recovers from where it was.
+            dec.apply(delta)
+
+
+def test_delta_stream_empty_diff_is_tiny_heartbeat():
+    """An unchanged table produces a near-empty delta (liveness ride)."""
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    dec = pw.DeltaStreamDecoder()
+    rows = _evolving_table(1)
+    dec.apply(enc.encode(1, _DELTA_FIELDS, rows, ts=1.0)[0])
+    frame, was_key = enc.encode(1, _DELTA_FIELDS, rows, ts=2.0)
+    assert not was_key and len(frame) < 32
+    res = dec.apply(frame)
+    _, _, ref = pw.decode_wire_frame(pw.encode_wire_frame(1, _DELTA_FIELDS, rows))
+    assert res["cols"] == ref
+
+
+def test_delta_stream_intlist_row_goes_none():
+    """A fixed-stride int-list cell flipping to None while its
+    neighbors stay put: the all-None sub-column must encode (as
+    _CT_NONE) rather than producing a stride-0 frame the decoder
+    refuses — regression for the encoder/decoder mismatch."""
+    fields = ["id", "coords", "duty"]
+    rows = [[f"c{i}", [i, 0, 0], 1.0 + i] for i in range(6)]
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    dec = pw.DeltaStreamDecoder()
+    dec.apply(enc.encode(1, fields, rows, ts=1.0)[0])
+    rows2 = [list(r) for r in rows]
+    rows2[3] = ["c3", None, 1.0 + 3]  # ONLY the coords cell changes
+    frame, was_key = enc.encode(1, fields, rows2, ts=2.0)
+    assert not was_key
+    res = dec.apply(frame)
+    _, _, ref = pw.decode_wire_frame(pw.encode_wire_frame(1, fields, rows2))
+    assert res["cols"] == ref
+    # ...and back to a list again.
+    rows3 = [list(r) for r in rows2]
+    rows3[3] = ["c3", [9, 9, 9], 1.0 + 3]
+    res = dec.apply(enc.encode(1, fields, rows3, ts=3.0)[0])
+    _, _, ref = pw.decode_wire_frame(pw.encode_wire_frame(1, fields, rows3))
+    assert res["cols"] == ref

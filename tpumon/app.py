@@ -85,6 +85,45 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         engine=AlertEngine(cfg.thresholds),
         notifier=notifier,
     )
+    # Hierarchical federation (tpumon.federation, docs/federation.md):
+    # aggregator/root roles grow a hub (downstream delta streams fan in
+    # through /api/federation/ingest, hub chips merge into the accel
+    # view); --federate-up grows an uplink that pushes THIS node's
+    # frames upstream (chip rows from a leaf, slice rows from an
+    # aggregator). Standalone monitors skip all of it.
+    role = cfg.federation_role or ("leaf" if cfg.federate_up else "")
+    if role not in ("", "leaf", "aggregator", "root"):
+        raise ValueError(
+            f"unknown federation_role {cfg.federation_role!r} "
+            f"(want leaf | aggregator | root)"
+        )
+    if role or cfg.federate_up:
+        import socket
+
+        from tpumon.federation import (
+            FederationHub,
+            FederationUplink,
+            HubMergedCollector,
+        )
+
+        node = cfg.federation_node or socket.gethostname()
+        if role in ("aggregator", "root"):
+            hub = FederationHub(
+                node=node, role=role, dark_after_s=cfg.federation_dark_after_s
+            )
+            hub.bind(sampler)
+            sampler.federation = hub
+            sampler.accel = HubMergedCollector(local=sampler.accel, hub=hub)
+        if cfg.federate_up and role != "root":
+            sampler.uplink = FederationUplink(
+                sampler,
+                url=cfg.federate_up,
+                node=node,
+                tier="aggregator" if sampler.federation is not None else "leaf",
+                hub=sampler.federation,
+                keyframe_every=cfg.federation_keyframe_every,
+                auth_token=cfg.auth_token,
+            )
     history = HistoryService(
         ring,
         prometheus_url=cfg.prometheus_url,
@@ -171,6 +210,10 @@ async def run(cfg: Config) -> None:
         f"accel={cfg.accel_backend} interval={cfg.sample_interval_s:g}s",
     )
     await sampler.start()
+    if sampler.uplink is not None:
+        # Push task starts with the tick loops: one delta frame per
+        # tick flows upstream from here on (keyframe first).
+        await sampler.uplink.start()
     if store is not None:
         await store.start(sampler)
     if snapshotter is not None:
@@ -329,6 +372,13 @@ def main(argv: list[str] | None = None) -> int:
             overrides["peers"] = take(arg)
         elif arg == "--peer-fanout":
             overrides["peer_fanout"] = take_int(arg)
+        elif arg == "--federate-up":
+            # Upstream aggregator this instance pushes delta frames to
+            # (tpumon.federation, docs/federation.md).
+            overrides["federate_up"] = take(arg)
+        elif arg == "--federation-role":
+            # leaf | aggregator | root; --federate-up alone implies leaf.
+            overrides["federation_role"] = take(arg)
         elif arg == "--sse-keyframe-every":
             # Delta-SSE keyframe cadence (1 = full frame per tick).
             overrides["sse_keyframe_every"] = take_int(arg)
@@ -376,6 +426,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-paged-attn gather|kernel] "
                 "[--loadgen-spec-source draft|prompt] "
                 "[--peers host:port,...] [--peer-fanout N] "
+                "[--federate-up http://agg:8888] "
+                "[--federation-role leaf|aggregator|root] "
                 "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
                 "[--history-snapshot-format binary|json] "

@@ -21,18 +21,29 @@ from dataclasses import dataclass, field
 from tpumon.collectors import Sample
 from tpumon.topology import HBM_BYTES_BY_KIND, ChipSample
 
-# topology name -> (kind, n_hosts, chips_per_host)
-FAKE_TOPOLOGIES: dict[str, tuple[str, int, int]] = {
-    "v5e-1": ("v5e", 1, 1),
-    "v5e-4": ("v5e", 1, 4),
-    "v5e-8": ("v5e", 1, 8),
-    "v5p-8": ("v5p", 2, 4),
-    "v5p-64": ("v5p", 16, 4),  # v5p: 4 chips per host VM
+# topology name -> (kind, n_hosts, chips_per_host, hosts_per_slice)
+# hosts_per_slice == n_hosts => the whole topology is one slice (the
+# original shapes); smaller => a pod-of-pods: chips carry per-slice
+# labels (slice-0.0, slice-0.1, ...) so group-by-slice rollups — the
+# federation tree's aggregation keys (tpumon.federation) — have real
+# values to group on.
+FAKE_TOPOLOGIES: dict[str, tuple[str, int, int, int]] = {
+    "v5e-1": ("v5e", 1, 1, 1),
+    "v5e-4": ("v5e", 1, 4, 1),
+    "v5e-8": ("v5e", 1, 8, 1),
+    "v5p-8": ("v5p", 2, 4, 2),
+    "v5p-64": ("v5p", 16, 4, 16),  # v5p: 4 chips per host VM
     # Production-scale shapes for the data-plane fast-path benchmarks
     # (bench.py fastpath/federation phases, docs/perf.md): the render
     # and delta-SSE costs are O(chips), so these pin 128/256-chip costs.
-    "v5p-128": ("v5p", 32, 4),
-    "v5p-256": ("v5p", 64, 4),
+    "v5p-128": ("v5p", 32, 4, 32),
+    "v5p-256": ("v5p", 64, 4, 64),
+    # Pod-of-pods shapes (ROADMAP item 2 / docs/federation.md): 2 and 8
+    # v5p-256 slices — the fake fleet geometry behind the federation
+    # tree bench and soak (a leaf monitor usually runs one 256-chip
+    # slice; v5p-2048 in ONE instance is the degenerate flat baseline).
+    "v5p-512": ("v5p", 128, 4, 64),
+    "v5p-2048": ("v5p", 512, 4, 64),
 }
 
 
@@ -72,7 +83,8 @@ class FakeTpuCollector:
 
     # --------------------------------------------------------------------
     def chips(self) -> list[ChipSample]:
-        kind, n_hosts, per_host = FAKE_TOPOLOGIES[self.topology]
+        kind, n_hosts, per_host, hosts_per_slice = FAKE_TOPOLOGIES[self.topology]
+        multi_slice = hosts_per_slice < n_hosts
         hbm_total = HBM_BYTES_BY_KIND[kind]
         t = self.clock()
         out: list[ChipSample] = []
@@ -80,6 +92,15 @@ class FakeTpuCollector:
             host = f"{self.host_prefix}-{h}"
             if host in self.dead_hosts:
                 continue
+            # Pod-of-pods: each hosts_per_slice-host group is its own
+            # slice (slice labels are the federation rollup keys);
+            # single-slice topologies keep the configured slice_id
+            # verbatim (back-compat with every existing test/config).
+            slice_id = (
+                f"{self.slice_id}.{h // hosts_per_slice}"
+                if multi_slice
+                else self.slice_id
+            )
             for i in range(per_host):
                 g = h * per_host + i  # global index => phase offset
                 phase = 0.7 * g
@@ -102,7 +123,7 @@ class FakeTpuCollector:
                 sample = ChipSample(
                     chip_id=f"{host}/chip-{i}",
                     host=host,
-                    slice_id=self.slice_id,
+                    slice_id=slice_id,
                     index=i,
                     kind=kind,
                     coords=(g % 4, g // 4, 0),

@@ -20,6 +20,17 @@ re-parsing instead of a full payload. Peers predating the wire route
 are detected once (404) and fetched via ``/api/accel/metrics`` forever
 after — mixed-version fleets federate fine.
 
+Fan-out budgeting: ``peer_timeout_s`` is the whole fan-out's wall
+budget, and every peer gets an **independent deadline slice** of it
+(budget / number-of-waves, clamped to what remains of the budget when
+its turn comes) — one hung peer burns only its own slice, never the
+window the peers queued behind it needed. Fetches also reuse
+**keep-alive connections** across ticks (the tpumon server honors
+``Connection: keep-alive``): the steady-state revalidation poll costs
+one request on a warm socket, not a TCP handshake per peer per tick;
+a stale socket (server restarted, idle timeout) retries once on a
+fresh connection before the peer counts as down.
+
 Peer chips keep their original chip_id/host/slice identity; cumulative
 ICI counters survive the merge, so the local sampler computes peer ICI
 rates exactly as it does for local chips. An unreachable peer degrades
@@ -30,9 +41,9 @@ alerting should see).
 from __future__ import annotations
 
 import asyncio
+import http.client
 import json
-import urllib.error
-import urllib.request
+import urllib.parse
 from dataclasses import dataclass, field
 
 from tpumon.collectors import Collector, Sample
@@ -104,13 +115,15 @@ class PeerFederatedCollector:
         """Per-peer incremental-merge state, created lazily so tests
         that build the collector without __init__ still work:
         etags (last seen epoch ETag), chips (last parsed list, reused
-        verbatim on 304), wire (peer speaks /api/accel/wire)."""
+        verbatim on 304), wire (peer speaks /api/accel/wire), conns
+        (keep-alive HTTP connections reused across ticks)."""
         st = self.__dict__.get("_peer_state")
         if st is None:
             st = self.__dict__["_peer_state"] = {
                 "etags": {},
                 "chips": {},
                 "wire": {},
+                "conns": {},
                 # journal-transition tracking: last ok/err per peer and
                 # which peers' wire-fallback has already been recorded
                 "ok": {},
@@ -118,36 +131,96 @@ class PeerFederatedCollector:
             }
         return st
 
-    def _fetch_peer(self, url: str) -> list[ChipSample]:
-        """Blocking fetch+parse of one peer (runs on a worker thread).
-        304 returns the peer's cached parsed chips untouched. Wire
-        fetches ask for the binary frame via Accept and sniff the
-        response — binary-speaking peers answer the columnar frame
-        (decoded straight to columns, zero per-chip dicts), JSON-only
-        peers answer JSON and parse exactly as before."""
+    def _drop_conn(self, url: str) -> None:
+        conn = self._state()["conns"].pop(url, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _request(
+        self, url: str, path: str, headers: dict, timeout_s: float
+    ) -> tuple[int, bytes, object]:
+        """One GET on the peer's keep-alive connection; returns
+        (status, body, response headers). A REUSED socket that fails
+        before any response (peer restarted, idle-closed) retries once
+        on a fresh connection — a cold-connection failure or a timeout
+        propagates immediately (retrying a timeout would double the
+        peer's deadline slice)."""
+        conns = self._state()["conns"]
         base = normalize_base_url(url)
+        parts = urllib.parse.urlsplit(base)
+        for attempt in (0, 1):
+            conn = conns.get(url)
+            if conn is None:
+                cls = (
+                    http.client.HTTPSConnection
+                    if parts.scheme == "https"
+                    else http.client.HTTPConnection
+                )
+                conn = conns[url] = cls(
+                    parts.hostname, parts.port, timeout=timeout_s
+                )
+            reused = conn.sock is not None
+            if reused:
+                conn.sock.settimeout(timeout_s)
+            else:
+                conn.timeout = timeout_s
+            try:
+                conn.request(
+                    "GET", path, headers={"Connection": "keep-alive", **headers}
+                )
+                r = conn.getresponse()
+                body = r.read()
+            except (TimeoutError, OSError, http.client.HTTPException) as e:
+                self._drop_conn(url)
+                stale = reused and isinstance(
+                    e,
+                    (
+                        http.client.BadStatusLine,
+                        http.client.CannotSendRequest,
+                        ConnectionResetError,
+                        BrokenPipeError,
+                    ),
+                )
+                if attempt == 0 and stale:
+                    continue  # stale keep-alive socket: one fresh retry
+                raise
+            if r.will_close:
+                self._drop_conn(url)
+            return r.status, body, r.headers
+        raise RuntimeError("unreachable")  # pragma: no cover
+
+    def _fetch_peer(self, url: str, timeout_s: float | None = None) -> list[ChipSample]:
+        """Blocking fetch+parse of one peer (runs on a worker thread)
+        within its deadline slice. 304 returns the peer's cached parsed
+        chips untouched. Wire fetches ask for the binary frame via
+        Accept and sniff the response — binary-speaking peers answer
+        the columnar frame (decoded straight to columns, zero per-chip
+        dicts), JSON-only peers answer JSON and parse exactly as
+        before."""
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
         st = self._state()
         use_wire = st["wire"].get(url, True)
         path = "/api/accel/wire" if use_wire else "/api/accel/metrics"
-        req = urllib.request.Request(f"{base}{path}")
+        headers = {}
         etag = st["etags"].get(url)
         if etag:
-            req.add_header("If-None-Match", etag)
+            headers["If-None-Match"] = etag
         if use_wire and self.wire_binary:
-            req.add_header("Accept", WIRE_FRAME_CTYPE)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                body = r.read()
-                new_etag = r.headers.get("ETag")
-        except urllib.error.HTTPError as e:
-            if e.code == 304:
-                return st["chips"].get(url, [])
-            if e.code == 404 and use_wire:
-                # Pre-wire peer: remember and fall back to the dict route.
-                st["wire"][url] = False
-                st["etags"].pop(url, None)
-                return self._fetch_peer(url)
-            raise
+            headers["Accept"] = WIRE_FRAME_CTYPE
+        status, body, rheaders = self._request(url, path, headers, timeout_s)
+        if status == 304:
+            return st["chips"].get(url, [])
+        if status == 404 and use_wire:
+            # Pre-wire peer: remember and fall back to the dict route.
+            st["wire"][url] = False
+            st["etags"].pop(url, None)
+            return self._fetch_peer(url, timeout_s)
+        if status != 200:
+            raise RuntimeError(f"peer answered HTTP {status}")
+        new_etag = rheaders.get("ETag")
         if use_wire:
             try:
                 if body[: len(WIRE_FRAME_MAGIC)] == WIRE_FRAME_MAGIC:
@@ -162,7 +235,7 @@ class PeerFederatedCollector:
                 # back to the stable dict route, like the 404 path.
                 st["wire"][url] = False
                 st["etags"].pop(url, None)
-                return self._fetch_peer(url)
+                return self._fetch_peer(url, timeout_s)
         else:
             chips = [
                 chip_from_json(d) for d in json.loads(body).get("chips", [])
@@ -197,19 +270,37 @@ class PeerFederatedCollector:
                 "(full-dict fetches from now on)",
             )
 
-    async def _peer_chips(self, url: str) -> tuple[str, list[ChipSample] | None]:
+    async def _peer_chips(
+        self, url: str, timeout_s: float | None = None
+    ) -> tuple[str, list[ChipSample] | None]:
         try:
-            return url, await asyncio.to_thread(self._fetch_peer, url)
+            return url, await asyncio.to_thread(self._fetch_peer, url, timeout_s)
         except Exception as e:
             self.last_peer_status[url] = f"{type(e).__name__}: {e}"
             return url, None
 
     async def collect(self) -> Sample:
-        sem = asyncio.Semaphore(max(1, getattr(self, "fanout", 16)))
+        fanout = max(1, getattr(self, "fanout", 16))
+        sem = asyncio.Semaphore(fanout)
+        # Independent deadline slices: timeout_s is the WHOLE fan-out's
+        # wall budget. With W waves of `fanout` concurrent fetches each
+        # peer's slice is budget/W, clamped to what's left of the
+        # budget when its slot frees up — a hung peer eats only its own
+        # slice, and a backlogged tick fails the stragglers fast
+        # instead of letting the fan-out overhang into the next tick.
+        budget = max(0.1, self.timeout_s)
+        waves = max(1, -(-len(self.peers) // fanout))
+        slice_s = budget / waves
+        loop = asyncio.get_running_loop()
+        t_deadline = loop.time() + budget
 
         async def bounded(url: str) -> tuple[str, list[ChipSample] | None]:
             async with sem:
-                return await self._peer_chips(url)
+                remaining = t_deadline - loop.time()
+                if remaining <= 0.01:
+                    self.last_peer_status[url] = "fan-out budget exhausted"
+                    return url, None
+                return await self._peer_chips(url, min(slice_s, remaining))
 
         tasks = [asyncio.ensure_future(bounded(u)) for u in self.peers]
         local_sample = None

@@ -230,6 +230,28 @@ class Config:
     # Accept header; JSON remains the default representation so
     # pre-binary peers keep federating). Off = JSON-only, both ways.
     wire_binary: bool = True
+    # --- hierarchical federation (tpumon.federation, docs/federation.md) ---
+    # Role in the aggregator tree: "" standalone (the default — no tree
+    # behavior at all), "leaf" (pushes chip-level delta frames to
+    # federate_up), "aggregator" (ingests downstream frames on
+    # /api/federation/ingest, computes slice rollups, pushes SLICE-level
+    # rows to federate_up), "root" (ingest + rollups only, the fleet
+    # view). federate_up set with no role implies "leaf".
+    federation_role: str = ""
+    # Upstream aggregator base URL this instance pushes delta frames to
+    # (long-lived chunked POST — push-based, the upstream never polls).
+    federate_up: str | None = None
+    # Node identity in upstream views/events; default = hostname.
+    federation_node: str | None = None
+    # Uplink keyframe cadence (the sse_keyframe_every idea applied to
+    # the federation wire): a full keyframe every N frames bounds how
+    # long a silently-desynced aggregator can stay wrong. Reconnects
+    # always start with a keyframe regardless.
+    federation_keyframe_every: int = 30
+    # A downstream node whose stream has been silent this long is
+    # marked dark: its slices flip to health="dark" in the fleet view
+    # and a serious ``federation`` event fires.
+    federation_dark_after_s: float = 5.0
     # Native TSDB append/downsample kernel (tpumon/native/tsdbkern.cpp):
     # off forces the bit-exact pure-Python ingest path even when the
     # shared library is built.
@@ -320,6 +342,11 @@ _SCALAR_FIELDS: dict[str, type] = {
     "peer_fanout": int,
     "peer_timeout_s": float,
     "wire_binary": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "federation_role": str,
+    "federate_up": str,
+    "federation_node": str,
+    "federation_keyframe_every": int,
+    "federation_dark_after_s": float,
     "ingest_kernel": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "sse_keyframe_every": int,
     "webhook_min_severity": str,

@@ -55,6 +55,8 @@ KINDS = (
     "breaker",    # circuit-breaker state transition (tpumon.sampler)
     "chaos",      # injected fault (tpumon.collectors.chaos)
     "config",     # monitor configured / reconfigured (tpumon.sampler)
+    "federation", # aggregator tree: tier up/down, keyframe resync,
+                  # rollup lag (tpumon.federation)
     "history",    # history/state/journal snapshot save+restore moments
     "peer",       # federation peer up / down / wire-fallback
     "profile",    # jax.profiler device capture (tpumon.profiler)

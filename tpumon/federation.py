@@ -1,0 +1,823 @@
+"""Hierarchical federation: a push-based aggregator tree.
+
+The flat peer fan-out (tpumon.collectors.accel_peers) polls every peer
+from one instance and tops out around the 256-chip wire format — fine
+for one pod, wrong for a pod-of-pods fleet. This module is the scale
+step (ROADMAP item 2): a three-tier tree
+
+    leaf monitors  →  slice aggregators  →  fleet root
+
+where the data flows UP by push, not by poll. Each downstream node
+holds one long-lived chunked POST to its upstream's
+``/api/federation/ingest`` route and streams columnar **delta frames**
+(tpumon.protowire DeltaStreamEncoder: a baseline keyframe, then
+per-tick changed-columns diffs with row masks — steady state ships only
+the cells that moved). Tiers differ in WHAT they ship:
+
+- a **leaf** pushes its chip table (topology.WIRE_FIELDS rows — the
+  same columns /api/accel/wire serves);
+- an **aggregator** ingests leaf frames, materializes chips through the
+  zero-copy batch path (topology.chips_from_columns →
+  RingHistory.record_batch), computes per-slice rollups (mean/max/p95
+  duty, HBM, temp) at ingest, and pushes SLICE-level rows upstream —
+  so the root never stores 2048 fine-grained chip series, only
+  ``slice.<id>.*`` rollup series that downsample into the TSDB
+  mid/coarse tiers like any other series;
+- the **root** ingests slice rows and serves the fleet view
+  (``GET /api/federation``).
+
+Failure domains ride the same tree. A leaf whose stream goes silent for
+``federation_dark_after_s`` is marked **dark** at its aggregator: its
+slices flip to ``health="dark"`` (propagated upstream in the slice
+rows) and a serious ``federation`` event fires. An aggregator that goes
+silent at the root marks its whole subtree **unreachable** — the root
+can therefore tell "slice 3 is dark" (its aggregator says so) from
+"the aggregator is partitioned" (the root observed the silence itself).
+
+Resync mirrors the SSE client protocol (docs/perf.md): any gap — an
+aggregator restart, a dropped connection, a delta the decoder refuses —
+tears down the stream, and the reconnecting uplink always opens with a
+keyframe. No replay, no duplicated points: the keyframe re-baselines
+state, and history landings only ever append the new frame's timestamp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import time
+import urllib.parse
+
+from tpumon import tsdb
+from tpumon.collectors import Collector, Sample
+from tpumon.protowire import (
+    DELTA_STREAM_CTYPE,
+    DeltaStreamDecoder,
+    DeltaStreamEncoder,
+    decode_varint,
+    encode_varint,
+)
+from tpumon.topology import (
+    WIRE_VERSION,
+    ChipSample,
+    chips_from_columns,
+    chips_to_wire,
+    slice_views,
+)
+
+INGEST_PATH = "/api/federation/ingest"
+
+# Slice-rollup wire schema (aggregator → root frames). Same contract
+# style as topology.WIRE_FIELDS: order is the wire layout, append new
+# fields at the END, bump the version only on incompatible changes.
+SLICE_WIRE_VERSION = 1
+SLICE_FIELDS: tuple[str, ...] = (
+    "slice_id",
+    "node",      # which downstream reported it (failure-domain identity)
+    "kind",
+    "chips",
+    "hosts",
+    "duty_mean",
+    "duty_max",
+    "duty_p95",
+    "hbm_mean",
+    "temp_mean",
+    "temp_max",
+    "health",    # "ok" | "dark" | "unreachable"
+    "ts",        # the sample's own timestamp (not receipt time)
+)
+
+# slice-row key -> history series suffix: the rollup series an
+# aggregator/root lands per ingested frame (slice.<id>.<suffix>), which
+# downsample into the TSDB mid/coarse tiers at append like any series.
+ROLLUP_SERIES: tuple[tuple[str, str], ...] = (
+    ("duty_mean", "duty"),
+    ("duty_max", "duty_max"),
+    ("duty_p95", "duty_p95"),
+    ("hbm_mean", "hbm"),
+    ("temp_mean", "temp"),
+    ("temp_max", "temp_max"),
+    ("chips", "chips"),
+)
+
+_MAX_RECORD = 16 * 1024 * 1024  # one frame can never plausibly exceed this
+
+# Float metric fields the uplink quantizes to f32 before encoding
+# (tsdb.quantize_val — the same round-trip the TSDB applies at append
+# anyway): an exactly-f32 column rides the delta wire at half width
+# (protowire _CT_F32). Identity, capacity and timestamp fields are
+# untouched.
+_F32_CHIP_FIELDS = frozenset({"mxu_duty_pct", "temp_c"})
+_F32_SLICE_FIELDS = frozenset(
+    {"duty_mean", "duty_max", "duty_p95", "hbm_mean", "temp_mean", "temp_max"}
+)
+
+
+def _quantize_rows(fields: list[str], rows: list[list], which: frozenset) -> None:
+    f32 = tsdb.quantize_val
+    for ci, f in enumerate(fields):
+        if f in which:
+            for row in rows:
+                if row[ci] is not None:
+                    row[ci] = f32(row[ci])
+
+
+def slice_rollup_rows(
+    chips: list[ChipSample], node: str, ts: float, health: str = "ok"
+) -> list[dict]:
+    """Per-slice rollup rows for a chip set — the aggregator tier's
+    upstream payload and fleet-view unit. Statistics come from
+    topology.SliceView (mean/max/p95), so the rollup math lives next to
+    the topology model it aggregates."""
+    rows = []
+    for v in slice_views(chips):
+        rows.append(
+            {
+                "slice_id": v.slice_id,
+                "node": node,
+                "kind": v.chips[0].kind if v.chips else None,
+                "chips": v.reporting_chips,
+                "hosts": len(v.hosts),
+                "duty_mean": v.mean("mxu_duty_pct"),
+                "duty_max": v.max("mxu_duty_pct"),
+                "duty_p95": v.p95("mxu_duty_pct"),
+                "hbm_mean": v.mean("hbm_pct"),
+                "temp_mean": v.mean("temp_c"),
+                "temp_max": v.max("temp_c"),
+                "health": health,
+                "ts": ts,
+            }
+        )
+    return rows
+
+
+def _rows_to_wire(rows: list[dict]) -> list[list]:
+    return [[r.get(f) for f in SLICE_FIELDS] for r in rows]
+
+
+def split_records(buf: bytearray) -> list[bytes]:
+    """Split complete varint-length-prefixed records off the front of
+    ``buf`` (mutates it). Incomplete tails stay buffered; a malformed
+    or implausibly-sized prefix raises ValueError (the ingest side
+    answers 400 and drops the stream — sender resyncs)."""
+    out: list[bytes] = []
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        try:
+            ln, p2 = decode_varint(bytes(buf[pos : pos + 10]), 0)
+        except ValueError:
+            if n - pos >= 10:
+                raise  # 10 bytes is a full varint: this one is garbage
+            break  # genuinely incomplete: wait for more bytes
+        if ln > _MAX_RECORD:
+            raise ValueError(f"implausible stream record size {ln}")
+        if pos + p2 + ln > n:
+            break
+        out.append(bytes(buf[pos + p2 : pos + p2 + ln]))
+        pos += p2 + ln
+    del buf[:pos]
+    return out
+
+
+class NodeState:
+    """One downstream node's fan-in state at an aggregator/root."""
+
+    __slots__ = (
+        "node", "tier", "status", "connected", "decoder", "chips",
+        "slice_rows", "last_ts", "last_wall", "frames", "keyframes",
+        "resyncs", "bytes", "lagging", "conn", "error",
+    )
+
+    def __init__(self, node: str, tier: str):
+        self.node = node
+        self.tier = tier  # "leaf" (chip rows) | "aggregator" (slice rows)
+        self.status = "ok"
+        self.connected = False
+        self.decoder = DeltaStreamDecoder()
+        self.chips: list[ChipSample] = []
+        self.slice_rows: list[dict] = []
+        self.last_ts: float | None = None
+        self.last_wall: float | None = None
+        self.frames = 0
+        self.keyframes = 0
+        self.resyncs = 0
+        self.bytes = 0
+        self.lagging = False
+        self.conn: object | None = None  # current connection token
+        self.error: str | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "tier": self.tier,
+            "status": self.status,
+            "connected": self.connected,
+            "frames": self.frames,
+            "keyframes": self.keyframes,
+            "resyncs": self.resyncs,
+            "bytes": self.bytes,
+            "slices": len(self.slice_rows),
+            "chips": len(self.chips),
+            "last_ts": self.last_ts,
+            "age_s": (
+                round(time.monotonic() - self.last_wall, 3)
+                if self.last_wall is not None
+                else None
+            ),
+            **({"error": self.error} if self.error else {}),
+        }
+
+
+class FederationHub:
+    """Aggregator/root-side fan-in: ingests downstream delta streams,
+    lands rollups in the TSDB, and owns the failure-domain health view.
+
+    Created by tpumon.app.build when ``federation_role`` is
+    ``aggregator`` or ``root`` and bound to the sampler (history,
+    journal, epoch clock) once it exists. All ingest work runs on the
+    event loop — one task per downstream connection."""
+
+    # Bound on distinct downstream nodes: the table is keyed on the
+    # client-supplied X-Tpumon-Node header, so without a cap any client
+    # could grow it (and the fleet view) without limit — same rule as
+    # the server's per-path latency table.
+    MAX_NODES = 256
+
+    def __init__(self, node: str, role: str = "aggregator", dark_after_s: float = 5.0):
+        self.node = node
+        self.role = role
+        self.dark_after_s = max(0.25, dark_after_s)
+        # A dark, disconnected node is eventually FORGOTTEN (renamed or
+        # decommissioned leaves must not pin stale slices in the fleet
+        # view forever); generous so a long outage still reads as dark,
+        # not as absent.
+        self.forget_after_s = max(600.0, 24 * self.dark_after_s)
+        self.nodes: dict[str, NodeState] = {}
+        self.sampler = None
+        self.history = None
+        self.journal = None
+        self.clock = None
+        # Aggregator-with-local-chips case: the merged collector
+        # stashes the LOCAL chips here so upstream rollups cover them
+        # without double-counting the hub's own downstream chips.
+        self.local_chips: list[ChipSample] = []
+        self.frames = 0
+
+    def bind(self, sampler) -> None:
+        self.sampler = sampler
+        self.history = sampler.history
+        self.journal = sampler.journal
+        self.clock = sampler.clock
+
+    # ------------------------------ ingest ------------------------------
+
+    async def handle_ingest(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        node: str | None,
+        tier: str | None,
+        chunked: bool,
+    ) -> None:
+        """Serve one long-lived downstream push stream. Frames are
+        decoded and landed as they arrive; the HTTP response is only
+        written when the stream ends (200) or a frame is refused (400 —
+        the sender reconnects and resyncs with a keyframe)."""
+        peer = writer.get_extra_info("peername")
+        node = node or (f"{peer[0]}:{peer[1]}" if peer else "unknown")
+        tier = tier if tier in ("leaf", "aggregator") else "leaf"
+        ns = self.nodes.get(node)
+        if ns is None:
+            if len(self.nodes) >= self.MAX_NODES:
+                with contextlib.suppress(Exception):
+                    body = json.dumps(
+                        {"error": f"node table full ({self.MAX_NODES})"}
+                    ).encode()
+                    writer.write(
+                        (
+                            "HTTP/1.1 400 Bad Request\r\n"
+                            "Content-Type: application/json\r\n"
+                            f"Content-Length: {len(body)}\r\n"
+                            "Connection: close\r\n\r\n"
+                        ).encode("latin-1")
+                        + body
+                    )
+                    await writer.drain()
+                return
+            ns = self.nodes[node] = NodeState(node, tier)
+            if self.journal is not None:
+                self.journal.record(
+                    "federation", "info", node,
+                    f"downstream {tier} {node} connected",
+                )
+        else:
+            ns.tier = tier
+            ns.resyncs += 1
+        token = object()
+        ns.conn = token  # a reconnect supersedes the old stream
+        ns.connected = True
+        ns.decoder = DeltaStreamDecoder()  # new stream ⇒ fresh baseline
+        status, err = 200, None
+        buf = bytearray()
+        try:
+            while True:
+                data = await asyncio.wait_for(
+                    self._read_some(reader, chunked), timeout=60
+                )
+                if data is None:
+                    break  # orderly end of stream
+                if ns.conn is not token:
+                    return  # superseded by a newer connection: bow out
+                buf += data
+                for frame in split_records(buf):
+                    ns.bytes += len(frame)
+                    self._ingest_frame(ns, frame)
+        except ValueError as e:
+            status, err = 400, f"{type(e).__name__}: {e}"
+            ns.error = err
+            if self.journal is not None:
+                self.journal.record(
+                    "federation", "minor", node,
+                    f"refused frame from {node}: {e} (stream dropped, "
+                    f"sender resyncs via keyframe)",
+                )
+        except (asyncio.TimeoutError, ConnectionError, asyncio.IncompleteReadError):
+            pass  # connection-level failure: staleness marks it dark
+        finally:
+            if ns.conn is token:
+                ns.connected = False
+        with contextlib.suppress(Exception):
+            body = (
+                b"{}" if err is None
+                else json.dumps({"error": err}).encode()
+            )
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} {'OK' if status == 200 else 'Bad Request'}\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode("latin-1")
+                + body
+            )
+            await writer.drain()
+
+    async def _read_some(
+        self, reader: asyncio.StreamReader, chunked: bool
+    ) -> bytes | None:
+        """One read step: a chunk (chunked transfer) or a raw segment
+        (Connection-close framing). None = orderly end of stream."""
+        if not chunked:
+            data = await reader.read(65536)
+            return data or None
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            size = int(line.split(b";")[0].strip() or b"x", 16)
+        except ValueError:
+            raise ValueError("bad chunk header")
+        if size > _MAX_RECORD:
+            raise ValueError(f"implausible chunk size {size}")
+        if size == 0:
+            with contextlib.suppress(Exception):
+                await reader.readline()  # trailing CRLF
+            return None
+        data = await reader.readexactly(size)
+        await reader.readexactly(2)  # CRLF
+        return data
+
+    def _ingest_frame(self, ns: NodeState, frame: bytes) -> None:
+        res = ns.decoder.apply(frame)  # ValueError → caller answers 400
+        self.frames += 1
+        ns.frames += 1
+        if res["key"]:
+            ns.keyframes += 1
+        ns.last_ts = res["ts"]
+        ns.last_wall = time.monotonic()
+        ns.error = None
+        if ns.status != "ok":
+            ns.status = "ok"
+            if self.journal is not None:
+                self.journal.record(
+                    "federation", "info", ns.node,
+                    f"downstream {ns.node} recovered (keyframe resync)",
+                )
+        if ns.tier == "aggregator":
+            # Slice-level rows from a lower aggregator.
+            fields = res["fields"]
+            ns.slice_rows = [
+                dict(zip(fields, row)) for row in zip(*res["cols"])
+            ] if res["cols"] else []
+            ns.chips = []
+        else:
+            # Chip-level rows from a leaf: the PR 6 zero-parse batch
+            # path — columns → positional ChipSamples, rollups at
+            # ingest, one record_batch per frame.
+            chips = chips_from_columns(res["fields"], res["cols"])
+            ns.chips = chips
+            ns.slice_rows = slice_rollup_rows(chips, ns.node, res["ts"])
+        self._record_rollups(ns.slice_rows, res["ts"])
+        # Rollup lag: frames landing long after their sample time mean
+        # the tree is buffering somewhere — one event per transition.
+        lag = time.time() - res["ts"]
+        if lag > self.dark_after_s:
+            if not ns.lagging:
+                ns.lagging = True
+                if self.journal is not None:
+                    self.journal.record(
+                        "federation", "minor", ns.node,
+                        f"rollup lag: {ns.node} frames arriving "
+                        f"{lag:.1f}s after their sample time",
+                        lag_s=round(lag, 2),
+                    )
+        elif lag < self.dark_after_s / 2:
+            ns.lagging = False
+        if self.clock is not None:
+            self.clock.bump("federation")
+
+    def _record_rollups(self, rows: list[dict], ts: float) -> None:
+        """Land slice rollups in the TSDB through the batch path: one
+        record_batch per frame, series named slice.<node>.<id>.<stat>.
+        The reporting node is part of the key because slice ids are
+        only unique WITHIN a leaf (two leaves can both run a
+        "slice-0") — node-qualified series keep per-series timestamps
+        monotonic (one writer each), so appends stay on the fast
+        path and curves never interleave unrelated slices."""
+        if self.history is None or not rows:
+            return
+        batch = []
+        for r in rows:
+            sid = r.get("slice_id")
+            if not sid:
+                continue
+            node = r.get("node") or "unknown"
+            # Dark/unreachable rows carry LAST-KNOWN metrics for the
+            # fleet view — landing those again at fresh timestamps
+            # would flat-line the series indistinguishably from a live
+            # slice. An outage is an honest gap in the rollup curves.
+            if (r.get("health") or "ok") != "ok":
+                continue
+            for key, suffix in ROLLUP_SERIES:
+                v = r.get(key)
+                if v is not None:
+                    batch.append((f"slice.{node}.{sid}.{suffix}", v))
+        if batch:
+            self.history.record_batch(batch, ts=ts)
+
+    # ------------------------------ views -------------------------------
+
+    def check_staleness(self) -> None:
+        """Flip silent downstreams to dark — and eventually forget
+        dark, disconnected ones — called once per sampler tick (the
+        merged collector) and before every fleet-view render."""
+        now = time.monotonic()
+        for name in list(self.nodes):
+            ns = self.nodes[name]
+            if (
+                ns.status != "ok"
+                and not ns.connected
+                and ns.last_wall is not None
+                and now - ns.last_wall > self.forget_after_s
+            ):
+                del self.nodes[name]
+                if self.journal is not None:
+                    self.journal.record(
+                        "federation", "info", name,
+                        f"downstream {name} forgotten after "
+                        f"{(now - ns.last_wall) / 60:.0f}min dark",
+                    )
+                if self.clock is not None:
+                    self.clock.bump("federation")
+                continue
+            if (
+                ns.status == "ok"
+                and ns.last_wall is not None
+                and now - ns.last_wall > self.dark_after_s
+            ):
+                ns.status = "down"
+                dark = sorted({r.get("slice_id") for r in ns.slice_rows if r})
+                if self.journal is not None:
+                    self.journal.record(
+                        "federation", "serious", ns.node,
+                        f"downstream {ns.tier} {ns.node} dark: no frames "
+                        f"for {now - ns.last_wall:.1f}s"
+                        + (f" (slices {', '.join(map(str, dark))})" if dark else ""),
+                    )
+                if self.clock is not None:
+                    self.clock.bump("federation")
+
+    def chips(self) -> list[ChipSample]:
+        """Fresh downstream chips (leaf-tier nodes only; dark nodes'
+        chips drop out — exactly what slice alerting should see)."""
+        out: list[ChipSample] = []
+        for node in sorted(self.nodes):
+            ns = self.nodes[node]
+            if ns.status == "ok" and ns.chips:
+                out.extend(ns.chips)
+        return out
+
+    def slices(self) -> list[dict]:
+        """The failure-domain-aware slice table. Rows from a dark LEAF
+        keep their last metrics but health="dark"; rows from a dark
+        AGGREGATOR become health="unreachable" — the root can tell a
+        reported-dark slice from a partitioned aggregator subtree."""
+        out: list[dict] = []
+        for node in sorted(self.nodes):
+            ns = self.nodes[node]
+            for r in ns.slice_rows:
+                row = dict(r)
+                if ns.status != "ok":
+                    row["health"] = (
+                        "unreachable" if ns.tier == "aggregator" else "dark"
+                    )
+                out.append(row)
+        return out
+
+    def upstream_rows(self, ts: float) -> list[list]:
+        """The slice-level wire rows this tier pushes to ITS upstream:
+        every downstream slice (dark/unreachable markers included) plus
+        rollups of any local chips the merged collector stashed."""
+        rows = self.slices()
+        if self.local_chips:
+            rows += slice_rollup_rows(self.local_chips, self.node, ts)
+        return _rows_to_wire(rows)
+
+    def fleet(self) -> dict:
+        slices = self.slices()
+        chips = sum(r.get("chips") or 0 for r in slices)
+        duty = [
+            (r["duty_mean"], r.get("chips") or 0)
+            for r in slices
+            if r.get("duty_mean") is not None
+        ]
+        wsum = sum(n for _, n in duty)
+        return {
+            "slices": len(slices),
+            "chips": chips,
+            "dark_slices": sum(1 for r in slices if r.get("health") == "dark"),
+            "unreachable_slices": sum(
+                1 for r in slices if r.get("health") == "unreachable"
+            ),
+            "duty_mean": (
+                round(sum(d * n for d, n in duty) / wsum, 3) if wsum else None
+            ),
+        }
+
+    def to_json(self) -> dict:
+        self.check_staleness()
+        return {
+            "node": self.node,
+            "nodes": {n: ns.to_json() for n, ns in sorted(self.nodes.items())},
+            "slices": self.slices(),
+            "fleet": self.fleet(),
+            "frames": self.frames,
+        }
+
+    def health_json(self) -> dict:
+        ok = sum(1 for ns in self.nodes.values() if ns.status == "ok")
+        return {
+            "nodes": len(self.nodes),
+            "nodes_ok": ok,
+            "frames": self.frames,
+            "dark_slices": sum(
+                1 for r in self.slices() if r.get("health") != "ok"
+            ),
+        }
+
+
+class HubMergedCollector:
+    """Accel wrapper at an aggregator: merges the hub's downstream
+    chips into the local view each tick (the local collector, when any,
+    runs unchanged underneath). Dark downstreams degrade the sample's
+    error note — never its ok bit, so the accel breaker can't lock out
+    local collection because a *remote* leaf went silent."""
+
+    name = "accel"
+
+    def __init__(self, local: Collector | None, hub: FederationHub):
+        self.local = local
+        self.hub = hub
+
+    def set_journal(self, journal) -> None:
+        if self.local is not None and hasattr(self.local, "set_journal"):
+            self.local.set_journal(journal)
+
+    async def collect(self) -> Sample:
+        self.hub.check_staleness()
+        chips: list[ChipSample] = []
+        errors: list[str] = []
+        ok = True
+        if self.local is not None:
+            s = await self.local.collect()
+            ok = s.ok
+            chips.extend(s.data or [])
+            if s.error:
+                errors.append(s.error)
+        self.hub.local_chips = list(chips)
+        seen = {c.chip_id for c in chips}
+        for c in self.hub.chips():
+            if c.chip_id not in seen:
+                chips.append(c)
+                seen.add(c.chip_id)
+        for node, ns in sorted(self.hub.nodes.items()):
+            if ns.status != "ok":
+                errors.append(f"downstream {node} dark")
+        return Sample(
+            source=self.name, ok=ok, data=chips,
+            error="; ".join(errors) or None,
+        )
+
+
+class FederationUplink:
+    """Downstream side of the tree: one long-lived chunked POST to the
+    upstream's /api/federation/ingest, one delta frame per sampler tick
+    (leaves push chip rows, aggregators push slice rows). Reconnects
+    with exponential backoff, and — because the encoder resets on every
+    reconnect — always resyncs with a keyframe."""
+
+    def __init__(
+        self,
+        sampler,
+        url: str,
+        node: str,
+        tier: str = "leaf",
+        hub: FederationHub | None = None,
+        keyframe_every: int = 30,
+        backoff_max_s: float = 5.0,
+        auth_token: str | None = None,
+    ):
+        self.sampler = sampler
+        base = url if url.startswith(("http://", "https://")) else f"http://{url}"
+        self.url = base.rstrip("/")
+        self.node = node
+        self.tier = tier
+        self.hub = hub
+        self.enc = DeltaStreamEncoder(keyframe_every=keyframe_every)
+        self.backoff_max_s = backoff_max_s
+        self._backoff = 0.25
+        # Bearer token for the upstream's POST auth gate — trees are
+        # normally deployed with one fleet-wide auth_token, so the
+        # node's own token is what app.build passes here.
+        self.auth_token = auth_token
+        self.connected = False
+        self.connects = 0
+        self.resyncs = 0
+        self.last_error: str | None = None
+        self._task: asyncio.Task | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._was_down = False
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._task
+            self._task = None
+        if self._writer is not None:
+            with contextlib.suppress(Exception):
+                self._writer.close()
+            self._writer = None
+        self.connected = False
+
+    def resync(self) -> None:
+        """Force a reconnect (tests/bench): the next frame after the
+        re-established stream is a keyframe."""
+        if self._writer is not None:
+            with contextlib.suppress(Exception):
+                self._writer.close()
+
+    def _payload(self, ts: float) -> tuple[int, list[str], list[list]]:
+        if self.tier == "aggregator" and self.hub is not None:
+            rows = self.hub.upstream_rows(ts)
+            _quantize_rows(list(SLICE_FIELDS), rows, _F32_SLICE_FIELDS)
+            return SLICE_WIRE_VERSION, list(SLICE_FIELDS), rows
+        w = chips_to_wire(self.sampler.chips())
+        # Metric floats ship f32-exact so their columns take the
+        # half-width delta coding (rows are freshly built — safe to
+        # quantize in place).
+        _quantize_rows(w["fields"], w["rows"], _F32_CHIP_FIELDS)
+        return w["v"], w["fields"], w["rows"]
+
+    async def _run(self) -> None:
+        journal = self.sampler.journal
+        while True:
+            try:
+                await self._stream_once(journal)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.connected = False
+                err = f"{type(e).__name__}: {e}"
+                if self.last_error != err or not self._was_down:
+                    self.last_error = err
+                if not self._was_down:
+                    self._was_down = True
+                    journal.record(
+                        "federation", "serious", self.node,
+                        f"uplink to {self.url} lost: {err} (reconnecting; "
+                        f"resync will open with a keyframe)",
+                    )
+            await asyncio.sleep(self._backoff)
+            self._backoff = min(self._backoff * 2, self.backoff_max_s)
+
+    async def _stream_once(self, journal) -> None:
+        parts = urllib.parse.urlsplit(self.url)
+        tls = parts.scheme == "https"
+        reader, writer = await asyncio.open_connection(
+            parts.hostname,
+            parts.port or (443 if tls else 80),
+            ssl=True if tls else None,
+        )
+        self._writer = writer
+        try:
+            auth = (
+                f"Authorization: Bearer {self.auth_token}\r\n"
+                if self.auth_token
+                else ""
+            )
+            writer.write(
+                (
+                    f"POST {INGEST_PATH} HTTP/1.1\r\n"
+                    f"Host: {parts.netloc}\r\n"
+                    f"Content-Type: {DELTA_STREAM_CTYPE}\r\n"
+                    "Transfer-Encoding: chunked\r\n"
+                    f"{auth}"
+                    f"X-Tpumon-Node: {self.node}\r\n"
+                    f"X-Tpumon-Tier: {self.tier}\r\n\r\n"
+                ).encode("latin-1")
+            )
+            await writer.drain()
+            self.enc.reset()  # reconnect ⇒ next frame is a keyframe
+            # A successfully-established stream re-arms the fast retry:
+            # without this, transient blips over a long uptime would
+            # ratchet every future reconnect to backoff_max_s.
+            self._backoff = 0.25
+            self.connects += 1
+            self.connected = True
+            if self.connects == 1:
+                journal.record(
+                    "federation", "info", self.node,
+                    f"uplink established: pushing {self.tier} delta "
+                    f"frames to {self.url}",
+                )
+            if self._was_down:
+                self._was_down = False
+                self.resyncs += 1
+                journal.record(
+                    "federation", "info", self.node,
+                    f"uplink to {self.url} re-established "
+                    f"(keyframe resync)",
+                )
+            # Frame cadence: one per tick, but never a gap longer than
+            # ~2 s — a slow-ticking leaf (interval 10 s) still
+            # heartbeats (empty ~30 B deltas), so the upstream's
+            # dark_after_s staleness check is independent of every
+            # downstream's sample interval (no dark/recovered flap).
+            interval = max(0.25, self.sampler.cfg.sample_interval_s)
+            heartbeat = min(2.0, max(2 * interval, 0.25))
+            while True:
+                ts = time.time()
+                v, fields, rows = self._payload(ts)
+                frame, _was_key = self.enc.encode(v, fields, rows, ts)
+                rec = encode_varint(len(frame)) + frame
+                writer.write(b"%x\r\n" % len(rec) + rec + b"\r\n")
+                await writer.drain()
+                # The upstream only ever writes a response to END the
+                # stream (400 on a refused frame, or its own shutdown):
+                # any readable data means this stream is done.
+                with contextlib.suppress(asyncio.TimeoutError):
+                    data = await asyncio.wait_for(reader.read(4096), 0.001)
+                    raise ConnectionError(
+                        "upstream ended stream"
+                        if data
+                        else "upstream closed connection"
+                    )
+                await self.sampler.wait_tick(timeout_s=heartbeat)
+        finally:
+            self._writer = None
+            self.connected = False
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    def to_json(self) -> dict:
+        st = self.enc.stats
+        return {
+            "url": self.url,
+            "tier": self.tier,
+            "connected": self.connected,
+            "connects": self.connects,
+            "resyncs": self.resyncs,
+            "frames": st["frames"],
+            "keyframes": st["keyframes"],
+            "bytes": st["bytes"],
+            "delta_frames": st["delta_frames"],
+            "delta_bytes": st["delta_bytes"],
+            "keyframe_bytes": st["keyframe_bytes"],
+            **({"last_error": self.last_error} if self.last_error else {}),
+        }

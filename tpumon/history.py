@@ -984,23 +984,33 @@ class HistoryService:
         for name in PROM_QUERIES:
             if self._matches(name, series):
                 out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        self._add_per_chip(out, step, window, series)
+        # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
+        # drill-down charts, and per-slice rollup series
+        # (slice.<id>.<stat>) landed by the federation hub at ingest
+        # (tpumon.federation — an aggregator/root's group-by-slice
+        # curves); Prometheus equivalents are labelled series the
+        # client can also get via its own PromQL if deployed.
+        self._add_prefixed(out, "per_chip", "chip.", step, window, series)
+        self._add_prefixed(out, "per_slice", "slice.", step, window, series)
         return out
 
-    def _add_per_chip(
-        self, out: dict, step: float, window: float, series: str | None = None
+    def _add_prefixed(
+        self,
+        out: dict,
+        key: str,
+        prefix: str,
+        step: float,
+        window: float,
+        series: str | None = None,
     ) -> None:
-        # Ring-only per-chip series (chip.<id>.<field>) for the per-chip
-        # drill-down charts; Prometheus equivalents are labelled series the
-        # client can also get via its own PromQL if deployed.
-        per_chip: dict[str, dict] = {}
+        got: dict[str, dict] = {}
         for name in self.ring.series:
-            if name.startswith("chip.") and self._matches(name, series):
-                per_chip[name[len("chip.") :]] = self.ring.snapshot_series(
+            if name.startswith(prefix) and self._matches(name, series):
+                got[name[len(prefix) :]] = self.ring.snapshot_series(
                     name, step, window_s=window
                 )
-        if per_chip:
-            out["per_chip"] = per_chip
+        if got:
+            out[key] = got
 
     async def snapshot(
         self, window_s: float | None = None, series: str | None = None
@@ -1025,5 +1035,6 @@ class HistoryService:
                 out[name] = prom[name]
             else:
                 out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        self._add_per_chip(out, step, window, series)
+        self._add_prefixed(out, "per_chip", "chip.", step, window, series)
+        self._add_prefixed(out, "per_slice", "slice.", step, window, series)
         return out

@@ -159,6 +159,17 @@ _CT_STR = 4  # dict: nuniq + strings, then <u16 indices (0=None)
 _CT_BOOL = 5  # per-row byte: 0=None 1=False 2=True
 _CT_INTLIST_FIXED = 6  # varint m + bitmap + packed <i32 (m per non-null row)
 _CT_INTLIST = 7  # per-row varint (0=None else m+1) + m zigzag varints
+# Half-width floats for exactly-f32-representable columns (bitmap +
+# packed <f32 — lossless by construction). OPT-IN (allow_f32): only the
+# delta stream emits it — /api/accel/wire keeps the original ctype set
+# so pre-F32 peers never see an unknown column type. Decoders always
+# accept it.
+_CT_F32 = 8
+# Delta-frame-only flag on the per-column ctype byte: an i64 sub-column
+# coded as zigzag-varint DIFFS against the decoder's previous values at
+# those rows (cumulative ICI counters move ~2e9/tick — 5 varint bytes
+# instead of 8 fixed). Never valid in a full frame.
+_CTF_I64_DELTA = 0x80
 
 _I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
 _I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
@@ -180,8 +191,13 @@ def _null_bitmap(col: list) -> bytes:
     return bytes(bm)
 
 
-def _classify(col: list) -> int:
+def _f32_exact(v: float) -> bool:
+    return struct.unpack("<f", struct.pack("<f", v))[0] == v
+
+
+def _classify(col: list, allow_f32: bool = False) -> int:
     saw_float = saw_int = saw_big = False
+    f32_ok = True
     intlist_m = None
     intlist_ok = saw_list = False
     for v in col:
@@ -195,6 +211,8 @@ def _classify(col: list) -> int:
                 saw_big = True
         elif isinstance(v, float):
             saw_float = True
+            if f32_ok and not _f32_exact(v):
+                f32_ok = False
         elif isinstance(v, str):
             return _CT_STR
         elif isinstance(v, (list, tuple)):
@@ -214,6 +232,10 @@ def _classify(col: list) -> int:
     if saw_list:
         return _CT_INTLIST_FIXED if intlist_ok and intlist_m else _CT_INTLIST
     if saw_float:
+        # Pure-float f32-exact columns halve to <f32 when the caller
+        # opted in (losslessly — exactness was just proven per value).
+        if allow_f32 and not saw_int and f32_ok:
+            return _CT_F32
         # Mixed int/float columns ride as f64 (the ints come back
         # float-typed — numerically equal, which is what the federation
         # merge compares); only a mix of floats and >2**53 ints would
@@ -224,8 +246,77 @@ def _classify(col: list) -> int:
     return _CT_NONE
 
 
-def encode_wire_frame(v: int, fields: list[str], rows: list[list]) -> bytes:
-    """Serialize a chips_to_wire payload as a columnar binary frame."""
+def _encode_col(out: bytearray, col: list, ctype: int) -> None:
+    """Append one column's payload under an already-chosen ``ctype``.
+    Shared by full frames and delta sub-columns — the delta path
+    encodes a changed-rows subset under the FULL column's ctype, so a
+    replayed cell is byte-identical to the same cell in a full frame."""
+    if ctype == _CT_NONE:
+        return
+    if ctype == _CT_F64:
+        present = [float(x) for x in col if x is not None]
+        out += _null_bitmap(col)
+        out += struct.pack(f"<{len(present)}d", *present)
+    elif ctype == _CT_F32:
+        present = [float(x) for x in col if x is not None]
+        out += _null_bitmap(col)
+        out += struct.pack(f"<{len(present)}f", *present)
+    elif ctype == _CT_I64:
+        present = [x for x in col if x is not None]
+        out += _null_bitmap(col)
+        out += struct.pack(f"<{len(present)}q", *present)
+    elif ctype == _CT_VARINT:
+        out += _null_bitmap(col)
+        for x in col:
+            if x is not None:
+                out += encode_varint(_zigzag64(x))
+    elif ctype == _CT_STR:
+        uniq: dict[str, int] = {}
+        for x in col:
+            if x is not None and x not in uniq:
+                uniq[x] = len(uniq)
+        if len(uniq) > 0xFFFE:
+            raise ValueError("string dictionary overflow")
+        out += encode_varint(len(uniq))
+        for s in uniq:
+            raw = s.encode("utf-8")
+            out += encode_varint(len(raw)) + raw
+        out += struct.pack(
+            f"<{len(col)}H",
+            *(0 if x is None else uniq[x] + 1 for x in col),
+        )
+    elif ctype == _CT_BOOL:
+        out += bytes(0 if x is None else (2 if x else 1) for x in col)
+    elif ctype == _CT_INTLIST_FIXED:
+        flat: list[int] = []
+        m = 0
+        for x in col:
+            if x is not None:
+                m = len(x)
+                flat.extend(x)
+        out += encode_varint(m)
+        out += _null_bitmap(col)
+        out += struct.pack(f"<{len(flat)}i", *flat)
+    elif ctype == _CT_INTLIST:
+        for x in col:
+            if x is None:
+                out += encode_varint(0)
+            else:
+                out += encode_varint(len(x) + 1)
+                for n in x:
+                    out += encode_varint(_zigzag64(int(n)))
+    else:
+        raise ValueError(f"unknown wire column type {ctype}")
+
+
+def encode_wire_frame(
+    v: int, fields: list[str], rows: list[list], allow_f32: bool = False
+) -> bytes:
+    """Serialize a chips_to_wire payload as a columnar binary frame.
+
+    ``allow_f32`` opts in to the half-width float column type — the
+    delta stream uses it; /api/accel/wire keeps the default so frames
+    served to pre-F32 peers never contain a ctype they can't decode."""
     out = bytearray(WIRE_FRAME_MAGIC)
     out.append(WIRE_FRAME_VERSION)
     out += encode_varint(v)
@@ -236,58 +327,9 @@ def encode_wire_frame(v: int, fields: list[str], rows: list[list]) -> bytes:
     out += encode_varint(len(rows))
     for ci in range(len(fields)):
         col = [row[ci] for row in rows]
-        ctype = _classify(col)
+        ctype = _classify(col, allow_f32=allow_f32)
         out.append(ctype)
-        if ctype == _CT_NONE:
-            continue
-        if ctype == _CT_F64:
-            present = [float(x) for x in col if x is not None]
-            out += _null_bitmap(col)
-            out += struct.pack(f"<{len(present)}d", *present)
-        elif ctype == _CT_I64:
-            present = [x for x in col if x is not None]
-            out += _null_bitmap(col)
-            out += struct.pack(f"<{len(present)}q", *present)
-        elif ctype == _CT_VARINT:
-            out += _null_bitmap(col)
-            for x in col:
-                if x is not None:
-                    out += encode_varint(_zigzag64(x))
-        elif ctype == _CT_STR:
-            uniq: dict[str, int] = {}
-            for x in col:
-                if x is not None and x not in uniq:
-                    uniq[x] = len(uniq)
-            if len(uniq) > 0xFFFE:
-                raise ValueError("string dictionary overflow")
-            out += encode_varint(len(uniq))
-            for s in uniq:
-                raw = s.encode("utf-8")
-                out += encode_varint(len(raw)) + raw
-            out += struct.pack(
-                f"<{len(col)}H",
-                *(0 if x is None else uniq[x] + 1 for x in col),
-            )
-        elif ctype == _CT_BOOL:
-            out += bytes(0 if x is None else (2 if x else 1) for x in col)
-        elif ctype == _CT_INTLIST_FIXED:
-            flat: list[int] = []
-            m = 0
-            for x in col:
-                if x is not None:
-                    m = len(x)
-                    flat.extend(x)
-            out += encode_varint(m)
-            out += _null_bitmap(col)
-            out += struct.pack(f"<{len(flat)}i", *flat)
-        elif ctype == _CT_INTLIST:
-            for x in col:
-                if x is None:
-                    out += encode_varint(0)
-                else:
-                    out += encode_varint(len(x) + 1)
-                    for n in x:
-                        out += encode_varint(_zigzag64(int(n)))
+        _encode_col(out, col, ctype)
     return bytes(out)
 
 
@@ -318,6 +360,96 @@ def _packed(blob: bytes, pos: int, nrows: int, fmt: str, size: int):
 
 
 _POPCOUNT = [bin(i).count("1") for i in range(256)]
+
+
+def _decode_col(blob: bytes, pos: int, nrows: int, ctype: int) -> tuple[list, int]:
+    """Decode one column payload of ``nrows`` values under ``ctype``;
+    returns (values, new pos). Shared by full frames and delta
+    sub-columns. Raises ValueError on anything malformed/truncated."""
+    if ctype == _CT_NONE:
+        return [None] * nrows, pos
+    if ctype == _CT_F64:
+        return _packed(blob, pos, nrows, "d", 8)
+    if ctype == _CT_F32:
+        return _packed(blob, pos, nrows, "f", 4)
+    if ctype == _CT_I64:
+        return _packed(blob, pos, nrows, "q", 8)
+    if ctype == _CT_VARINT:
+        nbm = (nrows + 7) // 8
+        bm = blob[pos : pos + nbm]
+        if len(bm) < nbm:
+            raise ValueError("truncated null bitmap")
+        pos += nbm
+        col: list = []
+        for i in range(nrows):
+            if bm[i >> 3] & (1 << (i & 7)):
+                u, pos = decode_varint(blob, pos)
+                col.append(_unzigzag64(u))
+            else:
+                col.append(None)
+        return col, pos
+    if ctype == _CT_STR:
+        nuniq, pos = decode_varint(blob, pos)
+        if nuniq > 0xFFFE:
+            raise ValueError("implausible string dictionary")
+        # Index 0 = None, i+1 = uniq[i]: prepending None makes the
+        # per-row step one list index over the C-decoded u16 block.
+        uniq: list = [None]
+        for _ in range(nuniq):
+            ln, pos = decode_varint(blob, pos)
+            if pos + ln > len(blob):
+                raise ValueError("truncated string")
+            uniq.append(blob[pos : pos + ln].decode("utf-8"))
+            pos += ln
+        if pos + 2 * nrows > len(blob):
+            raise ValueError("truncated string indices")
+        idx = struct.unpack_from(f"<{nrows}H", blob, pos)
+        pos += 2 * nrows
+        try:
+            return [uniq[i] for i in idx], pos
+        except IndexError:
+            raise ValueError("string index out of range")
+    if ctype == _CT_BOOL:
+        if pos + nrows > len(blob):
+            raise ValueError("truncated bool column")
+        seg = blob[pos : pos + nrows]
+        pos += nrows
+        return [None if b == 0 else b == 2 for b in seg], pos
+    if ctype == _CT_INTLIST_FIXED:
+        m, pos = decode_varint(blob, pos)
+        if not 0 < m <= 64:
+            raise ValueError("implausible int-list stride")
+        nbm = (nrows + 7) // 8
+        bm = blob[pos : pos + nbm]
+        if len(bm) < nbm:
+            raise ValueError("truncated null bitmap")
+        pos += nbm
+        k = sum(_POPCOUNT[b] for b in bm)
+        if pos + 4 * m * k > len(blob):
+            raise ValueError("truncated int-list column")
+        flat = struct.unpack_from(f"<{m * k}i", blob, pos)
+        pos += 4 * m * k
+        lists = [list(flat[i : i + m]) for i in range(0, m * k, m)]
+        if k == nrows:
+            return lists, pos
+        return _weave(lists, bm, nrows), pos
+    if ctype == _CT_INTLIST:
+        # Ragged/oversized int lists — the rare fallback when
+        # _CT_INTLIST_FIXED's uniform stride doesn't hold, so plain
+        # varint calls are fine here.
+        col = []
+        for _ in range(nrows):
+            m, pos = decode_varint(blob, pos)
+            if m == 0:
+                col.append(None)
+            else:
+                xs: list = []
+                for _ in range(m - 1):
+                    u, pos = decode_varint(blob, pos)
+                    xs.append(_unzigzag64(u))
+                col.append(xs)
+        return col, pos
+    raise ValueError(f"unknown wire column type {ctype}")
 
 
 def decode_wire_frame(blob: bytes) -> tuple[int, list[str], list[list]]:
@@ -352,95 +484,317 @@ def decode_wire_frame(blob: bytes) -> tuple[int, list[str], list[list]]:
             raise ValueError("truncated column")
         ctype = blob[pos]
         pos += 1
-        if ctype == _CT_NONE:
-            cols.append([None] * nrows)
-        elif ctype == _CT_F64:
-            col, pos = _packed(blob, pos, nrows, "d", 8)
-            cols.append(col)
-        elif ctype == _CT_I64:
-            col, pos = _packed(blob, pos, nrows, "q", 8)
-            cols.append(col)
-        elif ctype == _CT_VARINT:
-            nbm = (nrows + 7) // 8
-            bm = blob[pos : pos + nbm]
-            if len(bm) < nbm:
-                raise ValueError("truncated null bitmap")
-            pos += nbm
-            col = []
-            for i in range(nrows):
-                if bm[i >> 3] & (1 << (i & 7)):
-                    u, pos = decode_varint(blob, pos)
-                    col.append(_unzigzag64(u))
-                else:
-                    col.append(None)
-            cols.append(col)
-        elif ctype == _CT_STR:
-            nuniq, pos = decode_varint(blob, pos)
-            if nuniq > 0xFFFE:
-                raise ValueError("implausible string dictionary")
-            # Index 0 = None, i+1 = uniq[i]: prepending None makes the
-            # per-row step one list index over the C-decoded u16 block.
-            uniq: list = [None]
-            for _ in range(nuniq):
-                ln, pos = decode_varint(blob, pos)
-                if pos + ln > len(blob):
-                    raise ValueError("truncated string")
-                uniq.append(blob[pos : pos + ln].decode("utf-8"))
-                pos += ln
-            if pos + 2 * nrows > len(blob):
-                raise ValueError("truncated string indices")
-            idx = struct.unpack_from(f"<{nrows}H", blob, pos)
-            pos += 2 * nrows
-            try:
-                cols.append([uniq[i] for i in idx])
-            except IndexError:
-                raise ValueError("string index out of range")
-        elif ctype == _CT_BOOL:
-            if pos + nrows > len(blob):
-                raise ValueError("truncated bool column")
-            seg = blob[pos : pos + nrows]
-            pos += nrows
-            cols.append([None if b == 0 else b == 2 for b in seg])
-        elif ctype == _CT_INTLIST_FIXED:
-            m, pos = decode_varint(blob, pos)
-            if not 0 < m <= 64:
-                raise ValueError("implausible int-list stride")
-            nbm = (nrows + 7) // 8
-            bm = blob[pos : pos + nbm]
-            if len(bm) < nbm:
-                raise ValueError("truncated null bitmap")
-            pos += nbm
-            k = sum(_POPCOUNT[b] for b in bm)
-            if pos + 4 * m * k > len(blob):
-                raise ValueError("truncated int-list column")
-            flat = struct.unpack_from(f"<{m * k}i", blob, pos)
-            pos += 4 * m * k
-            lists = [
-                list(flat[i : i + m]) for i in range(0, m * k, m)
-            ]
-            if k == nrows:
-                cols.append(lists)
-            else:
-                cols.append(_weave(lists, bm, nrows))
-        elif ctype == _CT_INTLIST:
-            # Ragged/oversized int lists — the rare fallback when
-            # _CT_INTLIST_FIXED's uniform stride doesn't hold, so plain
-            # varint calls are fine here.
-            col = []
-            for _ in range(nrows):
-                m, pos = decode_varint(blob, pos)
-                if m == 0:
-                    col.append(None)
-                else:
-                    xs = []
-                    for _ in range(m - 1):
-                        u, pos = decode_varint(blob, pos)
-                        xs.append(_unzigzag64(u))
-                    col.append(xs)
-            cols.append(col)
-        else:
-            raise ValueError(f"unknown wire column type {ctype}")
+        col, pos = _decode_col(blob, pos, nrows, ctype)
+        cols.append(col)
     return v, fields, cols
+
+
+# ---------------------- delta stream frames ----------------------------
+#
+# Push-based federation wire (tpumon.federation, docs/federation.md):
+# a leaf monitor streams its columnar table (chip rows, or slice-rollup
+# rows at the aggregator tier) upstream as a BASELINE KEYFRAME followed
+# by per-tick changed-columns diffs, so steady state ships only the
+# cells that moved (duty/HBM/temp/ICI counters) instead of the whole
+# 256-chip table every tick. Layout:
+#
+#   keyframe:  TPWK <u8 ver> <f64 ts> varint seq
+#              varint len + embedded TPWF full frame
+#   delta:     TPWD <u8 ver> <f64 ts> varint seq varint prev_seq
+#              varint nrows + row mask (ceil(nrows/8) bytes,
+#              bit i = row i changed)
+#              varint ncols; per col: varint (index<<1 | full_flag),
+#              u8 ctype, column payload over the masked rows (or ALL
+#              rows when full_flag — see below)
+#
+# Replay is BIT-EXACT versus decoding a full frame of the same table
+# (values and types): a changed cell is re-encoded under the ctype of
+# the FULL current column, and a column whose ctype changed since the
+# last frame (e.g. an all-int column gaining floats) is re-sent whole
+# under the new ctype, so no cell is ever interpreted under a stale
+# ctype. A delta whose prev_seq doesn't match the decoder's state
+# raises ValueError — the transport treats that as a gap and resyncs
+# by reconnecting, which always starts with a keyframe (the same
+# resync contract as the SSE delta stream, tpumon.deltas).
+
+DELTA_KEY_MAGIC = b"TPWK"
+DELTA_DIFF_MAGIC = b"TPWD"
+DELTA_FRAME_VERSION = 1
+DELTA_STREAM_CTYPE = "application/x-tpumon-deltastream"
+
+
+def _read_f64(blob: bytes, pos: int) -> tuple[float, int]:
+    if pos + 8 > len(blob):
+        raise ValueError("truncated f64")
+    return struct.unpack_from("<d", blob, pos)[0], pos + 8
+
+
+class DeltaStreamEncoder:
+    """Stateful keyframe+diff encoder over (v, fields, rows) tables.
+
+    ``encode`` returns ``(frame bytes, was_keyframe)``. Keyframes are
+    emitted on the first frame, on any shape change (field list, row
+    count, wire version), every ``keyframe_every`` frames (the
+    ``sse_keyframe_every`` cadence idea: a silently-desynced consumer
+    is bounded), and on ``force_key``/``reset()`` (transport
+    reconnect). ``stats`` feeds bench.py's federation_tree phase.
+    """
+
+    def __init__(self, keyframe_every: int = 30):
+        self.keyframe_every = max(1, int(keyframe_every))
+        self.seq = 0
+        self._since_key = 0
+        self._v: int | None = None
+        self._fields: list[str] | None = None
+        self._cols: list[list] | None = None
+        self._ctypes: list[int] | None = None
+        self.stats = {
+            "frames": 0, "keyframes": 0, "bytes": 0,
+            "delta_frames": 0, "delta_bytes": 0, "keyframe_bytes": 0,
+        }
+
+    def reset(self) -> None:
+        """Drop baseline state: the next encode() emits a keyframe
+        (reconnect resync — mirrors the SSE client protocol)."""
+        self._cols = None
+
+    def _header(self, magic: bytes, ts: float) -> bytearray:
+        out = bytearray(magic)
+        out.append(DELTA_FRAME_VERSION)
+        out += struct.pack("<d", ts)
+        out += encode_varint(self.seq)
+        return out
+
+    def encode(
+        self, v: int, fields: list[str], rows: list[list], ts: float,
+        force_key: bool = False,
+    ) -> tuple[bytes, bool]:
+        fields = list(fields)
+        cols = [[row[ci] for row in rows] for ci in range(len(fields))]
+        # allow_f32: stream frames are only read by DeltaStreamDecoder,
+        # so the compact float type is safe here (unlike the negotiated
+        # /api/accel/wire representation).
+        ctypes = [_classify(c, allow_f32=True) for c in cols]
+        nrows = len(rows)
+        prev = self._cols
+        need_key = (
+            force_key
+            or prev is None
+            or v != self._v
+            or fields != self._fields
+            or (prev and len(prev[0]) != nrows)
+            or (not prev and nrows)
+            or self._since_key >= self.keyframe_every
+        )
+        self.seq += 1
+        if need_key:
+            inner = encode_wire_frame(v, fields, rows, allow_f32=True)
+            out = self._header(DELTA_KEY_MAGIC, ts)
+            out += encode_varint(len(inner))
+            out += inner
+            self._since_key = 1
+            self.stats["keyframes"] += 1
+            self.stats["keyframe_bytes"] = len(out)
+            was_key = True
+        else:
+            prev_ctypes = self._ctypes
+            changed_rows = [False] * nrows
+            partial: list[int] = []
+            full: list[int] = []
+            for ci, (col, pc) in enumerate(zip(cols, prev)):
+                if ctypes[ci] != prev_ctypes[ci]:
+                    # ctype moved (int column gained floats, ...): the
+                    # whole column re-ships so no unchanged cell stays
+                    # decoded under the stale ctype.
+                    full.append(ci)
+                    continue
+                hit = False
+                for ri in range(nrows):
+                    a = col[ri]
+                    b = pc[ri]
+                    if a is b or a == b:
+                        continue
+                    changed_rows[ri] = True
+                    hit = True
+                if hit:
+                    partial.append(ci)
+            idx = [i for i, c in enumerate(changed_rows) if c]
+            out = self._header(DELTA_DIFF_MAGIC, ts)
+            out += encode_varint(self.seq - 1)
+            out += encode_varint(nrows)
+            mask = bytearray((nrows + 7) // 8)
+            for i in idx:
+                mask[i >> 3] |= 1 << (i & 7)
+            out += mask
+            out += encode_varint(len(partial) + len(full))
+            for ci in sorted(partial + full):
+                is_full = ci in full
+                out += encode_varint((ci << 1) | (1 if is_full else 0))
+                sub = cols[ci] if is_full else [cols[ci][ri] for ri in idx]
+                if all(x is None for x in sub):
+                    # An all-None subset under the full column's ctype
+                    # can be unencodable (_CT_INTLIST_FIXED needs a
+                    # stride from a non-null list) — and _CT_NONE is
+                    # both always valid and smaller.
+                    out.append(_CT_NONE)
+                    continue
+                if ctypes[ci] == _CT_I64 and not is_full:
+                    # Cumulative-counter sub-columns (ICI tx/rx, HBM
+                    # bytes) diff-code against the decoder's previous
+                    # values when every touched cell has an int on both
+                    # sides and the diff fits int64 — ~2e9/tick counter
+                    # steps cost 5 varint bytes instead of 8 fixed.
+                    olds = [prev[ci][ri] for ri in idx]
+                    if all(
+                        isinstance(o, int)
+                        and x is not None
+                        and _I64_MIN <= x - o <= _I64_MAX
+                        for o, x in zip(olds, sub)
+                    ):
+                        out.append(_CTF_I64_DELTA | _CT_I64)
+                        for o, x in zip(olds, sub):
+                            out += encode_varint(_zigzag64(x - o))
+                        continue
+                out.append(ctypes[ci])
+                _encode_col(out, sub, ctypes[ci])
+            self._since_key += 1
+            self.stats["delta_frames"] += 1
+            self.stats["delta_bytes"] += len(out)
+            was_key = False
+        self._v = v
+        self._fields = fields
+        self._cols = cols
+        self._ctypes = ctypes
+        self.stats["frames"] += 1
+        self.stats["bytes"] += len(out)
+        return bytes(out), was_key
+
+
+class DeltaStreamDecoder:
+    """Inverse of DeltaStreamEncoder: feed frames in stream order via
+    ``apply``; the decoder's ``cols`` converge bit-exactly on what a
+    full-frame decode of the sender's current table would produce.
+
+    Raises ValueError on malformed/truncated frames, a delta before
+    any keyframe, a row-count mismatch, or a ``prev_seq`` gap — the
+    caller drops the connection and the sender resyncs with a
+    keyframe. Delta application is two-phase (fully parsed, then
+    applied) so a raise never leaves half-applied state.
+    """
+
+    def __init__(self):
+        self.v: int | None = None
+        self.fields: list[str] = []
+        self.cols: list[list] = []
+        self.seq = 0
+        self.frames = 0
+        self.keyframes = 0
+        self._synced = False
+
+    def apply(self, blob: bytes) -> dict:
+        """Apply one frame; returns {"v", "fields", "cols", "ts",
+        "seq", "key"}. ``cols`` is the decoder's live state — read it
+        before feeding the next frame, don't mutate it."""
+        magic = blob[:4]
+        if magic == DELTA_KEY_MAGIC:
+            return self._apply_key(blob)
+        if magic == DELTA_DIFF_MAGIC:
+            return self._apply_diff(blob)
+        raise ValueError("bad delta stream frame magic")
+
+    def _head(self, blob: bytes) -> tuple[float, int, int]:
+        if len(blob) < 5:
+            raise ValueError("truncated delta frame header")
+        if blob[4] != DELTA_FRAME_VERSION:
+            raise ValueError(f"unsupported delta frame version {blob[4]}")
+        ts, pos = _read_f64(blob, 5)
+        seq, pos = decode_varint(blob, pos)
+        return ts, seq, pos
+
+    def _done(self, ts: float, seq: int, key: bool) -> dict:
+        self.seq = seq
+        self.frames += 1
+        self._synced = True
+        return {
+            "v": self.v, "fields": self.fields, "cols": self.cols,
+            "ts": ts, "seq": seq, "key": key,
+        }
+
+    def _apply_key(self, blob: bytes) -> dict:
+        ts, seq, pos = self._head(blob)
+        ln, pos = decode_varint(blob, pos)
+        if pos + ln > len(blob):
+            raise ValueError("truncated keyframe payload")
+        self.v, self.fields, self.cols = decode_wire_frame(blob[pos : pos + ln])
+        if pos + ln != len(blob):
+            raise ValueError("trailing bytes after keyframe")
+        self.keyframes += 1
+        return self._done(ts, seq, True)
+
+    def _apply_diff(self, blob: bytes) -> dict:
+        if not self._synced:
+            raise ValueError("delta frame before any keyframe")
+        ts, seq, pos = self._head(blob)
+        prev_seq, pos = decode_varint(blob, pos)
+        if prev_seq != self.seq:
+            raise ValueError(
+                f"delta sequence gap (frame follows {prev_seq}, "
+                f"state at {self.seq})"
+            )
+        nrows, pos = decode_varint(blob, pos)
+        if self.cols and nrows != len(self.cols[0]):
+            raise ValueError("delta row count mismatch")
+        nbm = (nrows + 7) // 8
+        mask = blob[pos : pos + nbm]
+        if len(mask) < nbm:
+            raise ValueError("truncated delta row mask")
+        pos += nbm
+        idx = [i for i in range(nrows) if mask[i >> 3] & (1 << (i & 7))]
+        ncols, pos = decode_varint(blob, pos)
+        if ncols > len(self.cols):
+            raise ValueError("implausible delta column count")
+        # Phase 1: parse everything (any truncation raises BEFORE any
+        # state is touched).
+        pending: list[tuple[int, bool, list]] = []
+        for _ in range(ncols):
+            tag, pos = decode_varint(blob, pos)
+            ci, is_full = tag >> 1, bool(tag & 1)
+            if ci >= len(self.cols):
+                raise ValueError("delta column index out of range")
+            if pos >= len(blob):
+                raise ValueError("truncated delta column")
+            ctype = blob[pos]
+            pos += 1
+            if ctype & _CTF_I64_DELTA:
+                # Diff-coded i64 sub-column: previous state + varint
+                # zigzag diffs (reading state here is fine — phase 2
+                # is the only writer).
+                if (ctype & ~_CTF_I64_DELTA) != _CT_I64 or is_full:
+                    raise ValueError("bad diff-coded column header")
+                col = self.cols[ci]
+                vals = []
+                for ri in idx:
+                    u, pos = decode_varint(blob, pos)
+                    old = col[ri]
+                    if not isinstance(old, int):
+                        raise ValueError("diff against a non-int cell")
+                    vals.append(old + _unzigzag64(u))
+            else:
+                vals, pos = _decode_col(
+                    blob, pos, nrows if is_full else len(idx), ctype
+                )
+            pending.append((ci, is_full, vals))
+        if pos != len(blob):
+            raise ValueError("trailing bytes after delta frame")
+        # Phase 2: apply.
+        for ci, is_full, vals in pending:
+            if is_full:
+                self.cols[ci] = vals
+            else:
+                col = self.cols[ci]
+                for k, ri in enumerate(idx):
+                    col[ri] = vals[k]
+        return self._done(ts, seq, False)
 
 
 def decode_message(buf: bytes, max_depth: int = 16) -> Message:

@@ -195,6 +195,14 @@ class Sampler:
         self._last_tick_ms: float | None = None
         self._fleet_duty: float | None = None
         self._fleet_hbm: float | None = None
+        # Hierarchical federation (tpumon.federation): tpumon.app.build
+        # attaches a FederationHub here when this instance is an
+        # aggregator/root (downstream delta streams fan in through it)
+        # and a FederationUplink when --federate-up is configured (this
+        # instance pushes delta frames to its upstream). Both are None
+        # on a standalone monitor.
+        self.federation = None
+        self.uplink = None
         # Chaos wrappers and peer federations record their own journal
         # events; hand them the shared journal (duck-typed so the
         # collector layer stays import-free of the sampler).
@@ -270,6 +278,27 @@ class Sampler:
             **(
                 {"anomaly": self.anomaly.to_json()}
                 if self.anomaly is not None and self.anomaly.detectors
+                else {}
+            ),
+            # Aggregator-tree health (tpumon.federation): downstream
+            # fan-in counts when this node aggregates, uplink stream
+            # state when it pushes. Absent on standalone monitors.
+            **(
+                {
+                    "federation": {
+                        **(
+                            self.federation.health_json()
+                            if self.federation is not None
+                            else {}
+                        ),
+                        **(
+                            {"uplink": self.uplink.to_json()}
+                            if self.uplink is not None
+                            else {}
+                        ),
+                    }
+                }
+                if self.federation is not None or self.uplink is not None
                 else {}
             ),
             **(
@@ -854,6 +883,10 @@ class Sampler:
             )
 
     async def stop(self) -> None:
+        # The uplink stops first: it waits on tick events the stopping
+        # loops will never fire again.
+        if self.uplink is not None:
+            await self.uplink.stop()
         # Tick loops stop first — a tick firing during notifier.close()
         # would schedule a dispatch task nobody awaits.
         for t in self._tasks:

@@ -49,6 +49,16 @@ Route map (SURVEY §2.3, re-keyed for TPU):
                         transitions, chaos injections, anomaly fires,
                         peer up/down — ?after=<cursor>&kind=&severity=
                         &since=&limit= filters, cursor-paginated
+  /api/federation       aggregator-tree fleet view (tpumon.federation,
+                        docs/federation.md): per-downstream stream
+                        state, the failure-domain-aware slice table
+                        (ok/dark/unreachable) and fleet totals; on a
+                        standalone instance reports role "standalone"
+  /api/federation/ingest  POST (long-lived, chunked): the push-based
+                        federation wire — downstream leaves/aggregators
+                        stream columnar delta frames (protowire
+                        TPWK/TPWD) up the tree; 404 unless this
+                        instance is an aggregator/root
   /metrics              in-tree Prometheus exporter
 
 The reference's ``/danyichun`` path-prefix file read (monitor_server.js:
@@ -143,6 +153,11 @@ class MonitorServer:
         self.sampler = sampler
         self.history = history
         self._server: asyncio.Server | None = None
+        # Live client connections: keep-alive sockets and long-lived
+        # streams (SSE, federation ingest) outlive individual requests,
+        # so stop() must close them too — a "stopped" server that kept
+        # answering warm connections would fake peer liveness.
+        self._client_writers: set = set()
         self.request_latencies_ms: deque = deque(maxlen=2048)
         self.per_path_latencies_ms: dict[str, deque] = {}
         self._dashboard = StaticFile(
@@ -191,6 +206,11 @@ class MonitorServer:
             # activity, so "samples" (bumped every poll) is the honest
             # version — between ticks every request reuses the render.
             "/api/trace": (("samples",), self._api_trace),
+            # Fleet view of the aggregator tree (tpumon.federation):
+            # "federation" moves as downstream frames land; "samples"
+            # keeps uplink/staleness stats fresh per tick. Standalone
+            # instances render once ("standalone") and cache forever.
+            "/api/federation": (("federation", "samples"), self._api_federation),
         }
         # SSE epoch sections (see RT_SECTIONS): the trace strip rides
         # the payload only when tracing is on, and only then may the
@@ -307,6 +327,22 @@ class MonitorServer:
         per-chip key/value dicts — a fraction of the bytes and parse
         work of /api/accel/metrics at 256 chips."""
         return chips_to_wire(self.sampler.chips())
+
+    def _api_federation(self) -> dict:
+        """Aggregator-tree status (tpumon.federation): this node's
+        role, uplink stream state, per-downstream fan-in state, the
+        failure-domain-aware slice table and fleet totals."""
+        hub = getattr(self.sampler, "federation", None)
+        uplink = getattr(self.sampler, "uplink", None)
+        out: dict = {
+            "role": self.cfg.federation_role
+            or ("leaf" if uplink is not None else "standalone"),
+        }
+        if uplink is not None:
+            out["uplink"] = uplink.to_json()
+        if hub is not None:
+            out.update(hub.to_json())
+        return out
 
     def _api_trace(self) -> dict:
         """Self-trace view: ring stats, per-stage p50/p95/max, per-route
@@ -621,7 +657,7 @@ class MonitorServer:
                     "/", "/monitor.html", "/index.html", "/dashboard",
                     "/logo.svg", "/chartcore.js", "/dashboard.js",
                     "/metrics", "/api/health", "/api/history",
-                    "/api/events",
+                    "/api/events", "/api/federation/ingest",
                     "/api/profile", "/api/stream", "/api/trace/export",
                     "/api/silence", "/api/unsilence",
                 }
@@ -803,117 +839,193 @@ class MonitorServer:
     # ---------------------------- HTTP plumbing ----------------------------
 
     async def _client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        t0 = time.monotonic()
+        self._client_writers.add(writer)
         try:
-            request_line = await asyncio.wait_for(reader.readline(), timeout=10)
-            if not request_line:
-                return
-            try:
-                method, target, _version = request_line.decode("latin-1").split()
-            except ValueError:
-                return
-            # Drain headers; Content-Length is the only one routing needs
-            # (POST bodies for the silence routes).
-            content_length = 0
-            origin = host_hdr = auth_hdr = inm_hdr = accept_hdr = None
-            while True:
-                line = await asyncio.wait_for(reader.readline(), timeout=10)
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                lower = line.lower()
-                if lower.startswith(b"content-length:"):
-                    try:
-                        content_length = int(line.split(b":", 1)[1])
-                    except ValueError:
-                        pass
-                elif lower.startswith(b"origin:"):
-                    origin = line.split(b":", 1)[1].strip().decode("latin-1")
-                elif lower.startswith(b"host:"):
-                    host_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
-                elif lower.startswith(b"authorization:"):
-                    auth_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
-                elif lower.startswith(b"if-none-match:"):
-                    inm_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
-                elif lower.startswith(b"accept:"):
-                    accept_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
-            # Query stripped from routing (monitor_server.js:250) but kept
-            # for the routes that take parameters (/api/profile).
-            path, _, query = target.partition("?")
-
-            if method == "OPTIONS":
-                await self._respond(writer, 204, "text/plain", b"")
-                return
-            if method == "GET" and path == "/api/stream":
-                try:
-                    await self._stream(writer)
-                except (ConnectionError, asyncio.CancelledError, OSError):
-                    pass
-                return
-            if method not in ("GET", "HEAD", "POST"):
-                await self._respond(
-                    writer,
-                    405,
-                    "application/json",
-                    json.dumps({"error": "method not allowed"}).encode(),
-                )
-                return
-            # CSRF guard for the state-mutating POST routes: a browser
-            # always sends Origin on cross-origin POSTs; reject any whose
-            # host differs from the Host we're being addressed as.
-            # Non-browser clients (curl, scripts) send no Origin and pass.
-            if method == "POST" and origin and host_hdr:
-                # "Origin: null" (sandboxed iframe, data: URL) and
-                # unparsable origins are cross-origin too — anything that
-                # is present but doesn't match Host is refused.
-                origin_host = urllib.parse.urlsplit(origin).netloc
-                if origin_host != host_hdr:
-                    await self._respond(
-                        writer,
-                        403,
-                        "application/json",
-                        json.dumps(
-                            {"error": f"cross-origin POST from {origin} refused"}
-                        ).encode(),
-                    )
-                    return
-            req_body = b""
-            if method == "POST" and 0 < content_length <= 65536:
-                req_body = await asyncio.wait_for(
-                    reader.readexactly(content_length), timeout=10
-                )
-            headers: dict = {}
-            try:
-                status, ctype, body, headers = await self.handle_ex(
-                    method, path, query, req_body, auth=auth_hdr,
-                    if_none_match=inm_hdr, accept=accept_hdr,
-                )
-            except HttpError as e:
-                status, ctype = e.status, "application/json"
-                body = json.dumps({"error": e.message}).encode()
-            except Exception as e:  # 500-with-JSON (monitor_server.js:292-294)
-                status, ctype = 500, "application/json"
-                body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-            if method == "HEAD":
-                body = b""
-            await self._respond(writer, status, ctype, body, headers)
-            ms = (time.monotonic() - t0) * 1e3
-            self.request_latencies_ms.append(ms)
-            # Per-path stats only for served routes: keying on raw client
-            # paths would let a URL scanner grow the dict without bound.
-            if status != 404:
-                self.per_path_latencies_ms.setdefault(
-                    path, deque(maxlen=512)
-                ).append(ms)
-            if self.cfg.access_log:
-                print(f"{method} {path} {status} {ms:.2f}ms", flush=True)
+            # Serve requests until the client stops asking to keep the
+            # connection open (or an idle keep-alive socket times out):
+            # federating peers revalidate every tick, so re-handshaking
+            # TCP per poll would tax exactly the hottest clients.
+            while await self._serve_one(reader, writer):
+                pass
         except (asyncio.TimeoutError, ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
+            self._client_writers.discard(writer)
             try:
                 writer.close()
                 await writer.wait_closed()
             except Exception:
                 pass
+
+    async def _serve_one(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> bool:
+        """Read and answer ONE request; returns True to hold the
+        connection for another (the client explicitly sent
+        ``Connection: keep-alive`` on a plain GET/HEAD)."""
+        request_line = await asyncio.wait_for(reader.readline(), timeout=10)
+        # Latency clock starts AFTER the request line arrives: on a
+        # keep-alive connection the wait above is client think-time
+        # (a federating peer's whole tick interval), not our latency.
+        t0 = time.monotonic()
+        if not request_line:
+            return False
+        try:
+            method, target, _version = request_line.decode("latin-1").split()
+        except ValueError:
+            return False
+        # Drain headers; Content-Length is the only one routing needs
+        # (POST bodies for the silence routes).
+        content_length = 0
+        origin = host_hdr = auth_hdr = inm_hdr = accept_hdr = None
+        conn_hdr = te_hdr = node_hdr = tier_hdr = None
+        while True:
+            line = await asyncio.wait_for(reader.readline(), timeout=10)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            lower = line.lower()
+            if lower.startswith(b"content-length:"):
+                try:
+                    content_length = int(line.split(b":", 1)[1])
+                except ValueError:
+                    pass
+            elif lower.startswith(b"origin:"):
+                origin = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"host:"):
+                host_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"authorization:"):
+                auth_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"if-none-match:"):
+                inm_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"accept:"):
+                accept_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"connection:"):
+                conn_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"transfer-encoding:"):
+                te_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"x-tpumon-node:"):
+                node_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"x-tpumon-tier:"):
+                tier_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+        # Query stripped from routing (monitor_server.js:250) but kept
+        # for the routes that take parameters (/api/profile).
+        path, _, query = target.partition("?")
+
+        if method == "OPTIONS":
+            await self._respond(writer, 204, "text/plain", b"")
+            return False
+        if method == "GET" and path == "/api/stream":
+            try:
+                await self._stream(writer)
+            except (ConnectionError, asyncio.CancelledError, OSError):
+                pass
+            return False
+        if method == "POST" and path == "/api/federation/ingest":
+            # Push-based federation (tpumon.federation): a downstream
+            # node streams delta frames over a long-lived chunked POST.
+            # Handled upstream of handle_ex — the body IS the stream —
+            # so the POST auth gate and the cross-origin guard both
+            # apply HERE (forged frames would land straight in the
+            # fleet view, TSDB and journal otherwise; uplinks send the
+            # configured token as a Bearer header).
+            try:
+                self._check_auth(auth_hdr)
+            except HttpError as e:
+                await self._respond(
+                    writer, e.status, "application/json",
+                    json.dumps({"error": e.message}).encode(),
+                )
+                return False
+            if origin and host_hdr:
+                origin_host = urllib.parse.urlsplit(origin).netloc
+                if origin_host != host_hdr:
+                    await self._respond(
+                        writer, 403, "application/json",
+                        json.dumps(
+                            {"error": f"cross-origin POST from {origin} refused"}
+                        ).encode(),
+                    )
+                    return False
+            hub = getattr(self.sampler, "federation", None)
+            if hub is None:
+                await self._respond(
+                    writer, 404, "application/json",
+                    json.dumps(
+                        {"error": "not an aggregator (federation_role unset)"}
+                    ).encode(),
+                )
+                return False
+            await hub.handle_ingest(
+                reader, writer, node=node_hdr, tier=tier_hdr,
+                chunked="chunked" in (te_hdr or "").lower(),
+            )
+            return False
+        if method not in ("GET", "HEAD", "POST"):
+            await self._respond(
+                writer,
+                405,
+                "application/json",
+                json.dumps({"error": "method not allowed"}).encode(),
+            )
+            return False
+        # CSRF guard for the state-mutating POST routes: a browser
+        # always sends Origin on cross-origin POSTs; reject any whose
+        # host differs from the Host we're being addressed as.
+        # Non-browser clients (curl, scripts) send no Origin and pass.
+        if method == "POST" and origin and host_hdr:
+            # "Origin: null" (sandboxed iframe, data: URL) and
+            # unparsable origins are cross-origin too — anything that
+            # is present but doesn't match Host is refused.
+            origin_host = urllib.parse.urlsplit(origin).netloc
+            if origin_host != host_hdr:
+                await self._respond(
+                    writer,
+                    403,
+                    "application/json",
+                    json.dumps(
+                        {"error": f"cross-origin POST from {origin} refused"}
+                    ).encode(),
+                )
+                return False
+        req_body = b""
+        if method == "POST" and 0 < content_length <= 65536:
+            req_body = await asyncio.wait_for(
+                reader.readexactly(content_length), timeout=10
+            )
+        headers: dict = {}
+        try:
+            status, ctype, body, headers = await self.handle_ex(
+                method, path, query, req_body, auth=auth_hdr,
+                if_none_match=inm_hdr, accept=accept_hdr,
+            )
+        except HttpError as e:
+            status, ctype = e.status, "application/json"
+            body = json.dumps({"error": e.message}).encode()
+        except Exception as e:  # 500-with-JSON (monitor_server.js:292-294)
+            status, ctype = 500, "application/json"
+            body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+        if method == "HEAD":
+            body = b""
+        # Persistent connections only when explicitly requested (the
+        # peer federation fetcher does): every pre-existing client gets
+        # the old Connection: close behavior unchanged.
+        keep_alive = (
+            method in ("GET", "HEAD")
+            and conn_hdr is not None
+            and "keep-alive" in conn_hdr.lower()
+        )
+        await self._respond(writer, status, ctype, body, headers, keep_alive=keep_alive)
+        ms = (time.monotonic() - t0) * 1e3
+        self.request_latencies_ms.append(ms)
+        # Per-path stats only for served routes: keying on raw client
+        # paths would let a URL scanner grow the dict without bound.
+        if status != 404:
+            self.per_path_latencies_ms.setdefault(
+                path, deque(maxlen=512)
+            ).append(ms)
+        if self.cfg.access_log:
+            print(f"{method} {path} {status} {ms:.2f}ms", flush=True)
+        return keep_alive
 
     async def _respond(
         self,
@@ -922,6 +1034,7 @@ class MonitorServer:
         ctype: str,
         body: bytes,
         headers: dict | None = None,
+        keep_alive: bool = False,
     ) -> None:
         extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         head = (
@@ -933,7 +1046,7 @@ class MonitorServer:
             "Access-Control-Allow-Origin: *\r\n"
             "Access-Control-Allow-Methods: GET, POST, OPTIONS\r\n"
             "Access-Control-Allow-Headers: Content-Type\r\n"
-            "Connection: close\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
             "\r\n"
         )
         writer.write(head.encode("latin-1") + body)
@@ -952,6 +1065,16 @@ class MonitorServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        # Client writers close BEFORE wait_closed(): on Python >= 3.12.1
+        # wait_closed() waits for connection handlers too, and the
+        # long-lived streams (SSE, federation ingest) would hold it
+        # open indefinitely otherwise.
+        for w in list(self._client_writers):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._client_writers.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

@@ -37,7 +37,14 @@ from dataclasses import dataclass
 # "events" versions the structured event journal (tpumon.events):
 # bumped once per tick when the journal grew, plus immediately on
 # out-of-tick mutations (silence POSTs, profiler captures).
-SECTIONS = ("host", "accel", "k8s", "serving", "alerts", "samples", "events")
+# "federation" versions the aggregator tree's fan-in state
+# (tpumon.federation): bumped as downstream delta frames land and on
+# dark/recover transitions, so /api/federation re-renders only when
+# the fleet view actually moved.
+SECTIONS = (
+    "host", "accel", "k8s", "serving", "alerts", "samples", "events",
+    "federation",
+)
 
 
 class EpochClock:

@@ -121,10 +121,26 @@ class SliceView:
             return 0
         return max(0, self.expected_chips - len(self.chips))
 
+    def _vals(self, attr: str) -> list[float]:
+        return [v for c in self.chips if (v := getattr(c, attr)) is not None]
+
     def mean(self, attr: str) -> float | None:
-        vals = [getattr(c, attr) for c in self.chips]
-        vals = [v for v in vals if v is not None]
+        vals = self._vals(attr)
         return sum(vals) / len(vals) if vals else None
+
+    def max(self, attr: str) -> float | None:
+        vals = self._vals(attr)
+        return max(vals) if vals else None
+
+    def p95(self, attr: str) -> float | None:
+        """Nearest-rank p95 over the slice's reporting chips — the
+        aggregator-tier rollup statistic (tpumon.federation): a single
+        hot chip must survive the mean without requiring the root to
+        keep per-chip series."""
+        vals = sorted(self._vals(attr))
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(0.95 * (len(vals) - 1) + 0.5))]
 
     def to_json(self) -> dict:
         return {
