@@ -46,3 +46,9 @@ except ImportError:  # jax-less environments still run the pure-Python tests
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# tpulint's known-bad fixture trees (tests/fixtures/lint/*) contain
+# deliberately-broken snippets, including a test_*.py the wire pass
+# scans by path — pytest must never collect them (the fixture
+# test_protowire.py would collide with the real module's import name).
+collect_ignore_glob = ["fixtures/*"]

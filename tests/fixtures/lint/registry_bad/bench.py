@@ -1,0 +1,10 @@
+"""Known-bad fixture: a key of record no phase ever produces."""
+
+KEYS_OF_RECORD = (
+    "produced_key",
+    "never_set_key",
+)
+
+
+def phase():
+    return {"produced_key": 1.0}

@@ -1,0 +1,11 @@
+"""Known-bad fixture: a CLI flag writing an unaccepted config key."""
+
+
+def main(argv):
+    overrides = {}
+    for arg in argv:
+        if arg == "--port":
+            overrides["port"] = 1
+        elif arg == "--ghost":
+            overrides["nope"] = 1  # unknown key + undocumented flag
+    return overrides

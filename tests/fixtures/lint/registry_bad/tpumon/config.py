@@ -1,0 +1,14 @@
+"""Known-bad fixture: the loader accepts a key Config doesn't have."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Config:
+    port: int = 8888
+
+
+_SCALAR_FIELDS: dict = {
+    "port": int,
+    "ghost_key": str,  # no Config field, not in README -> 2 findings
+}

@@ -1,0 +1,9 @@
+"""Known-bad fixture: records an unregistered event kind."""
+
+
+class Engine:
+    def __init__(self, journal):
+        self.journal = journal
+
+    def fire(self):
+        self.journal.record("phantom", "info", "engine", "boom")

@@ -1,0 +1,8 @@
+"""Known-bad fixture event registry."""
+
+KINDS = ("alert",)
+
+
+class EventJournal:
+    def record(self, kind, severity, source, message):
+        assert kind in KINDS

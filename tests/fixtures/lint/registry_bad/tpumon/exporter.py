@@ -1,0 +1,6 @@
+"""Known-bad fixture: an undocumented federation gauge."""
+
+
+def render(w):
+    g = w.gauge("tpumon_federation_ghost_gauge", "documented nowhere")
+    g.add({}, 1.0)

@@ -1,0 +1,4 @@
+"""Route map: /api/known only."""
+
+
+ROUTES = ("/api/known", "/api/ghost")  # /api/ghost documented nowhere

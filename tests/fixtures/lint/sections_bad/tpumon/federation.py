@@ -1,0 +1,11 @@
+"""Known-bad fixture: publishes fan-in state without an epoch bump."""
+
+
+class Hub:
+    def land_frame(self, ns, rows):
+        ns.slice_rows = rows  # published, but no bump -> finding
+        ns.status = "ok"
+
+    def mark_dark(self, ns):
+        ns.status = "down"
+        self.clock.bump("accel")  # paired: no finding here

@@ -1,0 +1,10 @@
+"""Known-bad fixture: bumps an undeclared section name."""
+
+
+class Sampler:
+    def publish(self, sample):
+        self.latest[sample.source] = sample
+        self.clock.bump(sample.source)  # dynamic: covers host/accel
+
+    def publish_alerts(self):
+        self.clock.bump("typo_section")  # undeclared -> finding

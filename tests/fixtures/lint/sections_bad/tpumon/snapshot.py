@@ -1,0 +1,3 @@
+"""Known-bad fixture: 'ghost' is declared but nothing ever bumps it."""
+
+SECTIONS = ("host", "accel", "ghost")

@@ -1,0 +1,15 @@
+"""Known-bad fixture: malformed suppressions."""
+
+import threading
+
+
+def fire(work):
+    # reasonless: the underlying finding is suppressed, but the
+    # missing-reason finding (unsuppressable) keeps the run red.
+    threading.Thread(target=work).start()  # tpulint: disable=threads
+
+
+def fire2(work):
+    # names a pass that doesn't exist
+    # tpulint: disable=nosuchpass (this pass is fictional)
+    threading.Thread(target=work).start()

@@ -1,0 +1,8 @@
+"""Fixture: a well-formed suppression (with reason) silences a finding."""
+
+import threading
+
+
+def fire(work):
+    # tpulint: disable=threads.undaemonized-unjoined (fixture: the worker owns its own lifetime)
+    threading.Thread(target=work).start()

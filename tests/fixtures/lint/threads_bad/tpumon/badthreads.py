@@ -1,0 +1,63 @@
+"""Known-bad fixture: every thread-discipline rule trips once."""
+
+import threading
+from http.server import ThreadingHTTPServer
+
+
+def fire_and_forget(work):
+    # neither daemon nor joined -> threads.undaemonized-unjoined
+    threading.Thread(target=work).start()
+
+
+def start_server(handler):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    # shutdown() below but the listening socket is never closed
+    # -> threads.serve-forever-unclosed
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def stop_server(srv):
+    srv.shutdown()
+
+
+class Poller:
+    # spawns a background thread, defines no stop()/close()
+    # -> threads.no-stop; self.state mutated from both the thread body
+    # and an owner method without a lock -> threads.unguarded-attr
+    def __init__(self):
+        self.state = None
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            self.state = "polled"
+
+    def reset(self):
+        self.state = None
+
+
+class Watcher:
+    # a well-formed stoppable component (for the owner rule below)
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._thread.join(timeout=1.0)
+
+
+class Owner:
+    # holds a Watcher but never stops it -> threads.stoppable-not-stopped
+    def __init__(self):
+        self._w = Watcher()
+        self._w.start()

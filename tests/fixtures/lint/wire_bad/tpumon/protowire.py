@@ -1,0 +1,18 @@
+"""Known-bad fixture: _CT_BAD has an encoder branch but no decoder
+branch and no truncation-test reference."""
+
+_CT_GOOD = 1
+_CT_BAD = 2
+
+
+def _encode_col(out, col, ctype):
+    if ctype == _CT_GOOD:
+        out += b"g"
+    elif ctype == _CT_BAD:
+        out += b"b"
+
+
+def _decode_col(blob, pos, nrows, ctype):
+    if ctype == _CT_GOOD:
+        return ["g"] * nrows, pos
+    raise ValueError("unknown ctype")

@@ -1,45 +1,37 @@
-"""Event-kind lint (ISSUE 4 satellite, the tests/test_routes_doc.py
-pattern applied to the event vocabulary): every ``kind`` literal
-recorded anywhere in the tree must be a registered KINDS member AND
-appear in both README.md's event table and docs/events.md's; every
+"""Event-kind lint (ISSUE 4 satellite; since ISSUE 8 a thin shell over
+tpulint's registry pass — tools/tpulint/checks/registry.py owns the
+scanners, so this file, the standalone ``python -m tools.tpulint`` run
+and tests/test_lint.py all enforce the SAME contract): every ``kind``
+literal recorded anywhere in the tree must be a registered KINDS member
+AND appear in both README.md's event table and docs/events.md's; every
 documented kind must be recordable. Docs and code cannot drift."""
 
 import os
-import re
 
+from tools.tpulint.checks import registry as reg
+from tools.tpulint.core import Project
 from tpumon.events import KINDS, EventJournal
 
 ROOT = os.path.join(os.path.dirname(__file__), "..")
-
-# journal.record("<kind>", ... — matched across the line break black
-# puts after the paren. Restricted to journal receivers so
-# RingHistory.record("cpu", ...) never matches.
-RECORD_RE = re.compile(r'journal\.record\(\s*"([a-z_]+)"')
-# "| `kind` | ..." table rows (both README.md and docs/events.md).
-TABLE_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.M)
-
-
-def _tree_sources() -> str:
-    out = []
-    for dirpath, _dirs, names in os.walk(os.path.join(ROOT, "tpumon")):
-        for name in names:
-            if name.endswith(".py"):
-                out.append(open(os.path.join(dirpath, name)).read())
-    return "\n".join(out)
+_project = Project(ROOT)
 
 
 def recorded_kinds() -> set[str]:
-    kinds = set(RECORD_RE.findall(_tree_sources()))
-    assert kinds, "kind-literal scan matched nothing — regex stale?"
+    kinds = set(reg.recorded_event_kinds(_project))
+    assert kinds, "kind-literal scan matched nothing — scanner stale?"
     return kinds
 
 
 def documented_kinds(path: str) -> set[str]:
-    with open(os.path.join(ROOT, path)) as f:
-        found = set(TABLE_ROW_RE.findall(f.read()))
     # Docs tables may also contain config-key rows (docs/events.md's
     # anomaly-tuning table); only kind-vocabulary entries count.
-    return found & set(KINDS)
+    return reg.documented_table_kinds(_project, path) & set(KINDS)
+
+
+def test_registry_scan_matches_runtime_kinds():
+    """The AST-side registry (what tpulint checks) and the imported
+    module (what the monitor enforces at record()) must agree."""
+    assert set(reg.declared_event_kinds(_project)) == set(KINDS)
 
 
 def test_every_recorded_kind_is_registered():
@@ -68,9 +60,7 @@ def test_every_registered_kind_is_documented_and_recordable():
 def test_documented_kinds_match_registry_exactly():
     # The dedicated table in docs/events.md is the vocabulary of record:
     # it may not document a kind that doesn't exist.
-    with open(os.path.join(ROOT, "docs", "events.md")) as f:
-        text = f.read()
-    rows = set(TABLE_ROW_RE.findall(text))
+    rows = reg.documented_table_kinds(_project, "docs/events.md")
     # Rows that look like kinds (single lowercase word) but aren't
     # registered are drift — except known config-key table entries.
     config_keys = {k for k in rows if k.startswith("anomaly_") or k.startswith("events_")}

@@ -382,6 +382,89 @@ def test_peer_deadline_slices_bound_the_fanout():
     asyncio.run(scenario())
 
 
+def test_federation_exporter_block():
+    """The tpumon_federation_* exporter block (ROADMAP item 2 follow-up):
+    per-downstream freshness/liveness, fleet dark/unreachable counts and
+    uplink wire accounting, rendered on the "federation" dirty section —
+    and absent entirely on standalone monitors."""
+    import time as _time
+
+    from tpumon.exporter import render_exporter
+    from tpumon.federation import (
+        FederationHub,
+        FederationUplink,
+        NodeState,
+        slice_rollup_rows,
+    )
+
+    sampler, server = serve()
+    # Standalone: no federation families at all.
+    text = render_exporter(sampler)
+    assert "tpumon_federation_" not in text
+
+    hub = FederationHub(node="agg-0", role="aggregator", dark_after_s=5.0)
+    hub.bind(sampler)
+    sampler.federation = hub
+    chips = [
+        ChipSample(
+            chip_id=f"leaf-0/c{i}", host="leaf-0", slice_id="s0", index=i,
+            kind="v5p", coords=(i, 0, 0), mxu_duty_pct=50.0 + i,
+            hbm_used=10, hbm_total=100, temp_c=40.0,
+        )
+        for i in range(4)
+    ]
+    ns = NodeState("leaf-0", "leaf")
+    ns.chips = chips
+    ns.slice_rows = slice_rollup_rows(chips, "leaf-0", ts=_time.time())
+    ns.frames, ns.bytes = 7, 4096
+    ns.last_wall = _time.monotonic() - 1.0
+    hub.nodes["leaf-0"] = ns
+    # A second downstream that went dark long ago.
+    dark = NodeState("leaf-1", "leaf")
+    dark.slice_rows = [dict(r, slice_id="s1", node="leaf-1")
+                       for r in ns.slice_rows]
+    dark.last_wall = _time.monotonic() - 60.0
+    hub.nodes["leaf-1"] = dark
+    sampler.uplink = FederationUplink(sampler, url="http://root:1", node="agg-0")
+
+    text = render_exporter(sampler)
+    assert 'tpumon_federation_downstream_up{node="leaf-0",tier="leaf"} 1' in text
+    assert 'tpumon_federation_downstream_up{node="leaf-1",tier="leaf"} 0' in text
+    assert 'tpumon_federation_downstream_frames_total{node="leaf-0",tier="leaf"} 7' in text
+    assert 'tpumon_federation_downstream_bytes_total{node="leaf-0",tier="leaf"} 4096' in text
+    assert "tpumon_federation_dark_slices 1" in text
+    assert "tpumon_federation_fleet_slices 2" in text
+    # dark slices keep their last-known chip count in the fleet totals
+    assert "tpumon_federation_fleet_chips 8" in text
+    assert "tpumon_federation_uplink_connected 0" in text
+    assert "tpumon_federation_uplink_frames_total 0" in text
+    # age gauge present and plausible for the live leaf
+    import re
+
+    m = re.search(
+        r'tpumon_federation_downstream_age_seconds\{node="leaf-0",tier="leaf"\} ([0-9.]+)',
+        text,
+    )
+    assert m is not None and 0.5 <= float(m.group(1)) < 10.0
+    # The dark flip recorded a serious federation event.
+    assert any(
+        e["kind"] == "federation" and e["severity"] == "serious"
+        for e in sampler.journal.recent(50)
+    )
+    # Cached-block behavior: unchanged sections reuse the render; a
+    # federation bump invalidates exactly this block.
+    from tpumon.snapshot import ExporterCache
+
+    cache = ExporterCache(sampler.clock)
+    render_exporter(sampler, cache=cache)
+    render_exporter(sampler, cache=cache)
+    assert cache.hits.get("federation", 0) >= 1
+    renders_before = cache.renders.get("federation", 0)
+    sampler.clock.bump("federation")
+    render_exporter(sampler, cache=cache)
+    assert cache.renders.get("federation", 0) == renders_before + 1
+
+
 def test_api_federation_standalone_answers():
     """/api/federation on an unfederated instance reports role
     standalone (and caches — the section never moves)."""

@@ -1,0 +1,165 @@
+"""tpulint (ISSUE 8): the tree must be lint-clean, and every checker
+must still FIRE — each pass has a known-bad fixture tree under
+tests/fixtures/lint/ that must produce exactly its expected findings,
+so a checker that silently stops detecting its bug class fails CI
+(the same reason the wire tests truncate at every prefix)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from tools.tpulint import CHECKS, lint_tree
+from tools.tpulint.core import summary_line
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+
+def _fixture(name: str, only=()) -> list:
+    return lint_tree(os.path.join(FIXTURES, name), only=tuple(only))
+
+
+def _checkset(findings, suppressed=False) -> set[tuple[str, str]]:
+    return {
+        (f.check, f.path)
+        for f in findings
+        if f.suppressed == suppressed
+    }
+
+
+# ------------------------------- the gate -------------------------------
+
+
+def test_tree_is_lint_clean():
+    """The acceptance gate: zero unsuppressed findings on the real
+    tree. When this fails, fix the defect (preferred) or suppress WITH
+    a reason — see docs/static-analysis.md."""
+    findings = lint_tree(ROOT)
+    live = [f for f in findings if not f.suppressed]
+    assert not live, "\n" + "\n".join(f.render() for f in live)
+
+
+# -------------------------- checker self-tests --------------------------
+
+
+def test_sections_checker_fires_on_fixture():
+    got = _checkset(_fixture("sections_bad", only=("sections",)))
+    assert got == {
+        ("sections.undeclared", "tpumon/sampler.py"),
+        ("sections.never-bumped", "tpumon/snapshot.py"),
+        ("sections.publish-without-bump", "tpumon/federation.py"),
+    }
+
+
+def test_threads_checker_fires_on_fixture():
+    got = _checkset(_fixture("threads_bad", only=("threads",)))
+    assert got == {
+        ("threads.undaemonized-unjoined", "tpumon/badthreads.py"),
+        ("threads.serve-forever-unclosed", "tpumon/badthreads.py"),
+        ("threads.no-stop", "tpumon/badthreads.py"),
+        ("threads.unguarded-attr", "tpumon/badthreads.py"),
+        ("threads.stoppable-not-stopped", "tpumon/badthreads.py"),
+    }
+
+
+def test_wire_checker_fires_on_fixture():
+    got = _checkset(_fixture("wire_bad", only=("wire",)))
+    assert got == {
+        ("wire.no-decoder", "tpumon/protowire.py"),
+        ("wire.untested", "tpumon/protowire.py"),
+    }
+    # _CT_GOOD (encoder + decoder + test reference) stays clean.
+    assert not any(
+        "_CT_GOOD" in f.message for f in _fixture("wire_bad", only=("wire",))
+    )
+
+
+def test_registry_checker_fires_on_fixture():
+    got = _checkset(_fixture("registry_bad", only=("registry",)))
+    assert got == {
+        ("registry.config-key-unknown-field", "tpumon/config.py"),
+        ("registry.config-key-undocumented", "tpumon/config.py"),
+        ("registry.cli-flag-unknown-key", "tpumon/app.py"),
+        ("registry.cli-flag-undocumented", "tpumon/app.py"),
+        ("registry.event-kind-unregistered", "tpumon/engine.py"),
+        ("registry.event-kind-phantom", "docs/events.md"),
+        ("registry.route-undocumented", "tpumon/server.py"),
+        ("registry.bench-key-unproduced", "bench.py"),
+        ("registry.metric-undocumented", "tpumon/exporter.py"),
+    }
+
+
+# ---------------------------- suppressions ----------------------------
+
+
+def test_suppression_without_reason_fails():
+    findings = _fixture("suppression_bad", only=("threads",))
+    checks = {f.check for f in findings}
+    assert "suppression.missing-reason" in checks
+    assert "suppression.unknown-check" in checks
+    # The malformed suppressions keep the run red even though one
+    # underlying finding was (cosmetically) suppressed.
+    assert any(not f.suppressed for f in findings)
+
+
+def test_suppression_with_reason_is_green():
+    findings = _fixture("suppression_ok", only=("threads",))
+    assert all(f.suppressed for f in findings)
+    sup = [f for f in findings if f.suppressed]
+    assert sup and sup[0].suppress_reason  # reason carried through
+
+
+def test_every_pass_has_a_fixture_self_test():
+    """Adding a checker without a known-bad fixture tree is itself a
+    lint violation (of this test)."""
+    have = {d[: -len("_bad")] for d in os.listdir(FIXTURES) if d.endswith("_bad")}
+    assert set(CHECKS) <= have, f"passes without fixtures: {set(CHECKS) - have}"
+
+
+# ------------------------------- the CLI -------------------------------
+
+
+def _cli(*args):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", *args],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+        timeout=120,
+    )
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def test_cli_green_on_tree_and_red_on_fixture():
+    code, out, _ = _cli()
+    assert code == 0, out
+    last = out.strip().splitlines()[-1]
+    assert last.startswith("tpulint: OK: 0 finding(s)")  # stable summary
+
+    bad = os.path.join(FIXTURES, "threads_bad")
+    code, out, _ = _cli("--root", bad, "threads")
+    assert code == 1
+    assert out.strip().splitlines()[-1].startswith("tpulint: FAIL:")
+
+
+def test_cli_json_output():
+    bad = os.path.join(FIXTURES, "wire_bad")
+    code, out, _ = _cli("--root", bad, "--json", "wire")
+    assert code == 1
+    body = "\n".join(out.strip().splitlines()[:-1])  # summary line last
+    doc = json.loads(body)
+    assert doc["unsuppressed"] == 2
+    assert {f["check"] for f in doc["findings"]} == {
+        "wire.no-decoder",
+        "wire.untested",
+    }
+
+
+def test_cli_rejects_unknown_pass():
+    code, _, err = _cli("nosuchpass")
+    assert code == 2 and "unknown pass" in err
+
+
+def test_summary_line_shape_is_stable():
+    assert summary_line([], 4) == "tpulint: OK: 0 finding(s), 0 suppressed, 4 pass(es)"

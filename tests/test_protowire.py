@@ -210,6 +210,46 @@ def test_delta_stream_truncation_raises_at_every_prefix():
             dec.apply(delta)
 
 
+def test_wire_frame_truncation_at_every_prefix_all_ctypes():
+    """Exhaustive column-type coverage (pinned by tpulint's wire pass):
+    one column per _CT_* type, classification asserted per column, then
+    the frame round-trips exactly and EVERY truncation prefix raises
+    ValueError — a new column type cannot ship without its short-read
+    behavior being exercised."""
+    # column name -> (values, expected ctype). Six rows, nulls mixed in.
+    table = {
+        "f64": ([0.1, None, 2.25, 3.0, -0.5, 1e300], pw._CT_F64),
+        "f32": ([1.5, None, 2.25, -0.5, 3.0, 0.0], pw._CT_F32),
+        "i64": ([1, None, -5, 2**62, 0, -(2**63)], pw._CT_I64),
+        "big": ([2**65, None, -(2**65), 1, 0, 5], pw._CT_VARINT),
+        "s": (["a", None, "b", "a", "", "c"], pw._CT_STR),
+        "b": ([True, None, False, True, False, True], pw._CT_BOOL),
+        "ilf": (
+            [[1, 2, 3], None, [4, 5, 6], [7, 8, 9], [0, 0, 0], [1, 1, 1]],
+            pw._CT_INTLIST_FIXED,
+        ),
+        "il": ([[1], None, [2, 3], [], [2**40], [5]], pw._CT_INTLIST),
+        "none": ([None] * 6, pw._CT_NONE),
+    }
+    fields = list(table)
+    for name, (col, want) in table.items():
+        assert pw._classify(col, allow_f32=True) == want, name
+    rows = [
+        [table[f][0][i] for f in fields] for i in range(6)
+    ]
+    frame = pw.encode_wire_frame(1, fields, rows, allow_f32=True)
+    v, got_fields, cols = pw.decode_wire_frame(frame)
+    assert v == 1 and got_fields == fields
+    for name, got in zip(fields, cols):
+        want = table[name][0]
+        # int-valued cells may come back as lists (tuples encode as
+        # lists); everything else round-trips exactly, types included.
+        assert [list(x) if isinstance(x, tuple) else x for x in want] == got
+    for cut in range(len(frame)):
+        with pytest.raises(ValueError):
+            pw.decode_wire_frame(frame[:cut])
+
+
 def test_delta_stream_empty_diff_is_tiny_heartbeat():
     """An unchanged table produces a near-empty delta (liveness ride)."""
     enc = pw.DeltaStreamEncoder(keyframe_every=1000)

@@ -1,17 +1,22 @@
-"""Route-table lint (ISSUE 3 satellite): every route the server answers
-must appear in the README and in tpumon/server.py's module docstring
-(its route map), and every route-like string literal in server.py must
-be in the server's route registry — a new endpoint (e.g. /api/trace)
-cannot ship undocumented or unregistered."""
+"""Route-table lint (ISSUE 3 satellite; since ISSUE 8 the static scans
+come from tpulint's registry pass — tools/tpulint/checks/registry.py —
+so this file and ``python -m tools.tpulint`` enforce one contract):
+every route the server answers must appear in the README and in
+tpumon/server.py's module docstring (its route map), and every
+route-like string literal in server.py must be in the server's route
+registry — a new endpoint (e.g. /api/trace) cannot ship undocumented
+or unregistered. The live checks (registered routes actually answer)
+stay here: they need a running server, which a static pass can't be."""
 
-import inspect
 import os
-import re
 
 import tpumon.server
 from tests.test_server_api import serve
+from tools.tpulint.checks import registry as reg
+from tools.tpulint.core import Project
 
-README = os.path.join(os.path.dirname(__file__), "..", "README.md")
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+_project = Project(ROOT)
 
 
 def _public_routes(server) -> list[str]:
@@ -23,8 +28,7 @@ def _public_routes(server) -> list[str]:
 
 def test_every_route_is_documented():
     _, server = serve()
-    with open(README) as f:
-        readme = f.read()
+    readme = _project.file("README.md").text
     docstring = tpumon.server.__doc__
     routes = _public_routes(server)
     assert "/api/trace" in routes and "/api/trace/export" in routes
@@ -36,14 +40,14 @@ def test_every_route_is_documented():
 
 
 def test_every_route_literal_is_registered():
-    """Scan server.py for route-shaped string literals: anything the
-    code matches against must be in routes(), so the registry (and
-    therefore the doc lint above) can't silently go stale."""
+    """Scan server.py for route-shaped string literals (the tpulint
+    registry scanner): anything the code matches against must be in
+    routes(), so the registry (and therefore the doc lint above) can't
+    silently go stale."""
     _, server = serve()
     registered = set(server.routes())
-    src = inspect.getsource(tpumon.server)
-    literals = set(re.findall(r'"(/(?:api/[a-z0-9_/]+|metrics))"', src))
-    assert literals, "route literal scan matched nothing — regex stale?"
+    literals = set(reg.route_literals(_project))
+    assert literals, "route literal scan matched nothing — scanner stale?"
     unregistered = literals - registered
     assert not unregistered, (
         f"routes referenced in server.py but absent from routes(): "

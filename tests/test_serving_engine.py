@@ -147,6 +147,7 @@ def test_collector_scrapes_live_engine():
         assert "ttft_p50_ms" in t
     finally:
         server.shutdown()
+        server.server_close()
 
 
 def test_chunked_prefill_matches_forward(params):

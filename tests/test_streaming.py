@@ -113,6 +113,7 @@ def test_generate_endpoint_json_and_sse():
     finally:
         stop.set()
         server.shutdown()
+        server.server_close()
 
 
 def test_stop_tokens_end_generation_early():
@@ -212,6 +213,7 @@ def test_generate_stop_param():
     finally:
         stop.set()
         server.shutdown()
+        server.server_close()
 
 
 def test_generate_queue_full_returns_429():
@@ -226,3 +228,4 @@ def test_generate_queue_full_returns_429():
         assert e.code == 429
     finally:
         server.shutdown()
+        server.server_close()

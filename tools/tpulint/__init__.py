@@ -1,0 +1,28 @@
+"""tpulint — in-tree AST static analysis for tpumon's real bug classes.
+
+Run: ``python -m tools.tpulint`` (see docs/static-analysis.md).
+"""
+
+from tools.tpulint.checks import CHECKS
+from tools.tpulint.core import (
+    Finding,
+    Project,
+    render_report,
+    run,
+    summary_line,
+)
+
+__all__ = [
+    "CHECKS",
+    "Finding",
+    "Project",
+    "lint_tree",
+    "render_report",
+    "run",
+    "summary_line",
+]
+
+
+def lint_tree(root: str, only: tuple[str, ...] = ()) -> list["Finding"]:
+    """All findings (suppressed ones flagged) for a source tree."""
+    return run(root, CHECKS, only=only)

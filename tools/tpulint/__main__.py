@@ -1,0 +1,70 @@
+"""``python -m tools.tpulint`` — run the static-analysis passes.
+
+Usage::
+
+    python -m tools.tpulint [--json] [--root DIR] [--list] [PASS ...]
+
+Exit status: 0 when every finding is suppressed (with a reason — a
+reasonless suppression is itself an unsuppressable finding), 1 on any
+live finding, 2 on usage errors. The last line printed is always the
+stable one-line summary (``tpulint: OK|FAIL: ...``) for CI logs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tools.tpulint import CHECKS, lint_tree, render_report
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = False
+    root = _REPO_ROOT
+    only: list[str] = []
+    it = iter(argv)
+    for arg in it:
+        if arg == "--json":
+            as_json = True
+        elif arg == "--root":
+            root = next(it, None)
+            if root is None:
+                print("--root requires a directory", file=sys.stderr)
+                return 2
+        elif arg == "--list":
+            for name in CHECKS:
+                print(name)
+            return 0
+        elif arg in ("-h", "--help"):
+            print(__doc__.strip())
+            print(f"\npasses: {', '.join(CHECKS)}")
+            return 0
+        elif arg.startswith("-"):
+            print(f"unknown argument {arg!r}", file=sys.stderr)
+            return 2
+        else:
+            if arg not in CHECKS:
+                print(
+                    f"unknown pass {arg!r} (known: {', '.join(CHECKS)})",
+                    file=sys.stderr,
+                )
+                return 2
+            only.append(arg)
+    if not os.path.isdir(root):
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    findings = lint_tree(root, only=tuple(only))
+    report, code = render_report(
+        findings, npasses=len(only or CHECKS), as_json=as_json
+    )
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,20 @@
+"""Checker registry: pass name -> check(project) -> [Finding].
+
+Adding a checker (docs/static-analysis.md has the full recipe):
+
+1. write ``tools/tpulint/checks/<name>.py`` exposing
+   ``check(project) -> list[Finding]``;
+2. register it in ``CHECKS`` below;
+3. add a known-bad fixture tree under ``tests/fixtures/lint/<name>_bad/``
+   and a self-test in tests/test_lint.py asserting the expected finding
+   fires — a checker that silently stops firing fails CI.
+"""
+
+from tools.tpulint.checks import registry, sections, threads, wire
+
+CHECKS = {
+    "sections": sections.check,
+    "threads": threads.check,
+    "wire": wire.check,
+    "registry": registry.check,
+}

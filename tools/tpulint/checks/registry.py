@@ -1,0 +1,476 @@
+"""Pass 4 — registry/doc coherence.
+
+Generalizes the two ad-hoc source lints this repo already trusted
+(tests/test_routes_doc.py, tests/test_events_doc.py) into one
+declarative pass over every name registry the tree carries:
+
+- config keys: every key the loader accepts (``_SCALAR_FIELDS`` /
+  ``_DURATION_KEYS`` / ``_LIST_FIELDS`` / the ``_apply_mapping``
+  specials) must name a real ``Config`` field
+  (``registry.config-key-unknown-field``) and appear backticked in
+  README.md (``registry.config-key-undocumented``) — the TPUMON_* env
+  surface is derived from the same table, so documenting the key
+  documents all three spellings;
+- CLI flags: every ``--flag`` branch in tpumon/app.py must write an
+  accepted config key (``registry.cli-flag-unknown-key``) and appear in
+  README.md (``registry.cli-flag-undocumented``);
+- event kinds: every ``journal.record("<kind>")`` literal must be in
+  ``events.KINDS``; every KINDS member must appear in README.md's and
+  docs/events.md's tables; the docs table may not invent kinds
+  (``registry.event-kind-*``);
+- routes: every route-shaped literal in tpumon/server.py must appear in
+  README.md and the server module docstring's route map
+  (``registry.route-undocumented``);
+- bench keys: every ``KEYS_OF_RECORD`` entry must be *produced*
+  somewhere else in bench.py (``registry.bench-key-unproduced``) — a
+  key of record that no phase writes serializes as null forever;
+- exporter metrics: every ``tpumon_federation_*`` family name in
+  tpumon/exporter.py must appear in README.md or docs/federation.md
+  (``registry.metric-undocumented``) — the fleet gauges are an
+  operator-facing contract, not an implementation detail.
+
+The scan helpers are module-level so tests/test_routes_doc.py and
+tests/test_events_doc.py run their original assertions through the
+same scanners (one coherence framework, not three regex dialects).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.tpulint.core import Finding, Project, const_str, dotted
+
+CONFIG = "tpumon/config.py"
+APP = "tpumon/app.py"
+EVENTS = "tpumon/events.py"
+SERVER = "tpumon/server.py"
+BENCH = "bench.py"
+EXPORTER = "tpumon/exporter.py"
+README = "README.md"
+EVENTS_DOC = "docs/events.md"
+FEDERATION_DOC = "docs/federation.md"
+
+# journal.record("<kind>" — restricted to journal receivers so
+# RingHistory.record("cpu", ...) never matches (same contract as the
+# original tests/test_events_doc.py regex).
+RECORD_RE = re.compile(r'journal\.record\(\s*"([a-z_]+)"')
+# "| `kind` | ..." table rows (README.md and docs/events.md).
+TABLE_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.M)
+# Route-shaped string literals in server.py (the original
+# tests/test_routes_doc.py scan).
+ROUTE_RE = re.compile(r'"(/(?:api/[a-z0-9_/]+|metrics))"')
+
+
+def _assign_targets(node: ast.AST) -> list[tuple[ast.AST, ast.AST]]:
+    """(target, value) pairs for plain and annotated assignments —
+    registry tables are often annotated (``X: dict[str, type] = {...}``)."""
+    if isinstance(node, ast.Assign):
+        return [(t, node.value) for t in node.targets]
+    if isinstance(node, ast.AnnAssign) and node.value is not None:
+        return [(node.target, node.value)]
+    return []
+
+
+# --------------------------- scan helpers ---------------------------
+# (shared with tests/test_routes_doc.py and tests/test_events_doc.py)
+
+
+def recorded_event_kinds(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """kind -> [(file, line)] for every journal.record literal in the
+    tree. One multiline-tolerant scan per file (the regex spans black's
+    wrap after the paren); line numbers come from the match offset so a
+    finding anchors where the call actually is — and an inline
+    suppression there actually covers it."""
+    out: dict[str, list[tuple[str, int]]] = {}
+    for sf in project.py_files("tpumon"):
+        for m in RECORD_RE.finditer(sf.text):
+            line = sf.text.count("\n", 0, m.start()) + 1
+            out.setdefault(m.group(1), []).append((sf.rel, line))
+    return out
+
+
+def declared_event_kinds(project: Project) -> dict[str, int]:
+    sf = project.file(EVENTS)
+    if sf is None or sf.tree is None:
+        return {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KINDS":
+                    out = {}
+                    for elt in ast.walk(node.value):
+                        s = const_str(elt)
+                        if s is not None:
+                            out[s] = elt.lineno
+                    return out
+    return {}
+
+
+def documented_table_kinds(project: Project, rel: str) -> set[str]:
+    sf = project.file(rel)
+    if sf is None:
+        return set()
+    return set(TABLE_ROW_RE.findall(sf.text))
+
+
+def route_literals(project: Project) -> dict[str, int]:
+    sf = project.file(SERVER)
+    if sf is None:
+        return {}
+    out: dict[str, int] = {}
+    for i, line in enumerate(sf.lines, start=1):
+        for r in ROUTE_RE.findall(line):
+            out.setdefault(r, i)
+    return out
+
+
+def accepted_config_keys(project: Project) -> dict[str, int]:
+    """Every key the config loader accepts (file/env spelling), with the
+    line it is declared on."""
+    sf = project.file(CONFIG)
+    if sf is None or sf.tree is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        for t, value in _assign_targets(node):
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id in ("_SCALAR_FIELDS", "_DURATION_KEYS") and isinstance(
+                value, ast.Dict
+            ):
+                for k in value.keys:
+                    s = const_str(k)
+                    if s is not None:
+                        out[s] = k.lineno
+            elif t.id == "_LIST_FIELDS" and isinstance(
+                value, (ast.Set, ast.Tuple, ast.List)
+            ):
+                for elt in value.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        out[s] = elt.lineno
+    # The _apply_mapping specials (mapping-valued keys handled by
+    # dedicated elif branches): any string compared against ``key``.
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_apply_mapping":
+            for cmp in ast.walk(node):
+                if isinstance(cmp, ast.Compare):
+                    for c in cmp.comparators:
+                        s = const_str(c)
+                        if s is not None and not s.startswith("_"):
+                            out.setdefault(s, c.lineno)
+    return out
+
+
+def config_fields(project: Project) -> set[str]:
+    sf = project.file(CONFIG)
+    if sf is None or sf.tree is None:
+        return set()
+    out: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out.add(stmt.target.id)
+    return out
+
+
+def duration_field_map(project: Project) -> dict[str, str]:
+    """_DURATION_KEYS: file-facing spelling -> Config field name."""
+    sf = project.file(CONFIG)
+    if sf is None or sf.tree is None:
+        return {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "_DURATION_KEYS"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    return {
+                        const_str(k): const_str(v)
+                        for k, v in zip(node.value.keys, node.value.values)
+                        if const_str(k) and const_str(v)
+                    }
+    return {}
+
+
+def cli_flags(project: Project) -> list[tuple[tuple[str, ...], list[str], int]]:
+    """(flag aliases, override keys written in its branch, line) for
+    every ``--flag`` branch of tpumon/app.py's main()."""
+    sf = project.file(APP)
+    if sf is None or sf.tree is None:
+        return []
+    out: list[tuple[tuple[str, ...], list[str], int]] = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.FunctionDef) and node.name == "main"):
+            continue
+        for branch in ast.walk(node):
+            if not isinstance(branch, ast.If):
+                continue
+            flags: list[str] = []
+            test = branch.test
+            if isinstance(test, ast.Compare):
+                for c in test.comparators:
+                    s = const_str(c)
+                    if s is not None and s.startswith("-"):
+                        flags.append(s)
+                    elif isinstance(c, (ast.Tuple, ast.List)):
+                        flags.extend(
+                            v
+                            for v in (const_str(e) for e in c.elts)
+                            if v is not None and v.startswith("-")
+                        )
+            if not flags:
+                continue
+            keys: list[str] = []
+            for stmt in branch.body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Subscript)
+                        and dotted(sub.value) == "overrides"
+                    ):
+                        s = const_str(sub.slice)
+                        if s is not None:
+                            keys.append(s)
+                    elif (
+                        isinstance(sub, ast.Call)
+                        and dotted(sub.func) == "overrides.update"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Dict)
+                    ):
+                        keys.extend(
+                            v
+                            for v in (
+                                const_str(k) for k in sub.args[0].keys
+                            )
+                            if v is not None
+                        )
+            out.append((tuple(flags), keys, branch.lineno))
+    return out
+
+
+def bench_keys_of_record(project: Project) -> list[tuple[str, int]]:
+    sf = project.file(BENCH)
+    if sf is None or sf.tree is None:
+        return []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
+            targets = (
+                [node.target]
+                if isinstance(node, ast.AnnAssign)
+                else node.targets
+            )
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "KEYS_OF_RECORD":
+                    return [
+                        (elt.value, elt.lineno)
+                        for elt in ast.walk(node.value)
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)
+                    ]
+    return []
+
+
+def exporter_metric_families(project: Project) -> dict[str, int]:
+    """Literal metric-family names registered in tpumon/exporter.py."""
+    sf = project.file(EXPORTER)
+    if sf is None or sf.tree is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("gauge", "counter", "histogram")
+            and node.args
+        ):
+            s = const_str(node.args[0])
+            if s is not None:
+                out.setdefault(s, node.lineno)
+    return out
+
+
+# ------------------------------ the pass ------------------------------
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    readme = project.file(README)
+    readme_text = readme.text if readme else ""
+
+    # --- config keys ---
+    fields = config_fields(project)
+    durations = duration_field_map(project)
+    accepted = accepted_config_keys(project)
+    for key, line in sorted(accepted.items()):
+        target_field = durations.get(key, key)
+        if fields and target_field not in fields:
+            findings.append(
+                Finding(
+                    check="registry.config-key-unknown-field",
+                    path=CONFIG,
+                    line=line,
+                    message=(
+                        f"loader accepts key {key!r} but Config has no "
+                        f"field {target_field!r} — Config(**kw) raises on use"
+                    ),
+                )
+            )
+        if readme and f"`{key}`" not in readme_text:
+            findings.append(
+                Finding(
+                    check="registry.config-key-undocumented",
+                    path=CONFIG,
+                    line=line,
+                    message=(
+                        f"config key {key!r} (also TPUMON_{key.upper()}) "
+                        f"is not documented in README.md"
+                    ),
+                )
+            )
+
+    # --- CLI flags ---
+    for flags, keys, line in cli_flags(project):
+        flag = max(flags, key=len)  # canonical (long) spelling
+        for k in keys:
+            if accepted and k not in accepted:
+                findings.append(
+                    Finding(
+                        check="registry.cli-flag-unknown-key",
+                        path=APP,
+                        line=line,
+                        message=(
+                            f"flag {flag} writes config key {k!r}, which "
+                            f"the loader does not accept"
+                        ),
+                    )
+                )
+        if "--help" in flags:
+            continue
+        if readme and not any(f in readme_text for f in flags):
+            findings.append(
+                Finding(
+                    check="registry.cli-flag-undocumented",
+                    path=APP,
+                    line=line,
+                    message=f"CLI flag {flag} is not mentioned in README.md",
+                )
+            )
+
+    # --- event kinds ---
+    kinds = declared_event_kinds(project)
+    if kinds:
+        recorded = recorded_event_kinds(project)
+        for kind, sites in sorted(recorded.items()):
+            if kind not in kinds:
+                path, line = sites[0]
+                findings.append(
+                    Finding(
+                        check="registry.event-kind-unregistered",
+                        path=path,
+                        line=line,
+                        message=(
+                            f"journal.record kind {kind!r} is not in "
+                            f"events.KINDS — record() raises at runtime"
+                        ),
+                    )
+                )
+        for rel in (README, EVENTS_DOC):
+            table = documented_table_kinds(project, rel)
+            if not table:
+                continue
+            for kind, line in sorted(kinds.items()):
+                if kind not in table:
+                    findings.append(
+                        Finding(
+                            check="registry.event-kind-undocumented",
+                            path=EVENTS,
+                            line=line,
+                            message=f"event kind {kind!r} missing from {rel}'s table",
+                        )
+                    )
+        # the dedicated docs table may not document unknown kinds
+        # (config-key rows in the same doc are the allowed exception,
+        # same carve-out as the original lint).
+        doc_table = documented_table_kinds(project, EVENTS_DOC)
+        for kind in sorted(doc_table - set(kinds)):
+            if kind.startswith(("anomaly_", "events_")):
+                continue
+            findings.append(
+                Finding(
+                    check="registry.event-kind-phantom",
+                    path=EVENTS_DOC,
+                    line=1,
+                    message=(
+                        f"docs/events.md documents kind {kind!r}, which "
+                        f"events.KINDS does not declare"
+                    ),
+                )
+            )
+
+    # --- routes ---
+    srv = project.file(SERVER)
+    if srv is not None and srv.tree is not None:
+        docstring = ast.get_docstring(srv.tree) or ""
+        for route, line in sorted(route_literals(project).items()):
+            missing = []
+            if readme and route not in readme_text:
+                missing.append("README.md")
+            if route not in docstring:
+                missing.append("the server.py module docstring")
+            if missing:
+                findings.append(
+                    Finding(
+                        check="registry.route-undocumented",
+                        path=SERVER,
+                        line=line,
+                        message=(
+                            f"route {route} is referenced in server.py but "
+                            f"missing from {' and '.join(missing)}"
+                        ),
+                    )
+                )
+
+    # --- bench keys of record ---
+    bench = project.file(BENCH)
+    if bench is not None:
+        for key, line in bench_keys_of_record(project):
+            # Produced = the literal appears outside the declaration
+            # tuple (dict construction, result[...] assignment).
+            occurrences = bench.text.count(f'"{key}"')
+            if occurrences < 2:
+                findings.append(
+                    Finding(
+                        check="registry.bench-key-unproduced",
+                        path=BENCH,
+                        line=line,
+                        message=(
+                            f"KEYS_OF_RECORD entry {key!r} is never "
+                            f"produced by any bench phase — it serializes "
+                            f"as null in every summary"
+                        ),
+                    )
+                )
+
+    # --- federation exporter gauges (ISSUE 8 satellite) ---
+    fed_doc = project.file(FEDERATION_DOC)
+    fed_text = (fed_doc.text if fed_doc else "") + readme_text
+    for name, line in sorted(exporter_metric_families(project).items()):
+        if name.startswith("tpumon_federation_") and name not in fed_text:
+            findings.append(
+                Finding(
+                    check="registry.metric-undocumented",
+                    path=EXPORTER,
+                    line=line,
+                    message=(
+                        f"federation exporter family {name!r} is not "
+                        f"documented in docs/federation.md or README.md"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,305 @@
+"""Pass 1 — dirty-section coherence (the stale-route-bytes bug class).
+
+The epoch render cache (tpumon/snapshot.py) only re-renders a route
+when one of its dependency *sections* bumped. That contract has three
+ways to rot, each of which serves stale bytes forever without a single
+exception:
+
+- a consumer keys on a section name that was never declared in
+  ``SECTIONS`` (``EpochClock.version_of`` KeyErrors at request time, or
+  — worse — a registry tuple quietly drifts from the declaration);
+- a declared section is never *bumped* anywhere, so every route keyed
+  on it is frozen at its boot render;
+- a publisher mutates served state without bumping its section — the
+  exact shape of PR 7's "series nobody could query" and the stale-ETag
+  hazards docs/perf.md warns about.
+
+Rules:
+
+- ``sections.undeclared``: every section-name literal used by
+  ``bump()``/``version_of()``, a render/exporter-cache call, the
+  server's ``_cached_routes``/``RT_SECTIONS`` registries or exporter's
+  ``EXPORTER_SECTIONS`` must be declared in snapshot.py's SECTIONS.
+- ``sections.never-bumped``: every declared section must have a bump
+  site. The four collector sections (host/accel/k8s/serving) are
+  bumped dynamically — ``clock.bump(s.source)`` in the sampler — so
+  they are exempt only when a dynamic-argument bump call exists.
+- ``sections.publish-without-bump``: in the publisher modules
+  (federation.py / sampler.py), a function that mutates published
+  fan-in state (NodeState status/chips/slice_rows/connected/tier/error,
+  the hub's node table, the sampler's ``latest``) must also contain a
+  ``bump()`` call — publish and epoch advance travel together.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Finding, Project, const_str, dotted, str_tuple
+
+SNAPSHOT = "tpumon/snapshot.py"
+SERVER = "tpumon/server.py"
+EXPORTER = "tpumon/exporter.py"
+
+# Sections covered by the sampler's dynamic `clock.bump(s.source)`:
+# the per-collector sections, whose names arrive as Sample.source at
+# runtime. Kept in sync with Config.collectors' default by the
+# registry pass (the collector set is itself a registry entry).
+DYNAMIC_SECTIONS = frozenset({"host", "accel", "k8s", "serving"})
+
+# module -> published attributes whose mutation must ride with a bump.
+# Non-self attribute writes only (NodeState.__init__ initializes its
+# own fields; that is construction, not publication).
+PUBLISH_ATTRS = {
+    "tpumon/federation.py": frozenset(
+        {"status", "chips", "slice_rows", "connected", "tier", "error"}
+    ),
+    "tpumon/sampler.py": frozenset({"latest"}),
+}
+
+# Functions exempt from publish-without-bump: constructors, pure
+# serializers, and binders that only wire references.
+_PUBLISH_EXEMPT = frozenset({"__init__", "__post_init__", "bind", "to_json"})
+
+
+def _declared_sections(project: Project) -> tuple[dict[str, int], str | None]:
+    sf = project.file(SNAPSHOT)
+    if sf is None:
+        return {}, ""  # no snapshot module at all: pass doesn't apply
+    if sf.tree is None:
+        return {}, f"{SNAPSHOT} unparsable"
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SECTIONS":
+                    tup = str_tuple(node.value)
+                    if tup is not None:
+                        return dict(tup), None
+    return {}, f"no SECTIONS tuple of string literals in {SNAPSHOT}"
+
+
+def _is_cacheish(call: ast.Call) -> bool:
+    """cache.get(...) / exporter_cache.block(...) shapes: the receiver's
+    dotted name mentions "cache" so dict.get(k, (tuple,)) can't match."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in ("get", "block"):
+        return False
+    recv = dotted(f.value) or ""
+    return "cache" in recv
+
+
+def _scan_literal_uses(
+    sf, declared: dict[str, int], findings: list[Finding]
+) -> tuple[set[str], bool]:
+    """Collect bump()d section literals in one file; flag undeclared
+    names at every recognized use site. Returns (bumped, saw_dynamic)."""
+    bumped: set[str] = set()
+    dynamic = False
+
+    def check(name: str, lineno: int, where: str) -> None:
+        if name not in declared:
+            findings.append(
+                Finding(
+                    check="sections.undeclared",
+                    path=sf.rel,
+                    line=lineno,
+                    message=(
+                        f"section {name!r} used by {where} is not declared "
+                        f"in {SNAPSHOT} SECTIONS — its consumers would "
+                        f"never re-render (or KeyError at request time)"
+                    ),
+                )
+            )
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        if attr == "bump" and node.args:
+            s = const_str(node.args[0])
+            if s is None:
+                dynamic = True
+            else:
+                bumped.add(s)
+                check(s, node.lineno, "a bump() call")
+        elif attr == "version_of":
+            for a in node.args:
+                s = const_str(a)
+                if s is not None:
+                    check(s, a.lineno, "a version_of() call")
+        elif _is_cacheish(node) and len(node.args) >= 2:
+            tup = str_tuple(node.args[1])
+            if tup:
+                for s, ln in tup:
+                    check(s, ln, "a render-cache dependency tuple")
+    return bumped, dynamic
+
+
+def _scan_registries(project: Project, declared, findings: list[Finding]):
+    """The named section registries: server._cached_routes dep tuples,
+    RT_SECTIONS, exporter EXPORTER_SECTIONS."""
+
+    def check(sf, s: str, lineno: int, where: str) -> None:
+        if s not in declared:
+            findings.append(
+                Finding(
+                    check="sections.undeclared",
+                    path=sf.rel,
+                    line=lineno,
+                    message=(
+                        f"section {s!r} in {where} is not declared in "
+                        f"{SNAPSHOT} SECTIONS"
+                    ),
+                )
+            )
+
+    srv = project.file(SERVER)
+    if srv is not None and srv.tree is not None:
+        for node in ast.walk(srv.tree):
+            if isinstance(node, ast.Assign):
+                tgt = node.targets[0]
+                name = dotted(tgt) or ""
+                if name.endswith("RT_SECTIONS"):
+                    for s, ln in str_tuple(node.value) or []:
+                        check(srv, s, ln, "RT_SECTIONS")
+                if name.endswith("_cached_routes") and isinstance(
+                    node.value, ast.Dict
+                ):
+                    for v in node.value.values:
+                        if isinstance(v, ast.Tuple) and v.elts:
+                            for s, ln in str_tuple(v.elts[0]) or []:
+                                check(srv, s, ln, "_cached_routes")
+    exp = project.file(EXPORTER)
+    if exp is not None and exp.tree is not None:
+        for node in ast.walk(exp.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "EXPORTER_SECTIONS"
+                and isinstance(node.value, ast.Tuple)
+            ):
+                for entry in node.value.elts:
+                    if isinstance(entry, ast.Tuple) and len(entry.elts) == 2:
+                        for s, ln in str_tuple(entry.elts[1]) or []:
+                            check(exp, s, ln, "EXPORTER_SECTIONS")
+
+
+class _PublishScan(ast.NodeVisitor):
+    """Per-function: does it mutate published attrs / call bump()?"""
+
+    def __init__(self, attrs: frozenset[str]):
+        self.attrs = attrs
+        self.publishes: list[tuple[str, int]] = []
+        self.bumps = False
+
+    def _target(self, t: ast.AST) -> None:
+        # ns.status = ..., self.nodes[k] = ..., del self.nodes[k]
+        if isinstance(t, ast.Attribute):
+            base = dotted(t.value)
+            if base != "self" and t.attr in self.attrs:
+                self.publishes.append((f"{base}.{t.attr}", t.lineno))
+            elif t.attr == "nodes":
+                self.publishes.append((f"{base}.nodes", t.lineno))
+        elif isinstance(t, ast.Subscript):
+            name = dotted(t.value) or ""
+            if name.endswith(".nodes") or name == "self.latest":
+                self.publishes.append((name + "[...]", t.lineno))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        # bump() or a wrapper of it by convention (FederationHub._bump)
+        if isinstance(f, ast.Attribute) and f.attr.endswith("bump"):
+            self.bumps = True
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested defs
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_publishers(project: Project, findings: list[Finding]) -> None:
+    for rel, attrs in PUBLISH_ATTRS.items():
+        sf = project.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in _PUBLISH_EXEMPT:
+                continue
+            scan = _PublishScan(attrs)
+            for stmt in node.body:
+                scan.visit(stmt)
+            if scan.publishes and not scan.bumps:
+                what, line = scan.publishes[0]
+                findings.append(
+                    Finding(
+                        check="sections.publish-without-bump",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"{node.name}() mutates published state "
+                            f"({what}) without bumping an epoch section — "
+                            f"consumers keyed on it will serve stale bytes"
+                        ),
+                    )
+                )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    declared, err = _declared_sections(project)
+    if err == "":
+        return []  # tree has no snapshot module: nothing to check
+    if err is not None:
+        return [
+            Finding(
+                check="sections.missing-declaration",
+                path=SNAPSHOT,
+                line=1,
+                message=err,
+            )
+        ]
+    bumped: set[str] = set()
+    dynamic = False
+    for sf in project.py_files("tpumon"):
+        if sf.tree is None or sf.rel == SNAPSHOT:
+            continue
+        b, d = _scan_literal_uses(sf, declared, findings)
+        bumped |= b
+        dynamic = dynamic or d
+    _scan_registries(project, declared, findings)
+    _scan_publishers(project, findings)
+    for name, lineno in declared.items():
+        if name in bumped:
+            continue
+        if dynamic and name in DYNAMIC_SECTIONS:
+            continue
+        findings.append(
+            Finding(
+                check="sections.never-bumped",
+                path=SNAPSHOT,
+                line=lineno,
+                message=(
+                    f"section {name!r} is declared but never bumped — "
+                    f"every route keyed on it is frozen at its boot render"
+                ),
+            )
+        )
+    return findings

@@ -1,0 +1,352 @@
+"""Pass 2 — thread & lock discipline.
+
+The monitor is an asyncio core with threads at the edges (the k8s watch
+stream, workload reporters, loadgen HTTP servers). Every bug in that
+seam has the same three shapes, and all three are statically visible:
+
+- ``threads.undaemonized-unjoined``: a ``threading.Thread`` that is
+  neither ``daemon=True`` nor joined anywhere in its module can pin
+  process exit forever.
+- ``threads.serve-forever-unclosed``: a ``Thread(target=x.serve_forever)``
+  spawn whose module never calls BOTH ``x.shutdown()`` *and*
+  ``x.server_close()``. ``shutdown()`` alone stops the accept loop but
+  leaks the listening socket — every loadgen start/stop cycle then
+  holds an fd (the PR 8 serving.py defect).
+- ``threads.no-stop``: a class that spawns a background thread from one
+  of its own methods must expose a ``stop()``/``close()``/``shutdown()``
+  so an owner *can* stop it.
+- ``threads.stoppable-not-stopped``: a class holding such a component
+  as an attribute (``self.x = Watcher(...)``) must actually call its
+  stop — an orphaned watcher keeps its socket and thread after the
+  owner shut down (the PR 8 K8sCollector defect).
+- ``threads.unguarded-attr``: an attribute mutated both from a class's
+  thread body (the Thread target method + its transitive self-calls)
+  and from its owner-facing methods must be mutated under
+  ``with self._lock`` everywhere (or carry a justified suppression).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.tpulint.core import Finding, Project, dotted
+
+_STOP_NAMES = ("stop", "close", "shutdown")
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Name) and f.id == "Thread") or (
+        isinstance(f, ast.Attribute)
+        and f.attr == "Thread"
+        and dotted(f.value) == "threading"
+    )
+
+
+def _kw(node: ast.Call, name: str) -> ast.AST | None:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _assigned_name(node: ast.Call, tree: ast.AST) -> str | None:
+    """The dotted name a Thread(...) call is assigned to, if any
+    (``t = Thread(...)`` / ``self._thread = Thread(...)``)."""
+    for parent in ast.walk(tree):
+        if isinstance(parent, ast.Assign) and parent.value is node:
+            return dotted(parent.targets[0])
+    return None
+
+
+def _check_spawns(sf, findings: list[Finding]) -> None:
+    text = sf.text
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+            continue
+        # daemon-or-joined
+        daemon = _kw(node, "daemon")
+        is_daemon = (
+            isinstance(daemon, ast.Constant) and daemon.value is True
+        )
+        if not is_daemon:
+            name = _assigned_name(node, sf.tree)
+            joined = name is not None and re.search(
+                rf"\b{re.escape(name)}\.join\(", text
+            )
+            if not joined:
+                findings.append(
+                    Finding(
+                        check="threads.undaemonized-unjoined",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            "thread is neither daemon=True nor joined in "
+                            "this module — it can pin process exit"
+                        ),
+                    )
+                )
+        # serve_forever spawns: owner must both shutdown() AND
+        # server_close() the server somewhere in the module.
+        target = _kw(node, "target")
+        if (
+            isinstance(target, ast.Attribute)
+            and target.attr == "serve_forever"
+        ):
+            base = dotted(target.value)
+            if base is None:
+                continue
+            missing = [
+                m
+                for m in ("shutdown", "server_close")
+                if not re.search(rf"\b{re.escape(base)}\.{m}\(", text)
+            ]
+            if missing:
+                findings.append(
+                    Finding(
+                        check="threads.serve-forever-unclosed",
+                        path=sf.rel,
+                        line=node.lineno,
+                        message=(
+                            f"serve_forever thread for {base!r} but this "
+                            f"module never calls {base}.{missing[0]}() — "
+                            f"shutdown() without server_close() leaks the "
+                            f"listening socket"
+                        )
+                        if missing == ["server_close"]
+                        else (
+                            f"serve_forever thread for {base!r} with no "
+                            f"{' / '.join(f'{base}.{m}()' for m in missing)}"
+                            f" anywhere in this module — nothing can stop it"
+                        ),
+                    )
+                )
+
+
+class _AttrMutations(ast.NodeVisitor):
+    """self.<attr> mutation sites in one function, with lock context."""
+
+    def __init__(self):
+        self.sites: list[tuple[str, int, bool]] = []  # (attr, line, locked)
+        self._lock_depth = 0
+        self.self_calls: set[str] = set()
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(
+            "lock" in (dotted(item.context_expr) or "").lower()
+            or (
+                isinstance(item.context_expr, ast.Call)
+                and "lock" in (dotted(item.context_expr.func) or "").lower()
+            )
+            for item in node.items
+        )
+        if locked:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if locked:
+            self._lock_depth -= 1
+
+    def _target(self, t: ast.AST) -> None:
+        # self.x = / self.x += / self.x[k] = / self.x.pop-style mutations
+        # are approximated by assignment targets; method-call mutation
+        # (append/pop) is out of scope — those sites already hold a
+        # reference the lock rule can't see.
+        if isinstance(t, ast.Attribute) and dotted(t.value) == "self":
+            self.sites.append((t.attr, t.lineno, self._lock_depth > 0))
+        elif isinstance(t, ast.Subscript):
+            inner = t.value
+            if (
+                isinstance(inner, ast.Attribute)
+                and dotted(inner.value) == "self"
+            ):
+                self.sites.append(
+                    (inner.attr, t.lineno, self._lock_depth > 0)
+                )
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and dotted(f.value) == "self":
+            self.self_calls.add(f.attr)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):  # nested defs have own contexts
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _class_threads(cls: ast.ClassDef) -> tuple[set[str], bool]:
+    """(self-method Thread targets, spawns_any_thread)."""
+    targets: set[str] = set()
+    spawns = False
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _is_thread_call(node):
+            spawns = True
+            t = _kw(node, "target")
+            if (
+                isinstance(t, ast.Attribute)
+                and dotted(t.value) == "self"
+            ):
+                targets.add(t.attr)
+    return targets, spawns
+
+
+def _check_classes(sf, findings: list[Finding]) -> list[str]:
+    """Per-class rules; returns names of stoppable bg-thread classes."""
+    stoppable: list[str] = []
+    for cls in [
+        n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)
+    ]:
+        targets, spawns = _class_threads(cls)
+        if not spawns:
+            continue
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not any(m in methods for m in _STOP_NAMES):
+            findings.append(
+                Finding(
+                    check="threads.no-stop",
+                    path=sf.rel,
+                    line=cls.lineno,
+                    message=(
+                        f"class {cls.name} spawns a background thread but "
+                        f"defines no stop()/close()/shutdown() — owners "
+                        f"cannot stop it"
+                    ),
+                )
+            )
+        else:
+            stoppable.append(cls.name)
+        if not targets:
+            continue
+        # worker context: thread targets + transitive self-calls
+        scans = {name: _AttrMutations() for name in methods}
+        for name, fn in methods.items():
+            for stmt in fn.body:
+                scans[name].visit(stmt)
+        worker: set[str] = set()
+        frontier = [t for t in targets if t in methods]
+        while frontier:
+            m = frontier.pop()
+            if m in worker:
+                continue
+            worker.add(m)
+            frontier.extend(
+                c for c in scans[m].self_calls if c in methods and c not in worker
+            )
+        owner = set(methods) - worker - {"__init__", "__post_init__"}
+        ctx_sites: dict[str, dict[str, list[tuple[int, bool]]]] = {}
+        for name in methods:
+            ctx = "worker" if name in worker else "owner"
+            if name in ("__init__", "__post_init__"):
+                continue
+            for attr, line, locked in scans[name].sites:
+                ctx_sites.setdefault(attr, {}).setdefault(ctx, []).append(
+                    (line, locked)
+                )
+        for attr, by_ctx in sorted(ctx_sites.items()):
+            if "worker" not in by_ctx or "owner" not in by_ctx:
+                continue
+            unguarded = [
+                (line, ctx)
+                for ctx, sites in by_ctx.items()
+                for line, locked in sites
+                if not locked
+            ]
+            if unguarded:
+                line, _ = min(unguarded)
+                findings.append(
+                    Finding(
+                        check="threads.unguarded-attr",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"{cls.name}.{attr} is mutated from both the "
+                            f"thread body and owner methods, but not every "
+                            f"site holds self._lock"
+                        ),
+                    )
+                )
+    return stoppable
+
+
+def _check_owners(
+    project: Project, stoppable: set[str], findings: list[Finding]
+) -> None:
+    """Classes holding a stoppable component must stop it."""
+    for sf in project.py_files():
+        if sf.tree is None:
+            continue
+        for cls in [
+            n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)
+        ]:
+            held: dict[str, tuple[str, int]] = {}  # attr -> (cls, line)
+            for node in ast.walk(cls):
+                if (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    cname = dotted(node.value.func) or ""
+                    cname = cname.rsplit(".", 1)[-1]
+                    tgt = node.targets[0]
+                    if (
+                        cname in stoppable
+                        and isinstance(tgt, ast.Attribute)
+                        and dotted(tgt.value) == "self"
+                    ):
+                        held[tgt.attr] = (cname, node.lineno)
+            if not held:
+                continue
+            stopped: set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if node.func.attr in _STOP_NAMES:
+                        base = dotted(node.func.value) or ""
+                        if base.startswith("self."):
+                            stopped.add(base[len("self.") :])
+            for attr, (cname, line) in sorted(held.items()):
+                if attr in stopped:
+                    continue
+                findings.append(
+                    Finding(
+                        check="threads.stoppable-not-stopped",
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"{cls.name} holds a {cname} (self.{attr}) — a "
+                            f"background-thread component — but never calls "
+                            f"self.{attr}.stop(); the thread and its socket "
+                            f"outlive this owner"
+                        ),
+                    )
+                )
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    stoppable: set[str] = set()
+    for sf in project.py_files():
+        if sf.tree is None:
+            continue
+        _check_spawns(sf, findings)
+        stoppable.update(_check_classes(sf, findings))
+    _check_owners(project, stoppable, findings)
+    return findings

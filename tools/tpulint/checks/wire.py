@@ -1,0 +1,121 @@
+"""Pass 3 — wire-protocol exhaustiveness (tpumon/protowire.py).
+
+The columnar wire format is a closed enum of column types (``_CT_*``)
+with three obligations per member that live in three different places:
+an encoder branch (``_encode_col``), a decoder branch (``_decode_col``)
+and truncation coverage in tests/test_protowire.py. PR 7 shipped a
+near-miss of exactly this shape (an all-None intlist sub-column encoded
+a frame the decoder refused); the enum will keep growing, so the
+obligations are pinned:
+
+- ``wire.no-encoder`` / ``wire.no-decoder``: every ``_CT_`` constant
+  must be referenced inside both ``_encode_col`` and ``_decode_col``.
+  (Pure flag masks — the ``_CTF_`` prefix — are exempt: they modify a
+  ctype byte, they aren't column types.)
+- ``wire.untested``: every ``_CT_`` constant must be referenced by name
+  in tests/test_protowire.py, which must contain a
+  truncation-at-every-prefix test — a new column type whose frames were
+  never truncated byte-by-byte is how a decoder learns to hang on a
+  short read in production instead of in CI.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.tpulint.core import Finding, Project
+
+PROTOWIRE = "tpumon/protowire.py"
+WIRE_TESTS = "tests/test_protowire.py"
+
+
+def _ct_constants(tree: ast.AST) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id.startswith("_CT_")
+                    and not t.id.startswith("_CTF_")
+                ):
+                    out[t.id] = node.lineno
+    return out
+
+
+def _names_in_function(tree: ast.AST, fname: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fname:
+            return {
+                n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+            }
+    return set()
+
+
+def check(project: Project) -> list[Finding]:
+    sf = project.file(PROTOWIRE)
+    if sf is None or sf.tree is None:
+        return []  # fixture trees without a protowire simply skip
+    findings: list[Finding] = []
+    ctypes = _ct_constants(sf.tree)
+    if not ctypes:
+        return [
+            Finding(
+                check="wire.no-ctypes",
+                path=PROTOWIRE,
+                line=1,
+                message="no _CT_* column-type constants found — scan stale?",
+            )
+        ]
+    enc = _names_in_function(sf.tree, "_encode_col")
+    dec = _names_in_function(sf.tree, "_decode_col")
+    for name, line in sorted(ctypes.items()):
+        if name not in enc:
+            findings.append(
+                Finding(
+                    check="wire.no-encoder",
+                    path=PROTOWIRE,
+                    line=line,
+                    message=f"column type {name} has no _encode_col branch",
+                )
+            )
+        if name not in dec:
+            findings.append(
+                Finding(
+                    check="wire.no-decoder",
+                    path=PROTOWIRE,
+                    line=line,
+                    message=(
+                        f"column type {name} has no _decode_col branch — "
+                        f"frames containing it are refused by every peer"
+                    ),
+                )
+            )
+    tests = project.file(WIRE_TESTS)
+    if tests is None:
+        findings.append(
+            Finding(
+                check="wire.untested",
+                path=PROTOWIRE,
+                line=1,
+                message=f"{WIRE_TESTS} is missing",
+            )
+        )
+        return findings
+    has_truncation_test = (
+        "truncation" in tests.text and "every_prefix" in tests.text
+    )
+    for name, line in sorted(ctypes.items()):
+        if name not in tests.text or not has_truncation_test:
+            findings.append(
+                Finding(
+                    check="wire.untested",
+                    path=PROTOWIRE,
+                    line=line,
+                    message=(
+                        f"column type {name} is not referenced by a "
+                        f"truncation-at-every-prefix test in {WIRE_TESTS}"
+                    ),
+                )
+            )
+    return findings

@@ -1,0 +1,333 @@
+"""tpulint core: project model, suppressions, finding plumbing.
+
+The monitor's worst shipped bugs were *coherence* bugs, not logic bugs:
+a TSDB series nobody could query (PR 7, caught live), silent
+out-of-order appends (PR 6), routes that existed but weren't documented
+(the test_routes_doc.py lint exists because one almost shipped). This
+package is the cure grown into a framework: AST-based passes that pin
+the cross-file contracts this codebase actually breaks — dirty-section
+coherence, thread/lock discipline, wire-protocol exhaustiveness and the
+registry/doc tables. See docs/static-analysis.md.
+
+Design rules:
+
+- Checkers are *repo-level*: each pass loads the files it needs through
+  one ``Project`` and may correlate across them (a section declared in
+  snapshot.py, bumped in federation.py, consumed in server.py).
+- Findings are anchored to a file:line so inline suppressions work.
+- Suppressions (``# tpulint: disable=<check> (<reason>)``) MUST carry a
+  reason; a reasonless or unknown-check suppression is itself a finding
+  that cannot be suppressed. An allowlist you can't audit is drift with
+  extra steps.
+- Every checker has a known-bad fixture tree under tests/fixtures/lint/
+  driven by tests/test_lint.py — a checker that silently stops firing
+  fails CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# Suppression grammar: "# tpulint: disable=<check>[,<check>] (<reason>)".
+# The reason parens are part of the grammar, not decoration — the
+# missing-reason rule keys off their absence.
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_.,-]+)\s*(?:\(([^)]*)\))?"
+)
+
+
+@dataclass
+class Finding:
+    check: str  # "<pass>.<rule>", e.g. "threads.serve-forever-unclosed"
+    path: str  # project-relative path
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "check": self.check,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            **(
+                {"suppress_reason": self.suppress_reason}
+                if self.suppress_reason
+                else {}
+            ),
+        }
+
+    def render(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.check}: {self.message}{tag}"
+
+
+@dataclass
+class Suppression:
+    line: int  # line the comment is on
+    checks: tuple[str, ...]
+    reason: str | None
+    applies_to: tuple[int, ...] = ()  # effective lines (own or next)
+
+    def matches(self, check: str) -> bool:
+        return any(
+            check == tok or check.startswith(tok + ".") for tok in self.checks
+        )
+
+
+@dataclass
+class SourceFile:
+    rel: str
+    text: str
+    tree: ast.AST | None = None
+    parse_error: str | None = None
+    suppressions: list[Suppression] = field(default_factory=list)
+
+    @property
+    def lines(self) -> list[str]:
+        return self.text.splitlines()
+
+
+def _parse_suppressions(text: str) -> list[Suppression]:
+    """Suppressions live in real COMMENT tokens only: a docstring that
+    *documents* the syntax (docs/static-analysis.md's add-a-checker
+    recipe encourages exactly that) must never become an active
+    suppression, or the audit guarantee dies in the prose explaining
+    it. Unparsable files yield none (a finding can't be suppressed in
+    a file the checkers can't read either)."""
+    out: list[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return out
+    lines = text.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            continue
+        i = tok.start[0]
+        checks = tuple(t for t in m.group(1).split(",") if t)
+        reason = m.group(2)
+        reason = reason.strip() if reason is not None else None
+        # A comment-only line suppresses the NEXT line (comment-above
+        # style); an inline trailer suppresses its own line. Both cover
+        # the line they sit on so a finding anchored at the comment
+        # itself (rare) is still addressable.
+        src_line = lines[i - 1] if i <= len(lines) else ""
+        own_line_is_comment = src_line.lstrip().startswith("#")
+        applies = (i, i + 1) if own_line_is_comment else (i,)
+        out.append(
+            Suppression(line=i, checks=checks, reason=reason, applies_to=applies)
+        )
+    return out
+
+
+class Project:
+    """Lazy file loader rooted at a source tree.
+
+    ``py_files(prefix)`` iterates parsed Python sources under a relative
+    directory; ``file(rel)`` loads any single file (Python sources get
+    an AST and suppression table). Checkers take a Project so the same
+    pass runs against the real tree and against the known-bad fixture
+    trees under tests/fixtures/lint/.
+    """
+
+    # Directories whose Python files are scanned by tree-walking passes
+    # (threads, sections literals, suppression-format lint). tests/ is
+    # deliberately NOT walked: passes that need a specific test file
+    # (wire exhaustiveness) load it explicitly.
+    SCAN_DIRS = ("tpumon", "tools")
+
+    def __init__(self, root: str, scan_dirs: tuple[str, ...] | None = None):
+        self.root = os.path.abspath(root)
+        self.scan_dirs = scan_dirs if scan_dirs is not None else self.SCAN_DIRS
+        self._files: dict[str, SourceFile | None] = {}
+
+    def file(self, rel: str) -> SourceFile | None:
+        if rel in self._files:
+            return self._files[rel]
+        path = os.path.join(self.root, rel)
+        if not os.path.isfile(path):
+            self._files[rel] = None
+            return None
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        sf = SourceFile(rel=rel, text=text)
+        if rel.endswith(".py"):
+            try:
+                sf.tree = ast.parse(text)
+            except SyntaxError as e:
+                sf.parse_error = f"{type(e).__name__}: {e}"
+            sf.suppressions = _parse_suppressions(text)
+        self._files[rel] = sf
+        return sf
+
+    def py_files(self, prefix: str | None = None) -> list[SourceFile]:
+        rels: list[str] = []
+        dirs = (prefix,) if prefix else self.scan_dirs
+        for d in dirs:
+            top = os.path.join(self.root, d)
+            if not os.path.isdir(top):
+                continue
+            for dirpath, dirnames, names in os.walk(top):
+                dirnames[:] = [n for n in dirnames if n != "__pycache__"]
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        rels.append(os.path.relpath(full, self.root))
+        out = []
+        for rel in sorted(rels):
+            sf = self.file(rel)
+            if sf is not None:
+                out.append(sf)
+        return out
+
+
+# --------------------------- shared AST helpers ---------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """Render a Name/Attribute chain as "a.b.c"; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_tuple(node: ast.AST) -> list[tuple[str, int]] | None:
+    """(value, lineno) per element of an all-string tuple/list literal."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for elt in node.elts:
+        s = const_str(elt)
+        if s is None:
+            return None
+        out.append((s, elt.lineno))
+    return out
+
+
+# ------------------------------ the runner ------------------------------
+
+
+def apply_suppressions(project: Project, findings: list[Finding]) -> None:
+    """Mark findings covered by an inline suppression. Suppression-format
+    findings (the ``suppression.*`` checks) are exempt by construction —
+    a reasonless allowlist must not be able to allowlist itself."""
+    for f in findings:
+        if f.check.startswith("suppression."):
+            continue
+        sf = project.file(f.path)
+        if sf is None:
+            continue
+        for sup in sf.suppressions:
+            if f.line in sup.applies_to and sup.matches(f.check):
+                f.suppressed = True
+                f.suppress_reason = sup.reason
+                break
+
+
+def lint_suppressions(
+    project: Project, known_checks: set[str]
+) -> list[Finding]:
+    """The suppressions are themselves linted: every one must carry a
+    non-empty reason string and name a registered pass/rule."""
+    out: list[Finding] = []
+    for sf in project.py_files():
+        for sup in sf.suppressions:
+            if not sup.reason:
+                out.append(
+                    Finding(
+                        check="suppression.missing-reason",
+                        path=sf.rel,
+                        line=sup.line,
+                        message=(
+                            "suppression without a reason — write "
+                            "'# tpulint: disable=<check> (<why this is safe>)'"
+                        ),
+                    )
+                )
+            for tok in sup.checks:
+                base = tok.split(".", 1)[0]
+                if base not in known_checks:
+                    out.append(
+                        Finding(
+                            check="suppression.unknown-check",
+                            path=sf.rel,
+                            line=sup.line,
+                            message=(
+                                f"suppression names unknown check {tok!r} "
+                                f"(known: {', '.join(sorted(known_checks))})"
+                            ),
+                        )
+                    )
+    return out
+
+
+def run(
+    root: str, checks: dict[str, object], only: tuple[str, ...] = ()
+) -> list[Finding]:
+    """Run the selected passes (default: all) over ``root``; returns
+    every finding, suppressed ones flagged in place."""
+    project = Project(root)
+    findings: list[Finding] = []
+    selected = only or tuple(checks)
+    for name in selected:
+        checker = checks[name]
+        findings.extend(checker(project))
+    findings.extend(lint_suppressions(project, set(checks)))
+    apply_suppressions(project, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings
+
+
+def summary_line(findings: list[Finding], npasses: int) -> str:
+    """The stable one-line summary (always the last line the CLI
+    prints — log scrapers key off it, so keep the shape)."""
+    live = sum(1 for f in findings if not f.suppressed)
+    supp = sum(1 for f in findings if f.suppressed)
+    status = "OK" if live == 0 else "FAIL"
+    return (
+        f"tpulint: {status}: {live} finding(s), {supp} suppressed, "
+        f"{npasses} pass(es)"
+    )
+
+
+def render_report(
+    findings: list[Finding], npasses: int, as_json: bool = False
+) -> tuple[str, int]:
+    """(report text ending in the summary line, exit code)."""
+    live = [f for f in findings if not f.suppressed]
+    if as_json:
+        body = json.dumps(
+            {
+                "findings": [f.to_json() for f in findings],
+                "unsuppressed": len(live),
+            },
+            indent=1,
+        )
+        lines = [body]
+    else:
+        lines = [f.render() for f in findings]
+    lines.append(summary_line(findings, npasses))
+    return "\n".join(lines), (1 if live else 0)

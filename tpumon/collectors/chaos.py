@@ -129,6 +129,13 @@ class ChaosCollector:
         if inner_set is not None:  # chaos may wrap a peer federation
             inner_set(journal)
 
+    def stop(self) -> None:
+        """Forward owner-stop to the wrapped collector (the k8s watch
+        thread must stop even when its collector is chaos-wrapped)."""
+        inner_stop = getattr(self.inner, "stop", None)
+        if inner_stop is not None:
+            inner_stop()
+
     def _note(self, msg: str, **attrs) -> None:
         if self.journal is not None:
             self.journal.record("chaos", "minor", self.name, msg, **attrs)

@@ -288,7 +288,17 @@ class PodWatcher:
         self._thread.start()
 
     def stop(self) -> None:
+        """Stop the watch thread and wait (bounded) for it to exit.
+        Terminal: a stopped watcher stays stopped — the collector
+        builds a fresh one if watching resumes. The join timeout is
+        deliberate: a thread blocked inside the watch read can't be
+        interrupted mid-``urlopen`` (it notices the stop event at the
+        next line/reconnect), so the wait is bounded and the daemon
+        flag guarantees the stragglers can't pin process exit."""
         self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
 
     @property
     def synced(self) -> bool:
@@ -443,6 +453,16 @@ class K8sCollector:
         if self.mode == "none":
             return []
         return [ApiPodSource(api_url=self.api_url), KubectlPodSource()]
+
+    def stop(self) -> None:
+        """Release background resources: the watch mode's PodWatcher
+        holds a thread and a live HTTP stream that would otherwise
+        outlive the sampler (found by tpulint's stoppable-not-stopped
+        pass, PR 8). Poll modes hold nothing. A later collect() builds
+        a fresh watcher, so stop→collect still works."""
+        if self._watcher is not None:
+            self._watcher.stop()
+            self._watcher = None
 
     def _watch_sample(self) -> Sample | None:
         """Watch mode: serve from the live watcher map, annotating each

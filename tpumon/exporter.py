@@ -361,6 +361,94 @@ def _render_trace(sampler: Sampler, profiler=None) -> str:
     return w.render() if w.families else ""
 
 
+def _render_federation(sampler: Sampler) -> str:
+    """Aggregator-tree block (tpumon.federation; ROADMAP item 2
+    follow-up): per-downstream freshness and liveness, fleet-level
+    dark/unreachable counts, and the uplink's wire accounting — the
+    gauges an operator pages off when a subtree goes quiet. Rendered
+    on the "federation" dirty section (plus "samples" so age gauges
+    advance per tick); absent entirely on standalone monitors. The
+    family names below are documented in docs/federation.md — the
+    tpulint registry pass pins that."""
+    hub = getattr(sampler, "federation", None)
+    uplink = getattr(sampler, "uplink", None)
+    if hub is None and uplink is None:
+        return ""
+    w = MetricsWriter()
+    if hub is not None:
+        hub.check_staleness()  # dark flips land before the render
+        up = w.gauge(
+            "tpumon_federation_downstream_up",
+            "Downstream node streaming fresh frames (1=ok, 0=dark/unreachable)",
+        )
+        age = w.gauge(
+            "tpumon_federation_downstream_age_seconds",
+            "Seconds since the last frame landed from this downstream",
+        )
+        frames = w.counter(
+            "tpumon_federation_downstream_frames_total",
+            "Delta frames ingested per downstream node",
+        )
+        fbytes = w.counter(
+            "tpumon_federation_downstream_bytes_total",
+            "Wire bytes ingested per downstream node",
+        )
+        for node, ns in sorted(hub.nodes.items()):
+            labels = {"node": node, "tier": ns.tier}
+            up.add(labels, 1.0 if ns.status == "ok" else 0.0)
+            if ns.last_wall is not None:
+                age.add(labels, round(time.monotonic() - ns.last_wall, 3))
+            frames.add(labels, ns.frames)
+            fbytes.add(labels, ns.bytes)
+        fleet = hub.fleet()
+        g = w.gauge(
+            "tpumon_federation_fleet_slices", "Slices in the fleet view"
+        )
+        g.add({}, fleet["slices"])
+        g = w.gauge(
+            "tpumon_federation_fleet_chips", "Reporting chips in the fleet view"
+        )
+        g.add({}, fleet["chips"])
+        g = w.gauge(
+            "tpumon_federation_dark_slices",
+            "Slices whose leaf went silent (reported dark by its aggregator)",
+        )
+        g.add({}, fleet["dark_slices"])
+        g = w.gauge(
+            "tpumon_federation_unreachable_slices",
+            "Slices behind a partitioned aggregator subtree",
+        )
+        g.add({}, fleet["unreachable_slices"])
+    if uplink is not None:
+        st = uplink.enc.stats
+        g = w.gauge(
+            "tpumon_federation_uplink_connected",
+            "Upstream push stream established (1=connected)",
+        )
+        g.add({}, 1.0 if uplink.connected else 0.0)
+        c = w.counter(
+            "tpumon_federation_uplink_frames_total",
+            "Delta frames pushed upstream",
+        )
+        c.add({}, st["frames"])
+        c = w.counter(
+            "tpumon_federation_uplink_bytes_total",
+            "Wire bytes pushed upstream (keyframes + deltas)",
+        )
+        c.add({}, st["bytes"])
+        c = w.counter(
+            "tpumon_federation_uplink_delta_bytes_total",
+            "Wire bytes pushed upstream in delta frames (the steady state)",
+        )
+        c.add({}, st["delta_bytes"])
+        c = w.counter(
+            "tpumon_federation_uplink_resyncs_total",
+            "Keyframe resyncs after a lost upstream connection",
+        )
+        c.add({}, uplink.resyncs)
+    return w.render() if w.families else ""
+
+
 def _render_events(sampler: Sampler) -> str:
     """Event journal + anomaly detector block (tpumon.events /
     tpumon.anomaly): lifetime per-(kind, severity) event counters —
@@ -406,6 +494,10 @@ EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("trace", ("samples",)),
     # Journal counters + anomaly gauges move only when the journal does.
     ("events", ("events",)),
+    # Aggregator-tree gauges: "federation" moves as downstream frames
+    # land / nodes flip dark; "samples" keeps the per-downstream age
+    # and uplink counters fresh each tick even when no frame landed.
+    ("federation", ("federation", "samples")),
 )
 
 _RENDERERS = {
@@ -415,6 +507,7 @@ _RENDERERS = {
     "serving": _render_serving,
     "self": _render_self,
     "events": _render_events,
+    "federation": _render_federation,
 }
 
 

@@ -270,6 +270,15 @@ class FederationHub:
         self.journal = sampler.journal
         self.clock = sampler.clock
 
+    def _bump(self) -> None:
+        """Advance the "federation" dirty section — every mutation of
+        the published fleet view (frames landing, connect/disconnect,
+        dark flips, forgotten nodes) must ride with one of these, or
+        /api/federation and the exporter's federation block serve
+        stale bytes (tpulint sections.publish-without-bump)."""
+        if self.clock is not None:
+            self.clock.bump("federation")
+
     # ------------------------------ ingest ------------------------------
 
     async def handle_ingest(
@@ -318,6 +327,10 @@ class FederationHub:
         ns.conn = token  # a reconnect supersedes the old stream
         ns.connected = True
         ns.decoder = DeltaStreamDecoder()  # new stream ⇒ fresh baseline
+        # Connection state is part of the published fleet view
+        # (NodeState.to_json "connected"): a connect that lands before
+        # the first frame must re-render /api/federation too.
+        self._bump()
         status, err = 200, None
         buf = bytearray()
         try:
@@ -347,6 +360,7 @@ class FederationHub:
         finally:
             if ns.conn is token:
                 ns.connected = False
+                self._bump()
         with contextlib.suppress(Exception):
             body = (
                 b"{}" if err is None
@@ -434,8 +448,7 @@ class FederationHub:
                     )
         elif lag < self.dark_after_s / 2:
             ns.lagging = False
-        if self.clock is not None:
-            self.clock.bump("federation")
+        self._bump()
 
     def _record_rollups(self, rows: list[dict], ts: float) -> None:
         """Land slice rollups in the TSDB through the batch path: one
@@ -488,8 +501,7 @@ class FederationHub:
                         f"downstream {name} forgotten after "
                         f"{(now - ns.last_wall) / 60:.0f}min dark",
                     )
-                if self.clock is not None:
-                    self.clock.bump("federation")
+                self._bump()
                 continue
             if (
                 ns.status == "ok"
@@ -505,8 +517,7 @@ class FederationHub:
                         f"for {now - ns.last_wall:.1f}s"
                         + (f" (slices {', '.join(map(str, dark))})" if dark else ""),
                     )
-                if self.clock is not None:
-                    self.clock.bump("federation")
+                self._bump()
 
     def chips(self) -> list[ChipSample]:
         """Fresh downstream chips (leaf-tier nodes only; dark nodes'
@@ -603,6 +614,11 @@ class HubMergedCollector:
     def set_journal(self, journal) -> None:
         if self.local is not None and hasattr(self.local, "set_journal"):
             self.local.set_journal(journal)
+
+    def stop(self) -> None:
+        """Forward owner-stop to the wrapped local collector."""
+        if self.local is not None and hasattr(self.local, "stop"):
+            self.local.stop()
 
     async def collect(self) -> Sample:
         self.hub.check_staleness()

@@ -1679,7 +1679,9 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
       GET /generate?prompt=1,2,3&max_new=8&stream=1   → SSE, one
           ``data: <token>`` event per token as it is emitted, then
           ``event: done``. First event arrives at TTFT, not completion.
-    Runs in a daemon thread; call server.shutdown() to stop."""
+    Runs in a daemon thread; call server.shutdown() THEN
+    server.server_close() to stop — shutdown alone leaks the
+    listening socket."""
     import json as _json
     import urllib.parse
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -1859,7 +1861,11 @@ def start_background(rps: float = 0.5, max_new: int = 16,
         try:
             _arrival_loop(engine, rps, max_new, stop, seed=seed)
         finally:
+            # shutdown() alone stops the accept loop but LEAKS the
+            # listening socket — every start/stop cycle would pin an fd
+            # (found by tpulint's serve-forever-unclosed pass, PR 8).
             server.shutdown()
+            server.server_close()
 
     threading.Thread(target=_run, daemon=True).start()
     return engine, f"http://127.0.0.1:{bound}/metrics", stop
@@ -1963,7 +1969,7 @@ def main(argv: list[str] | None = None) -> int:
         decode_block=args.decode_block, kv_dtype=args.kv_dtype,
         paged_attn=args.paged_attn,
     ))
-    _, port = start_metrics_server(engine, args.port)
+    server, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
           f"(point TPUMON_SERVING_TARGETS=http://127.0.0.1:{port}/metrics)")
     reporter = None
@@ -1981,6 +1987,8 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         if reporter is not None:
             reporter.stop()
+        server.shutdown()
+        server.server_close()
     return 0
 
 

@@ -176,7 +176,12 @@ _I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
 
 
 def _zigzag64(n: int) -> int:
-    return (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+    # Arbitrary-precision zigzag. For int64-range values this is
+    # bit-identical to the classic (n << 1) ^ (n >> 63); the int64
+    # shift, however, silently corrupts negatives BEYOND int64 — the
+    # very values _CT_VARINT exists to carry (caught by the tpulint
+    # wire pass's exhaustive ctype truncation test, PR 8).
+    return (n << 1) if n >= 0 else ((-n) << 1) - 1
 
 
 def _unzigzag64(u: int) -> int:

@@ -899,3 +899,18 @@ class Sampler:
         self._tasks = []
         if self.notifier is not None:
             await self.notifier.close()
+        # Collectors with background resources stop with their owner
+        # (the k8s watch mode holds a thread + live HTTP stream; chaos
+        # wrappers and the federation merge forward the stop): a
+        # stopped sampler must not leave watcher threads holding
+        # sockets. Found by tpulint's stoppable-not-stopped pass.
+        # Off-loop: a stop may join a thread that is blocked in a
+        # network read (PodWatcher.stop's bounded join) — that wait
+        # must not freeze the event loop mid-shutdown.
+        for c in (self.host, self.accel, self.k8s, self.serving):
+            c_stop = getattr(c, "stop", None)
+            if c_stop is not None:
+                try:
+                    await asyncio.to_thread(c_stop)
+                except Exception:
+                    pass  # shutdown must not die on a wedged collector
