@@ -1,0 +1,44 @@
+"""Known-bad fixture: bindings drifted from the C declarations."""
+
+import ctypes
+
+FIX_ABI_VERSION = 1
+
+
+class FixStruct(ctypes.Structure):
+    _fields_ = [
+        ("a", ctypes.c_double),
+        ("b", ctypes.c_double),  # C declares int32_t b
+    ]
+
+
+def load():
+    lib = ctypes.CDLL("libfix.so")
+    # Arity drift: the C function takes (n, vals, scale).
+    lib.tpumon_fix_drift.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.tpumon_fix_drift.restype = ctypes.c_int64
+    # Type drift: C takes a double.
+    lib.tpumon_fix_badtype.argtypes = [ctypes.c_int32]
+    lib.tpumon_fix_badtype.restype = ctypes.c_int
+    # Struct layout drift: FixStruct's second field is c_double.
+    lib.tpumon_fix_struct.argtypes = [ctypes.POINTER(FixStruct)]
+    lib.tpumon_fix_struct.restype = ctypes.c_int
+    # Missing argtypes on a function that takes parameters.
+    lib.tpumon_fix_noargs.restype = ctypes.c_int
+    # Binding for a symbol no .cpp exports.
+    lib.tpumon_fix_gone.argtypes = []
+    lib.tpumon_fix_gone.restype = ctypes.c_int
+    lib.tpumon_fix_abi_version.restype = ctypes.c_int
+    lib.tpumon_fix2_abi_version.restype = ctypes.c_int
+    if lib.tpumon_fix_abi_version() != FIX_ABI_VERSION:
+        return None
+    return lib
+
+
+def load_more(lib):
+    # Missing restype on a double-returning function.
+    lib.tpumon_fix_noret.argtypes = []
+    return lib

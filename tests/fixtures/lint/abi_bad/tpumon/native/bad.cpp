@@ -1,0 +1,41 @@
+// Known-bad fixture: every flavor of ctypes <-> C ABI drift.
+
+#include <cstdint>
+
+extern "C" {
+
+struct FixSample {
+  double a;
+  int32_t b;
+};
+
+// Exported but never bound in __init__.py -> abi.unbound-export.
+int tpumon_fix_unbound(int a) { return a; }
+
+// Python binds only 2 of these 3 parameters -> abi.arity-mismatch.
+int64_t tpumon_fix_drift(int64_t n, const double* vals, double scale) {
+  return n + (int64_t)scale + (vals ? 1 : 0);
+}
+
+// Python binds argtypes [c_int32] for a double -> abi.type-mismatch.
+int tpumon_fix_badtype(double x) { return (int)x; }
+
+// Python's FixStruct declares (c_double, c_double) -> abi.struct-mismatch.
+int tpumon_fix_struct(FixSample* s) { return s ? s->b : 0; }
+
+// Python binds restype only, no argtypes -> abi.missing-argtypes.
+int tpumon_fix_noargs(int a) { return a; }
+
+// Python expects 1 -> abi.version-mismatch.
+int tpumon_fix_abi_version(void) { return 2; }
+
+// Bound but never compared against a constant -> abi.version-unchecked.
+int tpumon_fix2_abi_version(void) { return 1; }
+
+}  // extern "C"
+
+extern "C" {
+// Binding assigns argtypes but no restype; ctypes' default c_int
+// would silently mangle the double -> abi.missing-restype.
+double tpumon_fix_noret(void) { return 0.5; }
+}

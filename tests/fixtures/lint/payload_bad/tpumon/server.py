@@ -1,0 +1,23 @@
+"""Known-bad fixture: the realtime payload and dashboard.js disagree."""
+
+
+class MonitorServer:
+    def __init__(self):
+        self._cached_routes: dict = {
+            "/api/accel/metrics": (("accel",), self._api_accel),
+        }
+
+    def _api_accel(self) -> dict:
+        return {"chips": [], "health": {"error": None}}
+
+    def realtime_payload(self) -> dict:
+        return {
+            # Renamed: dashboard.js still reads streamData.host.
+            "hosts": {"cpu": 1.0},
+            "accel": self._api_accel(),
+            # Nobody anywhere reads this: dead SSE weight.
+            "legacy_debug": 1,
+        }
+
+    def routes(self):
+        return ("/api/accel/metrics",)

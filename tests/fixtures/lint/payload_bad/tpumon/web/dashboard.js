@@ -1,0 +1,25 @@
+/* Known-bad fixture: reads the pre-rename key + a typo'd chip field,
+ * and polls a route the server does not register. */
+"use strict";
+let streamData = null;
+
+function applyHost(host) {
+  const pct = host.cpu;
+  return pct;
+}
+
+function renderChips(accel) {
+  const grid = accel.chps;        /* typo: server emits "chips" */
+  const err = accel.health.error; /* fine: emitted */
+  return [grid, err];
+}
+
+function renderStream() {
+  applyHost(streamData.host);     /* server renamed this key */
+  renderChips(streamData.accel);
+}
+
+function fetchAll() {
+  net.getJson("/api/accel/metrics", accel => renderChips(accel));
+  net.getJson("/api/chips", d => d.rows);  /* route never registered */
+}

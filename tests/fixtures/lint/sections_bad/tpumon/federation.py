@@ -1,4 +1,5 @@
-"""Known-bad fixture: publishes fan-in state without an epoch bump."""
+"""Known-bad fixture: publishes fan-in state without an epoch bump —
+directly, and through a helper call (the interprocedural case)."""
 
 
 class Hub:
@@ -9,3 +10,26 @@ class Hub:
     def mark_dark(self, ns):
         ns.status = "down"
         self.clock.bump("accel")  # paired: no finding here
+
+    # Interprocedural: the mutation hides in a helper; the only caller
+    # never bumps either -> the helper is flagged.
+    def apply_rollup(self, ns, rows):
+        self._store_rows(ns, rows)
+
+    def _store_rows(self, ns, rows):
+        ns.chips = rows  # published via helper, no bump on any path
+
+    # Covered helper: every caller bumps, so the helper is clean.
+    def connect(self, ns):
+        self._set_status(ns, "ok")
+        self.clock.bump("accel")
+
+    def _set_status(self, ns, status):
+        ns.status = status  # callers all bump: no finding
+
+
+class Uplink:
+    # Same bare name as Hub.connect (which bumps): the class-qualified
+    # call graph must NOT let Hub's bump mask this bump-free publish.
+    def connect(self, ns):
+        ns.connected = True  # published, no bump on any path -> finding

@@ -15,11 +15,25 @@ import re
 import pytest
 
 from tests.test_server_api import serve
+from tools.tpulint.checks import payload as payload_lint
+from tools.tpulint.core import Project
 
 HTML_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "tpumon", "web", "dashboard.html",
 )
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(HTML_PATH)))
+
+
+@pytest.fixture(scope="module")
+def js_scan():
+    """The tpulint payload scanner's view of dashboard.js — the ONE
+    source of truth for which routes the page fetches and which payload
+    key paths it reads (tools/tpulint/checks/payload.py; the same scan
+    the lint gate runs)."""
+    scan = payload_lint.scan_js(Project(ROOT))
+    assert scan is not None and scan.error is None
+    return scan
 
 
 @pytest.fixture(scope="module")
@@ -45,10 +59,11 @@ def script(html):
     return "\n".join(parts)
 
 
-def test_fetched_endpoints_are_served(script):
-    # Both getJson("/api/x") and getJson("/api/x?param=" + v); query stripped.
-    endpoints = {e.split("?")[0]
-                 for e in re.findall(r'getJson\("(/api/[^"]+)"', script)}
+def test_fetched_endpoints_are_served(js_scan):
+    """Every route the scanner sees dashboard.js fetch answers 200 on a
+    live server (the static half — route registered at all — is the
+    lint's payload.unknown-route rule)."""
+    endpoints = js_scan.routes
     assert {"/api/history", "/api/accel/metrics"} <= endpoints
     sampler, server = serve()
 
@@ -59,6 +74,37 @@ def test_fetched_endpoints_are_served(script):
             assert status == 200, ep
 
     asyncio.run(check())
+
+
+def test_realtime_schema_single_source_of_truth(js_scan):
+    """The realtime (SSE) schema contract, asserted through the SAME
+    scanner+resolver the lint gate uses (formerly ad-hoc regex checks
+    here): the payload's top level is closed, every key is read by the
+    dashboard, and every dashboard read resolves against the emitted
+    tree (zero dead reads / orphans is the tpulint gate; this pins the
+    exact top-level vocabulary so a rename is a loud diff)."""
+    resolver = payload_lint.Resolver(Project(ROOT))
+    shape = resolver.func_shape(payload_lint.SERVER, "realtime_payload")
+    assert shape.kind == "dict" and shape.closed
+    assert set(shape.keys) == {"host", "accel", "alerts", "trace", "events"}
+    # Every top-level key the server pushes is rendered by the page.
+    top_reads = {p[0] for r, p in js_scan.reads if r == payload_lint.REALTIME}
+    assert set(shape.keys) <= top_reads
+    # The event-feed subtree is closed and fully consumed.
+    events = shape.keys["events"][0]
+    assert events.closed and set(events.keys) == {"seq", "recent"}
+    assert {("seq",), ("recent",)} <= {
+        p[1:] for r, p in js_scan.reads
+        if r == payload_lint.REALTIME and p[:1] == ("events",)
+    }
+
+
+def test_per_chip_drilldown_reads_served_series(js_scan):
+    """The chip modal's per_chip reads go through the scanner too: the
+    dashboard must read /api/history per_chip (the reference collected
+    per-device history it never drew — SURVEY §2.1 gpuTemp)."""
+    hist_reads = {p for r, p in js_scan.reads if r == "/api/history"}
+    assert ("per_chip",) in hist_reads
 
 
 def test_dom_ids_exist(html, script):

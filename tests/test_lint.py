@@ -44,12 +44,79 @@ def test_tree_is_lint_clean():
 
 
 def test_sections_checker_fires_on_fixture():
-    got = _checkset(_fixture("sections_bad", only=("sections",)))
+    findings = _fixture("sections_bad", only=("sections",))
+    got = _checkset(findings)
     assert got == {
         ("sections.undeclared", "tpumon/sampler.py"),
         ("sections.never-bumped", "tpumon/snapshot.py"),
         ("sections.publish-without-bump", "tpumon/federation.py"),
     }
+    # Interprocedural: the mutation hidden in _store_rows (reached only
+    # through bump-free apply_rollup) fires and names the caller...
+    msgs = [f.message for f in findings if "publish-without-bump" in f.check]
+    assert any(
+        "Hub._store_rows" in m and "Hub.apply_rollup" in m for m in msgs
+    ), msgs
+    # ...while _set_status (every caller bumps) stays clean.
+    assert not any("_set_status" in m for m in msgs), msgs
+    # The call graph is class-qualified: Hub.connect's bump must not
+    # mask the same-named Uplink.connect's bump-free publish.
+    assert any("Uplink.connect" in m for m in msgs), msgs
+
+
+def test_abi_checker_fires_on_fixture():
+    """Every ABI drift flavor fires exactly once on the seeded
+    .cpp/binding pair — arity drift, type drift, struct-layout drift,
+    missing argtypes, unbound export, phantom symbol, and both version
+    failure modes."""
+    findings = _fixture("abi_bad", only=("abi",))
+    got = {(f.check, f.path) for f in findings}
+    assert got == {
+        ("abi.unbound-export", "tpumon/native/bad.cpp"),
+        ("abi.unknown-symbol", "tpumon/native/__init__.py"),
+        ("abi.arity-mismatch", "tpumon/native/__init__.py"),
+        ("abi.type-mismatch", "tpumon/native/__init__.py"),
+        ("abi.struct-mismatch", "tpumon/native/__init__.py"),
+        ("abi.missing-argtypes", "tpumon/native/__init__.py"),
+        ("abi.missing-restype", "tpumon/native/__init__.py"),
+        ("abi.version-mismatch", "tpumon/native/__init__.py"),
+        ("abi.version-unchecked", "tpumon/native/__init__.py"),
+    }
+    assert len(findings) == 9  # one finding per seeded drift, no noise
+    # The arity drift names both sides of the seam.
+    (arity,) = [f for f in findings if f.check == "abi.arity-mismatch"]
+    assert "tpumon_fix_drift" in arity.message
+    assert "2" in arity.message and "3" in arity.message
+
+
+def test_payload_checker_fires_on_fixture():
+    """The renamed realtime key fires from BOTH ends — the JS read of
+    the old name (dead UI) and the new name's lack of consumers (dead
+    SSE weight) — plus the typo'd chip field on both its bindings and
+    the unregistered route."""
+    findings = _fixture("payload_bad", only=("payload",))
+    got = {(f.check, f.path) for f in findings}
+    assert got == {
+        ("payload.dead-read", "tpumon/web/dashboard.js"),
+        ("payload.orphan-key", "tpumon/server.py"),
+        ("payload.unknown-route", "tpumon/web/dashboard.js"),
+    }
+    dead = sorted(
+        f.message for f in findings if f.check == "payload.dead-read"
+    )
+    assert any("'host'" in m for m in dead), dead  # renamed key, JS side
+    assert any("'chps'" in m for m in dead), dead  # typo'd chip field
+    orphans = sorted(
+        f.message for f in findings if f.check == "payload.orphan-key"
+    )
+    # Exactly the two seeded orphans: the renamed key ('hosts') AND the
+    # consumer-less key ('legacy_debug') — a regression dropping either
+    # must fail here, not hide behind the other.
+    assert len(orphans) == 2, orphans
+    assert "'hosts'" in orphans[0] and "'legacy_debug'" in orphans[1], orphans
+    assert all("B of dead weight" in m for m in orphans)  # byte cost
+    unknown = [f for f in findings if f.check == "payload.unknown-route"]
+    assert len(unknown) == 1 and "/api/chips" in unknown[0].message
 
 
 def test_threads_checker_fires_on_fixture():
@@ -154,6 +221,48 @@ def test_cli_json_output():
         "wire.no-decoder",
         "wire.untested",
     }
+
+
+def test_cli_sarif_output():
+    """--sarif: stdout is a pure SARIF 2.1.0 document (the summary line
+    moves to stderr so annotation tooling can parse the whole stream).
+    The shape is a schema contract — CI integrations key on these exact
+    fields."""
+    bad = os.path.join(FIXTURES, "abi_bad")
+    code, out, err = _cli("--root", bad, "--sarif", "abi")
+    assert code == 1
+    assert err.strip().splitlines()[-1].startswith("tpulint: FAIL:")
+    doc = json.loads(out)  # the WHOLE stdout parses
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"] == "https://json.schemastore.org/sarif-2.1.0.json"
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    assert results and rule_ids == {r["ruleId"] for r in results}
+    for r in results:
+        assert r["level"] == "error"
+        assert r["message"]["text"]
+        (loc,) = r["locations"]
+        phys = loc["physicalLocation"]
+        assert phys["artifactLocation"]["uri"].startswith(
+            ("tpumon/", "tools/", "tests/", "docs/")
+        )
+        assert phys["region"]["startLine"] >= 1
+        assert "suppressions" not in r  # nothing suppressed in abi_bad
+
+    # A suppressed finding carries SARIF suppressions with the reason.
+    ok = os.path.join(FIXTURES, "suppression_ok")
+    code, out, _ = _cli("--root", ok, "--sarif", "threads")
+    assert code == 0  # suppressed-with-reason => green
+    (run,) = json.loads(out)["runs"]
+    (res,) = run["results"]
+    (sup,) = res["suppressions"]
+    assert sup["kind"] == "inSource" and sup["justification"]
+
+    # --json and --sarif are mutually exclusive.
+    code, _, err = _cli("--json", "--sarif")
+    assert code == 2 and "mutually exclusive" in err
 
 
 def test_cli_rejects_unknown_pass():

@@ -40,7 +40,12 @@ def test_capture_produces_xplane_dump(tmp_path):
 def test_capture_clamps_seconds(tmp_path):
     svc = ProfilerService(base_dir=str(tmp_path), max_seconds=0.2)
     result = asyncio.run(svc.capture(seconds=999))
-    assert result["seconds"] < 2.0  # clamped to max_seconds, not 999
+    # The assertion proves the CLAMP (999 -> 0.2s), not the capture
+    # overhead: "seconds" is wall time including jax trace start/stop
+    # and serialization, which under full-suite load has been observed
+    # past 2s (the flake CHANGES.md carried since PR 4). Any bound well
+    # under the unclamped 999 proves clamping; 10s absorbs a loaded box.
+    assert result["seconds"] < 10.0  # clamped to max_seconds, not 999
 
 
 def test_single_capture_at_a_time(tmp_path):

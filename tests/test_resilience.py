@@ -145,7 +145,12 @@ def test_hung_collector_does_not_stall_tick_fast_or_other_sources():
         return time.monotonic() - t0
 
     elapsed = asyncio.run(run())
-    assert elapsed < 1.0  # deadline 0.1 s + slack, not 60 s
+    # Deadline 0.1s + slack, NOT the 60s hang — that is the claim. The
+    # old 1.0s bound flaked under full-suite load (CHANGES.md, PR 7):
+    # the event loop itself gets starved, which is scheduler pressure,
+    # not a deadline failure. 5s still refutes the hang by an order of
+    # magnitude while absorbing a loaded box.
+    assert elapsed < 5.0  # deadline 0.1 s + slack, not 60 s
     assert not sampler.latest["host"].ok
     assert sampler.latest["host"].error.startswith(DEADLINE_ERROR)
     assert sampler.latest["accel"].ok and fast.calls == 1

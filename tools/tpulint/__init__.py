@@ -8,6 +8,7 @@ from tools.tpulint.core import (
     Finding,
     Project,
     render_report,
+    render_sarif,
     run,
     summary_line,
 )
@@ -18,6 +19,7 @@ __all__ = [
     "Project",
     "lint_tree",
     "render_report",
+    "render_sarif",
     "run",
     "summary_line",
 ]

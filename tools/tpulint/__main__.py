@@ -2,12 +2,15 @@
 
 Usage::
 
-    python -m tools.tpulint [--json] [--root DIR] [--list] [PASS ...]
+    python -m tools.tpulint [--json|--sarif] [--root DIR] [--list] [PASS ...]
 
 Exit status: 0 when every finding is suppressed (with a reason — a
 reasonless suppression is itself an unsuppressable finding), 1 on any
 live finding, 2 on usage errors. The last line printed is always the
-stable one-line summary (``tpulint: OK|FAIL: ...``) for CI logs.
+stable one-line summary (``tpulint: OK|FAIL: ...``) for CI logs —
+except under ``--sarif``, where stdout is a pure SARIF 2.1.0 document
+(annotation tooling parses the whole stream) and the summary line goes
+to stderr instead.
 """
 
 from __future__ import annotations
@@ -16,6 +19,7 @@ import os
 import sys
 
 from tools.tpulint import CHECKS, lint_tree, render_report
+from tools.tpulint.core import render_sarif, summary_line
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -25,12 +29,15 @@ _REPO_ROOT = os.path.dirname(
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     as_json = False
+    as_sarif = False
     root = _REPO_ROOT
     only: list[str] = []
     it = iter(argv)
     for arg in it:
         if arg == "--json":
             as_json = True
+        elif arg == "--sarif":
+            as_sarif = True
         elif arg == "--root":
             root = next(it, None)
             if root is None:
@@ -58,7 +65,14 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isdir(root):
         print(f"not a directory: {root}", file=sys.stderr)
         return 2
+    if as_json and as_sarif:
+        print("--json and --sarif are mutually exclusive", file=sys.stderr)
+        return 2
     findings = lint_tree(root, only=tuple(only))
+    if as_sarif:
+        print(render_sarif(findings))
+        print(summary_line(findings, len(only or CHECKS)), file=sys.stderr)
+        return 1 if any(not f.suppressed for f in findings) else 0
     report, code = render_report(
         findings, npasses=len(only or CHECKS), as_json=as_json
     )

@@ -10,11 +10,13 @@ Adding a checker (docs/static-analysis.md has the full recipe):
    fires — a checker that silently stops firing fails CI.
 """
 
-from tools.tpulint.checks import registry, sections, threads, wire
+from tools.tpulint.checks import abi, payload, registry, sections, threads, wire
 
 CHECKS = {
     "sections": sections.check,
     "threads": threads.check,
     "wire": wire.check,
     "registry": registry.check,
+    "abi": abi.check,
+    "payload": payload.check,
 }

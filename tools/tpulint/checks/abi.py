@@ -1,0 +1,598 @@
+"""Pass 5 — ctypes ↔ C ABI coherence (the silent-corruption seam).
+
+The native layer is a pure C ABI crossed via ctypes (no pybind11 in
+this environment), which means NOTHING checks the two sides against
+each other at build time: a drifted ``argtypes`` list marshals garbage
+into ``libtpumon_tsdb.so`` and the TSDB happily stores the corrupted
+bytes — no exception, no crash, exactly the ``_zigzag64`` failure
+class PR 8 caught in pure Python but across a language boundary. This
+pass is the missing compiler: a lightweight parser for the
+``extern "C"`` declarations in ``tpumon/native/*.cpp`` cross-checked
+against every ``lib.<sym>.argtypes``/``.restype`` assignment in
+``tpumon/native/__init__.py``.
+
+Rules:
+
+- ``abi.unbound-export``: every non-static function exported from an
+  ``extern "C"`` block must have a Python binding (an ``argtypes`` or
+  ``restype`` assignment) — an unbound export is dead weight at best
+  and a forgotten fast path at worst.
+- ``abi.unknown-symbol``: every Python binding must name a symbol some
+  .cpp actually exports (a renamed C function leaves the old binding
+  raising AttributeError at load time — or worse, binding a stale .so).
+- ``abi.missing-argtypes``: a bound symbol whose C declaration takes
+  parameters must assign ``argtypes`` — without it ctypes guesses, and
+  a float passed as an implicit int is silent corruption.
+- ``abi.missing-restype``: a bound symbol whose C return type is not
+  int-compatible must assign ``restype`` — ctypes defaults to c_int,
+  silently mangling doubles/pointers/int64s on the way out.
+- ``abi.arity-mismatch``: ``len(argtypes)`` must equal the C parameter
+  count (``(void)`` counts as zero).
+- ``abi.type-mismatch``: each argtype and the restype must be
+  ctypes-compatible with the C type at that position
+  (c_double↔double, c_int64↔int64_t, pointer kinds, etc.).
+- ``abi.struct-mismatch``: a ``POINTER(SomeStructure)`` parameter is
+  checked field-by-field against the C struct of the matching
+  parameter type — count and per-field type compatibility.
+- ``abi.version-mismatch`` / ``abi.version-unchecked``: each
+  ``*_abi_version`` export's literal return value must equal the
+  Python-side expected constant it is compared against, and every
+  version export must actually be compared somewhere — the version
+  gate is the ONLY runtime defense the .so loader has.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.tpulint.core import Finding, Project
+
+NATIVE_DIR = "tpumon/native"
+BINDINGS = "tpumon/native/__init__.py"
+
+# ctypes name -> C type spellings it is ABI-compatible with (canonical
+# form: const stripped, whitespace collapsed, pointer star attached).
+_SCALAR_COMPAT = {
+    "c_double": {"double"},
+    "c_float": {"float"},
+    "c_int": {"int", "int32_t"},
+    "c_uint": {"unsigned int", "uint32_t"},
+    "c_int8": {"int8_t", "signed char"},
+    "c_uint8": {"uint8_t", "unsigned char"},
+    "c_int16": {"int16_t", "short"},
+    "c_uint16": {"uint16_t", "unsigned short"},
+    "c_int32": {"int32_t", "int"},
+    "c_uint32": {"uint32_t", "unsigned int"},
+    "c_int64": {"int64_t", "long long", "long"},
+    "c_uint64": {"uint64_t", "unsigned long long", "unsigned long"},
+    "c_size_t": {"size_t"},
+    "c_bool": {"bool"},
+    "c_char": {"char"},
+    "c_char_p": {"char*", "uint8_t*", "unsigned char*", "signed char*"},
+    "c_void_p": {"void*"},
+}
+
+
+# --------------------------- C-side parsing ---------------------------
+
+
+class CFunc:
+    __slots__ = ("name", "ret", "params", "line", "path", "ret_literal")
+
+    def __init__(self, name, ret, params, line, path, ret_literal=None):
+        self.name = name
+        self.ret = ret
+        self.params = params  # list of canonical C type strings
+        self.line = line
+        self.path = path
+        self.ret_literal = ret_literal  # int literal for `return N;` bodies
+
+
+def _strip_c_comments(text: str) -> str:
+    """Remove // and /* */ comments and string literals, preserving
+    newlines so match offsets still map to line numbers."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            j = text.find("\n", i)
+            i = n if j < 0 else j
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            seg = text[i : (n if j < 0 else j + 2)]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        elif c == '"':
+            j = i + 1
+            while j < n and text[j] != '"':
+                j += 2 if text[j] == "\\" else 1
+            out.append('""')
+            i = min(n, j + 1)
+        elif c == "'":
+            # Char literals too: '"' or '{' would otherwise corrupt the
+            # string/brace scan for everything after them.
+            j = i + 1
+            while j < n and text[j] != "'":
+                j += 2 if text[j] == "\\" else 1
+            out.append("''")
+            i = min(n, j + 1)
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _canon_ctype(raw: str) -> str:
+    """Canonicalize a C type: drop const/struct/volatile, collapse
+    whitespace, attach '*' without spaces ("const double *" -> "double*")."""
+    toks = [
+        t
+        for t in re.split(r"(\*)|\s+", raw)
+        if t and t not in ("const", "volatile", "struct")
+    ]
+    out = ""
+    for t in toks:
+        if t == "*":
+            out += "*"
+        else:
+            out = (out + " " + t).strip()
+    return out
+
+
+_FUNC_RE = re.compile(
+    r"^[ \t]*((?:[A-Za-z_][A-Za-z0-9_]*[ \t\n*]+)+?)"  # return type
+    r"([A-Za-z_][A-Za-z0-9_]*)"  # name
+    r"[ \t]*\(([^)]*)\)[ \t\n]*\{",  # params + opening brace
+    re.M,
+)
+_STRUCT_RE = re.compile(
+    r"^[ \t]*(?:typedef[ \t]+)?struct[ \t]+([A-Za-z_][A-Za-z0-9_]*)"
+    r"[ \t\n]*\{([^}]*)\}",
+    re.M,
+)
+_RET_LIT_RE = re.compile(r"return[ \t]+(-?\d+)[ \t]*;")
+_KEYWORDS = {"if", "while", "for", "switch", "return", "else", "do", "sizeof"}
+# Words that are C types, never parameter names: an unnamed parameter
+# like "unsigned int" must not have its last word stripped as a name.
+_C_TYPE_WORDS = {
+    "int", "char", "long", "short", "double", "float", "void", "bool",
+    "signed", "unsigned",
+}
+
+
+def _is_type_word(word: str) -> bool:
+    return word in _C_TYPE_WORDS or word.endswith("_t")
+
+
+def _parse_cpp(path: str, text: str):
+    """(exported functions, structs) declared in extern "C" regions.
+
+    The grammar here is deliberately tiny — flat ``ret name(params) {``
+    definitions and ``struct X { fields };`` — which is exactly what a
+    pure C ABI surface looks like; anything fancier (templates,
+    overloads, default args) can't cross ctypes anyway.
+    """
+    clean = _strip_c_comments(text)
+    # Only declarations inside extern "C" survive C++ name mangling.
+    regions: list[tuple[int, int]] = []
+    # NB: string literals are already blanked to "" by the comment
+    # stripper, so the marker to find is `extern "" {`.
+    for m in re.finditer(r'extern\s+""\s*\{', clean):
+        depth, i = 1, m.end()
+        while i < len(clean) and depth:
+            if clean[i] == "{":
+                depth += 1
+            elif clean[i] == "}":
+                depth -= 1
+            i += 1
+        regions.append((m.end(), i))
+
+    def exported(pos: int) -> bool:
+        return any(a <= pos < b for a, b in regions)
+
+    funcs: list[CFunc] = []
+    for m in _FUNC_RE.finditer(clean):
+        ret_raw, name, args = m.group(1), m.group(2), m.group(3)
+        if not exported(m.start()):
+            continue
+        head = ret_raw.split()
+        if "static" in head or "inline" in head or name in _KEYWORDS:
+            continue
+        if head and head[0] in _KEYWORDS:
+            continue
+        params: list[str] = []
+        args = args.strip()
+        if args and args != "void":
+            for piece in args.split(","):
+                piece = piece.strip()
+                # Drop the trailing parameter name: "double* ts_q" ->
+                # "double*". A trailing TYPE word stays ("unsigned int",
+                # "const double*" unnamed) — stripping it would turn the
+                # type into garbage and mislint a correct binding.
+                pm = re.match(
+                    r"^(.*?)[ \t\n*]([A-Za-z_][A-Za-z0-9_]*)$", piece, re.S
+                )
+                if (
+                    pm
+                    and pm.group(1).strip()
+                    and not _is_type_word(pm.group(2))
+                ):
+                    type_part = piece[: len(piece) - len(pm.group(2))]
+                else:
+                    type_part = piece
+                params.append(_canon_ctype(type_part))
+        line = clean[: m.start()].count("\n") + 1
+        # `return N;` literal for version functions (brace-balanced body
+        # scan is overkill: version functions are one-liners, grab the
+        # first return literal after the signature).
+        ret_literal = None
+        tail = clean[m.end() : m.end() + 200]
+        rl = _RET_LIT_RE.search(tail)
+        if rl is not None and name.endswith("_abi_version"):
+            ret_literal = int(rl.group(1))
+        funcs.append(
+            CFunc(name, _canon_ctype(ret_raw), params, line, path, ret_literal)
+        )
+
+    structs: dict[str, list[str]] = {}
+    for m in _STRUCT_RE.finditer(clean):
+        if not exported(m.start()):
+            continue
+        fields = []
+        for decl in m.group(2).split(";"):
+            decl = decl.strip()
+            if not decl:
+                continue
+            pm = re.match(r"^(.*?)([A-Za-z_][A-Za-z0-9_]*)(\[[^\]]*\])?$", decl, re.S)
+            if pm:
+                fields.append(_canon_ctype(pm.group(1)))
+        structs[m.group(1)] = fields
+    return funcs, structs
+
+
+# ------------------------- Python-side parsing -------------------------
+
+
+def _ctype_name(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonical ctypes spelling of an expression: "c_double",
+    "POINTER(c_int64)", "POINTER(struct:HostSampleStruct)"."""
+    if isinstance(node, ast.Attribute):  # ctypes.c_double
+        return node.attr
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname == "POINTER" and node.args:
+            inner = _ctype_name(node.args[0], aliases)
+            return f"POINTER({inner})" if inner else None
+    return None
+
+
+class PyBinding:
+    __slots__ = ("sym", "argtypes", "restype", "arg_line", "res_line")
+
+    def __init__(self, sym: str):
+        self.sym = sym
+        self.argtypes: list[str] | None = None
+        self.restype: str | None = None
+        self.arg_line = 0
+        self.res_line = 0
+
+
+def _parse_bindings(tree: ast.AST):
+    """(bindings by symbol, struct classes, module int constants,
+    version-check sites [(symbol, expected-expr, line)])."""
+    aliases: dict[str, str] = {}
+    constants: dict[str, int] = {}
+    structs: dict[str, list[str]] = {}
+    bindings: dict[str, PyBinding] = {}
+    checks: list[tuple[str, ast.AST, int]] = []
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                # _PD = ctypes.POINTER(ctypes.c_double) alias, or an
+                # int constant (ABI_VERSION = 1).
+                ct = _ctype_name(node.value, aliases)
+                if ct is not None and ct.startswith("POINTER("):
+                    aliases[t.id] = ct
+                elif isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, int
+                ):
+                    constants[t.id] = node.value.value
+            elif (
+                isinstance(t, ast.Attribute)
+                and t.attr in ("argtypes", "restype")
+                and isinstance(t.value, ast.Attribute)
+            ):
+                sym = t.value.attr
+                b = bindings.setdefault(sym, PyBinding(sym))
+                if t.attr == "argtypes":
+                    b.arg_line = node.lineno
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        b.argtypes = [
+                            _ctype_name(e, aliases) or "?" for e in node.value.elts
+                        ]
+                else:
+                    b.res_line = node.lineno
+                    b.restype = _ctype_name(node.value, aliases) or "?"
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                bn = base.attr if isinstance(base, ast.Attribute) else (
+                    base.id if isinstance(base, ast.Name) else None
+                )
+                if bn == "Structure":
+                    for stmt in node.body:
+                        if (
+                            isinstance(stmt, ast.Assign)
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and stmt.targets[0].id == "_fields_"
+                            and isinstance(stmt.value, (ast.List, ast.Tuple))
+                        ):
+                            fields = []
+                            for e in stmt.value.elts:
+                                if (
+                                    isinstance(e, (ast.Tuple, ast.List))
+                                    and len(e.elts) == 2
+                                ):
+                                    fields.append(
+                                        _ctype_name(e.elts[1], aliases) or "?"
+                                    )
+                            structs[node.name] = fields
+        # Version gates: lib.<sym>() != EXPECTED — the call may sit on
+        # either side of the comparison.
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            for call_side, other in (
+                (node.left, node.comparators[0]),
+                (node.comparators[0], node.left),
+            ):
+                if (
+                    isinstance(call_side, ast.Call)
+                    and not call_side.args
+                    and isinstance(call_side.func, ast.Attribute)
+                    and call_side.func.attr.endswith("_abi_version")
+                ):
+                    checks.append(
+                        (call_side.func.attr, other, node.lineno)
+                    )
+                    break
+    return bindings, structs, constants, checks
+
+
+# ------------------------------ the check ------------------------------
+
+
+def _compatible(
+    py: str, c: str, py_structs: dict[str, list[str]], c_structs: dict[str, list[str]]
+) -> tuple[bool, str | None]:
+    """Is ctypes spelling ``py`` ABI-compatible with C type ``c``?
+    Returns (ok, struct-detail) — struct-detail carries a field-level
+    message when a struct pointer matched by name but not by layout."""
+    if py == "?" or c == "...":
+        return True, None  # unresolvable: don't guess
+    if py == "c_void_p":
+        return c.endswith("*"), None
+    if py.startswith("POINTER(") and py.endswith(")"):
+        inner = py[len("POINTER(") : -1]
+        if not c.endswith("*"):
+            return False, None
+        target = c[:-1]
+        if inner in _SCALAR_COMPAT:
+            return target in _SCALAR_COMPAT[inner], None
+        # Pointer to a ctypes.Structure: match against the C struct.
+        if inner in py_structs:
+            cf = c_structs.get(target)
+            if cf is None:
+                return True, None  # struct not declared in scanned .cpp
+            pf = py_structs[inner]
+            if len(pf) != len(cf):
+                return False, (
+                    f"struct {inner} has {len(pf)} fields, C struct "
+                    f"{target} has {len(cf)}"
+                )
+            for i, (a, b) in enumerate(zip(pf, cf)):
+                ok, _ = _compatible(a, b, py_structs, c_structs)
+                if not ok:
+                    return False, (
+                        f"struct field {i} ({inner}): {a} vs C {b!r}"
+                    )
+            return True, None
+        return True, None  # unknown pointee: not our drift to call
+    if py in _SCALAR_COMPAT:
+        return c in _SCALAR_COMPAT[py], None
+    return True, None  # unknown ctypes spelling: stay quiet
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    sf = project.file(BINDINGS)
+    cpp_files = [
+        (rel, project.file(rel))
+        for rel in project.files_matching(NATIVE_DIR, ".cpp")
+    ]
+    if sf is None and not cpp_files:
+        return []  # tree without a native layer: pass doesn't apply
+    if sf is None or sf.tree is None:
+        return [
+            Finding(
+                check="abi.unparsable",
+                path=BINDINGS,
+                line=1,
+                message=f"{BINDINGS} missing or unparsable but .cpp files exist",
+            )
+        ]
+
+    c_funcs: dict[str, CFunc] = {}
+    c_structs: dict[str, list[str]] = {}
+    for rel, f in cpp_files:
+        if f is None:
+            continue
+        funcs, structs = _parse_cpp(rel, f.text)
+        for fn in funcs:
+            c_funcs[fn.name] = fn
+        c_structs.update(structs)
+
+    bindings, py_structs, constants, checks = _parse_bindings(sf.tree)
+
+    # Every export bound; every binding a real export.
+    for name, fn in sorted(c_funcs.items()):
+        if name not in bindings:
+            findings.append(
+                Finding(
+                    check="abi.unbound-export",
+                    path=fn.path,
+                    line=fn.line,
+                    message=(
+                        f"extern \"C\" export {name}() has no argtypes/restype "
+                        f"binding in {BINDINGS} — dead export or forgotten "
+                        f"(unchecked) call path"
+                    ),
+                )
+            )
+    for sym, b in sorted(bindings.items()):
+        line = b.arg_line or b.res_line
+        if sym not in c_funcs:
+            findings.append(
+                Finding(
+                    check="abi.unknown-symbol",
+                    path=BINDINGS,
+                    line=line,
+                    message=(
+                        f"binding for {sym!r} matches no extern \"C\" export "
+                        f"in {NATIVE_DIR}/*.cpp — renamed or removed C symbol"
+                    ),
+                )
+            )
+            continue
+        fn = c_funcs[sym]
+        if b.argtypes is None:
+            if fn.params:
+                findings.append(
+                    Finding(
+                        check="abi.missing-argtypes",
+                        path=BINDINGS,
+                        line=line,
+                        message=(
+                            f"{sym} takes {len(fn.params)} parameter(s) in "
+                            f"{fn.path} but the binding never assigns "
+                            f"argtypes — ctypes will marshal by guess"
+                        ),
+                    )
+                )
+        elif len(b.argtypes) != len(fn.params):
+            findings.append(
+                Finding(
+                    check="abi.arity-mismatch",
+                    path=BINDINGS,
+                    line=b.arg_line,
+                    message=(
+                        f"{sym}.argtypes has {len(b.argtypes)} entr(ies) but "
+                        f"the C declaration in {fn.path}:{fn.line} takes "
+                        f"{len(fn.params)} — every call silently corrupts "
+                        f"the stack marshalling"
+                    ),
+                )
+            )
+        else:
+            for i, (py, c) in enumerate(zip(b.argtypes, fn.params)):
+                ok, detail = _compatible(py, c, py_structs, c_structs)
+                if not ok:
+                    findings.append(
+                        Finding(
+                            check=(
+                                "abi.struct-mismatch"
+                                if detail
+                                else "abi.type-mismatch"
+                            ),
+                            path=BINDINGS,
+                            line=b.arg_line,
+                            message=(
+                                f"{sym} argument {i}: ctypes {py} is not "
+                                f"ABI-compatible with C {c!r} "
+                                f"({fn.path}:{fn.line})"
+                                + (f" — {detail}" if detail else "")
+                            ),
+                        )
+                    )
+        if b.restype is not None and fn.ret != "void":
+            ok, detail = _compatible(b.restype, fn.ret, py_structs, c_structs)
+            if not ok:
+                findings.append(
+                    Finding(
+                        check="abi.type-mismatch",
+                        path=BINDINGS,
+                        line=b.res_line,
+                        message=(
+                            f"{sym}.restype {b.restype} is not ABI-compatible "
+                            f"with C return type {fn.ret!r} ({fn.path}:{fn.line})"
+                        ),
+                    )
+                )
+        elif b.restype is None and fn.ret != "void":
+            # ctypes defaults restype to c_int: fine for int-returning
+            # functions, silent truncation/reinterpretation otherwise
+            # (the return-side twin of missing-argtypes).
+            ok, _ = _compatible("c_int", fn.ret, py_structs, c_structs)
+            if not ok:
+                findings.append(
+                    Finding(
+                        check="abi.missing-restype",
+                        path=BINDINGS,
+                        line=line,
+                        message=(
+                            f"{sym} returns {fn.ret!r} in {fn.path}:{fn.line} "
+                            f"but the binding never assigns restype — ctypes "
+                            f"defaults to c_int and silently mangles the value"
+                        ),
+                    )
+                )
+
+    # ABI version gates: the C literal must equal the Python-side
+    # expected value, and every version export must be compared.
+    checked_syms = set()
+    for sym, expected, line in checks:
+        checked_syms.add(sym)
+        fn = c_funcs.get(sym)
+        if fn is None or fn.ret_literal is None:
+            continue
+        value = None
+        if isinstance(expected, ast.Constant) and isinstance(expected.value, int):
+            value = expected.value
+        elif isinstance(expected, ast.Name):
+            value = constants.get(expected.id)
+        if value is not None and value != fn.ret_literal:
+            findings.append(
+                Finding(
+                    check="abi.version-mismatch",
+                    path=BINDINGS,
+                    line=line,
+                    message=(
+                        f"Python expects {sym}() == {value} but "
+                        f"{fn.path}:{fn.line} returns {fn.ret_literal} — "
+                        f"the loader would refuse a freshly built .so "
+                        f"(or accept a stale one)"
+                    ),
+                )
+            )
+    for name, fn in sorted(c_funcs.items()):
+        if name.endswith("_abi_version") and name not in checked_syms:
+            if name in bindings:  # bound but never compared
+                findings.append(
+                    Finding(
+                        check="abi.version-unchecked",
+                        path=BINDINGS,
+                        line=bindings[name].res_line or 1,
+                        message=(
+                            f"{name}() is bound but its value is never "
+                            f"compared against an expected constant — the "
+                            f"ABI gate is decorative"
+                        ),
+                    )
+                )
+    return findings

@@ -1,0 +1,800 @@
+"""Pass 6 — server payload ↔ dashboard coherence (the dead-card seam).
+
+The server and the dashboard agree on a JSON vocabulary that nothing
+type-checks: the SSE realtime frame and every ``/api/*`` body are built
+in Python, and ``tpumon/web/dashboard.js`` reads them by key. A renamed
+server key is a dashboard card that silently renders "–" forever (dead
+UI); a key the dashboard (and the CLI, and the tests) never read is
+bytes serialized into EVERY delta frame for nobody (dead SSE weight on
+the hot path PR 2/PR 6 optimized). This pass closes the seam from both
+ends:
+
+- Server side: an AST *shape* resolver follows the payload builders —
+  ``realtime_payload``, each ``_cached_routes`` builder, the special
+  routes — through helper calls (``self.sampler.host_data()``,
+  ``journal.recent()``, ``tracer.to_json()``) and local build-up
+  patterns (``out = {...}; out["k"] = v; return out``), producing a key
+  tree in which every dict is *closed* (all keys known), *open*
+  (literal keys + a dynamic splat/comprehension) or *opaque*.
+- JS side: ``tpumon/web/dashboard.js`` is parsed with the in-repo
+  jsmini parser (tests/jsmini.py — the same dialect CI executes) and
+  key-path reads are traced from two kinds of roots: ``net.getJson``
+  callbacks (bound to their route's body) and the module variable
+  named in ``REALTIME_JS_ROOT`` (``streamData`` — the SSE keyframe
+  payload; a fixture tree must use the same name). Bindings propagate
+  through one-file function calls, closure assignments, ``for..of``
+  and array-method arrows.
+
+Rules:
+
+- ``payload.dead-read``: a JS read whose parent resolved to a *closed*
+  dict that does not emit the key — dead UI. (Open/opaque parents are
+  never flagged: no guessing.)
+- ``payload.orphan-key``: a key emitted into the realtime payload with
+  no consumer — no JS read reaches it, its name appears nowhere in
+  dashboard.js/dashboard.html, ``tpumon/cli.py`` or ``tests/`` —
+  reported with the per-frame byte cost of carrying it.
+- ``payload.unknown-route``: dashboard.js fetches a route the server
+  does not register (routes() + _cached_routes) — the fetch 404s on
+  every poll.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.tpulint.core import Finding, Project
+
+SERVER = "tpumon/server.py"
+DASHBOARD_JS = "tpumon/web/dashboard.js"
+DASHBOARD_HTML = "tpumon/web/dashboard.html"
+CLI = "tpumon/cli.py"
+
+# The module-level JS variable holding the SSE keyframe payload
+# (dashboard.js ``streamData = d.key``). A named contract, like the
+# sections pass's PUBLISH_ATTRS: the checker can't derive "which JS
+# variable is the realtime root" without executing the stream protocol.
+REALTIME_JS_ROOT = "streamData"
+REALTIME = "realtime"
+
+# Attribute receivers the resolver follows into other modules:
+# ``self.sampler.host_data()`` resolves to ``def host_data`` in
+# sampler.py. Unknown receivers resolve to opaque (never guessed).
+RECEIVER_MODULES = {
+    "sampler": "tpumon/sampler.py",
+    "history": "tpumon/history.py",
+    "journal": "tpumon/events.py",
+    "engine": "tpumon/alerts.py",
+    "tracer": "tpumon/tracing.py",
+    "profiler": "tpumon/profiler.py",
+    "_profiler": "tpumon/profiler.py",
+    "uplink": "tpumon/federation.py",
+    "federation": "tpumon/federation.py",
+    "hub": "tpumon/federation.py",
+    "clock": "tpumon/snapshot.py",
+    "cache": "tpumon/snapshot.py",
+    "exporter_cache": "tpumon/snapshot.py",
+    "snapshotter": "tpumon/history.py",
+    "notifier": "tpumon/notify.py",
+    "anomaly": "tpumon/anomaly.py",
+}
+
+# Routes whose payloads are not built by a _cached_routes builder.
+# None = deliberately unresolved (opaque): request-shaped or streaming.
+ROUTE_SPECIAL = {
+    "/api/history": ("tpumon/history.py", "snapshot_ring"),
+    "/api/health": (SERVER, "_api_health"),
+    "/api/events": None,
+    "/api/profile": None,
+    "/api/trace/export": None,
+    "/api/stream": None,
+    "/metrics": None,
+}
+
+_MAX_DEPTH = 8
+
+
+# ----------------------------- shape model -----------------------------
+
+
+class Shape:
+    """A resolved JSON subtree: DICT (keys -> (child, file, line),
+    ``closed`` when every possible key is known), LIST (elem) or
+    OPAQUE (unresolvable — reads under it are never flagged)."""
+
+    __slots__ = ("kind", "keys", "closed", "elem")
+
+    def __init__(self, kind, keys=None, closed=False, elem=None):
+        self.kind = kind  # "dict" | "list" | "opaque"
+        self.keys = keys if keys is not None else {}
+        self.closed = closed
+        self.elem = elem
+
+    @classmethod
+    def opaque(cls):
+        return cls("opaque")
+
+    @classmethod
+    def dict_(cls, closed=True):
+        return cls("dict", {}, closed)
+
+
+def merge(a: Shape, b: Shape) -> Shape:
+    if a.kind == "opaque" and b.kind == "opaque":
+        return Shape.opaque()
+    if a.kind == "dict" or b.kind == "dict":
+        out = Shape.dict_(closed=True)
+        for s in (a, b):
+            if s.kind == "dict":
+                for k, v in s.keys.items():
+                    if k in out.keys:
+                        out.keys[k] = (merge(out.keys[k][0], v[0]), *v[1:])
+                    else:
+                        out.keys[k] = v
+                out.closed = out.closed and s.closed
+            else:
+                out.closed = False  # opaque/list half may carry anything
+        return out
+    if a.kind == "list" and b.kind == "list":
+        return Shape("list", elem=merge(a.elem or Shape.opaque(), b.elem or Shape.opaque()))
+    return a if a.kind == "list" else b
+
+
+# --------------------------- server resolver ---------------------------
+
+
+class Resolver:
+    """Resolves payload-builder functions to Shapes, repo-wide."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._memo: dict[tuple[str, str], Shape] = {}
+        self._imports: dict[str, dict[str, str]] = {}
+
+    # -- module helpers --
+
+    def _tree(self, rel: str) -> ast.AST | None:
+        sf = self.project.file(rel)
+        return sf.tree if sf is not None else None
+
+    def _import_map(self, rel: str) -> dict[str, str]:
+        if rel in self._imports:
+            return self._imports[rel]
+        out: dict[str, str] = {}
+        tree = self._tree(rel)
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    mod = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        out[alias.asname or alias.name] = mod
+        self._imports[rel] = out
+        return out
+
+    def _find_def(self, rel: str, name: str) -> ast.AST | None:
+        tree = self._tree(rel)
+        if tree is None:
+            return None
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name
+            ):
+                return node
+        return None
+
+    # -- shape resolution --
+
+    def func_shape(self, rel: str, name: str, depth: int = 0) -> Shape:
+        key = (rel, name)
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = Shape.opaque()  # cycle guard
+        fn = self._find_def(rel, name)
+        if fn is None or depth > _MAX_DEPTH:
+            return Shape.opaque()
+        shape = self._body_shape(fn, rel, depth)
+        self._memo[key] = shape
+        return shape
+
+    def _body_shape(self, fn, rel: str, depth: int) -> Shape:
+        env: dict[str, Shape] = {}
+        returns: list[Shape] = []
+
+        def own(shape: Shape) -> Shape:
+            """Private top-level copy for an env binding: the `out =
+            self.helper(); out["k"] = v` pattern mutates the bound
+            shape in place, and expr_shape may hand back a MEMOIZED
+            function shape — mutating that would pollute the helper's
+            shape for every other route that calls it."""
+            if shape.kind != "dict":
+                return shape
+            return Shape("dict", dict(shape.keys), shape.closed)
+
+        def handle(stmt) -> None:
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                returns.append(self.expr_shape(stmt.value, rel, env, depth))
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                if isinstance(t, ast.Name):
+                    env[t.id] = own(
+                        self.expr_shape(stmt.value, rel, env, depth)
+                    )
+                elif isinstance(t, ast.Subscript) and isinstance(
+                    t.value, ast.Name
+                ):
+                    sh = env.get(t.value.id)
+                    if sh is not None and sh.kind == "dict":
+                        k = t.slice
+                        if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str
+                        ):
+                            sh.keys[k.value] = (
+                                self.expr_shape(stmt.value, rel, env, depth),
+                                rel,
+                                stmt.lineno,
+                            )
+                        else:
+                            sh.closed = False  # dynamic key
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    env[stmt.target.id] = own(
+                        self.expr_shape(stmt.value, rel, env, depth)
+                    )
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                call = stmt.value
+                f = call.func
+                # out.update(x) / out passed to a helper: unknown keys.
+                if isinstance(f, ast.Attribute) and isinstance(
+                    f.value, ast.Name
+                ):
+                    sh = env.get(f.value.id)
+                    if sh is not None and sh.kind == "dict":
+                        sh.closed = False
+                for a in call.args:
+                    if isinstance(a, ast.Name) and a.id in env:
+                        if env[a.id].kind == "dict":
+                            env[a.id].closed = False
+            # recurse into compound statements
+            for attr in ("body", "orelse", "finalbody"):
+                for sub in getattr(stmt, attr, []) or []:
+                    handle(sub)
+            for h in getattr(stmt, "handlers", []) or []:
+                for sub in h.body:
+                    handle(sub)
+
+        for stmt in fn.body:
+            handle(stmt)
+        if not returns:
+            return Shape.opaque()
+        out = returns[0]
+        for r in returns[1:]:
+            out = merge(out, r)
+        return out
+
+    def expr_shape(self, node, rel: str, env: dict, depth: int) -> Shape:
+        if depth > _MAX_DEPTH:
+            return Shape.opaque()
+        if isinstance(node, ast.Dict):
+            out = Shape.dict_(closed=True)
+            for k, v in zip(node.keys, node.values):
+                if k is None:  # **splat
+                    sub = self.expr_shape(v, rel, env, depth + 1)
+                    out = merge(out, sub)
+                elif isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.keys[k.value] = (
+                        self.expr_shape(v, rel, env, depth + 1),
+                        rel,
+                        k.lineno,
+                    )
+                else:
+                    out.closed = False
+            return out
+        if isinstance(node, (ast.DictComp,)):
+            return Shape.dict_(closed=False)
+        if isinstance(node, ast.List):
+            elem = Shape.opaque()
+            for e in node.elts:
+                elem = merge(elem, self.expr_shape(e, rel, env, depth + 1))
+            return Shape("list", elem=elem)
+        if isinstance(node, ast.ListComp):
+            return Shape(
+                "list", elem=self.expr_shape(node.elt, rel, env, depth + 1)
+            )
+        if isinstance(node, ast.IfExp):
+            return merge(
+                self.expr_shape(node.body, rel, env, depth + 1),
+                self.expr_shape(node.orelse, rel, env, depth + 1),
+            )
+        if isinstance(node, ast.BoolOp):
+            out = self.expr_shape(node.values[0], rel, env, depth + 1)
+            for v in node.values[1:]:
+                out = merge(out, self.expr_shape(v, rel, env, depth + 1))
+            return out
+        if isinstance(node, ast.Name):
+            return env.get(node.id, Shape.opaque())
+        if isinstance(node, ast.Call):
+            return self._call_shape(node, rel, env, depth)
+        return Shape.opaque()
+
+    def _call_shape(self, node: ast.Call, rel: str, env: dict, depth: int) -> Shape:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id == "dict":
+                return Shape.dict_(closed=False)
+            target = self._import_map(rel).get(f.id, rel)
+            return self.func_shape(target, f.id, depth + 1)
+        if isinstance(f, ast.Attribute):
+            meth = f.attr
+            recv = f.value
+            # self.helper() -> same file; self.a.b.helper() / a.helper()
+            # -> the module mapped for the innermost named receiver.
+            parts: list[str] = []
+            while isinstance(recv, ast.Attribute):
+                parts.append(recv.attr)
+                recv = recv.value
+            if isinstance(recv, ast.Name):
+                parts.append(recv.id)
+            recv_name = parts[0] if parts else None
+            if recv_name == "self" and len(parts) == 1:
+                return self.func_shape(rel, meth, depth + 1)
+            if recv_name in RECEIVER_MODULES:
+                return self.func_shape(RECEIVER_MODULES[recv_name], meth, depth + 1)
+        return Shape.opaque()
+
+
+def _route_builders(project: Project, resolver: Resolver):
+    """route -> Shape for every resolvable GET route, plus the set of
+    all registered route literals (for unknown-route)."""
+    shapes: dict[str, Shape] = {}
+    registered: set[str] = set()
+    sf = project.file(SERVER)
+    if sf is None or sf.tree is None:
+        return shapes, registered
+    env: dict = {}
+    for node in ast.walk(sf.tree):
+        tgt = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            tgt, val = node.target, node.value
+        else:
+            continue
+        if (
+            isinstance(tgt, ast.Attribute)
+            and tgt.attr == "_cached_routes"
+            and isinstance(val, ast.Dict)
+        ):
+            for k, v in zip(val.keys, val.values):
+                route = k.value if isinstance(k, ast.Constant) else None
+                if not isinstance(route, str):
+                    continue
+                registered.add(route)
+                builder = None
+                if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                    builder = v.elts[1]
+                if isinstance(builder, ast.Attribute):
+                    shapes[route] = resolver.func_shape(SERVER, builder.attr)
+                elif isinstance(builder, ast.Lambda):
+                    shapes[route] = resolver.expr_shape(
+                        builder.body, SERVER, env, 0
+                    )
+    # the routes() registry: every string literal inside it is served
+    routes_def = resolver._find_def(SERVER, "routes")
+    if routes_def is not None:
+        for n in ast.walk(routes_def):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                if n.value.startswith("/"):
+                    registered.add(n.value)
+    for route, spec in ROUTE_SPECIAL.items():
+        registered.add(route)
+        if spec is not None and route not in shapes:
+            shapes[route] = resolver.func_shape(spec[0], spec[1])
+    return shapes, registered
+
+
+# ----------------------------- JS scanning -----------------------------
+
+# Property names that are language/stdlib surface, not payload keys.
+_JS_BUILTIN_PROPS = frozenset(
+    {
+        "length", "map", "filter", "forEach", "find", "some", "every",
+        "slice", "concat", "join", "indexOf", "includes", "push", "pop",
+        "reduce", "sort", "fill", "reverse", "split", "toFixed",
+        "toUpperCase", "toLowerCase", "charCodeAt", "trim", "padStart",
+        "repeat", "keys", "values",
+    }
+)
+_ARRAY_ARROW_METHODS = frozenset(
+    {"map", "filter", "forEach", "find", "some", "every"}
+)
+
+
+class JsScan:
+    """What dashboard.js actually does with payloads: the routes it
+    fetches and every key-path read rooted at a payload binding.
+    Exposed for tests (tests/test_dashboard_static.py drives the same
+    scanner, so the realtime schema has ONE source of truth)."""
+
+    def __init__(self):
+        self.routes: set[str] = set()  # getJson targets (query stripped)
+        self.post_routes: set[str] = set()
+        self.reads: set[tuple[str, tuple[str, ...]]] = set()
+        self.error: str | None = None
+
+
+def _walk_nodes(node):
+    """Yield every tuple node in a jsmini AST."""
+    if isinstance(node, tuple) and node and isinstance(node[0], str):
+        yield node
+        for part in node[1:]:
+            yield from _walk_nodes(part)
+    elif isinstance(node, list):
+        for part in node:
+            yield from _walk_nodes(part)
+
+
+def _leftmost_str(expr) -> str | None:
+    while isinstance(expr, tuple):
+        if expr[0] == "str":
+            return expr[1]
+        if expr[0] == "bin" and expr[1] == "+":
+            expr = expr[2]
+            continue
+        return None
+    return None
+
+
+def scan_js(project: Project, rel: str = DASHBOARD_JS) -> JsScan | None:
+    sf = project.file(rel)
+    if sf is None:
+        return None
+    scan = JsScan()
+    try:
+        from tests.jsmini import JsSyntaxError, Parser, tokenize
+
+        prog = Parser(tokenize(sf.text)).parse_program()
+    except Exception as e:  # noqa: BLE001 - surface as a finding, not a crash
+        scan.error = f"{type(e).__name__}: {e}"
+        return scan
+
+    # Function table: fundecls anywhere + const name = arrow.
+    funcs: dict[str, tuple[list, object]] = {}
+    for node in _walk_nodes(prog):
+        if node[0] == "fundecl":
+            funcs[node[1]] = (node[2], node[3])
+        elif node[0] == "vardecl":
+            for d in node[2]:
+                if (
+                    d[0] == "one"
+                    and isinstance(d[2], tuple)
+                    and d[2]
+                    and d[2][0] == "arrow"
+                ):
+                    funcs[d[1]] = (d[2][1], d[2][2])
+
+    global_bindings: dict[str, set] = {REALTIME_JS_ROOT: {(REALTIME, ())}}
+    param_bindings: dict[tuple[str, int], set] = {}
+
+    def resolve(expr, env) -> set:
+        """PathRef set {(root, path)} for an expression, or empty."""
+        if not (isinstance(expr, tuple) and expr):
+            return set()
+        if expr[0] == "name":
+            return set(env.get(expr[1], set())) | set(
+                global_bindings.get(expr[1], set())
+            )
+        if expr[0] == "member":
+            prop = expr[2]
+            if prop in _JS_BUILTIN_PROPS:
+                return set()
+            return {(r, p + (prop,)) for r, p in resolve(expr[1], env)}
+        if expr[0] in ("index", "optindex"):
+            base = resolve(expr[1], env)
+            idx = expr[2]
+            if isinstance(idx, tuple) and idx and idx[0] == "str":
+                return {(r, p + (idx[1],)) for r, p in base}
+            return {(r, p + ("*",)) for r, p in base}
+        return set()
+
+    def walk(node, env) -> None:
+        if isinstance(node, list):
+            for part in node:
+                walk(part, env)
+            return
+        if not (isinstance(node, tuple) and node and isinstance(node[0], str)):
+            return
+        kind = node[0]
+        if kind in ("member", "index", "optindex"):
+            prop = None
+            if kind == "member":
+                prop = node[2]
+            elif isinstance(node[2], tuple) and node[2] and node[2][0] == "str":
+                prop = node[2][1]
+            if prop is not None and prop not in _JS_BUILTIN_PROPS:
+                for r, p in resolve(node[1], env):
+                    scan.reads.add((r, p + (prop,)))
+            walk(node[1], env)
+            if kind != "member":
+                walk(node[2], env)
+            return
+        if kind == "call":
+            f, args = node[1], node[2]
+            # net.getJson(url, cb) / net.postJson(url, body, done)
+            if (
+                isinstance(f, tuple)
+                and f[0] == "member"
+                and isinstance(f[1], tuple)
+                and f[1][0] == "name"
+                and f[1][1] == "net"
+                and f[2] in ("getJson", "postJson")
+                and args
+            ):
+                url = _leftmost_str(args[0])
+                if url is not None:
+                    route = url.split("?")[0]
+                    if f[2] == "getJson":
+                        scan.routes.add(route)
+                        if len(args) >= 2:
+                            cb = args[1]
+                            ref = {(route, ())}
+                            if isinstance(cb, tuple) and cb[0] == "arrow":
+                                sub = dict(env)
+                                if cb[1]:
+                                    sub[cb[1][0]] = ref
+                                walk(cb[2], sub)
+                                for a in args[2:]:
+                                    walk(a, env)
+                                walk(args[0], env)
+                                return
+                            if isinstance(cb, tuple) and cb[0] == "name":
+                                param_bindings.setdefault(
+                                    (cb[1], 0), set()
+                                ).update(ref)
+                    else:
+                        scan.post_routes.add(route)
+            # known function called with payload-resolving args
+            if isinstance(f, tuple) and f[0] == "name" and f[1] in funcs:
+                for i, a in enumerate(args):
+                    refs = resolve(a, env)
+                    if refs:
+                        param_bindings.setdefault((f[1], i), set()).update(refs)
+            # arr.map(x => ...) over a payload list
+            if (
+                isinstance(f, tuple)
+                and f[0] == "member"
+                and f[2] in _ARRAY_ARROW_METHODS
+                and args
+                and isinstance(args[0], tuple)
+                and args[0][0] == "arrow"
+            ):
+                refs = resolve(f[1], env)
+                if refs:
+                    arrow = args[0]
+                    sub = dict(env)
+                    if arrow[1]:
+                        sub[arrow[1][0]] = {(r, p + ("[]",)) for r, p in refs}
+                    walk(f[1], env)
+                    walk(arrow[2], sub)
+                    for a in args[1:]:
+                        walk(a, env)
+                    return
+            walk(f, env)
+            walk(args, env)
+            return
+        if kind == "assign" and node[1] == "=" and node[2][0] == "name":
+            refs = resolve(node[3], env)
+            if refs:
+                global_bindings.setdefault(node[2][1], set()).update(refs)
+            walk(node[3], env)
+            return
+        if kind == "vardecl":
+            for d in node[2]:
+                if d[0] == "one" and d[2] is not None:
+                    refs = resolve(d[2], env)
+                    if refs:
+                        env[d[1]] = refs
+                    walk(d[2], env)
+            return
+        if kind == "forof":
+            refs = resolve(node[2], env)
+            sub = env
+            if refs:
+                sub = dict(env)
+                sub[node[1]] = {(r, p + ("[]",)) for r, p in refs}
+            walk(node[2], env)
+            walk(node[3], sub)
+            return
+        if kind == "arrow":
+            walk(node[2], dict(env))
+            return
+        if kind == "fundecl":
+            return  # walked via its own param bindings below
+        for part in node[1:]:
+            walk(part, env)
+
+    # Fixpoint: closure assignments (streamData = d.key; lastHistory = h)
+    # and cross-function param bindings settle in a few rounds. The
+    # round cap bounds propagation DEPTH (each round pushes bindings
+    # one call-hop further): 12 hops is far past anything the jsmini
+    # dialect's flat call style produces, and an unconverged scan only
+    # under-reports (reads stop resolving — never a false positive).
+    for _ in range(12):
+        before = (
+            len(scan.reads),
+            sum(len(v) for v in global_bindings.values()),
+            sum(len(v) for v in param_bindings.values()),
+        )
+        for name, (params, body) in funcs.items():
+            env = {
+                p: set(param_bindings.get((name, i), set()))
+                for i, p in enumerate(params)
+                if (name, i) in param_bindings
+            }
+            walk(body, env)
+        # top-level statements outside any function
+        for stmt in prog:
+            if not (isinstance(stmt, tuple) and stmt[0] == "fundecl"):
+                walk(stmt, {})
+        after = (
+            len(scan.reads),
+            sum(len(v) for v in global_bindings.values()),
+            sum(len(v) for v in param_bindings.values()),
+        )
+        if after == before:
+            break
+    return scan
+
+
+# ------------------------------ the check ------------------------------
+
+
+def _line_of(text: str, needle: str) -> int:
+    for i, line in enumerate(text.splitlines(), 1):
+        if needle in line:
+            return i
+    return 1
+
+
+def _shape_at(shape: Shape, path: tuple[str, ...]):
+    """Walk a read path; returns ("dead", depth) when a closed dict
+    lacks the segment, else ("ok", None)."""
+    cur = shape
+    for i, seg in enumerate(path):
+        if cur.kind == "opaque":
+            return "ok", None
+        if cur.kind == "list":
+            if seg in ("[]", "*"):
+                cur = cur.elem or Shape.opaque()
+                continue
+            return "ok", None  # property read on a list: not our rule
+        # dict
+        if seg in ("[]", "*"):
+            return "ok", None  # dynamic access: can't judge
+        hit = cur.keys.get(seg)
+        if hit is None:
+            if cur.closed:
+                return "dead", i
+            return "ok", None
+        cur = hit[0]
+    return "ok", None
+
+
+def _iter_emitted(shape: Shape, path=()):
+    """Yield (path, child shape, file, line) for every literal key."""
+    if shape.kind == "dict":
+        for k, (child, file, line) in shape.keys.items():
+            yield path + (k,), child, file, line
+            yield from _iter_emitted(child, path + (k,))
+    elif shape.kind == "list" and shape.elem is not None:
+        yield from _iter_emitted(shape.elem, path + ("[]",))
+
+
+def check(project: Project) -> list[Finding]:
+    srv = project.file(SERVER)
+    if srv is None or srv.tree is None:
+        return []  # tree without a server: pass doesn't apply
+    findings: list[Finding] = []
+    resolver = Resolver(project)
+    realtime = resolver.func_shape(SERVER, "realtime_payload")
+    route_shapes, registered = _route_builders(project, resolver)
+
+    js = scan_js(project)
+    dash = project.file(DASHBOARD_JS)
+    if js is not None and js.error is not None:
+        findings.append(
+            Finding(
+                check="payload.js-unparsable",
+                path=DASHBOARD_JS,
+                line=1,
+                message=(
+                    f"dashboard.js failed to parse under the jsmini "
+                    f"dialect: {js.error} — the payload scan (and "
+                    f"tests/test_dashboard_js.py) cannot see it"
+                ),
+            )
+        )
+        js = None
+
+    # --- dead reads: JS key paths no server path emits ---
+    if js is not None and dash is not None:
+        reported: set = set()  # one finding per first dead segment
+        for root, path in sorted(js.reads):
+            shape = realtime if root == REALTIME else route_shapes.get(root)
+            if shape is None:
+                continue  # unresolved route: unknown-route covers it
+            verdict, depth = _shape_at(shape, path)
+            if verdict == "dead":
+                if (root, path[: depth + 1]) in reported:
+                    continue
+                reported.add((root, path[: depth + 1]))
+                dead_key = path[depth]
+                parent = ".".join(path[:depth]) or (
+                    "the realtime payload" if root == REALTIME else root
+                )
+                findings.append(
+                    Finding(
+                        check="payload.dead-read",
+                        path=DASHBOARD_JS,
+                        line=_line_of(dash.text, dead_key),
+                        message=(
+                            f"dashboard.js reads {'.'.join(path[: depth + 1])!r} from "
+                            f"{root if root != REALTIME else 'the SSE realtime payload'}"
+                            f" but no server path emits {dead_key!r} under "
+                            f"{parent} — this card renders empty forever"
+                        ),
+                    )
+                )
+        # --- routes fetched that the server never registers ---
+        for route in sorted(js.routes | js.post_routes):
+            if route not in registered:
+                findings.append(
+                    Finding(
+                        check="payload.unknown-route",
+                        path=DASHBOARD_JS,
+                        line=_line_of(dash.text, route),
+                        message=(
+                            f"dashboard.js fetches {route!r} but the server "
+                            f"registers no such route — 404 on every poll"
+                        ),
+                    )
+                )
+
+    # --- orphan realtime keys: emitted but consumed by nobody ---
+    consumer_text = []
+    for rel in (DASHBOARD_JS, DASHBOARD_HTML, CLI):
+        f = project.file(rel)
+        if f is not None:
+            consumer_text.append(f.text)
+    for rel in project.files_matching("tests", ".py"):
+        f = project.file(rel)
+        if f is not None:
+            consumer_text.append(f.text)
+    blob = "\n".join(consumer_text)
+    reads = js.reads if js is not None else set()
+    for path, child, file, line in _iter_emitted(realtime):
+        key = path[-1]
+        if key == "[]":
+            continue
+        consumed = any(
+            r == REALTIME and p[: len(path)] == path for r, p in reads
+        )
+        if not consumed and re.search(rf"\b{re.escape(key)}\b", blob):
+            consumed = True  # named somewhere a consumer lives
+        if not consumed:
+            est = len(key) + 4  # '"key":' + separators, per frame
+            findings.append(
+                Finding(
+                    check="payload.orphan-key",
+                    path=file,
+                    line=line,
+                    message=(
+                        f"realtime payload key {'.'.join(path)!r} has no "
+                        f"consumer in dashboard.js, the CLI or tests — "
+                        f"~{est}+ B of dead weight in every SSE frame "
+                        f"(values cost extra)"
+                    ),
+                )
+            )
+    return findings

@@ -27,8 +27,13 @@ Rules:
 - ``sections.publish-without-bump``: in the publisher modules
   (federation.py / sampler.py), a function that mutates published
   fan-in state (NodeState status/chips/slice_rows/connected/tier/error,
-  the hub's node table, the sampler's ``latest``) must also contain a
-  ``bump()`` call — publish and epoch advance travel together.
+  the hub's node table, the sampler's ``latest``) must ride with a
+  ``bump()`` — publish and epoch advance travel together. The check is
+  *interprocedural* within the module: a mutation reached through a
+  helper call is attributed to the helper, and the helper is covered
+  when it (or a callee) bumps, or when every caller path that reaches
+  it bumps. A helper whose callers all bump is clean; a helper with
+  even one bump-free caller path is not.
 """
 
 from __future__ import annotations
@@ -186,12 +191,15 @@ def _scan_registries(project: Project, declared, findings: list[Finding]):
 
 
 class _PublishScan(ast.NodeVisitor):
-    """Per-function: does it mutate published attrs / call bump()?"""
+    """Per-function: does it mutate published attrs / call bump()?
+    Also records which same-module functions it calls, so the publisher
+    rule can follow mutations through helpers (interprocedural)."""
 
     def __init__(self, attrs: frozenset[str]):
         self.attrs = attrs
         self.publishes: list[tuple[str, int]] = []
         self.bumps = False
+        self.calls: set[str] = set()
 
     def _target(self, t: ast.AST) -> None:
         # ns.status = ..., self.nodes[k] = ..., del self.nodes[k]
@@ -225,6 +233,10 @@ class _PublishScan(ast.NodeVisitor):
         # bump() or a wrapper of it by convention (FederationHub._bump)
         if isinstance(f, ast.Attribute) and f.attr.endswith("bump"):
             self.bumps = True
+        if isinstance(f, ast.Attribute):
+            self.calls.add(f.attr)  # self.helper() / obj.helper()
+        elif isinstance(f, ast.Name):
+            self.calls.add(f.id)  # module-level helper()
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):  # don't descend into nested defs
@@ -234,32 +246,131 @@ class _PublishScan(ast.NodeVisitor):
 
 
 def _scan_publishers(project: Project, findings: list[Finding]) -> None:
+    """Interprocedural publish/bump coherence, per publisher module.
+
+    A function that mutates published state is fine when the bump
+    travels with the publish along EVERY call path: either the
+    function (or something it calls, transitively) bumps, or every
+    function that can reach it does. Mutations buried in helpers no
+    longer hide (the PR 9 upgrade); helpers whose callers all bump no
+    longer false-positive. The call graph is name-keyed within the
+    module — cross-module calls are out of scope by design (the
+    publisher modules are the ones that own served state)."""
     for rel, attrs in PUBLISH_ATTRS.items():
         sf = project.file(rel)
         if sf is None or sf.tree is None:
             continue
-        for node in ast.walk(sf.tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        # Graph nodes are CLASS-QUALIFIED ("Hub.connect"), never merged
+        # by bare name: two classes with a same-named method must not
+        # share publish/bump state (a bump in FederationHub.connect
+        # must not launder FederationUplink.connect's bump-free
+        # publish). ``self.x()`` resolves within the class first; a
+        # bare-name fallback covers cross-object calls, conservatively
+        # fanning out to every candidate.
+        scans: dict[str, _PublishScan] = {}
+        by_bare: dict[str, list[str]] = {}
+        own_class: dict[str, str | None] = {}
+
+        def collect(node: ast.AST, cls: str | None) -> None:
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.ClassDef):
+                    collect(sub, sub.name)
+                elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{cls}.{sub.name}" if cls else sub.name
+                    scan = _PublishScan(attrs)
+                    for stmt in sub.body:
+                        scan.visit(stmt)
+                    scans[qual] = scan
+                    by_bare.setdefault(sub.name, []).append(qual)
+                    own_class[qual] = cls
+                    collect(sub, cls)  # nested defs keep the class
+
+        collect(sf.tree, None)
+        # Resolved edges are exact: ``self.x()`` within the class, or a
+        # module-level function calling the unique module-level function
+        # of that name. Anything else — ``obj.x()`` where only SOME
+        # class happens to define a bumping ``x`` — is AMBIGUOUS: the
+        # receiver could be any object, so such edges grant NO bump
+        # credit (or `peer.connect()` would launder a bump-free publish
+        # through an unrelated class's bumping connect()). Ambiguous
+        # edges still register as caller edges, which is the
+        # conservative direction: more callers can only make coverage
+        # harder to claim, never easier.
+        resolved: dict[str, set[str]] = {}
+        ambiguous: dict[str, set[str]] = {}
+        for qual, scan in scans.items():
+            res: set[str] = set()
+            amb: set[str] = set()
+            cls = own_class[qual]
+            for c in scan.calls:
+                if cls and f"{cls}.{c}" in scans:
+                    res.add(f"{cls}.{c}")
+                    continue
+                candidates = by_bare.get(c, [])
+                if (
+                    cls is None
+                    and len(candidates) == 1
+                    and own_class[candidates[0]] is None
+                ):
+                    res.update(candidates)  # module fn -> module fn
+                else:
+                    amb.update(candidates)
+            resolved[qual] = res - {qual}
+            ambiguous[qual] = amb - {qual}
+        callers: dict[str, set[str]] = {name: set() for name in scans}
+        for src in scans:
+            for dst in resolved[src] | ambiguous[src]:
+                callers[dst].add(src)
+        # bump*: the function bumps or a RESOLVED callee bump*s.
+        bump_star = {name: scan.bumps for name, scan in scans.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name in scans:
+                if not bump_star[name] and any(
+                    bump_star[c] for c in resolved[name]
+                ):
+                    bump_star[name] = changed = True
+        # covered: bump* holds, or every caller is covered (the bump
+        # happens upstream on each path that can reach the publish).
+        covered = dict(bump_star)
+        changed = True
+        while changed:
+            changed = False
+            for name in scans:
+                if (
+                    not covered[name]
+                    and callers[name]
+                    and all(covered[c] for c in callers[name])
+                ):
+                    covered[name] = changed = True
+        for name in sorted(scans):
+            scan = scans[name]
+            if name.rsplit(".", 1)[-1] in _PUBLISH_EXEMPT or not scan.publishes:
                 continue
-            if node.name in _PUBLISH_EXEMPT:
+            if covered[name]:
                 continue
-            scan = _PublishScan(attrs)
-            for stmt in node.body:
-                scan.visit(stmt)
-            if scan.publishes and not scan.bumps:
-                what, line = scan.publishes[0]
-                findings.append(
-                    Finding(
-                        check="sections.publish-without-bump",
-                        path=sf.rel,
-                        line=line,
-                        message=(
-                            f"{node.name}() mutates published state "
-                            f"({what}) without bumping an epoch section — "
-                            f"consumers keyed on it will serve stale bytes"
-                        ),
-                    )
+            what, line = scan.publishes[0]
+            uncovered = [c for c in sorted(callers[name]) if not covered[c]]
+            via = (
+                f" (reached from {', '.join(uncovered)}() which never "
+                f"bumps either)"
+                if uncovered
+                else ""
+            )
+            findings.append(
+                Finding(
+                    check="sections.publish-without-bump",
+                    path=sf.rel,
+                    line=line,
+                    message=(
+                        f"{name}() mutates published state ({what}) and "
+                        f"neither it, its callees, nor every caller bumps "
+                        f"an epoch section{via} — consumers keyed on it "
+                        f"will serve stale bytes"
+                    ),
                 )
+            )
 
 
 def check(project: Project) -> list[Finding]:

@@ -6,8 +6,10 @@ out-of-order appends (PR 6), routes that existed but weren't documented
 (the test_routes_doc.py lint exists because one almost shipped). This
 package is the cure grown into a framework: AST-based passes that pin
 the cross-file contracts this codebase actually breaks — dirty-section
-coherence, thread/lock discipline, wire-protocol exhaustiveness and the
-registry/doc tables. See docs/static-analysis.md.
+coherence, thread/lock discipline, wire-protocol exhaustiveness, the
+registry/doc tables, and (cross-language, PR 9) the ctypes↔C ABI seam
+and the server-payload↔dashboard key vocabulary. See
+docs/static-analysis.md.
 
 Design rules:
 
@@ -172,6 +174,24 @@ class Project:
         self._files[rel] = sf
         return sf
 
+    def files_matching(self, reldir: str, suffix: str) -> list[str]:
+        """Relative paths of files anywhere under ``reldir`` (recursive)
+        ending in ``suffix`` — the cross-language passes (abi: .cpp,
+        payload: .js / the tests consumer audit) discover their
+        non-Python inputs through this so the same pass runs against
+        fixture trees unchanged."""
+        top = os.path.join(self.root, reldir)
+        if not os.path.isdir(top):
+            return []
+        out = []
+        for dirpath, dirnames, names in os.walk(top):
+            dirnames[:] = sorted(n for n in dirnames if n != "__pycache__")
+            for name in sorted(names):
+                if name.endswith(suffix):
+                    full = os.path.join(dirpath, name)
+                    out.append(os.path.relpath(full, self.root))
+        return out
+
     def py_files(self, prefix: str | None = None) -> list[SourceFile]:
         rels: list[str] = []
         dirs = (prefix,) if prefix else self.scan_dirs
@@ -311,6 +331,67 @@ def summary_line(findings: list[Finding], npasses: int) -> str:
         f"tpulint: {status}: {live} finding(s), {supp} suppressed, "
         f"{npasses} pass(es)"
     )
+
+
+def render_sarif(findings: list[Finding]) -> str:
+    """The findings as a SARIF 2.1.0 log — the interchange format CI
+    annotation tooling (GitHub code scanning, SARIF viewers) consumes.
+    Shape contract (locked by tests/test_lint.py):
+
+    - one run, ``tool.driver.name`` == "tpulint"; every distinct check
+      id appears once under ``tool.driver.rules``;
+    - one ``result`` per finding: ``ruleId`` = the check, ``level`` =
+      "error" (suppressed findings instead carry ``suppressions`` with
+      ``kind: "inSource"`` and the reason as ``justification``);
+    - one physical location per result: project-relative ``uri`` +
+      1-based ``startLine`` — the same file:line the human report
+      prints, so annotations land where a suppression would go.
+    """
+    rule_ids = sorted({f.check for f in findings})
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.check,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line},
+                    }
+                }
+            ],
+        }
+        if f.suppressed:
+            result["suppressions"] = [
+                {
+                    "kind": "inSource",
+                    **(
+                        {"justification": f.suppress_reason}
+                        if f.suppress_reason
+                        else {}
+                    ),
+                }
+            ]
+        results.append(result)
+    doc = {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [{"id": rid} for rid in rule_ids],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=1)
 
 
 def render_report(

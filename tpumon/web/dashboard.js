@@ -135,6 +135,11 @@ function makeDashboard(doc, net, env, mkSurface) {
             `${fmtGiB(host.memory?.used)} / ${fmtGiB(host.memory?.total)}`);
     setCard("disk", host.disk?.percent,
             `${fmtGiB(host.disk?.used)} / ${fmtGiB(host.disk?.total)}`);
+    // Live NIC rates — the cross-host DCN-traffic proxy (the chart
+    // plots the same series historically; this is the current tick).
+    const nr = host.net_rates;
+    $("dcn-tag").textContent = nr && nr.tx_bps != null
+      ? `now ↑ ${fmtBps(nr.tx_bps)} · ↓ ${fmtBps(nr.rx_bps)}` : "";
   }
 
   /* --------------------------- chips & topo --------------------------- */
