@@ -639,6 +639,120 @@ def _bench_serving(on_tpu: bool) -> dict:
     }
 
 
+def _bench_serving_concurrency(on_tpu: bool) -> dict:
+    """Continuous-batching scheduler comparison at 32- and 128-way
+    concurrency (ROADMAP item 4's missing numbers): a multi-tenant
+    burst — a long-prompt (RAG-style) tenant ahead of short chat
+    traffic, the worst-case head-of-line order — served once by the
+    interleaved chunked-prefill scheduler and once by the sequential
+    stop-the-world baseline (``ServeConfig.scheduler``), on otherwise
+    identical engines. Slots == concurrency: the running batch IS the
+    concurrency level (continuous batching's premise), so TTFT measures
+    prefill *scheduling*, not queue depth.
+
+    Reported: aggregate tokens/s under the interleaved scheduler at
+    both levels, and TTFT p95 at 128-way under both schedulers — the
+    sequential number is the stop-the-world interference the
+    interleaved scheduler exists to remove (the p95 request is a chat
+    request stuck behind the long-prompt burst). Schedulers are run in
+    alternating repetitions with best-of per scheduler (this box's
+    noise is multiplicative drift, so pairing + best-of beats
+    averaging); greedy decoding and per-(request, index) sampling keys
+    make the token streams identical across all runs — only the
+    schedule differs."""
+    import random
+
+    from tpumon.loadgen.model import ModelConfig
+    from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+    p = 32  # prefill chunk / page size (tokens)
+    long_chunks = 32
+    model = ModelConfig(vocab=1024, d_model=128, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=512,
+                        max_seq=p * (long_chunks + 1))
+
+    def mk_mix(n_conc: int, n_long: int, seed: int) -> list:
+        rng = random.Random(seed)
+        reqs = []
+        for i in range(n_conc):
+            if i < n_long:
+                # Long-prompt tenant bursts FIRST: every chat request
+                # behind it eats the whole burst's prefill under
+                # stop-the-world admission.
+                plen, mx = p * long_chunks - 3, 4
+            else:
+                plen, mx = rng.randint(10, p - 2), 4
+            prompt = [1 + (i * 17 + j * 7) % (model.vocab - 1)
+                      for j in range(plen)]
+            reqs.append((prompt, mx))
+        return reqs
+
+    def build(n_conc: int, scheduler: str) -> "ServingEngine":
+        eng = ServingEngine(ServeConfig(
+            model=model, slots=n_conc, prefill_len=p,
+            scheduler=scheduler, decode_block=4),
+            max_queue=n_conc + 8)
+        # Warmup compiles prefill + block/single decode out of the
+        # measured window.
+        eng.submit(list(range(8)), max_new=6)
+        eng.drain()
+        eng.submit(list(range(model.max_seq - 8)), max_new=6)
+        eng.drain()
+        return eng
+
+    def one_rep(eng: "ServingEngine", n_conc: int, n_long: int,
+                seed: int) -> tuple[float, float]:
+        mix = mk_mix(n_conc, n_long, seed)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(pr, max_new=mx) for pr, mx in mix]
+        eng.drain(max_steps=1_000_000)
+        wall = time.perf_counter() - t0
+        assert all(r.done.is_set() for r in reqs)
+        tokens = sum(len(r.output) for r in reqs)
+        ttfts = sorted(r.ttft_s for r in reqs)
+        p95 = ttfts[int(0.95 * (len(ttfts) - 1))] * 1e3
+        return tokens / wall, p95
+
+    def compare(n_conc: int, n_long: int, reps: int = 2) -> dict:
+        engines = {s: build(n_conc, s)
+                   for s in ("interleaved", "sequential")}
+        got: dict[str, list] = {s: [] for s in engines}
+        for rep in range(reps):
+            for sched, eng in engines.items():  # alternating pairs
+                got[sched].append(one_rep(eng, n_conc, n_long, rep))
+        return {
+            sched: (max(v[0] for v in vals),  # best tokens/s
+                    min(v[1] for v in vals))  # best-case p95
+            for sched, vals in got.items()
+        }
+
+    c32 = compare(32, 2)
+    c128 = compare(128, 6)
+    int32, seq32 = c32["interleaved"], c32["sequential"]
+    int128, seq128 = c128["interleaved"], c128["sequential"]
+    return {
+        "serving_conc32_tokens_per_sec": round(int32[0], 1),
+        "serving_conc128_tokens_per_sec": round(int128[0], 1),
+        "serving_conc128_ttft_p95_ms": round(int128[1], 1),
+        "serving_conc128_ttft_p95_sequential_ms": round(seq128[1], 1),
+        # Context for the record keys (full results only).
+        "serving_conc32_ttft_p95_ms": round(int32[1], 1),
+        "serving_conc32_ttft_p95_sequential_ms": round(seq32[1], 1),
+        "serving_conc32_tokens_per_sec_sequential": round(seq32[0], 1),
+        "serving_conc128_tokens_per_sec_sequential": round(seq128[0], 1),
+        "serving_conc128_ttft_p95_speedup": round(
+            seq128[1] / int128[1], 2) if int128[1] else None,
+        "serving_conc128_tps_vs_sequential": round(
+            int128[0] / seq128[0], 3) if seq128[0] else None,
+        "serving_concurrency_workload": {
+            "prefill_chunk_tokens": p, "long_chunks": long_chunks,
+            "long_requests": {"conc32": 2, "conc128": 6},
+            "short_max_new": 4, "decode_block": 4,
+            "slots": "== concurrency", "reps": 2,
+        },
+    }
+
+
 async def _bench_fastpath(topology: str, iters: int = 30, warmup: int = 5) -> dict:
     """Data-plane fast path at production chip counts (docs/perf.md):
     single instance on a fake v5p topology, measuring the epoch-cached
@@ -1359,6 +1473,17 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                       "serving_paged_prefix_ttft_hit_ms",
                       "serving_paged_prefix_ttft_stats",
                       "serving_requests")),
+    "serving_concurrency": (600, (
+        "serving_conc32_tokens_per_sec",
+        "serving_conc128_tokens_per_sec",
+        "serving_conc128_ttft_p95_ms",
+        "serving_conc128_ttft_p95_sequential_ms",
+        "serving_conc32_ttft_p95_ms",
+        "serving_conc32_ttft_p95_sequential_ms",
+        "serving_conc32_tokens_per_sec_sequential",
+        "serving_conc128_tokens_per_sec_sequential",
+        "serving_conc128_ttft_p95_speedup",
+        "serving_conc128_tps_vs_sequential")),
 }
 
 
@@ -1408,14 +1533,22 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "paged_engine_step_gather_ms", "paged_engine_step_kernel_ms",
     # train
     "train_mfu_pct", "train_tokens_per_sec", "train_seq8k_mfu_pct",
-    # serving
+    # serving (the int8-KV throughput, prompt-lookup ratio and prefix
+    # TTFT pair moved to full results to make room for the concurrency
+    # keys under the summary byte budget — prefix hit/cold remain as
+    # diagnostics in BENCH_FULL.json)
     "serving_tokens_per_sec", "serving_block8_tokens_per_sec",
     "serving_spec_tokens_per_sec", "serving_spec_accept_pct",
-    "serving_spec_prompt_vs_block8",
     "serving_paged_block8_tokens_per_sec",
     "serving_paged_kernel_vs_gather",
-    "serving_int8kv_block8_tokens_per_sec",
-    "serving_prefix_ttft_cold_ms", "serving_prefix_ttft_hit_ms",
+    # serving_concurrency (chunked-prefill scheduler vs the sequential
+    # stop-the-world baseline at 32/128-way concurrency; the conc32
+    # TTFT pair, per-scheduler operands and ratios live in full
+    # results)
+    "serving_conc32_tokens_per_sec",
+    "serving_conc128_tokens_per_sec",
+    "serving_conc128_ttft_p95_ms",
+    "serving_conc128_ttft_p95_sequential_ms",
 )
 
 SUMMARY_MAX_BYTES = 1800
@@ -1487,6 +1620,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return _bench_train(on_tpu)
     if name == "serving":
         return _bench_serving(on_tpu)
+    if name == "serving_concurrency":
+        return _bench_serving_concurrency(on_tpu)
     raise ValueError(f"unknown phase {name!r}")
 
 

@@ -44,6 +44,7 @@ GET_ENDPOINTS = [
     ("/api/k8s/pods", ""),
     ("/api/alerts", ""),
     ("/api/serving", ""),
+    ("/api/federation", ""),
     ("/api/health", ""),
     ("/api/trace", ""),
     ("/api/events", "limit=20"),
@@ -544,6 +545,64 @@ def test_serving_and_train_cards_hidden_without_targets(js, payloads):
     d["fetchServing"]()
     assert doc.el("serving-card")["style"]["display"] == "none"
     assert doc.el("train-card")["style"]["display"] == "none"
+
+
+def test_federation_card_hidden_on_standalone(js, payloads):
+    """A standalone monitor answers /api/federation with role only —
+    no fleet, no uplink — and the card stays hidden (same contract as
+    the serving card without targets)."""
+    d, doc, net, env, surf = mkdash(js, payloads)
+    d["fetchFederation"]()
+    assert doc.el("federation-card")["style"]["display"] == "none"
+    # Server down (cb null) must also hide, never throw.
+    d2, doc2, _, _, _ = mkdash(js, {})
+    d2["fetchFederation"]()
+    assert doc2.el("federation-card")["style"]["display"] == "none"
+
+
+FEDERATION = {
+    "role": "root",
+    "node": "root-0",
+    "nodes": {
+        "agg-0": {"tier": "aggregator", "status": "ok", "connected": True,
+                  "frames": 12.0, "slices": 4.0, "chips": 0.0,
+                  "age_s": 0.4},
+        "agg-1": {"tier": "aggregator", "status": "unreachable",
+                  "connected": False, "frames": 3.0, "slices": 4.0,
+                  "chips": 0.0, "age_s": 31.5},
+    },
+    "slices": [],
+    "fleet": {"slices": 8.0, "chips": 2048.0, "dark_slices": 1.0,
+              "unreachable_slices": 4.0, "duty_mean": 72.5},
+    "frames": 15.0,
+}
+
+
+def test_federation_card_renders_fleet_view(js):
+    """The fleet card reads the aggregator-tree view: totals with the
+    failure domains (dark vs unreachable), per-downstream liveness and
+    the oldest frame age — the operator's 'is the tree healthy' glance
+    (docs/federation.md)."""
+    d, doc, net, env, surf = mkdash(js, {"/api/federation": FEDERATION})
+    d["fetchFederation"]()
+    assert doc.el("federation-card")["style"]["display"] == ""
+    assert doc.el("fed-tag")["textContent"] == "root · root-0"
+    assert doc.el("fed-slices")["textContent"] == "8"
+    assert doc.el("fed-chips")["textContent"] == "2048"
+    assert doc.el("fed-dark")["textContent"] == "1"
+    assert doc.el("fed-dark")["style"]["color"] == "var(--red)"
+    assert doc.el("fed-unreach")["textContent"] == "4"
+    assert doc.el("fed-duty")["textContent"] == "72.5%"
+    assert doc.el("fed-nodes")["textContent"] == "1/2"
+    assert doc.el("fed-age")["textContent"] == "31.5 s"
+    assert doc.el("fed-uplink")["textContent"] == "–"  # root has none
+    # A leaf: uplink state only, fleet absent — card still shows.
+    leaf = {"role": "leaf", "uplink": {"connected": False, "frames": 7.0}}
+    d2, doc2, _, _, _ = mkdash(js, {"/api/federation": leaf})
+    d2["fetchFederation"]()
+    assert doc2.el("federation-card")["style"]["display"] == ""
+    assert doc2.el("fed-uplink")["textContent"] == "down"
+    assert doc2.el("fed-uplink")["style"]["color"] == "var(--red)"
 
 
 SERVING = {

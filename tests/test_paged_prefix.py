@@ -125,11 +125,20 @@ def test_pool_pressure_evicts_instead_of_deadlocking():
 
 def test_blocked_queue_head_does_not_inflate_counters():
     """A queued request re-probed every step while waiting for pages
-    must not pump the hit/miss counters (each failed admission rolls
-    its lookup back)."""
+    must not pump the hit/miss counters. The scheduler probes with the
+    side-effect-free ``peek()`` and only runs the counting ``lookup``
+    for the request actually admitted — no counter-decrement rollback
+    surgery anywhere (the pre-scheduler ``_admit`` decremented
+    hits/misses by hand after a failed reservation)."""
     eng = engine(pool_pages=5, slots=2)
     a = eng.submit(list(range(1, 21)), max_new=8)   # reserves all 4 pages
     b = eng.submit(list(range(40, 60)), max_new=4)  # blocked on pages
+    # Drive the blocked head through many probe cycles explicitly: the
+    # counters must stay untouched WHILE it is still blocked (the old
+    # rollback made them merely net-zero after the fact).
+    for _ in range(3):
+        eng.step()
+        assert eng.prefix_cache.misses == 1  # a's admission only
     eng.drain()
     assert a.done.is_set() and b.done.is_set()
     # Exactly two ADMITTED lookups happened (one per request, both

@@ -1,4 +1,11 @@
-"""On-device temperature / top-k sampling (serving.sample_tokens)."""
+"""On-device temperature / top-k sampling (serving.sample_tokens).
+
+Keys fold (request id, token index) — NOT a global step counter — so a
+request's sampled stream is a pure function of (seed, prompt, params):
+batch composition, slot assignment and scheduler choice cannot change
+it. That invariant is what tests/test_scheduler.py pins end to end;
+here it is pinned at the sampler itself.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -11,6 +18,9 @@ CFG = ModelConfig(
     vocab=64, d_model=32, n_layers=1, n_heads=4, n_kv_heads=2, d_ff=64, max_seq=32
 )
 
+RIDS = jnp.arange(4, dtype=jnp.int32)
+CTRS = jnp.zeros((4,), jnp.int32)
+
 
 def logits_batch():
     return jax.random.normal(jax.random.PRNGKey(7), (4, 64)) * 3.0
@@ -18,14 +28,14 @@ def logits_batch():
 
 def test_temperature_zero_is_argmax():
     logits = logits_batch()
-    out = sample_tokens(logits, KEY, jnp.uint32(1), jnp.zeros((4,)),
+    out = sample_tokens(logits, KEY, RIDS, CTRS, jnp.zeros((4,)),
                         jnp.zeros((4,), jnp.int32))
     assert (out == jnp.argmax(logits, axis=-1)).all()
 
 
 def test_top_k_one_is_argmax_even_when_hot():
     logits = logits_batch()
-    out = sample_tokens(logits, KEY, jnp.uint32(1),
+    out = sample_tokens(logits, KEY, RIDS, CTRS,
                         jnp.full((4,), 5.0), jnp.ones((4,), jnp.int32))
     assert (out == jnp.argmax(logits, axis=-1)).all()
 
@@ -35,7 +45,7 @@ def test_top_k_restricts_support():
     k = 3
     top3 = jnp.argsort(-logits, axis=-1)[:, :k]
     for ctr in range(30):
-        out = sample_tokens(logits, KEY, jnp.uint32(ctr),
+        out = sample_tokens(logits, KEY, RIDS, jnp.full((4,), ctr, jnp.int32),
                             jnp.full((4,), 2.0), jnp.full((4,), k, jnp.int32))
         for row in range(4):
             assert int(out[row]) in top3[row].tolist()
@@ -45,22 +55,59 @@ def test_sampling_is_reproducible_and_varies_with_counter():
     logits = logits_batch()
     temps = jnp.full((4,), 1.5)
     topk = jnp.zeros((4,), jnp.int32)
-    a = sample_tokens(logits, KEY, jnp.uint32(3), temps, topk)
-    b = sample_tokens(logits, KEY, jnp.uint32(3), temps, topk)
-    assert (a == b).all()  # same key+counter -> same tokens
+    ctr3 = jnp.full((4,), 3, jnp.int32)
+    a = sample_tokens(logits, KEY, RIDS, ctr3, temps, topk)
+    b = sample_tokens(logits, KEY, RIDS, ctr3, temps, topk)
+    assert (a == b).all()  # same (rid, index) -> same tokens
     outs = {
-        tuple(sample_tokens(logits, KEY, jnp.uint32(c), temps, topk).tolist())
+        tuple(sample_tokens(logits, KEY, RIDS,
+                            jnp.full((4,), c, jnp.int32),
+                            temps, topk).tolist())
         for c in range(20)
     }
-    assert len(outs) > 1  # the counter actually advances the stream
+    assert len(outs) > 1  # the token index actually advances the stream
+
+
+def test_streams_differ_per_request_id():
+    """Two requests at the same token index draw from DIFFERENT key
+    streams — the rid is folded in, not just the index."""
+    logits = jnp.tile(logits_batch()[0], (4, 1))  # identical rows
+    temps = jnp.full((4,), 1.5)
+    topk = jnp.zeros((4,), jnp.int32)
+    cols = [
+        tuple(sample_tokens(logits, KEY, RIDS,
+                            jnp.full((4,), c, jnp.int32),
+                            temps, topk)[r].item() for c in range(16))
+        for r in range(4)
+    ]
+    assert len(set(cols)) > 1
+
+
+def test_row_position_does_not_change_the_draw():
+    """The draw depends only on (rid, index, logits row) — NOT on which
+    batch row (slot) the request occupies or who shares the batch. This
+    is the sampler-level form of schedule independence."""
+    logits = logits_batch()
+    temps = jnp.full((4,), 1.5)
+    topk = jnp.zeros((4,), jnp.int32)
+    ctr = jnp.full((4,), 5, jnp.int32)
+    full = sample_tokens(logits, KEY, RIDS, ctr, temps, topk)
+    perm = jnp.asarray([2, 0, 3, 1])
+    permuted = sample_tokens(logits[perm], KEY, RIDS[perm], ctr,
+                             temps, topk)
+    assert (permuted == full[perm]).all()
+    # Batch of one == the same row inside a batch of four.
+    solo = sample_tokens(logits[1:2], KEY, RIDS[1:2], ctr[:1],
+                         temps[:1], topk[:1])
+    assert int(solo[0]) == int(full[1])
 
 
 def test_mixed_greedy_and_sampled_slots():
     logits = logits_batch()
     temps = jnp.array([0.0, 5.0, 0.0, 5.0])
     greedy = jnp.argmax(logits, axis=-1)
-    out = sample_tokens(logits, KEY, jnp.uint32(9), temps,
-                        jnp.zeros((4,), jnp.int32))
+    out = sample_tokens(logits, KEY, RIDS, jnp.full((4,), 9, jnp.int32),
+                        temps, jnp.zeros((4,), jnp.int32))
     assert int(out[0]) == int(greedy[0])
     assert int(out[2]) == int(greedy[2])
 

@@ -1,0 +1,381 @@
+"""Chunked-prefill continuous-batching scheduler (serving.ServeConfig
+scheduler/prefill_chunk_budget/admit_lookahead).
+
+The load-bearing invariant: per-request token streams are a pure
+function of (seed, prompt, params) — sampling keys fold (request id,
+token index), so the sequential stop-the-world baseline and the
+interleaved scheduler emit BIT-IDENTICAL streams for every request,
+across dense/paged layouts and block/speculative decode modes, greedy
+and seeded sampling alike. That is what makes the scheduler rework
+provable rather than plausible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from tpumon.loadgen.model import ModelConfig
+from tpumon.loadgen.serving import ServeConfig, ServingEngine
+
+# float32 so every mode/schedule pair is bit-deterministic (the same
+# contract every other engine-identity test in this tree relies on).
+MODEL = ModelConfig(vocab=97, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq=96,
+                    compute_dtype="float32")
+
+# Arrival trace: chunked long prompts (prefill_len=8 -> up to 8
+# chunks), short prompts, a seeded-sampling request and a greedy one
+# landing together — the interleavings differ per scheduler, the
+# streams must not.
+TRACE = [
+    ([(7 * i + 3) % 97 for i in range(37)], 6, 0.0, 0),    # 5 chunks
+    ([5, 1, 88], 8, 0.0, 0),
+    ([(3 * i + 11) % 97 for i in range(21)], 5, 1.0, 8),   # sampled
+    ([9, 2, 6, 5], 7, 0.0, 0),
+    ([(11 * i + 2) % 97 for i in range(49)], 4, 0.0, 0),   # 7 chunks
+    ([4, 4, 2], 6, 0.7, 12),                               # sampled
+    ([8, 1, 8, 2, 8], 6, 0.0, 0),
+]
+
+
+def run_trace(**cfg_over) -> list[list[int]]:
+    eng = ServingEngine(ServeConfig(
+        model=MODEL, slots=cfg_over.pop("slots", 2), prefill_len=8,
+        **cfg_over), seed=5)
+    reqs = [eng.submit(p, max_new=mx, temperature=t, top_k=k)
+            for p, mx, t, k in TRACE]
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    return [r.output for r in reqs]
+
+
+class TestScheduleIndependence:
+    """Same seed + arrival trace => bit-identical per-request streams,
+    whatever the scheduler, layout, or decode mode."""
+
+    @pytest.fixture(scope="class")
+    def reference(self):
+        return run_trace(scheduler="sequential")
+
+    @pytest.mark.parametrize("over", [
+        dict(scheduler="interleaved"),
+        dict(scheduler="interleaved", prefill_chunk_budget=2),
+        dict(scheduler="interleaved", prefill_chunk_budget=7),
+        dict(scheduler="sequential", kv_layout="paged"),
+        dict(scheduler="interleaved", kv_layout="paged"),
+        dict(scheduler="interleaved", kv_layout="paged", pool_pages=17),
+        dict(scheduler="sequential", decode_block=4),
+        dict(scheduler="interleaved", decode_block=4),
+        dict(scheduler="interleaved", kv_layout="paged", decode_block=4),
+        dict(scheduler="sequential", spec_len=2),
+        dict(scheduler="interleaved", spec_len=2),
+        dict(scheduler="interleaved", kv_layout="paged", spec_len=2),
+    ], ids=lambda o: "-".join(f"{k}={v}" for k, v in o.items()))
+    def test_stream_matches_sequential_dense(self, reference, over):
+        assert run_trace(**over) == reference
+
+    def test_slot_count_does_not_change_streams(self, reference):
+        # More slots => completely different batch compositions and
+        # admission timing; the per-request streams stay put.
+        assert run_trace(scheduler="interleaved", slots=4) == reference
+        assert run_trace(scheduler="sequential", slots=4) == reference
+
+
+class TestInterleaving:
+    def test_decode_flows_while_long_prompt_prefills(self):
+        """The headline behavior: with budget=1, an active request
+        keeps emitting one token per step while a long prompt's chunks
+        trickle in — under the sequential baseline the same admission
+        runs all chunks inside one step (stop-the-world)."""
+        eng = ServingEngine(ServeConfig(
+            model=MODEL, slots=2, prefill_len=8, scheduler="interleaved"))
+        short = eng.submit([1, 2, 3], max_new=30)
+        eng.step()  # admit + first decode
+        assert short.ttft_s is not None
+        long_req = eng.submit([(5 * i) % 97 for i in range(48)], max_new=4)
+        for _ in range(5):  # 6 chunks: still prefilling for 5 steps
+            before = len(short.output)
+            eng.step()
+            assert long_req.ttft_s is None  # mid-prefill, budget 1
+            assert len(short.output) == before + 1  # decode flowed
+        eng.step()  # final chunk -> first token
+        assert long_req.ttft_s is not None
+        eng.drain()
+        assert short.done.is_set() and long_req.done.is_set()
+
+    def test_sequential_admission_is_stop_the_world(self):
+        eng = ServingEngine(ServeConfig(
+            model=MODEL, slots=2, prefill_len=8, scheduler="sequential"))
+        short = eng.submit([1, 2, 3], max_new=30)
+        eng.step()
+        long_req = eng.submit([(5 * i) % 97 for i in range(48)], max_new=4)
+        eng.step()  # whole 6-chunk prefill runs inline in this step
+        assert long_req.ttft_s is not None
+
+    def test_prefill_state_visible_in_metrics(self):
+        eng = ServingEngine(ServeConfig(
+            model=MODEL, slots=2, prefill_len=8, scheduler="interleaved"))
+        active = eng.submit([1, 2], max_new=20)
+        eng.step()
+        eng.submit([(5 * i) % 97 for i in range(48)], max_new=2)
+        eng.step()  # long assigned, mid-prefill
+        assert "tpumon_serving_slots_prefill 1" in eng.metrics_text()
+        eng.drain()
+        text = eng.metrics_text()
+        assert "tpumon_serving_slots_prefill 0" in text
+        # Per-request latency gauges appear once requests completed.
+        assert "tpumon_serving_ttft_p50_ms" in text
+        assert "tpumon_serving_ttft_p95_ms" in text
+        assert "tpumon_serving_tpot_p50_ms" in text  # active decoded >1
+        assert active.done.is_set()
+
+    def test_latency_gauges_distill(self):
+        from tpumon.collectors.serving import distill_serving_metrics
+
+        eng = ServingEngine(ServeConfig(model=MODEL, slots=2,
+                                        prefill_len=8))
+        eng.submit([3, 1, 4], max_new=6)
+        eng.drain()
+        d = distill_serving_metrics(eng.metrics_text())
+        assert d["ttft_p95_ms"] >= d["ttft_p50_ms"] > 0
+        assert d["tpot_p95_ms"] >= d["tpot_p50_ms"] > 0
+        assert d["slots_prefill"] == 0
+
+    def test_cancel_mid_prefill_releases_and_counts_cancelled(self):
+        eng = ServingEngine(ServeConfig(
+            model=MODEL, slots=2, prefill_len=8, scheduler="interleaved",
+            kv_layout="paged"))
+        free0 = eng.allocator.free_pages
+        blocker = eng.submit([1, 2], max_new=25)
+        eng.step()
+        victim = eng.submit([(5 * i) % 97 for i in range(48)], max_new=4)
+        eng.step()  # victim assigned, mid-prefill (pages reserved)
+        assert eng.allocator.free_pages < free0 - 4
+        victim.cancel()
+        eng.step()
+        assert victim.done.is_set() and victim.output == []
+        assert eng.cancelled_total == 1  # not a completion: no token out
+        blocker.cancel()
+        eng.drain()
+        assert eng.allocator.free_pages == free0
+
+
+class TestLookaheadAdmission:
+    """Paged admission lookahead: a request whose prefix is fully
+    cached (near-zero new pages) must not starve behind a page-blocked
+    head — but the head must not starve either (aging bound)."""
+
+    PREFIX = [7, 1, 8, 2, 8, 1, 8, 2]  # one chunk at prefill_len=8
+
+    def engine(self, lookahead=0, max_skips=8, pool_pages=12, slots=2):
+        return ServingEngine(ServeConfig(
+            model=MODEL, slots=slots, prefill_len=8, kv_layout="paged",
+            pool_pages=pool_pages, prefix_cache_entries=4,
+            scheduler="sequential", admit_lookahead=lookahead,
+            admit_max_skips=max_skips))
+
+    def seed_prefix(self, eng):
+        r = eng.submit(self.PREFIX + [3, 3], max_new=2)
+        eng.drain()
+        assert r.done.is_set()
+        return r
+
+    def hog_and_head(self, eng):
+        """Occupy most of the pool with a long-running request, then
+        queue a head that cannot reserve."""
+        hog = eng.submit([(3 * i) % 97 for i in range(30)], max_new=40)
+        eng.step()
+        assert hog.ttft_s is not None
+        head = eng.submit([(11 * i + 1) % 97 for i in range(30)],
+                          max_new=40)
+        eng.step()
+        assert head.ttft_s is None  # blocked on pages
+        return hog, head
+
+    def test_fifo_blocks_cached_candidate_without_lookahead(self):
+        eng = self.engine(lookahead=0)
+        self.seed_prefix(eng)
+        hog, head = self.hog_and_head(eng)
+        cand = eng.submit(self.PREFIX + [9, 9], max_new=1)
+        for _ in range(6):
+            eng.step()
+        assert cand.ttft_s is None  # strict FIFO: waits behind the head
+        hog.cancel()
+        eng.drain()
+        assert head.done.is_set() and cand.done.is_set()
+
+    def test_lookahead_admits_cached_candidate_past_blocked_head(self):
+        eng = self.engine(lookahead=2)
+        self.seed_prefix(eng)
+        hog, head = self.hog_and_head(eng)
+        cand = eng.submit(self.PREFIX + [9, 9], max_new=1)
+        for _ in range(6):
+            eng.step()
+        assert cand.done.is_set()  # jumped the page-blocked head
+        assert head.ttft_s is None
+        assert eng._head_skips == 1
+        hog.cancel()
+        eng.drain()
+        assert head.done.is_set()
+        assert eng._head_skips == 0  # head admission resets the age
+
+    def test_aged_head_is_force_next_under_sustained_hits(self):
+        """Sustained prefix-hit traffic keeps jumping the queue — but
+        only admit_max_skips times; then the window collapses to the
+        head until it admits (nothing starves)."""
+        eng = self.engine(lookahead=4, max_skips=2)
+        self.seed_prefix(eng)
+        hog, head = self.hog_and_head(eng)
+        cands = [eng.submit(self.PREFIX + [9, i], max_new=1)
+                 for i in range(5)]
+        for _ in range(20):
+            eng.step()
+        served_early = [c for c in cands if c.done.is_set()]
+        assert len(served_early) == 2  # the aging bound, exactly
+        assert eng._head_skips == 2
+        hog.cancel()
+        eng.drain()
+        # Head admitted before the remaining candidates (sequential
+        # scheduler: admission order == TTFT order).
+        assert head.done.is_set()
+        late = [c for c in cands if c not in served_early]
+        assert all(c.done.is_set() for c in late)
+        assert all(head.ttft_s < c.ttft_s for c in late)
+
+    def test_cancelled_aged_head_does_not_poison_successor(self):
+        """An aged-out head that gets cancelled must not bequeath its
+        suspended lookahead window to the next head — the skip count is
+        pinned to the head's request id and resets on succession."""
+        eng = self.engine(lookahead=4, max_skips=2)
+        self.seed_prefix(eng)
+        hog, head = self.hog_and_head(eng)
+        head2 = eng.submit([(13 * i + 2) % 97 for i in range(30)],
+                           max_new=40)  # blocked too, right behind head
+        first = [eng.submit(self.PREFIX + [9, i], max_new=1)
+                 for i in range(2)]
+        for _ in range(8):
+            eng.step()
+        assert all(c.done.is_set() for c in first)
+        assert eng._head_skips == 2  # head aged out
+        head.cancel()
+        eng.step()  # purge; head2 takes the head slot with a fresh age
+        second = [eng.submit(self.PREFIX + [8, i], max_new=1)
+                  for i in range(2)]
+        for _ in range(8):
+            eng.step()
+        assert all(c.done.is_set() for c in second)  # window restored
+        assert head2.ttft_s is None
+        hog.cancel()
+        eng.drain()
+        assert head2.done.is_set()
+
+    def test_head_eviction_cannot_evict_its_own_prefix(self):
+        """Freeing pages FOR the queue head must not evict the prefix
+        the head is about to share, even when that entry is the LRU one
+        — the pre-scheduler lookup-first admission protected it via
+        retain+LRU-touch; the peek-based scheduler protects it by name
+        (PagePrefixCache.evict_one(protect=...))."""
+        # Pool: 1 trash + 9 usable. Two cached prefixes pin 1 page
+        # each; a filler request then occupies the rest, so admitting a
+        # prefix-sharing head forces an eviction.
+        eng = self.engine(pool_pages=10, slots=2)
+        self.seed_prefix(eng)                       # PREFIX entry (LRU-first)
+        other = [9, 9, 9, 9, 9, 9, 9, 9]
+        r2 = eng.submit(other + [1, 1], max_new=2)  # second entry (MRU)
+        eng.drain()
+        assert r2.done.is_set() and eng.prefix_cache.entries == 2
+        # Filler reserves all 7 remaining pages (20+36 rows -> 7 pages).
+        filler = eng.submit([(3 * i) % 97 for i in range(20)], max_new=36)
+        eng.step()
+        assert filler.ttft_s is not None
+        assert eng.allocator.free_pages == 0
+        hits0 = eng.prefix_cache.hits
+        # Head shares PREFIX (the LRU entry): needs one page beyond its
+        # shared chunk, so an eviction must free it — the OTHER entry
+        # must go, not the head's own.
+        head = eng.submit(self.PREFIX + [5] * 4, max_new=2)
+        eng.step()
+        assert head.ttft_s is not None  # admitted (eviction freed pages)
+        assert eng.prefix_cache.hits == hits0 + 1  # the hit survived
+        assert tuple(self.PREFIX) in eng.prefix_cache._store
+        filler.cancel()
+        eng.drain()
+
+    def test_lookahead_streams_are_schedule_independent(self):
+        """Queue-jumping changes admission ORDER, never streams."""
+        outs = {}
+        for la in (0, 2):
+            eng = self.engine(lookahead=la, pool_pages=12)
+            self.seed_prefix(eng)
+            hog, head = self.hog_and_head(eng)
+            cand = eng.submit(self.PREFIX + [9, 9], max_new=4)
+            for _ in range(4):
+                eng.step()
+            hog.cancel()
+            eng.drain()
+            outs[la] = (head.output, cand.output)
+        assert outs[0] == outs[2]
+
+
+class TestPeek:
+    def test_page_prefix_peek_is_side_effect_free(self):
+        from tpumon.loadgen.paged_kv import PageAllocator, PagePrefixCache
+
+        alloc = PageAllocator(8)
+        pc = PagePrefixCache(chunk=4, allocator=alloc, max_entries=4)
+        pages = alloc.alloc(3)
+        prompt = list(range(10))  # strict prefix = 8 tokens = 2 pages
+        pc.store(prompt, pages)
+        free_before = alloc.free_pages
+        m, shared = pc.peek(prompt)
+        assert m == 8 and shared == pages[:2]
+        # No retain, no counters, no LRU churn — probe leaves no trace.
+        assert alloc.free_pages == free_before
+        assert alloc._refs[pages[0]] == 2  # store's pin only
+        assert pc.hits == 0 and pc.misses == 0 and pc.saved_tokens == 0
+        assert pc.peek([55, 66, 77, 88, 99]) == (0, [])
+        assert pc.misses == 0
+        # The real lookup still counts and retains.
+        m2, shared2 = pc.lookup(prompt)
+        assert (m2, shared2) == (m, shared)
+        assert pc.hits == 1 and alloc._refs[pages[0]] == 3
+
+    def test_dense_prefix_peek_matches_restore_probe(self):
+        eng = ServingEngine(ServeConfig(
+            model=MODEL, slots=2, prefill_len=8, prefix_cache_entries=4))
+        prompt = [7, 1, 8, 2, 8, 1, 8, 2, 5, 5]
+        eng.submit(prompt, max_new=2)
+        eng.drain()
+        pc = eng.prefix_cache
+        hits, misses = pc.hits, pc.misses
+        assert pc.peek(prompt) == 8
+        assert pc.peek([1, 2, 3]) == 0
+        assert (pc.hits, pc.misses) == (hits, misses)
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize("over,msg", [
+        (dict(scheduler="bogus"), "scheduler"),
+        (dict(prefill_chunk_budget=0), "prefill_chunk_budget"),
+        (dict(admit_lookahead=-1), "admit_lookahead"),
+        (dict(admit_lookahead=2), "paged"),  # dense never blocks
+        (dict(admit_max_skips=0), "admit_max_skips"),
+    ])
+    def test_rejected(self, over, msg):
+        with pytest.raises(ValueError, match=msg):
+            ServingEngine(ServeConfig(model=MODEL, **over))
+
+    def test_start_background_passthrough(self):
+        from tpumon.loadgen.serving import start_background
+
+        eng, url, stop = start_background(
+            rps=0.0, scheduler="sequential", prefill_budget=3,
+            admit_lookahead=2, kv_layout="paged")
+        try:
+            assert eng.cfg.scheduler == "sequential"
+            assert eng.cfg.prefill_chunk_budget == 3
+            assert eng.cfg.admit_lookahead == 2
+        finally:
+            stop.set()

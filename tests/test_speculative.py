@@ -179,8 +179,12 @@ class TestSpeculativeEngine:
             model=SMALL, slots=2, prefill_len=8, spec_len=3))
         greedy = eng.submit([3, 1, 4, 1], max_new=20)
         # Force plain fallbacks directly, then let spec rounds resume.
+        # (Admission assigns + chunk-prefills; fallbacks only make
+        # sense for slots that finished prefill — drain it first.)
         for _ in range(4):
             eng._admit()
+            for s in range(eng.cfg.slots):
+                eng._drain_prefill_slot(s)
             active = [s for s in range(eng.cfg.slots) if eng._slots[s]]
             eng._plain_step(active)
         assert eng._draft_pos[0] < eng._host_positions[0]  # hole exists

@@ -277,6 +277,9 @@ def main(argv: list[str] | None = None) -> int:
     loadgen_kv_dtype = "compute"
     loadgen_paged_attn = "gather"
     loadgen_spec_source = "draft"
+    loadgen_scheduler = "interleaved"
+    loadgen_prefill_budget = 1
+    loadgen_admit_lookahead = 0
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -366,6 +369,22 @@ def main(argv: list[str] | None = None) -> int:
             # --loadgen-spec-len).
             loadgen_spec_source = take(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-scheduler":
+            # "interleaved" (chunked-prefill continuous batching,
+            # default) | "sequential" (stop-the-world admission — the
+            # bench baseline).
+            loadgen_scheduler = take(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-prefill-budget":
+            # Prefill chunk dispatches per engine step under the
+            # interleaved scheduler (ServeConfig.prefill_chunk_budget).
+            loadgen_prefill_budget = take_int(arg)
+            serve_loadgen = True
+        elif arg == "--loadgen-admit-lookahead":
+            # Paged admission lookahead window past a page-blocked
+            # queue head (0 = strict FIFO; aging-bounded).
+            loadgen_admit_lookahead = take_int(arg)
+            serve_loadgen = True
         elif arg == "--peers":
             # Comma-separated peer tpumon instances to federate
             # (docs/perf.md; also TPUMON_PEERS / config "peers").
@@ -425,6 +444,9 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-kv-dtype compute|int8] "
                 "[--loadgen-paged-attn gather|kernel] "
                 "[--loadgen-spec-source draft|prompt] "
+                "[--loadgen-scheduler interleaved|sequential] "
+                "[--loadgen-prefill-budget N] "
+                "[--loadgen-admit-lookahead N] "
                 "[--peers host:port,...] [--peer-fanout N] "
                 "[--federate-up http://agg:8888] "
                 "[--federation-role leaf|aggregator|root] "
@@ -472,6 +494,9 @@ def main(argv: list[str] | None = None) -> int:
                 decode_block=loadgen_block, kv_dtype=loadgen_kv_dtype,
                 paged_attn=loadgen_paged_attn,
                 spec_source=loadgen_spec_source,
+                scheduler=loadgen_scheduler,
+                prefill_budget=loadgen_prefill_budget,
+                admit_lookahead=loadgen_admit_lookahead,
             )
         except ValueError as e:  # uncomposable/unknown engine options
             print(f"--serve-loadgen: {e}", file=sys.stderr)

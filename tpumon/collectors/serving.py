@@ -120,6 +120,22 @@ def distill_serving_metrics(
     tpot = _histogram_p(by_name, TPOT_HISTOGRAMS, 0.5)
     if tpot:
         out["tpot_p50_ms"] = tpot[1] * 1e3
+    # Engine-native per-request quantile gauges (tpumon.loadgen.serving
+    # metrics_text): recent-window TTFT/TPOT p50/p95 plus the scheduler
+    # state — queue depth is above via QUEUE_GAUGES; in-prefill slots
+    # are the interleaved scheduler's "admitted, not yet decoding"
+    # count. Gauges win over the histogram-derived quantiles when both
+    # are present (exact per-request sorts beat bucket interpolation).
+    for metric, field_name in (
+        ("tpumon_serving_ttft_p50_ms", "ttft_p50_ms"),
+        ("tpumon_serving_ttft_p95_ms", "ttft_p95_ms"),
+        ("tpumon_serving_tpot_p50_ms", "tpot_p50_ms"),
+        ("tpumon_serving_tpot_p95_ms", "tpot_p95_ms"),
+        ("tpumon_serving_slots_prefill", "slots_prefill"),
+    ):
+        got = _sum_samples(by_name, (metric,))
+        if got:
+            out[field_name] = got[1]
 
     tokens = _sum_samples(by_name, TOKEN_COUNTERS)
     if tokens:

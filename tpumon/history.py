@@ -56,6 +56,11 @@ PROM_QUERIES: dict[str, str] = {
     "throttle_max": "max(tpu_throttle_score)",
     "tokens_per_sec": "sum(tpumon_serving_tokens_per_sec)",
     "ttft_p50_ms": "avg(tpumon_serving_ttft_p50_ms)",
+    # Scheduler pressure (docs/perf.md "Continuous-batching scheduler"):
+    # waiting requests across targets, and the worst per-request decode
+    # cadence — the SLO-soak inputs for the serving alert layer.
+    "queue_depth": "sum(jetstream_queue_size)",
+    "tpot_p95_ms": "max(tpumon_serving_tpot_p95_ms)",
     # The `> 0` clause drops idle samples instead of producing 0/0
     # NaN points (which would serialize as invalid JSON).
     "spec_accept_pct": (

@@ -339,21 +339,22 @@ def paged_decode_block(cfg, params: dict, pool: dict, tokens: jax.Array,
 def paged_decode_rounds(cfg, params: dict, pool: dict,
                         last_tokens: jax.Array, positions: jax.Array,
                         tables: jax.Array, base_key: jax.Array,
-                        ctr0: jax.Array, temps: jax.Array,
-                        topks: jax.Array, steps: int):
+                        rids: jax.Array, ctr0: jax.Array,
+                        temps: jax.Array, topks: jax.Array, steps: int):
     """``steps`` (paged_decode_step -> sample) pairs in ONE dispatch —
-    the paged twin of serving.decode_rounds. Tables are loop-invariant:
-    pages are reserved for the whole request at admission, and trailing
-    table entries point at the permanent trash page, so a block that
-    overshoots a request's reserved rows writes harmlessly (the same
-    guard that protects freed slots). Returns
-    (pool, last_tokens, positions, tokens [B, steps])."""
+    the paged twin of serving.decode_rounds (rids/ctr0 carry each
+    request's (id, next token index) for the schedule-independent
+    sampling keys). Tables are loop-invariant: pages are reserved for
+    the whole request at admission, and trailing table entries point at
+    the permanent trash page, so a block that overshoots a request's
+    reserved rows writes harmlessly (the same guard that protects freed
+    slots). Returns (pool, last_tokens, positions, tokens [B, steps])."""
     from tpumon.loadgen.serving import sample_tokens
 
     def body(carry, _):
         pool, last, pos, ctr = carry
         pool, logits = paged_decode_step(cfg, params, pool, last, pos, tables)
-        nxt = sample_tokens(logits, base_key, ctr, temps, topks)
+        nxt = sample_tokens(logits, base_key, rids, ctr, temps, topks)
         pos = jnp.minimum(pos + 1, cfg.model.max_seq - 1)
         return (pool, nxt, pos, ctr + 1), nxt
 
@@ -395,25 +396,38 @@ class PagePrefixCache:
         self.saved_tokens = 0
         self.page_bytes = 0  # set by the engine (pool row bytes / page)
 
+    def peek(self, prompt: list[int]) -> tuple[int, list[int]]:
+        """Side-effect-free ``lookup``: (prefix_len, shared_pages) for
+        the longest cached chunk-aligned strict prefix, WITHOUT
+        retaining pages, touching the LRU order, or counting a hit or
+        miss. The admission scheduler probes with this (a page-blocked
+        queue head is re-probed every step — probes must leave no
+        trace); ``lookup`` runs only when the admission actually
+        happens. (0, []) on miss."""
+        n = len(prompt)
+        m = ((n - 1) // self.chunk) * self.chunk
+        while m >= self.chunk:
+            pages = self._store.get(tuple(prompt[:m]))
+            if pages is not None:
+                return m, list(pages)
+            m -= self.chunk
+        return 0, []
+
     def lookup(self, prompt: list[int]) -> tuple[int, list[int]]:
         """(prefix_len, shared_pages) for the longest cached
         chunk-aligned strict prefix; retains the pages for the caller
         (who must release them — normally at request completion).
-        (0, []) on miss."""
-        n = len(prompt)
-        m = ((n - 1) // self.chunk) * self.chunk
-        while m >= self.chunk:
-            key = tuple(prompt[:m])
-            pages = self._store.get(key)
-            if pages is not None:
-                self._store.move_to_end(key)
-                self.allocator.retain(pages)
-                self.hits += 1
-                self.saved_tokens += m
-                return m, list(pages)
-            m -= self.chunk
-        self.misses += 1
-        return 0, []
+        ``peek`` plus the accounting: LRU touch, page retain, hit/miss
+        and saved-token counters. (0, []) on miss."""
+        m, pages = self.peek(prompt)
+        if not m:
+            self.misses += 1
+            return 0, []
+        self._store.move_to_end(tuple(prompt[:m]))
+        self.allocator.retain(pages)
+        self.hits += 1
+        self.saved_tokens += m
+        return m, pages
 
     def store(self, prompt: list[int], pages: list[int]) -> None:
         """Pin the chunk-aligned strict prefix's pages (``pages`` is
@@ -433,14 +447,21 @@ class PagePrefixCache:
         while len(self._store) > self.max_entries:
             self.evict_one()
 
-    def evict_one(self) -> bool:
-        """Drop the LRU entry (its pages free once no live request
-        shares them); False when empty."""
-        if not self._store:
-            return False
-        _, pages = self._store.popitem(last=False)
-        self.allocator.release(pages)
-        return True
+    def evict_one(self, protect: tuple | None = None) -> bool:
+        """Drop the least-recently-used entry (its pages free once no
+        live request shares them); False when nothing evictable.
+        ``protect`` names one key that must survive — the admission
+        scheduler passes the queue head's own peeked prefix so freeing
+        pages FOR the head can't evict the very prefix it is about to
+        share (the old lookup-first admission protected it by retaining
+        + LRU-touching; the side-effect-free probe protects it by
+        name)."""
+        for key in self._store:
+            if key != protect:
+                pages = self._store.pop(key)
+                self.allocator.release(pages)
+                return True
+        return False
 
     @property
     def entries(self) -> int:

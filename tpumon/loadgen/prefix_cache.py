@@ -84,6 +84,14 @@ class PrefixCache:
             m -= self.chunk
         return 0
 
+    def peek(self, prompt: list[int]) -> int:
+        """Side-effect-free probe: the cached chunk-aligned strict
+        prefix length ``restore`` would serve, without touching LRU
+        order or the hit/miss counters (``cached_prefix_len`` is
+        already side-effect-free; this is the name the scheduler's
+        probe contract uses across both cache kinds)."""
+        return self.cached_prefix_len(prompt)
+
     def restore(self, cache: dict, prompt: list[int], slot) -> int:
         """If a prefix of ``prompt`` is cached, write it into ``slot``
         (mutating ``cache`` in place) and return its length, else 0."""

@@ -26,8 +26,16 @@ TPU-first design:
 - sampling defaults to greedy (argmax), keeping the engine deterministic
   for the correctness tests (decode must reproduce full-forward logits);
   per-request temperature / top-k sampling runs on device in the same
-  dispatch (``sample_tokens``: top-k mask + categorical, keyed by one
-  base seed + step counter, so sampled runs are reproducible too).
+  dispatch (``sample_tokens``: top-k mask + categorical, keyed per
+  (request id, token index) off one base seed — every request's token
+  stream is a pure function of (seed, prompt, params), independent of
+  scheduling, slot assignment, and batch composition);
+- admission is an interleaved chunked-prefill scheduler
+  (``ServeConfig.scheduler``): each step spends at most
+  ``prefill_chunk_budget`` prefill chunk dispatches before the decode
+  batch, so long prompts admit over many steps while active slots keep
+  emitting tokens (``scheduler="sequential"`` keeps the stop-the-world
+  baseline the bench's serving_concurrency phase compares against).
 """
 
 from __future__ import annotations
@@ -147,6 +155,31 @@ class ServeConfig:
     # are no longer bit-identical to the bf16 cache). Dense single-
     # device engine; composes with decode_block and int8 weights.
     kv_dtype: str = "compute"
+    # Admission scheduler. "interleaved" (default, Sarathi-style chunked
+    # prefill): each step() runs at most ``prefill_chunk_budget`` prefill
+    # chunk dispatches before the decode batch, so a long prompt admits
+    # over many steps while every active slot keeps emitting tokens.
+    # "sequential" is the stop-the-world baseline: a request's ENTIRE
+    # chunked prefill runs inline at admission, stalling decode for the
+    # full prompt length (the prefill/decode interference the bench's
+    # serving_concurrency phase measures). Token streams are identical
+    # either way — sampling is keyed per (request id, token index), so a
+    # request's stream is a pure function of (seed, prompt, params)
+    # regardless of scheduling.
+    scheduler: str = "interleaved"
+    # Prefill chunk dispatches spent per step() under the interleaved
+    # scheduler (round-robin over in-prefill slots; draft-model prefill
+    # chunks count too). Higher = lower prefill latency, more decode
+    # stall per step. Ignored by scheduler="sequential".
+    prefill_chunk_budget: int = 1
+    # Paged admission lookahead (0 = strict FIFO): when the queue head's
+    # page reservation fails, probe up to this many following requests
+    # and admit the first whose reservation succeeds — a fully-cached
+    # prefix (zero new pages) must not wait behind a page-starved head.
+    # Bounded by ``admit_max_skips``: after that many queue-jumps the
+    # head is force-next (lookahead suspends) so nothing starves.
+    admit_lookahead: int = 0
+    admit_max_skips: int = 8
 
 
 # ---------------------------------------------------------------------------
@@ -362,7 +395,7 @@ def decode_step(cfg: ServeConfig, params: dict, cache: dict,
 
 def decode_rounds(cfg: ServeConfig, params: dict, cache: dict,
                   last_tokens: jax.Array, positions: jax.Array,
-                  base_key: jax.Array, ctr0: jax.Array,
+                  base_key: jax.Array, rids: jax.Array, ctr0: jax.Array,
                   temps: jax.Array, topks: jax.Array, steps: int
                   ) -> tuple[dict, jax.Array, jax.Array, jax.Array]:
     """``steps`` greedy/sampled decode steps fused into ONE dispatch.
@@ -371,10 +404,12 @@ def decode_rounds(cfg: ServeConfig, params: dict, cache: dict,
     execution backends, cache re-shipping) per token; scanning the
     (decode_step -> sample_tokens) pair inside jit pays it once per
     block — the same fusion idea as speculative verify, but for plain
-    decode. Sampling matches the per-step path: the PRNG counter
-    advances by one per in-block step, and greedy (temp<=0) rows are
-    pure argmax, so a block of greedy decode emits exactly the
-    per-step tokens.
+    decode. Sampling matches the per-step path exactly: rids [B] and
+    ctr0 [B] carry each request's (id, next token index), the index
+    advances by one per in-block step, and the key is a pure function
+    of (request, index) — so blocked decode emits the per-step stream
+    even when a mid-block completion discards the tail (discarded
+    indices are simply never re-used by that request).
 
     Returns (cache, last_tokens, positions, tokens [B, steps]).
     """
@@ -382,7 +417,7 @@ def decode_rounds(cfg: ServeConfig, params: dict, cache: dict,
     def body(carry, _):
         cache, last, pos, ctr = carry
         cache, logits = decode_step(cfg, params, cache, last, pos)
-        nxt = sample_tokens(logits, base_key, ctr, temps, topks)
+        nxt = sample_tokens(logits, base_key, rids, ctr, temps, topks)
         pos = jnp.minimum(pos + 1, cfg.model.max_seq - 1)
         return (cache, nxt, pos, ctr + 1), nxt
 
@@ -449,11 +484,12 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
 
     _rounds = jax.jit(
         partial(decode_rounds, cfg),
-        in_shardings=(shardings, cache_sh, rep, rep, rep, rep, rep, rep),
+        in_shardings=(shardings, cache_sh, rep, rep, rep, rep, rep, rep,
+                      rep),
         out_shardings=(cache_sh, rep, rep, rep),
         # static_argnums, not argnames: pjit with in_shardings rejects
         # kwargs, so steps is passed positionally below.
-        static_argnums=(8,),
+        static_argnums=(9,),
         donate_argnums=(1,),
     )
 
@@ -465,10 +501,10 @@ def make_sharded_serving(cfg: ServeConfig, mesh, params: dict):
     def decode_fn(cache, last_tokens, positions):
         return _dec(placed, cache, last_tokens, positions)
 
-    def rounds_fn(cache, last_tokens, positions, base_key, ctr0,
+    def rounds_fn(cache, last_tokens, positions, base_key, rids, ctr0,
                   temps, topks, steps):
         return _rounds(placed, cache, last_tokens, positions,
-                       base_key, ctr0, temps, topks, steps)
+                       base_key, rids, ctr0, temps, topks, steps)
 
     placed_cache = jax.device_put(init_cache(cfg), cache_sh)
     return prefill_fn, decode_fn, placed, placed_cache, rounds_fn
@@ -488,6 +524,7 @@ class Request:
     temperature: float = 0.0  # 0 = greedy (deterministic)
     top_k: int = 0  # 0 = full vocab
     ttft_s: float | None = None
+    first_tok_t: float | None = None  # monotonic at first emit (TPOT)
     output: list[int] = field(default_factory=list)
     done: threading.Event = field(default_factory=threading.Event)
     # Streaming: tokens are pushed here as they are emitted (None = end
@@ -520,24 +557,51 @@ class Request:
             self.stream.put(None)
 
 
+@dataclass
+class _PrefillWork:
+    """Per-slot chunked-prefill progress (the interleaved scheduler's
+    unit of preemption): which chunk runs next, how far the draft
+    model's own prefill got, and the final chunk's logits once
+    produced. A slot holding one is occupied but not yet decoding."""
+
+    req: Request
+    n: int                      # prompt length (tokens)
+    next_c0: int                # next target chunk's start row
+    draft_c0: int = 0           # next draft chunk's start row (spec)
+    logits: jax.Array | None = None   # final-chunk logits
+    pages: list[int] | None = None    # paged: full reservation
+    shared_n: int = 0           # paged: chunks served from shared pages
+    table_row: jax.Array | None = None  # paged: this slot's table
+
+
 @jax.jit
-def sample_tokens(logits: jax.Array, base_key: jax.Array, ctr: jax.Array,
-                  temps: jax.Array, topk: jax.Array) -> jax.Array:
+def sample_tokens(logits: jax.Array, base_key: jax.Array, rids: jax.Array,
+                  ctrs: jax.Array, temps: jax.Array,
+                  topk: jax.Array) -> jax.Array:
     """Per-slot token selection on device, one dispatch for the batch.
 
-    logits [B, V]; temps [B] (<=0 -> greedy argmax, the default); topk [B]
+    logits [B, V]; rids [B] int32 request ids; ctrs [B] int32 per-request
+    token indices; temps [B] (<=0 -> greedy argmax, the default); topk [B]
     (0 -> full vocab). Top-k keeps each row's k highest logits, then
-    temperature-scaled categorical sampling. The PRNG key folds a host
-    step counter into one base key, so a run is reproducible per seed.
+    temperature-scaled categorical sampling.
+
+    Each row's PRNG key folds (request id, token index) into the base
+    key — NOT a global step counter — so a request's sampled stream is a
+    pure function of (seed, prompt, params): independent of scheduler
+    choice, slot assignment, batch composition, and how requests
+    interleave. This is the invariant that makes sequential and
+    interleaved scheduling token-identical (tests/test_scheduler.py).
     """
     v = logits.shape[-1]
-    key = jax.random.fold_in(base_key, ctr)
+    keys = jax.vmap(
+        lambda r, c: jax.random.fold_in(jax.random.fold_in(base_key, r), c)
+    )(rids, ctrs)
     sorted_desc = -jnp.sort(-logits, axis=-1)
     k_idx = jnp.clip(jnp.where(topk > 0, topk, v) - 1, 0, v - 1)
     thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
     masked = jnp.where(logits >= thresh, logits, -1e30)
     scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
@@ -624,6 +688,25 @@ class ServingEngine:
         if self.cfg.decode_block < 1:
             raise ValueError(
                 f"decode_block must be >= 1, got {self.cfg.decode_block}")
+        if self.cfg.scheduler not in ("interleaved", "sequential"):
+            raise ValueError(f"unknown scheduler {self.cfg.scheduler!r}")
+        if self.cfg.prefill_chunk_budget < 1:
+            raise ValueError(
+                f"prefill_chunk_budget must be >= 1, got "
+                f"{self.cfg.prefill_chunk_budget}")
+        if self.cfg.admit_lookahead < 0:
+            raise ValueError(
+                f"admit_lookahead must be >= 0, got "
+                f"{self.cfg.admit_lookahead}")
+        if self.cfg.admit_lookahead and self.cfg.kv_layout != "paged":
+            raise ValueError(
+                "admit_lookahead requires kv_layout='paged' (dense "
+                "admission never blocks on pages, so the lookahead "
+                "window would silently do nothing)")
+        if self.cfg.admit_max_skips < 1:
+            raise ValueError(
+                f"admit_max_skips must be >= 1, got "
+                f"{self.cfg.admit_max_skips}")
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
         if self.cfg.paged_attn not in ("gather", "kernel"):
@@ -705,9 +788,9 @@ class ServingEngine:
                 lambda _params, cache, last, positions:
                 dec_fn(cache, last, positions))
             self._decode_rounds = (
-                (lambda _params, cache, last, positions, key, ctr,
+                (lambda _params, cache, last, positions, key, rids, ctr,
                  temps, topks, steps:
-                 rounds_fn(cache, last, positions, key, ctr,
+                 rounds_fn(cache, last, positions, key, rids, ctr,
                            temps, topks, steps))
                 if self.cfg.decode_block > 1 else None)
         else:
@@ -876,8 +959,26 @@ class ServingEngine:
         self.temps = jnp.zeros((self.cfg.slots,), jnp.float32)
         self.topks = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._sample_key = jax.random.PRNGKey(seed ^ 0x7A11)
-        self._sample_ctr = 0
+        # Per-slot sampling identity: the occupying request's id and its
+        # next token index (== len(req.output) while decoding). Together
+        # with _sample_key these fully determine every sampled token —
+        # sample_tokens keys per (rid, index), never per engine step.
+        self.rids = jnp.zeros((self.cfg.slots,), jnp.int32)
+        self.tok_ctrs = jnp.zeros((self.cfg.slots,), jnp.int32)
         self._slots: list[Request | None] = [None] * self.cfg.slots
+        # In-flight chunked-prefill state per slot (interleaved
+        # scheduler): a slot with a _PrefillWork is occupied but not yet
+        # decoding — excluded from decode batches until its final chunk
+        # yields first-token logits.
+        self._prefill_work: list[_PrefillWork | None] = (
+            [None] * self.cfg.slots)
+        self._prefill_rr = 0  # round-robin cursor over in-prefill slots
+        # Lookahead aging (guarded by _lock): how often the CURRENT
+        # queue head has been jumped. _head_rid pins the count to one
+        # request, so a cancelled/purged head can't bequeath its aged
+        # state to an innocent successor.
+        self._head_skips = 0
+        self._head_rid = -1
         self._queue: deque[Request] = deque()
         self.max_queue = max_queue
         self._rid = itertools.count()
@@ -892,6 +993,12 @@ class ServingEngine:
         self._ttft_counts = [0] * len(TTFT_BUCKETS_S)
         self._ttft_inf = 0
         self._ttft_sum = 0.0
+        # Recent per-request latency windows for the p50/p95 gauges
+        # (tracing.quantiles over a bounded deque — the same single-sort
+        # summary the monitor's own SourceStats use). TPOT = decode
+        # seconds per output token after the first.
+        self._ttft_recent: deque[float] = deque(maxlen=512)
+        self._tpot_recent: deque[float] = deque(maxlen=512)
         # Optional tpumon.loadgen.report.WorkloadReporter: when attached,
         # step() time counts as declared device activity (source:
         # workload in the monitor's counter chain).
@@ -968,18 +1075,18 @@ class ServingEngine:
             _rounds = jax.jit(
                 partial(paged_decode_rounds, self.cfg),
                 in_shardings=(shardings, pool_sh,
-                              rep, rep, rep, rep, rep, rep, rep),
+                              rep, rep, rep, rep, rep, rep, rep, rep),
                 out_shardings=(pool_sh, rep, rep, rep),
                 # static_argnums, not argnames: pjit with in_shardings
                 # rejects kwargs; the engine passes steps= by keyword,
-                # so adapt positionally. steps is arg index 9 after
+                # so adapt positionally. steps is arg index 10 after
                 # partial(cfg): params, pool, last, positions, tables,
-                # key, ctr, temps, topks, steps.
-                static_argnums=(9,), donate_argnums=(1,))
+                # key, rids, ctr, temps, topks, steps.
+                static_argnums=(10,), donate_argnums=(1,))
             self._decode_rounds = (
-                lambda params, pool, last, pos, tables, key, ctr,
+                lambda params, pool, last, pos, tables, key, rids, ctr,
                 temps, topks, steps:
-                _rounds(params, pool, last, pos, tables, key, ctr,
+                _rounds(params, pool, last, pos, tables, key, rids, ctr,
                         temps, topks, steps))
         if self.spec_len and self.cfg.spec_source == "prompt":
             from tpumon.loadgen.paged_kv import paged_decode_block as _pdb
@@ -1076,6 +1183,7 @@ class ServingEngine:
         else:
             self._ttft_inf += 1
         self._ttft_sum += dt_s
+        self._ttft_recent.append(dt_s)
 
     def _pages_needed(self, req: Request) -> int:
         """Worst-case page reservation: KV rows 0..prompt+max_new-1,
@@ -1106,130 +1214,240 @@ class ServingEngine:
             self._tables_dev = jnp.asarray(self._tables_host, jnp.int32)
             self._tables_dirty = False
 
-    def _draft_prefill_prompt(self, slot: int, req: "Request") -> None:
-        """Prefill the draft's dense cache with the FULL prompt (the
-        draft cache is unshared, so prefix-shared target chunks still
-        need draft K/V; draft prefill is cheap — the draft is shallow).
-        No-op for prompt-lookup proposals (no draft cache exists)."""
-        if self.cfg.spec_source == "prompt":
-            return
+    def _reserve_next_locked(self) -> tuple[Request, list, int] | None:
+        """Pick the next admissible queued request (caller holds the
+        lock; paged only): probe the head, then — bounded lookahead —
+        up to ``admit_lookahead`` requests behind it, admitting the
+        first whose page reservation succeeds. Probes use the prefix
+        cache's side-effect-free ``peek``; the hit/miss/retain
+        accounting (``lookup``) runs only for the request actually
+        admitted, so a blocked head re-probed every step leaves no
+        counter trace. Aging: every queue-jump past a blocked head
+        bumps ``_head_skips``; at ``admit_max_skips`` the lookahead
+        window collapses to the head alone until it admits, so
+        sustained prefix-hit traffic can't starve it. Returns
+        (request, pages, shared_chunks) or None when nothing fits."""
+        if self._queue[0].rid != self._head_rid:
+            # New head (admitted predecessor, or a cancelled head was
+            # purged): its age starts fresh.
+            self._head_rid = self._queue[0].rid
+            self._head_skips = 0
+        aged_out = self._head_skips >= self.cfg.admit_max_skips
+        window = 1 if aged_out else 1 + self.cfg.admit_lookahead
+        for i, cand in enumerate(self._queue):
+            if i >= window:
+                break
+            shared: list[int] = []
+            if self.prefix_cache is not None:
+                _, shared = self.prefix_cache.peek(cand.prompt)
+            need = self._pages_needed(cand) - len(shared)
+            pages = self.allocator.alloc(need)
+            if i == 0:
+                # Head under pool pressure may evict cache entries
+                # (their pinned pages are reclaimable capacity);
+                # lookahead candidates must fit WITHOUT eviction —
+                # a queue-jumper doesn't get to churn the cache. The
+                # head's own peeked prefix is protected from eviction:
+                # without that, freeing pages FOR the head could evict
+                # the prefix it is about to share and silently turn its
+                # hit into a full recompute.
+                protect = tuple(cand.prompt[:len(shared)
+                                            * self.cfg.prefill_len])
+                while pages is None and (
+                        self.prefix_cache is not None
+                        and self.prefix_cache.evict_one(
+                            protect=protect or None)):
+                    # The protected key IS the longest cached prefix,
+                    # so the peeked (shared, need) pair cannot change
+                    # under eviction — only retry the allocation.
+                    pages = self.allocator.alloc(need)
+            if pages is None:
+                continue
+            if self.prefix_cache is not None:
+                # The real lookup: retains the shared pages, counts the
+                # hit/miss, touches LRU — only now that admission is
+                # certain.
+                _, shared = self.prefix_cache.lookup(cand.prompt)
+            if i == 0:
+                self._queue.popleft()
+                self._head_skips = 0
+            else:
+                del self._queue[i]
+                self._head_skips += 1
+            return cand, shared + pages, len(shared)
+        return None
+
+    def _admit(self) -> None:
+        """Assign queued requests to free slots. Assignment reserves
+        resources (pages / dense prefix restore) and creates the slot's
+        prefill work state; the prefill chunk dispatches themselves run
+        in ``_prefill_tick`` — at most ``prefill_chunk_budget`` per
+        step under the interleaved scheduler, exhaustively and inline
+        under ``scheduler="sequential"`` (the stop-the-world bench
+        baseline)."""
+        with self._lock:
+            self._purge_cancelled_locked()
+        for slot in range(self.cfg.slots):
+            if self._slots[slot] is not None:
+                continue
+            with self._lock:
+                if not self._queue:
+                    return
+                if self.paged:
+                    picked = self._reserve_next_locked()
+                    if picked is None:
+                        return  # head (and window) blocked on pages
+                    req, pages, shared_n = picked
+                else:
+                    req, pages, shared_n = self._queue.popleft(), None, 0
+            self._assign_slot(slot, req, pages, shared_n)
+            if self.cfg.scheduler == "sequential":
+                self._drain_prefill_slot(slot)
+
+    def _assign_slot(self, slot: int, req: Request, pages: list | None,
+                     shared_n: int) -> None:
+        """Install ``req`` into ``slot`` in the in-prefill state: page
+        table / dense prefix restore, prefill work record, and the
+        garbage-write parking of the slot's position."""
         n = len(req.prompt)
         p = self.cfg.prefill_len
-        for c0 in range(0, n, p):
+        work = _PrefillWork(req=req, n=n, next_c0=shared_n * p,
+                            pages=pages, shared_n=shared_n)
+        if self.paged:
+            self._slot_pages[slot] = pages
+            trow = self._tables_host[slot]
+            for i in range(self._max_pages):
+                trow[i] = pages[i] if i < len(pages) else 0
+            self._tables_dirty = True
+            work.table_row = jnp.asarray(trow, jnp.int32)
+        elif self.prefix_cache is not None:
+            # Dense prefix restore is ONE HBM copy — run it at
+            # assignment (hit/miss accounting here IS the admission).
+            work.next_c0 = self.prefix_cache.restore(
+                self.cache, req.prompt, jnp.int32(slot))
+        self._slots[slot] = req
+        self._prefill_work[slot] = work
+        # Park the slot's position on the last row while prefill is in
+        # flight: batched decode dispatches still compute this slot (and
+        # write garbage K/V at its position), and a stale position could
+        # land that garbage on a row an earlier chunk already filled.
+        # Row max_seq-1 is never a prompt row (prompts cap at max_seq-1
+        # tokens) and is legitimately rewritten in the same dispatch
+        # that first attends it, so garbage there is dead.
+        park = self.cfg.model.max_seq - 1
+        self.positions = self.positions.at[slot].set(park)
+        self._host_positions[slot] = park
+
+    def _drain_prefill_slot(self, slot: int) -> None:
+        """Run this slot's remaining prefill chunks to completion (the
+        sequential scheduler's inline admission)."""
+        while self._prefill_work[slot] is not None:
+            self._prefill_chunk(slot)
+
+    def _prefill_tick(self) -> None:
+        """Interleaved scheduler: spend up to ``prefill_chunk_budget``
+        prefill chunk dispatches, round-robin over in-prefill slots so
+        a short prompt admitted next to a long one still reaches its
+        first token in a handful of steps instead of waiting out the
+        long prompt's whole chunk count.
+
+        The budget exists to bound how long the decode batch stalls per
+        step — so it only binds while there IS a decode batch. With no
+        decodable slot (e.g. the first steps of an arrival burst, when
+        every slot is mid-prefill), throttling prefill would starve
+        nobody and merely serialize idle steps; instead one full
+        round-robin round runs per step so every in-prefill slot
+        advances a chunk."""
+        if self.cfg.scheduler != "interleaved":
+            return
+        nslots = self.cfg.slots
+        decoding = any(
+            self._slots[s] is not None and self._prefill_work[s] is None
+            for s in range(nslots))
+        budget = self.cfg.prefill_chunk_budget
+        if not decoding:
+            budget = max(
+                budget,
+                sum(1 for w in self._prefill_work if w is not None))
+        while budget > 0:
+            pending = [s for s in range(nslots)
+                       if self._prefill_work[s] is not None]
+            if not pending:
+                return
+            # Start from the cursor so budget rotates across slots.
+            slot = min(pending,
+                       key=lambda s: (s - self._prefill_rr) % nslots)
+            self._prefill_chunk(slot)
+            self._prefill_rr = (slot + 1) % nslots
+            budget -= 1
+
+    def _prefill_chunk(self, slot: int) -> None:
+        """One prefill chunk dispatch for ``slot``: target chunks
+        first, then (speculative draft mode) the draft model's own
+        chunks — the draft cache is unshared, so prefix-shared target
+        chunks still need draft K/V. Completing the last chunk samples
+        the first token and flips the slot to decoding."""
+        work = self._prefill_work[slot]
+        req = work.req
+        p = self.cfg.prefill_len
+        draft_mode = self.spec_len and self.cfg.spec_source != "prompt"
+        if work.next_c0 < work.n:
+            c0 = work.next_c0
+            chunk = req.prompt[c0:c0 + p]
+            ln = len(chunk)
+            toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
+            if self.paged:
+                ci = c0 // p
+                self.pool, work.logits = self._paged_prefill(
+                    self.params, self.pool, toks, jnp.int32(ln),
+                    jnp.int32(work.pages[ci]), work.table_row,
+                    jnp.int32(c0))
+            else:
+                self.cache, work.logits = self._prefill(
+                    self.params, self.cache, toks, jnp.int32(ln),
+                    jnp.int32(slot), jnp.int32(c0))
+            work.next_c0 = c0 + p
+        elif draft_mode and work.draft_c0 < work.n:
+            c0 = work.draft_c0
             chunk = req.prompt[c0:c0 + p]
             ln = len(chunk)
             toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
             self.draft_cache, _ = self._draft_prefill(
                 self.draft_params, self.draft_cache, toks,
                 jnp.int32(ln), jnp.int32(slot), jnp.int32(c0))
-        self._draft_pos[slot] = n
-
-    def _admit(self) -> None:
-        with self._lock:
-            self._purge_cancelled_locked()
-        for slot in range(self.cfg.slots):
-            if self._slots[slot] is not None:
-                continue
-            pages: list[int] | None = None
-            shared_n = 0
-            with self._lock:
-                if not self._queue:
-                    return
-                if self.paged:
-                    # Prefix hit: point this request's table at the
-                    # cached prefix's pages (lookup retains them) and
-                    # reserve only the remainder. Reservation before
-                    # admission; exhaustion first evicts cache entries
-                    # (their pinned pages are reclaimable capacity),
-                    # then blocks the queue head (KV memory
-                    # backpressure, head-of-line to stay FIFO).
-                    shared: list[int] = []
-                    if self.prefix_cache is not None:
-                        _, shared = self.prefix_cache.lookup(
-                            self._queue[0].prompt)
-                    shared_n = len(shared)
-                    need = self._pages_needed(self._queue[0]) - shared_n
-                    pages = self.allocator.alloc(need)
-                    while pages is None and (
-                            self.prefix_cache is not None
-                            and self.prefix_cache.evict_one()):
-                        pages = self.allocator.alloc(need)
-                    if pages is None:
-                        # The admission didn't happen; roll back the
-                        # lookup's counters — a blocked queue head is
-                        # re-probed every step and must not inflate
-                        # hit/miss totals into meaninglessness.
-                        if shared:
-                            self.allocator.release(shared)
-                            self.prefix_cache.hits -= 1
-                            self.prefix_cache.saved_tokens -= (
-                                shared_n * self.cfg.prefill_len)
-                        elif self.prefix_cache is not None:
-                            self.prefix_cache.misses -= 1
-                        return
-                    pages = shared + pages
-                req = self._queue.popleft()
-            n = len(req.prompt)
-            p = self.cfg.prefill_len
+            work.draft_c0 = c0 + p
+        if work.next_c0 < work.n or (
+                draft_mode and work.draft_c0 < work.n):
+            return
+        # Prefill complete: pin the prefix for later sharers only now —
+        # storing at assignment would share pages whose K/V hasn't been
+        # computed yet.
+        if self.prefix_cache is not None:
             if self.paged:
-                self._slot_pages[slot] = pages
-                trow = self._tables_host[slot]
-                for i in range(self._max_pages):
-                    trow[i] = pages[i] if i < len(pages) else 0
-                self._tables_dirty = True
-                table_row = jnp.asarray(trow, jnp.int32)
-                for ci, c0 in enumerate(range(0, n, p)):
-                    if ci < shared_n:
-                        continue  # chunk served from shared pages
-                    chunk = req.prompt[c0:c0 + p]
-                    ln = len(chunk)
-                    toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
-                    self.pool, logits = self._paged_prefill(
-                        self.params, self.pool, toks, jnp.int32(ln),
-                        jnp.int32(pages[ci]), table_row, jnp.int32(c0))
-                if self.prefix_cache is not None:
-                    # Pin this prompt's chunk-aligned strict prefix for
-                    # later sharers (no-op if already cached).
-                    self.prefix_cache.store(req.prompt, pages)
-                if self.spec_len:
-                    self._draft_prefill_prompt(slot, req)
-                self._after_prefill(slot, req, n, logits)
-                continue
-            # Prefix cache: restore a previously-computed chunk-aligned
-            # prefix's K/V (one HBM copy) and prefill only the tail. The
-            # restored prefix is strictly shorter than the prompt, so
-            # the final chunk always runs and yields first-token logits.
-            start = 0
-            if self.prefix_cache is not None:
-                start = self.prefix_cache.restore(
-                    self.cache, req.prompt, jnp.int32(slot))
-            # Chunked prefill: one fixed-shape call per prefill_len chunk;
-            # only the final chunk's logits matter (position n-1).
-            for c0 in range(start, n, p):
-                chunk = req.prompt[c0:c0 + p]
-                ln = len(chunk)
-                toks = jnp.asarray(chunk + [0] * (p - ln), jnp.int32)
-                self.cache, logits = self._prefill(
-                    self.params, self.cache, toks, jnp.int32(ln),
-                    jnp.int32(slot), jnp.int32(c0))
-            if self.prefix_cache is not None:
+                self.prefix_cache.store(req.prompt, work.pages)
+            else:
                 self.prefix_cache.store(
                     self.cache, req.prompt, jnp.int32(slot))
-            if self.spec_len:
-                self._draft_prefill_prompt(slot, req)
-            self._after_prefill(slot, req, n, logits)
+        if draft_mode:
+            self._draft_pos[slot] = work.n
+        self._prefill_work[slot] = None
+        self._after_prefill(slot, req, work.n, work.logits)
 
     def _after_prefill(self, slot: int, req: Request, n: int,
                        logits: jax.Array) -> None:
-        """Shared admission tail: sample the first token, install the
-        request into its slot."""
-        self._sample_ctr += 1
+        """Shared admission tail: sample the first token (index 0 of
+        the request's stream, keyed by its rid), install the request
+        into its slot for decoding."""
         first = int(sample_tokens(
-            logits[None], self._sample_key, jnp.uint32(self._sample_ctr),
+            logits[None], self._sample_key,
+            jnp.asarray([req.rid], jnp.int32),
+            jnp.zeros((1,), jnp.int32),
             jnp.full((1,), req.temperature, jnp.float32),
             jnp.full((1,), req.top_k, jnp.int32))[0])
+        now = time.monotonic()
         with self._lock:
-            req.ttft_s = time.monotonic() - req.enqueued
+            req.ttft_s = now - req.enqueued
+            req.first_tok_t = now
             self._observe_ttft(req.ttft_s)
             req.emit([first])
             self.tokens_total += 1
@@ -1240,13 +1458,12 @@ class ServingEngine:
         self._host_last[slot] = first
         self.temps = self.temps.at[slot].set(req.temperature)
         self.topks = self.topks.at[slot].set(req.top_k)
+        self.rids = self.rids.at[slot].set(req.rid)
+        self.tok_ctrs = self.tok_ctrs.at[slot].set(1)  # index 0 spent
         if len(req.output) >= req.max_new + 1 or req.hit_stop():
             self._complete(slot)
 
-    def _complete(self, slot: int) -> None:
-        req = self._slots[slot]
-        assert req is not None
-        self._slots[slot] = None
+    def _release_slot_pages(self, slot: int) -> None:
         if self.paged:
             # Free the pages and park the slot's table on the trash
             # page so its garbage batched-decode writes can't corrupt
@@ -1255,8 +1472,31 @@ class ServingEngine:
             self._slot_pages[slot] = []
             self._tables_host[slot] = [0] * self._max_pages
             self._tables_dirty = True
+
+    def _complete(self, slot: int) -> None:
+        req = self._slots[slot]
+        assert req is not None
+        self._slots[slot] = None
+        self._release_slot_pages(slot)
         with self._lock:
             self.completed_total += 1
+            if req.first_tok_t is not None and len(req.output) > 1:
+                self._tpot_recent.append(
+                    (time.monotonic() - req.first_tok_t)
+                    / (len(req.output) - 1))
+        req.finish_stream()
+        req.done.set()
+
+    def _abort_prefill(self, slot: int) -> None:
+        """Cancellation observed while the slot was still prefilling:
+        release the reservation and count a cancellation (no token was
+        ever emitted — this is not a completion)."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._prefill_work[slot] = None
+        self._release_slot_pages(slot)
+        with self._lock:
+            self.cancelled_total += 1
         req.finish_stream()
         req.done.set()
 
@@ -1271,19 +1511,40 @@ class ServingEngine:
     def _step_inner(self) -> bool:
         self._admit()
         # Cancelled mid-flight requests free their slot (and paged
-        # pages) instead of decoding for a client that went away.
+        # pages) instead of decoding — or prefilling: the sweep runs
+        # BEFORE the prefill tick so a dead request's chunks never
+        # consume the step's budget.
         for slot in range(self.cfg.slots):
             req = self._slots[slot]
             if req is not None and req.cancelled.is_set():
-                self._complete(slot)
-        active = [s for s in range(self.cfg.slots) if self._slots[s]]
+                if self._prefill_work[slot] is not None:
+                    self._abort_prefill(slot)
+                else:
+                    self._complete(slot)
+        self._prefill_tick()
+        # Decode batch: slots still mid-prefill are excluded (their
+        # first token doesn't exist yet; the batched dispatch computes
+        # them as garbage the host ignores, like free slots).
+        in_prefill = any(w is not None for w in self._prefill_work)
+        active = [s for s in range(self.cfg.slots)
+                  if self._slots[s] is not None
+                  and self._prefill_work[s] is None]
         if active:
             # Speculative round needs room for spec_len+1 cache rows in
-            # every active slot, and at least one greedy slot to profit
+            # every active slot, at least one greedy slot to profit
             # (temperature slots accept zero drafts — a spec round for
-            # them alone is strictly slower than plain decode).
+            # them alone is strictly slower than plain decode), and —
+            # DENSE layout only — no slot mid-prefill: the dense
+            # verify's clamped [T]-row block write could land on rows a
+            # parked slot's prefill already filled. (Paged verify
+            # writes per-token through the page table, where a parked
+            # slot's rows resolve to the trash page or dead tail rows,
+            # so paged spec rounds run right through prefill.) Deferred
+            # rounds fall back to the plain step; the draft catch-up
+            # loop re-syncs afterwards.
             if (
                 self.spec_len
+                and (self.paged or not in_prefill)
                 and any(self._slots[s].temperature <= 0 for s in active)
                 and all(
                     self._host_positions[s]
@@ -1321,10 +1582,10 @@ class ServingEngine:
         else:
             self.cache, logits = self._decode(
                 self.params, self.cache, self.last_tokens, self.positions)
-        self._sample_ctr += 1
         nxt = sample_tokens(logits, self._sample_key,
-                            jnp.uint32(self._sample_ctr),
+                            self.rids, self.tok_ctrs,
                             self.temps, self.topks)
+        self.tok_ctrs = self.tok_ctrs + 1
         self.last_tokens = nxt
         self.positions = jnp.minimum(
             self.positions + 1, self.cfg.model.max_seq - 1)
@@ -1360,7 +1621,7 @@ class ServingEngine:
                 self._decode_rounds(
                     self.params, self.pool, self.last_tokens,
                     self.positions, self._tables_dev,
-                    self._sample_key, jnp.uint32(self._sample_ctr + 1),
+                    self._sample_key, self.rids, self.tok_ctrs,
                     self.temps, self.topks, steps=n,
                 )
             )
@@ -1369,11 +1630,11 @@ class ServingEngine:
                 self._decode_rounds(
                     self.params, self.cache, self.last_tokens,
                     self.positions,
-                    self._sample_key, jnp.uint32(self._sample_ctr + 1),
+                    self._sample_key, self.rids, self.tok_ctrs,
                     self.temps, self.topks, steps=n,
                 )
             )
-        self._sample_ctr += n
+        self.tok_ctrs = self.tok_ctrs + n
         toks_host = jax.device_get(toks).tolist()  # [B, n]
         emitted = 0
         with self._lock:
@@ -1422,12 +1683,16 @@ class ServingEngine:
                 req = self._slots[s]
                 p_s = self._host_positions[s]
                 f = self._draft_pos[s] + d
-                if req is not None and f < p_s:
+                if (req is not None and self._prefill_work[s] is None
+                        and f < p_s):
                     toks.append(self._seq_token(req, f))
                     rows.append(f)
                 else:
-                    # Caught-up or empty slot: rewrite the row the
-                    # proposal loop writes first anyway — idempotent.
+                    # Caught-up, empty, or mid-prefill slot (parked
+                    # position, stale _draft_pos — its own chunked
+                    # draft prefill owns that cache region): rewrite
+                    # the row the proposal loop writes first anyway —
+                    # idempotent.
                     toks.append(self._host_last[s])
                     rows.append(p_s)
             self.draft_cache, _ = self._draft_decode(
@@ -1499,9 +1764,8 @@ class ServingEngine:
         # rounds take tgt_h directly.
         any_temp = any(self._slots[s].temperature > 0 for s in active)
         if any_temp:
-            self._sample_ctr += 1
             samp0 = sample_tokens(vlogits[:, 0], self._sample_key,
-                                  jnp.uint32(self._sample_ctr),
+                                  self.rids, self.tok_ctrs,
                                   self.temps, self.topks)
             # ONE host-device sync per round.
             if prop_h is None:
@@ -1552,6 +1816,12 @@ class ServingEngine:
                 self._complete(slot)
         self.positions = jnp.asarray(self._host_positions, jnp.int32)
         self.last_tokens = jnp.asarray(self._host_last, jnp.int32)
+        # Re-sync per-slot token indices from the host truth: greedy
+        # slots advanced by their accepted length, temperature slots by
+        # one — len(output) IS the next sample index either way.
+        self.tok_ctrs = jnp.asarray(
+            [len(r.output) if (r := self._slots[s]) is not None else 0
+             for s in range(self.cfg.slots)], jnp.int32)
         with self._lock:
             self.decode_steps_total += 1
             self.spec_rounds_total += 1
@@ -1579,6 +1849,10 @@ class ServingEngine:
             inf = self._ttft_inf
             ttft_sum = self._ttft_sum
             free = sum(1 for s in self._slots if s is None)
+            in_prefill = sum(
+                1 for w in self._prefill_work if w is not None)
+            ttft_recent = list(self._ttft_recent)
+            tpot_recent = list(self._tpot_recent)
             spec_rounds = self.spec_rounds_total
             spec_proposed = self.spec_proposed_total
             spec_accepted = self.spec_accepted_total
@@ -1594,7 +1868,8 @@ class ServingEngine:
                   "requests dropped by queue backpressure"
                   ).add(value=rejected)
         w.counter("tpumon_serving_requests_cancelled",
-                  "requests cancelled before admission"
+                  "requests cancelled before their first token "
+                  "(while queued or mid-prefill)"
                   ).add(value=cancelled)
         w.counter("tpumon_serving_decode_steps", "fused decode steps"
                   ).add(value=steps)
@@ -1602,6 +1877,26 @@ class ServingEngine:
                 ).add(value=queue)
         w.gauge("jetstream_slots_available", "free decode slots"
                 ).add(value=free)
+        w.gauge("tpumon_serving_slots_prefill",
+                "slots mid-chunked-prefill (admitted, not yet decoding)"
+                ).add(value=in_prefill)
+        # Per-request latency quantiles over a recent window
+        # (tracing.quantiles — one sort per render): TTFT from enqueue
+        # to first token, TPOT decode seconds per token after it.
+        from tpumon.tracing import quantiles
+
+        for fam, series, unit in (
+            ("tpumon_serving_ttft", ttft_recent, 1e3),
+            ("tpumon_serving_tpot", tpot_recent, 1e3),
+        ):
+            q = quantiles(series)
+            if q is not None:
+                w.gauge(fam + "_p50_ms",
+                        "recent-window per-request p50"
+                        ).add(value=round(q[0] * unit, 3))
+                w.gauge(fam + "_p95_ms",
+                        "recent-window per-request p95"
+                        ).add(value=round(q[1] * unit, 3))
         from tpumon.loadgen.quant import QTensor, param_bytes
 
         weight_bytes = param_bytes(self.params)
@@ -1823,7 +2118,10 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                      kv_layout: str = "dense", pool_pages: int = 0,
                      decode_block: int = 1, kv_dtype: str = "compute",
                      paged_attn: str = "gather",
-                     spec_source: str = "draft"):
+                     spec_source: str = "draft",
+                     scheduler: str = "interleaved",
+                     prefill_budget: int = 1,
+                     admit_lookahead: int = 0):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
@@ -1833,7 +2131,10 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                         or kv_layout != "dense" or decode_block != 1
                         or kv_dtype != "compute"
                         or paged_attn != "gather"
-                        or spec_source != "draft"):
+                        or spec_source != "draft"
+                        or scheduler != "interleaved"
+                        or prefill_budget != 1
+                        or admit_lookahead != 0):
         import dataclasses
 
         # Keep the checkpoint-architecture adoption the engine would do
@@ -1852,7 +2153,9 @@ def start_background(rps: float = 0.5, max_new: int = 16,
             prefix_cache_entries=prefix_cache,
             kv_layout=kv_layout, pool_pages=pool_pages,
             decode_block=decode_block, kv_dtype=kv_dtype,
-            paged_attn=paged_attn, spec_source=spec_source)
+            paged_attn=paged_attn, spec_source=spec_source,
+            scheduler=scheduler, prefill_chunk_budget=prefill_budget,
+            admit_lookahead=admit_lookahead)
     engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
@@ -1923,6 +2226,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="paged decode read path: XLA fused gather or "
                          "the Pallas paged-attention kernel (regime "
                          "map in ops/paged_attention)")
+    ap.add_argument("--scheduler", choices=["interleaved", "sequential"],
+                    default="interleaved",
+                    help="admission scheduler: interleaved chunked "
+                         "prefill (decode keeps flowing while long "
+                         "prompts admit) or the sequential "
+                         "stop-the-world baseline")
+    ap.add_argument("--prefill-budget", type=int, default=1,
+                    help="prefill chunk dispatches per engine step "
+                         "under the interleaved scheduler")
+    ap.add_argument("--admit-lookahead", type=int, default=0,
+                    help="paged admission: probe this many requests "
+                         "behind a page-blocked queue head (0 = strict "
+                         "FIFO; aging-bounded, see ServeConfig)")
     ap.add_argument("--experts", type=int, default=0,
                     help="serve the MoE model family: this many "
                          "top-1-routed experts per layer (0 = dense; "
@@ -1948,6 +2264,11 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--spec-len must be >= 0")
     if args.pool_pages and args.kv_layout != "paged":
         ap.error("--pool-pages requires --kv-layout paged")
+    if args.prefill_budget < 1:
+        ap.error("--prefill-budget must be >= 1")
+    if args.admit_lookahead and args.kv_layout != "paged":
+        ap.error("--admit-lookahead requires --kv-layout paged (dense "
+                 "admission never blocks on pages)")
     if args.paged_attn == "kernel" and (
             args.kv_layout != "paged" or args.kv_dtype == "int8"):
         ap.error("--paged-attn kernel requires --kv-layout paged with "
@@ -1967,7 +2288,9 @@ def main(argv: list[str] | None = None) -> int:
         prefix_cache_entries=args.prefix_cache,
         kv_layout=args.kv_layout, pool_pages=args.pool_pages,
         decode_block=args.decode_block, kv_dtype=args.kv_dtype,
-        paged_attn=args.paged_attn,
+        paged_attn=args.paged_attn, scheduler=args.scheduler,
+        prefill_chunk_budget=args.prefill_budget,
+        admit_lookahead=args.admit_lookahead,
     ))
     server, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "

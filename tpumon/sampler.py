@@ -543,6 +543,12 @@ class Sampler:
         for key, name, agg in (
             ("tokens_per_sec", "tokens_per_sec", sum),
             ("ttft_p50_ms", "ttft_p50_ms", mean),
+            # Scheduler pressure (the SLO-soak inputs): waiting
+            # requests across targets and the worst per-request decode
+            # cadence — a prefill/decode interference regression shows
+            # here before it shows in throughput.
+            ("queue_depth", "queue_depth", sum),
+            ("tpot_p95_ms", "tpot_p95_ms", max),
             ("train_loss", "train_loss", mean),
             ("train_tokens_per_sec", "train_tokens_per_sec", sum),
             ("spec_accept_pct", "spec_accept_pct", mean),

@@ -711,6 +711,58 @@ function makeDashboard(doc, net, env, mkSurface) {
     });
   }
 
+  /* --------------------------- federation fleet ------------------------ */
+  /* GET /api/federation — the aggregator-tree fleet view (slices/chips
+   * with dark/unreachable failure domains, per-downstream freshness,
+   * uplink stream state). Hidden on a standalone monitor: the route
+   * always answers, but only a hub (aggregator/root) or an uplinked
+   * leaf has anything to show. */
+  function fetchFederation() {
+    net.getJson("/api/federation", res => {
+      const card = $("federation-card");
+      const fleet = res ? res.fleet : null;
+      const uplink = res ? res.uplink : null;
+      if (!res || (!fleet && !uplink)) {
+        card.style.display = "none";
+        return;
+      }
+      card.style.display = "";
+      $("fed-tag").textContent = res.role +
+        (res.node ? " · " + res.node : "");
+      const put = (id, v, fmt) => {
+        $(id).textContent = v == null ? "–" : fmt(v);
+      };
+      put("fed-slices", fleet ? fleet.slices : null, v => v.toFixed(0));
+      put("fed-chips", fleet ? fleet.chips : null, v => v.toFixed(0));
+      put("fed-dark", fleet ? fleet.dark_slices : null, v => v.toFixed(0));
+      $("fed-dark").style.color =
+        fleet && fleet.dark_slices > 0 ? "var(--red)" : "";
+      put("fed-unreach", fleet ? fleet.unreachable_slices : null,
+          v => v.toFixed(0));
+      $("fed-unreach").style.color =
+        fleet && fleet.unreachable_slices > 0 ? "var(--red)" : "";
+      put("fed-duty", fleet ? fleet.duty_mean : null,
+          v => v.toFixed(1) + "%");
+      const nodes = res.nodes || {};
+      const names = Object.keys(nodes);
+      let up = 0;
+      let oldest = null;
+      for (const name of names) {
+        const ns = nodes[name];
+        if (ns.status === "ok") up += 1;
+        if (ns.age_s != null && (oldest == null || ns.age_s > oldest))
+          oldest = ns.age_s;
+      }
+      put("fed-nodes", names.length ? up + "/" + names.length : null,
+          v => v);
+      put("fed-age", oldest, v => v.toFixed(1) + " s");
+      $("fed-uplink").textContent = uplink
+        ? (uplink.connected ? "connected" : "down") : "–";
+      $("fed-uplink").style.color =
+        uplink && !uplink.connected ? "var(--red)" : "";
+    });
+  }
+
   /* ------------------------------- health ------------------------------ */
   function fetchHealth() {
     net.getJson("/api/health", h => {
@@ -753,7 +805,8 @@ function makeDashboard(doc, net, env, mkSurface) {
 
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
-    fetchAlerts(); fetchServing(); fetchHealth(); fetchTrace();
+    fetchAlerts(); fetchServing(); fetchFederation(); fetchHealth();
+    fetchTrace();
     fetchEvents();
     updateTime();
   }
@@ -762,7 +815,8 @@ function makeDashboard(doc, net, env, mkSurface) {
     charts: charts,
     fetchRealtime: fetchRealtime, fetchHistory: fetchHistory,
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
-    fetchServing: fetchServing, fetchHealth: fetchHealth,
+    fetchServing: fetchServing, fetchFederation: fetchFederation,
+    fetchHealth: fetchHealth,
     fetchTrace: fetchTrace, fetchEvents: fetchEvents,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
