@@ -1378,6 +1378,228 @@ async def _bench_federation_tree(
     }
 
 
+async def _bench_query() -> dict:
+    """In-tree query engine (docs/query.md). Numbers of record:
+
+      query_instant_p50_ms          topk(5, avg_over_time(chip.mxu[5m]))
+                                    instant over a v5p-256-scale ring
+                                    (1024 per-chip series, 10 min data)
+      query_range_30m_p50_ms        avg(chip.mxu) on a 30 m / 30 s grid
+                                    (query_history_walk_p50_ms — the raw
+                                    /api/history render of the same ring
+                                    — rides full results for comparison)
+      query_rules_append_overhead_pct
+                                    record_batch cost with recording
+                                    rules registered vs without
+                                    (acceptance: <= 2%)
+      query_fed_2048_topk_p50_ms    distributed topk(5, rate(chip.hbm[1m]))
+                                    over the fake v5p-2048 tree (8×v5p-256
+                                    leaves -> 2 aggregators -> root),
+                                    partial aggregates only — the
+                                    TPWR bytes per query ride full results
+    """
+    from tpumon.history import HistoryService, RingHistory
+    from tpumon.query import QueryEngine, RecordingRule, RuleSet
+
+    # --- a v5p-256-scale ring: 256 chips × 4 series + fleet series ---
+    n_chips, ticks = 256, 600
+    now = time.time()
+
+    def fill(ring: RingHistory) -> list:
+        handles = []
+        for c in range(n_chips):
+            for metric in ("mxu", "hbm", "temp", "link"):
+                handles.append(ring.handle(f"chip.h{c % 32}/c{c}.{metric}"))
+        for name in ("cpu", "mxu", "hbm"):
+            handles.append(ring.handle(name))
+        for i in range(ticks):
+            ts = now - ticks + i
+            batch = [
+                (h, 30.0 + (j * 7 + i) % 60) for j, h in enumerate(handles)
+            ]
+            ring.record_batch(batch, ts=ts)
+        return handles
+
+    ring = RingHistory()
+    fill(ring)
+    engine = QueryEngine(ring)
+
+    expr = "topk(5, avg_over_time(chip.mxu[5m]))"
+    instant_ms: list[float] = []
+    for _ in range(40):
+        t0 = time.perf_counter()
+        out = engine.instant(expr, at=now)
+        instant_ms.append((time.perf_counter() - t0) * 1e3)
+    assert len(out["result"]) == 5
+
+    range_ms: list[float] = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        rq = engine.range_query("avg(chip.mxu)", 1800, 30, end=now)
+        range_ms.append((time.perf_counter() - t0) * 1e3)
+    assert rq["series"][0]["points"]
+
+    svc = HistoryService(ring)
+    walk_ms: list[float] = []
+    for _ in range(10):
+        ring._memo.clear()  # cold render, like a fresh window request
+        t0 = time.perf_counter()
+        svc.snapshot_ring(window_s=1800)
+        walk_ms.append((time.perf_counter() - t0) * 1e3)
+
+    # --- recording-rule append overhead ----------------------------------
+    # The marginal work rules add to the append path is the batched
+    # rule-store update (RuleSet.accum_batch — everything else in
+    # record_batch is identical with or without rules), so measure IT
+    # directly inside real ticks (cold caches, realistic batch) and
+    # report it against the rule-free tick p50. A/B tick deltas are the
+    # wrong instrument here: the effect is ~tens of µs on a ~2 ms tick,
+    # below cross-run box noise.
+    def mk_ring(with_rules: bool):
+        r2 = RingHistory()
+        if with_rules:
+            r2.set_recording_rules(
+                RuleSet([RecordingRule("chip.mxu[5m]"),
+                         RecordingRule("chip.hbm[5m]")])
+            )
+        hs = []
+        for c in range(n_chips):
+            for metric in ("mxu", "hbm", "temp", "link"):
+                hs.append(r2.handle(f"chip.h0/c{c}.{metric}"))
+        return r2, hs
+
+    def drive(ring2, hs2, accum_us: list[float] | None):
+        if accum_us is not None:
+            orig = RuleSet.accum_batch
+
+            def timed(self, ts, val_q, slots):
+                a0 = time.perf_counter()
+                orig(self, ts, val_q, slots)
+                accum_us.append((time.perf_counter() - a0) * 1e6)
+
+            RuleSet.accum_batch = timed
+        try:
+            per: list[float] = []
+            for i in range(400):
+                vals = [40.0 + (j + i) % 50 for j in range(len(hs2))]
+                batch = list(zip(hs2, vals))
+                t0 = time.perf_counter()
+                ring2.record_batch(batch, ts=now + i)
+                if i >= 40:
+                    per.append((time.perf_counter() - t0) * 1e3)
+            return per
+        finally:
+            if accum_us is not None:
+                RuleSet.accum_batch = orig
+
+    ring_p, hs_p = mk_ring(False)
+    t_plain = drive(ring_p, hs_p, None)
+    ring_r, hs_r = mk_ring(True)
+    accum_us: list[float] = []
+    t_rules = drive(ring_r, hs_r, accum_us)
+    accum_us = accum_us[40:]
+    plain_p50 = _p50(t_plain)
+    overhead_pct = 100.0 * (_p50(accum_us) / 1e3) / plain_p50
+    measured = {"rules": _p50(t_rules), "plain": plain_p50}
+
+    # --- distributed topk over the fake v5p-2048 tree --------------------
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    def mk(**env):
+        base = {
+            "TPUMON_PORT": "0", "TPUMON_HOST": "127.0.0.1",
+            "TPUMON_K8S_MODE": "none", "TPUMON_COLLECTORS": "accel",
+            "TPUMON_FEDERATION_DARK_AFTER_S": "30",
+        }
+        base.update(env)
+        return build(load_config(env=base))
+
+    nodes = []
+    fed_ms: list[float] = []
+    query_bytes = 0
+    try:
+        root_s, root_srv = mk(
+            TPUMON_ACCEL_BACKEND="none", TPUMON_FEDERATION_ROLE="root",
+            TPUMON_FEDERATION_NODE="root", TPUMON_HISTORY_PER_CHIP="0",
+        )
+        await root_s.tick_fast()
+        await root_srv.start()
+        nodes.append((root_s, root_srv))
+        aggs = []
+        for a in range(2):
+            agg_s, agg_srv = mk(
+                TPUMON_ACCEL_BACKEND="none",
+                TPUMON_FEDERATION_ROLE="aggregator",
+                TPUMON_FEDERATION_NODE=f"agg{a}",
+                TPUMON_FEDERATE_UP=f"http://127.0.0.1:{root_srv.port}",
+                TPUMON_HISTORY_PER_CHIP="0",
+            )
+            await agg_s.tick_fast()
+            await agg_srv.start()
+            await agg_s.uplink.start()
+            aggs.append(agg_s)
+            nodes.append((agg_s, agg_srv))
+        leaves = []
+        for i in range(8):
+            agg_port = nodes[1 + i // 4][1].port
+            leaf_s, leaf_srv = mk(
+                TPUMON_ACCEL_BACKEND=f"fake:v5p-256@leaf{i}",
+                TPUMON_FEDERATION_NODE=f"leaf{i}",
+                TPUMON_FEDERATE_UP=f"http://127.0.0.1:{agg_port}",
+            )
+            await leaf_s.tick_fast()
+            await leaf_s.uplink.start()
+            leaves.append(leaf_s)
+            nodes.append((leaf_s, leaf_srv))
+        # rate() needs >= 2 points per chip series; give every leaf a
+        # few ticks and let the uplinks establish.
+        for _ in range(3):
+            await asyncio.gather(*(lf.tick_fast() for lf in leaves))
+            await asyncio.sleep(0.02)
+        deadline = time.monotonic() + 30
+        while (
+            sum(
+                1
+                for ag in aggs
+                for ns in ag.federation.nodes.values()
+                if ns.connected
+            ) < 8
+        ):
+            if time.monotonic() > deadline:
+                raise RuntimeError("leaves never connected")
+            await asyncio.sleep(0.05)
+        fed_expr = "topk(5, rate(chip.hbm[1m]))"
+        answered0 = sum(lf.uplink.query_bytes for lf in leaves)
+        for i in range(18):
+            t0 = time.perf_counter()
+            out = await root_s.federation.fleet_query(fed_expr, timeout_s=10)
+            dt = (time.perf_counter() - t0) * 1e3
+            if i >= 3:
+                fed_ms.append(dt)
+        assert len(out["result"]) == 5 and not out.get("partial"), out
+        query_bytes = (
+            sum(lf.uplink.query_bytes for lf in leaves) - answered0
+        ) // 18
+    finally:
+        for sampler, server in nodes:
+            with contextlib.suppress(Exception):
+                await sampler.stop()
+            with contextlib.suppress(Exception):
+                await server.stop()
+
+    return {
+        "query_instant_p50_ms": round(_p50(instant_ms), 3),
+        "query_range_30m_p50_ms": round(_p50(range_ms), 3),
+        "query_history_walk_p50_ms": round(_p50(walk_ms), 3),
+        "query_rules_append_overhead_pct": round(overhead_pct, 2),
+        "query_rules_tick_ms": round(measured["rules"], 3),
+        "query_plain_tick_ms": round(measured["plain"], 3),
+        "query_fed_2048_topk_p50_ms": round(_p50(fed_ms), 3),
+        "query_fed_bytes_per_query_per_leaf": query_bytes,
+    }
+
+
 def _note(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}", file=sys.stderr)
 
@@ -1435,6 +1657,12 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                               "federation_keyframe_bytes",
                               "federation_delta_vs_keyframe_pct",
                               "federation_resync_ms")),
+    "query": (300, ("query_instant_p50_ms", "query_range_30m_p50_ms",
+                    "query_history_walk_p50_ms",
+                    "query_rules_append_overhead_pct",
+                    "query_rules_tick_ms", "query_plain_tick_ms",
+                    "query_fed_2048_topk_p50_ms",
+                    "query_fed_bytes_per_query_per_leaf")),
     "kernels": (700, ("mxu_matmul_pallas_tflops", "mxu_matmul_xla_tflops",
                       "mxu_matmul_vs_xla",
                       "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
@@ -1496,10 +1724,10 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # scrape (driver metric contract: metric/value/unit/vs_baseline)
     "metric", "value", "unit", "vs_baseline",
     "sampler_samples_per_sec", "accel_backend",
-    # fastpath (64 vs 256-chip cached render + delta SSE, docs/perf.md;
-    # the cold exporter render and keyframe bytes live in full results —
-    # the cached render and steady-state delta are the numbers of record)
-    "fastpath_64_scrape_to_render_p50_ms",
+    # fastpath (256-chip cached render + delta SSE, docs/perf.md; the
+    # 64-chip pair, cold exporter render and keyframe bytes live in
+    # full results — the at-scale cached render and steady-state delta
+    # are the numbers of record)
     "fastpath_256_scrape_to_render_p50_ms",
     "sse_delta_bytes_256",
     # observability (self-trace overhead at v5p-64, docs/observability.md)
@@ -1519,13 +1747,18 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "ingest_batch_p50_us", "ingest_tick_256_p50_ms",
     "wire_binary_decode_p50_us",
     # federation (flat peer fan-out + the push-based aggregator tree,
-    # docs/federation.md; keyframe bytes, chip counts and the
-    # delta-vs-keyframe ratio live in full results)
-    "federation_scrape_to_render_p50_ms",
+    # docs/federation.md; the 64-chip flat number, keyframe bytes, chip
+    # counts and the delta-vs-keyframe ratio live in full results)
     "federation_256_scrape_to_render_p50_ms",
     "federation_2048_root_scrape_p50_ms",
     "federation_delta_bytes_per_tick",
     "federation_resync_ms",
+    # query engine (in-tree PromQL subset, docs/query.md; the raw
+    # history-walk comparison, per-config rule tick operands and the
+    # per-leaf TPWR byte cost live in full results)
+    "query_instant_p50_ms", "query_range_30m_p50_ms",
+    "query_rules_append_overhead_pct",
+    "query_fed_2048_topk_p50_ms",
     # kernels
     "mxu_matmul_pallas_tflops", "mxu_matmul_vs_xla",
     "int8_matmul_pallas_tflops", "int8_matmul_vs_xla",
@@ -1542,10 +1775,8 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "serving_paged_block8_tokens_per_sec",
     "serving_paged_kernel_vs_gather",
     # serving_concurrency (chunked-prefill scheduler vs the sequential
-    # stop-the-world baseline at 32/128-way concurrency; the conc32
-    # TTFT pair, per-scheduler operands and ratios live in full
-    # results)
-    "serving_conc32_tokens_per_sec",
+    # stop-the-world baseline at 128-way concurrency; the conc32
+    # numbers, per-scheduler operands and ratios live in full results)
     "serving_conc128_tokens_per_sec",
     "serving_conc128_ttft_p95_ms",
     "serving_conc128_ttft_p95_sequential_ms",
@@ -1610,6 +1841,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(both_scales())
     if name == "federation_tree":
         return asyncio.run(_bench_federation_tree())
+    if name == "query":
+        return asyncio.run(_bench_query())
     if name == "kernels":
         if not on_tpu:
             # Keep the documented key set stable off-TPU: explicit nulls,

@@ -52,53 +52,6 @@ class FakeBackend:
         self.server.server_close()
 
 
-def fake_prometheus(series_value: float = 55.0) -> FakeBackend:
-    """Serves /api/v1/query_range with one synthetic series per query."""
-    b = FakeBackend()
-
-    def query_range(q):
-        start = float(q["start"][0])
-        end = float(q["end"][0])
-        step = float(q["step"][0])
-        values = []
-        t = start
-        while t <= end:
-            values.append([t, str(series_value)])
-            t += step
-        return (
-            200,
-            "application/json",
-            json.dumps(
-                {
-                    "status": "success",
-                    "data": {
-                        "resultType": "matrix",
-                        "result": [{"metric": {"q": q["query"][0]}, "values": values}],
-                    },
-                }
-            ),
-        )
-
-    def query(q):
-        return (
-            200,
-            "application/json",
-            json.dumps(
-                {
-                    "status": "success",
-                    "data": {
-                        "resultType": "vector",
-                        "result": [{"metric": {}, "value": [0, str(series_value)]}],
-                    },
-                }
-            ),
-        )
-
-    b.routes["/api/v1/query_range"] = query_range
-    b.routes["/api/v1/query"] = query
-    return b
-
-
 def fake_k8s_api(pods: list[dict]) -> FakeBackend:
     b = FakeBackend()
     b.routes["/api/v1/pods"] = lambda q: (

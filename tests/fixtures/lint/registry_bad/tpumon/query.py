@@ -1,0 +1,8 @@
+"""Fixture: query-function registry that drifted from its docs —
+``mystery_fn`` is declared but undocumented (query-func-undocumented),
+and the fixture docs/query.md documents ``made_up`` which is not
+declared (query-func-phantom)."""
+
+RANGE_FUNCTIONS = ("rate", "mystery_fn")
+AGG_OPS = ("topk",)
+FUNCTIONS = RANGE_FUNCTIONS + AGG_OPS

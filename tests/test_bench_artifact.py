@@ -46,7 +46,8 @@ def test_summary_carries_the_record_keys():
     for key in (
         "metric", "value", "unit", "vs_baseline", "sampler_samples_per_sec",
         "mxu_matmul_pallas_tflops", "paged_attention_pallas_kv_gbps",
-        "federation_scrape_to_render_p50_ms",
+        "federation_256_scrape_to_render_p50_ms",
+        "query_fed_2048_topk_p50_ms",
         "train_mfu_pct", "serving_tokens_per_sec",
     ):
         assert key in summary

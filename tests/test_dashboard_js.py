@@ -46,6 +46,7 @@ GET_ENDPOINTS = [
     ("/api/serving", ""),
     ("/api/federation", ""),
     ("/api/health", ""),
+    ("/api/query", "query=topk(5,avg_over_time(chip.mxu[5m]))"),
     ("/api/trace", ""),
     ("/api/events", "limit=20"),
 ]
@@ -118,6 +119,14 @@ def test_fetch_all_renders_real_payloads(js, payloads):
 
     # Clock set via env adapter.
     assert doc.el("clock")["textContent"] == "12:34:56"
+
+    # Hottest-chips card: the query-engine consumer (GET /api/query,
+    # a topk over per-chip 5m duty means) rendered 5 ranked rows.
+    rows = doc.el("topchips-body")["_children"]
+    assert len(rows) == 5
+    assert all_text(rows[0]).count("chip-") >= 1
+    assert "%" in all_text(rows[0])
+    assert doc.el("topchips-card")["style"]["display"] == ""
 
     # Every GET the dashboard issued is one of the endpoints the real
     # server answered (no route drift between JS and server).

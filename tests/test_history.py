@@ -61,13 +61,19 @@ def test_serving_spec_and_pool_series_in_contract():
     assert "prefix_hit_pct" in PROM_QUERIES
 
 
-def test_history_service_prometheus_unreachable_falls_back():
+def test_history_service_prometheus_url_deprecated_not_queried():
+    """The external-Prometheus path is retired (ISSUE 12): a configured
+    prometheus_url flips the deprecation flag and is otherwise ignored
+    — the ring answers, nothing dials out (the URL here would refuse
+    instantly if it were)."""
     ring = RingHistory(1800)
     ring.record("mxu", 77.0, ts=1000.0)
     svc = HistoryService(ring, prometheus_url="http://127.0.0.1:1")
+    assert svc.prometheus_deprecated is True
     out = asyncio.run(svc.snapshot())
     assert out["source"] == "ring"
     assert out["mxu"]["data"] == [77.0]
+    assert HistoryService(ring).prometheus_deprecated is False
 
 
 def test_tpu_health_series_worst_of_fleet():

@@ -154,7 +154,12 @@ def test_registry_checker_fires_on_fixture():
         ("registry.route-undocumented", "tpumon/server.py"),
         ("registry.bench-key-unproduced", "bench.py"),
         ("registry.metric-undocumented", "tpumon/exporter.py"),
+        ("registry.query-func-undocumented", "tpumon/query.py"),
+        ("registry.query-func-phantom", "docs/query.md"),
     }
+    msgs = " ".join(f.message for f in _fixture("registry_bad", only=("registry",)))
+    assert "mystery_fn" in msgs and "made_up" in msgs
+    assert "not_a_function" not in msgs  # rows outside ## Functions ignored
 
 
 # ---------------------------- suppressions ----------------------------

@@ -8,7 +8,7 @@ import urllib.request
 
 import pytest
 
-from tests.fakes import fake_jetstream, fake_k8s_api, fake_prometheus
+from tests.fakes import fake_jetstream, fake_k8s_api
 from tests.test_k8s import pod_doc
 from tests.test_serving import JETSTREAM_TEXT
 from tpumon.app import build
@@ -144,20 +144,22 @@ class TestApiContracts:
 
 
 def test_full_stack_with_fake_backends():
-    """All fake upstreams live at once: Prometheus, K8s apiserver,
-    JetStream — the §4.3 integration scenario."""
-    prom = fake_prometheus(series_value=61.5)
+    """All fake upstreams live at once: K8s apiserver + JetStream — the
+    §4.3 integration scenario. History comes from the in-process TSDB
+    (the external-Prometheus path is retired, ISSUE 12); the legacy
+    prometheus_url knob must deprecate loudly, not change behavior."""
     k8s = fake_k8s_api([pod_doc(name="js", phase="Running"), pod_doc(name="bad", phase="Failed")])
     js = fake_jetstream(JETSTREAM_TEXT)
     try:
         sampler, server = serve(
             {
-                "TPUMON_PROMETHEUS_URL": prom.url,
+                "TPUMON_PROMETHEUS_URL": "http://127.0.0.1:1",  # deprecated
                 "TPUMON_K8S_MODE": "api",
                 "TPUMON_K8S_API_URL": k8s.url,
                 "TPUMON_SERVING_TARGETS": js.url,
             }
         )
+        assert server.history.prometheus_deprecated is True
 
         async def scenario():
             await sampler.tick_all()
@@ -172,8 +174,14 @@ def test_full_stack_with_fake_backends():
             assert "pod.default/bad.failed" in keys
 
             hist = await asyncio.to_thread(get_json, port, "/api/history")
-            assert hist["source"] == "prometheus"
-            assert hist["cpu"]["data"][0] == 61.5
+            assert hist["source"] == "ring"
+            assert hist["cpu"]["data"], "host cpu series missing from ring"
+            # The same store answers rich expressions via the query
+            # engine route (tpumon.query).
+            q = await asyncio.to_thread(
+                get_json, port, "/api/query?query=avg_over_time(cpu[5m])"
+            )
+            assert q["result"] and q["result"][0]["value"] is not None
 
             serving = await asyncio.to_thread(get_json, port, "/api/serving")
             t = serving["targets"][0]
@@ -186,7 +194,6 @@ def test_full_stack_with_fake_backends():
 
         asyncio.run(scenario())
     finally:
-        prom.close()
         k8s.close()
         js.close()
 

@@ -27,7 +27,12 @@ declarative pass over every name registry the tree carries:
 - exporter metrics: every ``tpumon_federation_*`` family name in
   tpumon/exporter.py must appear in README.md or docs/federation.md
   (``registry.metric-undocumented``) — the fleet gauges are an
-  operator-facing contract, not an implementation detail.
+  operator-facing contract, not an implementation detail;
+- query functions: every name in tpumon/query.py's function registry
+  (``RANGE_FUNCTIONS`` + ``AGG_OPS``) must have a row in
+  docs/query.md's "## Functions" table, and that table may not invent
+  functions (``registry.query-func-*``) — the expression language's
+  vocabulary is user-facing and must not drift from its docs.
 
 The scan helpers are module-level so tests/test_routes_doc.py and
 tests/test_events_doc.py run their original assertions through the
@@ -47,9 +52,11 @@ EVENTS = "tpumon/events.py"
 SERVER = "tpumon/server.py"
 BENCH = "bench.py"
 EXPORTER = "tpumon/exporter.py"
+QUERY = "tpumon/query.py"
 README = "README.md"
 EVENTS_DOC = "docs/events.md"
 FEDERATION_DOC = "docs/federation.md"
+QUERY_DOC = "docs/query.md"
 
 # journal.record("<kind>" — restricted to journal receivers so
 # RingHistory.record("cpu", ...) never matches (same contract as the
@@ -276,6 +283,40 @@ def bench_keys_of_record(project: Project) -> list[tuple[str, int]]:
     return []
 
 
+def query_functions(project: Project) -> dict[str, int]:
+    """Function names declared in tpumon/query.py's registries
+    (``RANGE_FUNCTIONS`` + ``AGG_OPS`` literal tuples), with lines."""
+    sf = project.file(QUERY)
+    if sf is None or sf.tree is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        for t, value in _assign_targets(node):
+            if (
+                isinstance(t, ast.Name)
+                and t.id in ("RANGE_FUNCTIONS", "AGG_OPS")
+                and isinstance(value, (ast.Tuple, ast.List))
+            ):
+                for elt in value.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        out[s] = elt.lineno
+    return out
+
+
+def documented_query_functions(project: Project) -> set[str]:
+    """Function names with a table row in docs/query.md's
+    "## Functions" section (other tables in the doc — labels, bench
+    keys — are not function vocabulary)."""
+    sf = project.file(QUERY_DOC)
+    if sf is None:
+        return set()
+    m = re.search(r"^## Functions\n(.*?)(?=^## |\Z)", sf.text, re.M | re.S)
+    if not m:
+        return set()
+    return set(TABLE_ROW_RE.findall(m.group(1)))
+
+
 def exporter_metric_families(project: Project) -> dict[str, int]:
     """Literal metric-family names registered in tpumon/exporter.py."""
     sf = project.file(EXPORTER)
@@ -456,6 +497,39 @@ def check(project: Project) -> list[Finding]:
                         ),
                     )
                 )
+
+    # --- query-engine function vocabulary (ISSUE 12 satellite) ---
+    funcs = query_functions(project)
+    if funcs and project.file(QUERY_DOC) is not None:
+        documented = documented_query_functions(project)
+        # No `if documented` guard: a deleted/renamed "## Functions"
+        # table must fire one finding per function, not disarm the
+        # lint — the drift this pass exists to catch.
+        for name, line in sorted(funcs.items()):
+            if name not in documented:
+                findings.append(
+                    Finding(
+                        check="registry.query-func-undocumented",
+                        path=QUERY,
+                        line=line,
+                        message=(
+                            f"query function {name!r} has no row in "
+                            f"docs/query.md's Functions table"
+                        ),
+                    )
+                )
+        for name in sorted(documented - set(funcs)):
+            findings.append(
+                Finding(
+                    check="registry.query-func-phantom",
+                    path=QUERY_DOC,
+                    line=1,
+                    message=(
+                        f"docs/query.md documents function {name!r}, which "
+                        f"tpumon/query.py does not declare"
+                    ),
+                )
+            )
 
     # --- federation exporter gauges (ISSUE 8 satellite) ---
     fed_doc = project.file(FEDERATION_DOC)

@@ -16,6 +16,16 @@ Deliberate fixes over the reference:
 - TPU-only rules: stalled-chip (HBM committed but MXU idle), ICI link
   down, and slice-failure (expected chips missing) per SURVEY §2.2's
   north-star re-keying.
+- **Expression rules** (ISSUE 12): the host/chip/slice/serving
+  threshold rules are no longer hand-rolled comparison closures — each
+  is an expression in the in-tree query language (tpumon.query),
+  formatted with this config's threshold values and **compiled once
+  per engine** (``compile_env``); the per-tick loop evaluates the
+  generated closures over a flat ``chip.hbm``-style environment. The
+  pre-refactor behavior is pinned bit-for-bit by the golden scenario
+  fixture (tests/fixtures/alerts_scenario.json). Presentation
+  (title/desc/fix text) stays data in the rule specs; only the firing
+  *conditions* are expressions.
 """
 
 from __future__ import annotations
@@ -24,8 +34,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterable
 
-from tpumon.config import Thresholds
+from tpumon.config import Thresholds, TriLevel
 from tpumon.events import EventJournal
+from tpumon.query import compile_env
 from tpumon.topology import ChipSample, SliceView, attribute_pods
 
 SEVERITIES = ("minor", "serious", "critical")
@@ -59,6 +70,74 @@ def _bucketize(alerts: Iterable[Alert]) -> dict[str, list[dict]]:
 _SEV_LABEL = {"minor": "notice", "serious": "high", "critical": "critical"}
 
 
+# ------------------- expression-rule generation -------------------------
+#
+# Threshold rules are built from expression strings in the in-tree
+# query language (tpumon.query.compile_env): the gate/condition text is
+# formatted with the config's threshold values ONCE per engine and
+# compiled to a closure; evaluation is then closure(env) over a flat
+# environment ({"chip.hbm": 91.0, ...}). Missing data follows alerting
+# semantics — a comparison against None is False, so absent metrics
+# never fire. The generated evaluators slot into the same rules × items
+# loops the hand-rolled closures used, pinned by the golden scenario
+# fixture.
+
+
+def _tri_rule(value_expr: str, tri: TriLevel, gate_expr: str | None, emit):
+    """Generated evaluator for a TriLevel threshold: optional compiled
+    gate, compiled value expression, tri.severity() classification,
+    ``emit(item, value, sev, note) -> Alert``."""
+    value_fn = compile_env(value_expr)
+    gate_fn = compile_env(gate_expr) if gate_expr else None
+
+    def rule(item, env: dict, note: str) -> Alert | None:
+        if gate_fn is not None and not gate_fn(env):
+            return None
+        v = value_fn(env)
+        if v is None:
+            return None
+        sev = tri.severity(float(v))
+        if not sev:
+            return None
+        return emit(item, float(v), sev, note)
+
+    return rule
+
+
+def _cond_rule(cond_expr: str, emit):
+    """Generated evaluator for a fixed-severity condition expression:
+    ``emit(item, env, note) -> Alert`` runs iff the compiled condition
+    holds."""
+    cond_fn = compile_env(cond_expr)
+
+    def rule(item, env: dict, note: str) -> Alert | None:
+        if not cond_fn(env):
+            return None
+        return emit(item, env, note)
+
+    return rule
+
+
+def _chip_env(c: ChipSample, hbm: float | None) -> dict:
+    """The expression vocabulary for per-chip rules — deliberately the
+    same ``chip.<metric>`` spelling the query engine derives from the
+    ring's series naming, so an alert condition reads like a query."""
+    return {
+        "chip.hbm": hbm,
+        "chip.mxu": c.mxu_duty_pct,
+        "chip.temp": c.temp_c,
+        "chip.ici_health": (
+            None if c.ici_link_health is None else float(c.ici_link_health)
+        ),
+        "chip.throttle": (
+            None if c.throttle_score is None else float(c.throttle_score)
+        ),
+        "chip.link_up": (
+            None if c.ici_link_up is None else (1.0 if c.ici_link_up else 0.0)
+        ),
+    }
+
+
 class AlertEngine:
     def __init__(
         self,
@@ -66,10 +145,14 @@ class AlertEngine:
         journal: EventJournal | None = None,
     ):
         self.t = thresholds or Thresholds()
-        # Per-chip threshold rules built once per config — the per-tick
-        # loop evaluates closures instead of re-constructing rule
-        # tables per chip (_build_chip_rules).
+        # Threshold rules as compiled expressions, built once per
+        # config (the expression text embeds this config's threshold
+        # values): the per-tick loops evaluate generated closures, not
+        # hand-rolled comparisons (_build_*_rules; docs/query.md).
         self._chip_rules = self._build_chip_rules()
+        self._host_rules = self._build_host_rules()
+        self._slice_rule = self._build_slice_rule()
+        self._kv_rule = self._build_kv_rule()
         # Pod transition state (reference: module-global lastPodStates,
         # monitor_server.js:157 — here private to the engine, which is
         # only driven by the sampler).
@@ -170,76 +253,75 @@ class AlertEngine:
 
     # ---------------- host rules (monitor_server.js:162-175) -------------
 
-    def _host_alerts(self, host: dict | None) -> list[Alert]:
-        alerts: list[Alert] = []
-        if not host:
-            return alerts
-        checks = (
+    def _build_host_rules(self) -> list:
+        specs = (
             (
-                "cpu",
-                (host.get("cpu") or {}).get("percent"),
-                self.t.cpu_pct,
-                "CPU usage",
+                "cpu", self.t.cpu_pct, "CPU usage",
                 "Identify hot processes (top/pidstat); rebalance or scale out "
                 "CPU-bound preprocessing and data-loading work.",
             ),
             (
-                "memory",
-                (host.get("memory") or {}).get("percent"),
-                self.t.memory_pct,
-                "Memory usage",
+                "memory", self.t.memory_pct, "Memory usage",
                 "Find the largest consumers (ps --sort=-rss); lower host-side "
                 "cache sizes or move work off this host before the OOM killer "
                 "does it for you.",
             ),
             (
-                "disk",
-                (host.get("disk") or {}).get("percent"),
-                self.t.disk_pct,
-                "Disk usage",
+                "disk", self.t.disk_pct, "Disk usage",
                 "Clear old checkpoints/logs or expand the volume; full disks "
                 "break checkpoint writes and pod scheduling.",
             ),
         )
-        for key, value, tri, label, fix in checks:
-            if value is None:
-                continue
-            sev = tri.severity(float(value))
-            if sev:
-                alerts.append(
-                    Alert(
-                        severity=sev,
-                        title=f"{label} {_SEV_LABEL[sev]}",
-                        desc=f"{label} at {float(value):.1f}% "
-                        f"(threshold {getattr(tri, sev)}%)",
-                        fix=fix,
-                        key=f"host.{key}.{sev}",
-                    )
+        rules = []
+        for key, tri, label, fix in specs:
+
+            def emit(_item, v, sev, _note, key=key, tri=tri, label=label, fix=fix):
+                return Alert(
+                    severity=sev,
+                    title=f"{label} {_SEV_LABEL[sev]}",
+                    desc=f"{label} at {v:.1f}% "
+                    f"(threshold {getattr(tri, sev)}%)",
+                    fix=fix,
+                    key=f"host.{key}.{sev}",
                 )
+
+            rules.append(_tri_rule(f"host.{key}", tri, None, emit))
+        return rules
+
+    def _host_alerts(self, host: dict | None) -> list[Alert]:
+        alerts: list[Alert] = []
+        if not host:
+            return alerts
+        env = {
+            "host.cpu": (host.get("cpu") or {}).get("percent"),
+            "host.memory": (host.get("memory") or {}).get("percent"),
+            "host.disk": (host.get("disk") or {}).get("percent"),
+        }
+        for rule in self._host_rules:
+            a = rule(None, env, "")
+            if a is not None:
+                alerts.append(a)
         return alerts
 
     # ------------- per-chip rules (re-keyed monitor_server.js:178-184) ----
 
     def _build_chip_rules(self) -> list:
-        """Per-chip threshold rules, built ONCE per engine (thresholds
-        are fixed at construction): each rule is a closure over its
-        thresholds/fix text that maps (chip, hbm_pct, pod_note) ->
-        Alert | None. The per-tick loop below is then a flat
-        rules × chips evaluation with no per-chip string/tuple table
-        construction — at 256 chips this keeps alert evaluation linear
-        with a small constant."""
+        """Per-chip threshold rules as compiled expressions, built ONCE
+        per engine: each rule's firing condition is an expression in
+        the query language — formatted with this config's threshold
+        values, parsed by tpumon.query, compiled to a closure — and the
+        per-tick loop is a flat rules × chips evaluation of generated
+        evaluators over a per-chip environment (_chip_env). At 256
+        chips this keeps alert evaluation linear with a small constant,
+        and a deployment reading the rule table sees the *conditions*
+        in the same language it queries with."""
         t = self.t
 
-        def hbm_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            if hbm is None:
-                return None
-            sev = t.hbm_pct.severity(hbm)
-            if not sev:
-                return None
+        def hbm_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
             return Alert(
                 severity=sev,
                 title=f"HBM pressure on {c.chip_id}",
-                desc=f"HBM at {hbm:.1f}% "
+                desc=f"HBM at {v:.1f}% "
                 f"({(c.hbm_used or 0) / 2**30:.1f} / "
                 f"{(c.hbm_total or 0) / 2**30:.1f} GiB){pod_note}",
                 fix="Reduce batch size or sequence length, shard the "
@@ -248,16 +330,11 @@ class AlertEngine:
                 key=f"chip.{c.chip_id}.hbm.{sev}",
             )
 
-        def temp_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            if c.temp_c is None:
-                return None
-            sev = t.temp_c.severity(c.temp_c)
-            if not sev:
-                return None
+        def temp_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
             return Alert(
                 severity=sev,
                 title=f"Temperature {_SEV_LABEL[sev]} on {c.chip_id}",
-                desc=f"Chip at {c.temp_c:.0f}°C "
+                desc=f"Chip at {v:.0f}°C "
                 f"(threshold {getattr(t.temp_c, sev)}°C)",
                 fix="Check node cooling/airflow and ambient temp; "
                 "sustained thermal throttling degrades step time "
@@ -265,35 +342,26 @@ class AlertEngine:
                 key=f"chip.{c.chip_id}.temp.{sev}",
             )
 
-        def stalled_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            # HBM heavily committed but MXU ~idle ⇒ the job holds memory
-            # without computing (wedged collective, host input stall,
-            # deadlock).
-            if (
-                c.mxu_duty_pct is None
-                or hbm is None
-                or hbm <= t.mxu_idle_hbm_gate_pct
-                or c.mxu_duty_pct >= t.mxu_idle_pct
-            ):
-                return None
+        # HBM heavily committed but MXU ~idle ⇒ the job holds memory
+        # without computing (wedged collective, host input stall,
+        # deadlock).
+        def stalled_emit(c: ChipSample, env: dict, pod_note: str) -> Alert:
             return Alert(
                 severity="serious",
                 title=f"Chip {c.chip_id} stalled",
-                desc=f"HBM {hbm:.0f}% committed but MXU duty cycle only "
-                f"{c.mxu_duty_pct:.1f}%{pod_note}",
+                desc=f"HBM {env['chip.hbm']:.0f}% committed but MXU duty "
+                f"cycle only {c.mxu_duty_pct:.1f}%{pod_note}",
                 fix="The job holds memory but isn't computing: look for "
                 "a host-side input bottleneck, a hung collective "
                 "(one host of the slice down?), or a deadlocked step.",
                 key=f"chip.{c.chip_id}.stalled",
             )
 
-        def link_down_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            # Either the producer says so directly, or the SDK health
-            # score hits 10 ("link is not usable"). The engine owns this
-            # derivation so a producer that sets only the score (e.g. a
-            # fake-backend override) still raises the critical alert.
-            if not (c.ici_link_up is False or c.ici_link_health == 10):
-                return None
+        # Link down: the producer says so directly (link_up False), or
+        # the SDK health score hits 10 ("link is not usable") — the
+        # engine owns this derivation so a producer that sets only the
+        # score still raises the critical alert.
+        def link_down_emit(c: ChipSample, env: dict, pod_note: str) -> Alert:
             return Alert(
                 severity="critical",
                 title=f"ICI link down on {c.chip_id}",
@@ -304,15 +372,10 @@ class AlertEngine:
                 key=f"chip.{c.chip_id}.ici_down",
             )
 
-        def ici_health_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            # libtpu SDK 0-10 score (PROBE_libtpu.md): 1-5 transient ->
-            # minor, 6-9 persistent -> serious. Score 10 ("unusable") is
-            # the critical link-down rule above.
-            if c.ici_link_health is None or not 0 < c.ici_link_health < 10:
-                return None
-            sev = t.ici_health_score.severity(c.ici_link_health)
-            if not sev:
-                return None
+        # libtpu SDK 0-10 score (PROBE_libtpu.md): 1-5 transient ->
+        # minor, 6-9 persistent -> serious; 10 is the critical
+        # link-down rule above.
+        def ici_health_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
             return Alert(
                 severity=sev,
                 title=f"ICI link degraded on {c.chip_id}",
@@ -326,15 +389,9 @@ class AlertEngine:
                 key=f"chip.{c.chip_id}.ici_health.{sev}",
             )
 
-        def throttle_rule(c: ChipSample, hbm, pod_note: str) -> Alert | None:
-            # libtpu SDK score 0-10 = throttled by 0-100% — the
-            # platform's thermal/power proxy; TPUs expose no direct
-            # temperature metric (PROBE_libtpu.md finding #4).
-            if c.throttle_score is None or c.throttle_score <= 0:
-                return None
-            sev = t.throttle_score.severity(c.throttle_score)
-            if not sev:
-                return None
+        # Throttle score 0-10 = throttled by 0-100% — the platform's
+        # thermal/power proxy (PROBE_libtpu.md finding #4).
+        def throttle_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
             return Alert(
                 severity=sev,
                 title=f"TPU throttled on {c.chip_id}",
@@ -347,12 +404,29 @@ class AlertEngine:
             )
 
         return [
-            hbm_rule,
-            temp_rule,
-            stalled_rule,
-            link_down_rule,
-            ici_health_rule,
-            throttle_rule,
+            _tri_rule("chip.hbm", t.hbm_pct, None, hbm_emit),
+            _tri_rule("chip.temp", t.temp_c, None, temp_emit),
+            _cond_rule(
+                f"chip.hbm > {t.mxu_idle_hbm_gate_pct!r} "
+                f"and chip.mxu < {t.mxu_idle_pct!r}",
+                stalled_emit,
+            ),
+            _cond_rule(
+                "chip.link_up == 0 or chip.ici_health == 10",
+                link_down_emit,
+            ),
+            _tri_rule(
+                "chip.ici_health",
+                t.ici_health_score,
+                "chip.ici_health > 0 and chip.ici_health < 10",
+                ici_health_emit,
+            ),
+            _tri_rule(
+                "chip.throttle",
+                t.throttle_score,
+                "chip.throttle > 0",
+                throttle_emit,
+            ),
         ]
 
     def _chip_alerts(
@@ -365,32 +439,43 @@ class AlertEngine:
             # alert text so remediation starts at the right pod.
             pod = owners.get(c.chip_id)
             pod_note = f" — pod {pod}" if pod else ""
-            hbm = c.hbm_pct
+            env = _chip_env(c, c.hbm_pct)
             for rule in self._chip_rules:
-                a = rule(c, hbm, pod_note)
+                a = rule(c, env, pod_note)
                 if a is not None:
                     alerts.append(a)
         return alerts
 
     # ------------- slice rules (SURVEY §2.2 TPU re-keying) ----------------
 
+    def _build_slice_rule(self):
+        def emit(s: SliceView, env: dict, _note: str) -> Alert:
+            return Alert(
+                severity="critical",
+                title=f"Slice {s.slice_id} unhealthy",
+                desc=f"{s.reporting_chips}/{s.expected_chips} chips "
+                f"reporting ({s.missing_chips} missing) across hosts "
+                f"{', '.join(s.hosts) or 'none'}",
+                fix="A multi-host slice is all-or-nothing: check the "
+                "non-reporting hosts' pods/VMs and restart the slice "
+                "job from the last checkpoint once all hosts are back.",
+                key=f"slice.{s.slice_id}.missing",
+            )
+
+        return _cond_rule("slice.missing > 0 and slice.expected > 0", emit)
+
     def _slice_alerts(self, slices: list[SliceView]) -> list[Alert]:
         alerts: list[Alert] = []
         for s in slices:
-            if s.expected_chips and s.missing_chips > 0:
-                alerts.append(
-                    Alert(
-                        severity="critical",
-                        title=f"Slice {s.slice_id} unhealthy",
-                        desc=f"{s.reporting_chips}/{s.expected_chips} chips "
-                        f"reporting ({s.missing_chips} missing) across hosts "
-                        f"{', '.join(s.hosts) or 'none'}",
-                        fix="A multi-host slice is all-or-nothing: check the "
-                        "non-reporting hosts' pods/VMs and restart the slice "
-                        "job from the last checkpoint once all hosts are back.",
-                        key=f"slice.{s.slice_id}.missing",
-                    )
-                )
+            env = {
+                "slice.missing": float(s.missing_chips),
+                "slice.expected": (
+                    None if s.expected_chips is None else float(s.expected_chips)
+                ),
+            }
+            a = self._slice_rule(s, env, "")
+            if a is not None:
+                alerts.append(a)
         return alerts
 
     # ------------- pod rules (monitor_server.js:188-232) ------------------
@@ -582,24 +667,31 @@ class AlertEngine:
                         key=f"serving.{s.get('target')}.down",
                     )
                 )
-            kv = s.get("kv_pages_used_pct")
-            if s.get("ok") and kv is not None:
-                sev = self.t.kv_pool_pct.severity(kv)
-                if sev:
-                    alerts.append(
-                        Alert(
-                            severity=sev,
-                            title=f"KV pool pressure on {target}",
-                            desc=f"Paged KV pool {kv:.0f}% reserved "
-                            f"(threshold "
-                            f"{getattr(self.t.kv_pool_pct, sev):.0f}%)",
-                            fix="Admissions are about to queue on KV "
-                            "memory: grow --pool-pages, lower max_new, "
-                            "or add serving replicas.",
-                            key=f"serving.{target}.kv_pool",
-                        )
-                    )
+            if s.get("ok"):
+                a = self._kv_rule(
+                    target, {"serving.kv": s.get("kv_pages_used_pct")}, ""
+                )
+                if a is not None:
+                    alerts.append(a)
         return alerts
+
+    def _build_kv_rule(self):
+        t = self.t
+
+        def emit(target, v: float, sev: str, _note: str) -> Alert:
+            return Alert(
+                severity=sev,
+                title=f"KV pool pressure on {target}",
+                desc=f"Paged KV pool {v:.0f}% reserved "
+                f"(threshold "
+                f"{getattr(t.kv_pool_pct, sev):.0f}%)",
+                fix="Admissions are about to queue on KV "
+                "memory: grow --pool-pages, lower max_new, "
+                "or add serving replicas.",
+                key=f"serving.{target}.kv_pool",
+            )
+
+        return _tri_rule("serving.kv", t.kv_pool_pct, None, emit)
 
     # ------------- anomaly rule (tpumon.anomaly EWMA detectors) -----------
 

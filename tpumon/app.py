@@ -264,6 +264,13 @@ def main(argv: list[str] | None = None) -> int:
         from tpumon.events import events_cli
 
         return events_cli(argv[1:])
+    if argv and argv[0] == "query":
+        # ``tpumon query 'expr'`` — instant/range queries against a
+        # running server's in-tree engine (tpumon.query; docs/query.md);
+        # --fleet plans a distributed query over the federation tree.
+        from tpumon.query import query_cli
+
+        return query_cli(argv[1:])
     path = None
     overrides = {}
     serve_loadgen = False
@@ -433,6 +440,11 @@ def main(argv: list[str] | None = None) -> int:
             # Native TSDB append/downsample kernel ("off" forces the
             # bit-exact pure-Python ingest path).
             overrides["ingest_kernel"] = take(arg)
+        elif arg == "--recording-rules":
+            # Comma-separated query recording rules ("chip.mxu[5m]"):
+            # append-time aggregates for O(1) instant reads
+            # (tpumon.query, docs/query.md).
+            overrides["recording_rules"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
@@ -455,6 +467,7 @@ def main(argv: list[str] | None = None) -> int:
                 "[--history-snapshot-format binary|json] "
                 "[--history-per-chip N] "
                 "[--wire-binary on|off] [--ingest-kernel on|off] "
+                "[--recording-rules chip.mxu[5m],...] "
                 "[--trace-ring N] "
                 "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"
@@ -464,6 +477,9 @@ def main(argv: list[str] | None = None) -> int:
                 "       python -m tpumon events [--url HOST:8888] [-n N] "
                 "[--kind K] [--severity S] [--follow] [--json]   (event "
                 "journal tail)\n"
+                "       python -m tpumon query 'expr' [--url HOST:8888] "
+                "[--range 30m --step 30s] [--fleet] [--json]   (in-tree "
+                "PromQL-subset queries, docs/query.md)\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

@@ -117,7 +117,11 @@ class Config:
     host: str = "0.0.0.0"
 
     # --- history (reference: 30m window / 30s step, monitor_server.js:38) ---
-    prometheus_url: str | None = None  # None => ring-buffer-only degraded mode
+    # DEPRECATED: the external-Prometheus history path is retired — the
+    # in-process TSDB + query engine (tpumon.query, docs/query.md)
+    # serve /api/history and /api/query. Accepted so old configs load;
+    # a deprecation warning is printed, nothing is queried.
+    prometheus_url: str | None = None
     history_window_s: float = 30 * 60
     history_step_s: float = 30
     # Long-range tier: /api/history?window= up to this span, served from
@@ -257,6 +261,24 @@ class Config:
     # shared library is built.
     ingest_kernel: bool = True
 
+    # --- in-tree query engine (tpumon.query; docs/query.md) ---
+    # Recording rules: ``family[window]`` range selectors (e.g.
+    # "chip.mxu[5m]") whose count/sum/min/max/rate/quantile aggregates
+    # are maintained incrementally AT APPEND TIME — an instant
+    # *_over_time / rate read over a registered (family, window) is an
+    # O(1) head-state merge, never a point walk.
+    recording_rules: tuple[str, ...] = ()
+    # Default window when a range function omits [w]: rate(chip.hbm)
+    # reads the last query_default_range.
+    query_default_range_s: float = 60.0
+    # Instant-selector staleness bound: a series with no point newer
+    # than this is absent from instant vectors (Prometheus lookback).
+    query_lookback_s: float = 300.0
+    # Wall budget for one distributed (fleet=1) query across the
+    # federation tree; silent/dark nodes past it degrade the answer to
+    # an explicit partial instead of an error.
+    query_fleet_timeout_s: float = 2.0
+
     # --- SSE delta stream (tpumon.server, docs/perf.md) ---
     # The /api/stream push emits delta frames (only changed fields,
     # keyed by snapshot epoch); a full keyframe recurs every this many
@@ -292,7 +314,9 @@ class Config:
     access_log: bool = False
 
     # Bearer token gating the mutating/expensive routes (POST
-    # /api/silence, /api/unsilence; GET /api/profile). None (default)
+    # /api/silence, /api/unsilence; GET /api/profile; GET
+    # /api/query?fleet=1 — a distributed query fans sub-queries across
+    # the whole federation tree per request). None (default)
     # keeps those routes open — reference parity (monitor_server.js:
     # 244-248 serves everything unauthenticated) — but the reference has
     # no mutating routes, so deployments that page off tpumon alerts
@@ -348,6 +372,7 @@ _SCALAR_FIELDS: dict[str, type] = {
     "federation_keyframe_every": int,
     "federation_dark_after_s": float,
     "ingest_kernel": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "query_fleet_timeout_s": float,
     "sse_keyframe_every": int,
     "webhook_min_severity": str,
     "webhook_timeout_s": float,
@@ -364,8 +389,13 @@ _DURATION_KEYS = {
     "history_coarse_step": "history_coarse_step_s",
     "history_mid_step": "history_mid_step_s",
     "history_mid_window": "history_mid_window_s",
+    "query_default_range": "query_default_range_s",
+    "query_lookback": "query_lookback_s",
 }
-_LIST_FIELDS = {"collectors", "disk_mounts", "serving_targets", "peers", "alert_webhooks"}
+_LIST_FIELDS = {
+    "collectors", "disk_mounts", "serving_targets", "peers",
+    "alert_webhooks", "recording_rules",
+}
 
 
 def _coerce_thresholds(raw: Mapping[str, Any], base: Thresholds) -> Thresholds:

@@ -60,6 +60,9 @@ KINDS = (
     "history",    # history/state/journal snapshot save+restore moments
     "peer",       # federation peer up / down / wire-fallback
     "profile",    # jax.profiler device capture (tpumon.profiler)
+    "query",      # query engine: rejected recording rule, distributed
+                  # sub-query timeout, partial-merge degraded
+                  # (tpumon.query / tpumon.federation)
     "server",     # HTTP server lifecycle (tpumon.app)
     "silence",    # alert silence added / removed (tpumon.alerts)
     "watchdog",   # sampler loop overrun / swallowed exception

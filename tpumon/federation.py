@@ -53,11 +53,18 @@ from tpumon import tsdb
 from tpumon.collectors import Collector, Sample
 from tpumon.protowire import (
     DELTA_STREAM_CTYPE,
+    QUERY_REQ_MAGIC,
+    QUERY_RES_MAGIC,
     DeltaStreamDecoder,
     DeltaStreamEncoder,
+    decode_query_request,
+    decode_query_result,
     decode_varint,
+    encode_query_request,
+    encode_query_result,
     encode_varint,
 )
+from tpumon.query import QueryError
 from tpumon.topology import (
     WIRE_VERSION,
     ChipSample,
@@ -188,6 +195,7 @@ class NodeState:
         "node", "tier", "status", "connected", "decoder", "chips",
         "slice_rows", "last_ts", "last_wall", "frames", "keyframes",
         "resyncs", "bytes", "lagging", "conn", "error",
+        "writer", "wlock", "query_results",
     )
 
     def __init__(self, node: str, tier: str):
@@ -207,6 +215,12 @@ class NodeState:
         self.lagging = False
         self.conn: object | None = None  # current connection token
         self.error: str | None = None
+        # Live ingest-stream writer + its write lock — the hub's
+        # query push-down channel (TPWQ frames flow DOWN the same
+        # socket the delta frames flow up; cleared on disconnect).
+        self.writer: asyncio.StreamWriter | None = None
+        self.wlock: asyncio.Lock | None = None
+        self.query_results = 0  # TPWR partial-result frames received
 
     def to_json(self) -> dict:
         return {
@@ -263,6 +277,17 @@ class FederationHub:
         # without double-counting the hub's own downstream chips.
         self.local_chips: list[ChipSample] = []
         self.frames = 0
+        # Distributed-query plumbing (docs/query.md): in-flight TPWQ
+        # sub-queries awaiting a downstream TPWR, keyed by qid.
+        self._qid = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        # Journal hygiene: partial answers and per-node sub-query
+        # timeouts record on TRANSITIONS only (a dashboard polling a
+        # tree with one dark leaf must not flood the bounded event
+        # ring with an identical event per poll — same contract as the
+        # peer/federation kinds).
+        self._partial_missing: frozenset = frozenset()
+        self._timeout_logged: set[str] = set()
 
     def bind(self, sampler) -> None:
         self.sampler = sampler
@@ -327,6 +352,11 @@ class FederationHub:
         ns.conn = token  # a reconnect supersedes the old stream
         ns.connected = True
         ns.decoder = DeltaStreamDecoder()  # new stream ⇒ fresh baseline
+        # Query push-down rides this same socket (server→client bytes
+        # on the open POST; the uplink's reader task parses them as
+        # varint records).
+        ns.writer = writer
+        ns.wlock = asyncio.Lock()
         # Connection state is part of the published fleet view
         # (NodeState.to_json "connected"): a connect that lands before
         # the first frame must re-render /api/federation too.
@@ -360,6 +390,8 @@ class FederationHub:
         finally:
             if ns.conn is token:
                 ns.connected = False
+                ns.writer = None
+                ns.wlock = None
                 self._bump()
         with contextlib.suppress(Exception):
             body = (
@@ -403,6 +435,17 @@ class FederationHub:
         return data
 
     def _ingest_frame(self, ns: NodeState, frame: bytes) -> None:
+        if frame[:4] == QUERY_RES_MAGIC:
+            # A downstream's answer to a pushed sub-query: resolve the
+            # waiting future; never touches the delta decoder or the
+            # node's data-liveness clock (a node answering queries but
+            # sending no data frames still goes dark honestly).
+            qid, partial, error, payload = decode_query_result(frame)
+            ns.query_results += 1
+            fut = self._pending.get(qid)
+            if fut is not None and not fut.done():
+                fut.set_result((partial, error, payload))
+            return
         res = ns.decoder.apply(frame)  # ValueError → caller answers 400
         self.frames += 1
         ns.frames += 1
@@ -478,6 +521,166 @@ class FederationHub:
                     batch.append((f"slice.{node}.{sid}.{suffix}", v))
         if batch:
             self.history.record_batch(batch, ts=ts)
+
+    # ----------------------- distributed queries ------------------------
+    #
+    # The Monarch-style push-down (docs/query.md): a fleet query is a
+    # top-level aggregation; every tier evaluates the inner expression
+    # over ITS OWN data only and ships mergeable partial-aggregate
+    # state upstream — group sums/counts/min/max, topk row sets,
+    # quantile sketches — never raw points. The hub pushes TPWQ frames
+    # down the open ingest streams and merges the TPWR answers with its
+    # local partial; the root additionally finalizes. A silent or dark
+    # downstream degrades the answer to an explicit ``partial`` marker
+    # plus a ``query`` journal event instead of an error.
+
+    def _query_exclude(self):
+        """Series this node must NOT contribute to a fleet partial:
+        everything it LANDED from downstream rather than originated —
+        slice.* rollups (hub-landed by construction) and per-chip
+        series for downstream chips (the merged accel view records
+        them locally too). Without this an aggregator double-counts
+        every leaf it serves."""
+        downstream: set[str] = set()
+        for ns in self.nodes.values():
+            for c in ns.chips:
+                downstream.add(c.chip_id)
+
+        def exclude(family: str, labels: dict) -> bool:
+            if family.startswith("slice."):
+                return True
+            cid = labels.get("chip")
+            return cid is not None and cid in downstream
+
+        return exclude
+
+    async def _push_query(
+        self, ns: NodeState, expr: str, at: float, timeout_s: float
+    ):
+        """One TPWQ→TPWR round trip to one downstream; returns the
+        decoded (partial, error, payload) or None on timeout/transport
+        failure (the caller marks the node missing)."""
+        self._qid += 1
+        qid = self._qid
+        frame = encode_query_request(qid, expr, at, timeout_s)
+        rec = encode_varint(len(frame)) + frame
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[qid] = fut
+        try:
+            writer, lock = ns.writer, ns.wlock
+            if writer is None or lock is None:
+                return None
+            async with lock:
+                writer.write(rec)
+                await writer.drain()
+            return await asyncio.wait_for(fut, timeout_s)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            if self.journal is not None and ns.node not in self._timeout_logged:
+                # Transition only: a node that keeps timing out while a
+                # dashboard polls is ONE incident, not one per query.
+                self._timeout_logged.add(ns.node)
+                self.journal.record(
+                    "query", "minor", ns.node,
+                    f"fleet sub-query to {ns.node} timed out after "
+                    f"{timeout_s:.2f}s (answer degrades to partial)",
+                    timeout_s=round(timeout_s, 3),
+                )
+            return None
+        finally:
+            self._pending.pop(qid, None)
+
+    async def fleet_partial(
+        self, expr: str, at: float, timeout_s: float
+    ) -> tuple[dict, list[str]]:
+        """Evaluate a fleet query to PARTIAL state at this tier: push
+        the sub-query to every connected downstream, merge their
+        partials with the local one. Returns (merged partial state,
+        missing node names). Raises QueryError on an undistributable
+        expression (surfaces as 400 at the root)."""
+        engine = self.sampler.query if self.sampler is not None else None
+        if engine is None:
+            raise QueryError("query engine unavailable (hub not bound)")
+        self.check_staleness()
+        targets: list[NodeState] = []
+        missing: list[str] = []
+        for name in sorted(self.nodes):
+            ns = self.nodes[name]
+            if ns.connected and ns.writer is not None:
+                targets.append(ns)
+            else:
+                missing.append(name)
+        # Local partial FIRST: a parse/plan error must fail fast before
+        # any downstream work, and the local state is always available.
+        parts: list[dict] = [
+            engine.partial_eval(expr, at=at, exclude=self._query_exclude())
+        ]
+        if targets:
+            child_timeout = max(0.25, timeout_s * 0.8)
+            replies = await asyncio.gather(
+                *(self._push_query(ns, expr, at, child_timeout) for ns in targets)
+            )
+            for ns, reply in zip(targets, replies):
+                if reply is None:
+                    missing.append(ns.node)
+                    continue
+                self._timeout_logged.discard(ns.node)  # re-arm the log
+                partial_flag, error, payload = reply
+                if error is not None:
+                    missing.append(ns.node)
+                    if self.journal is not None:
+                        self.journal.record(
+                            "query", "minor", ns.node,
+                            f"fleet sub-query failed at {ns.node}: {error}",
+                        )
+                    continue
+                sub = payload.get("partial")
+                if sub:
+                    parts.append(sub)
+                missing.extend(
+                    f"{ns.node}/{m}" for m in payload.get("missing") or []
+                )
+        return engine.merge_partials(parts), missing
+
+    async def fleet_query(
+        self, expr: str, at: float | None = None, timeout_s: float = 2.0
+    ) -> dict:
+        """Root entry point (GET /api/query?fleet=1): plan, push down,
+        merge, finalize. A degraded answer carries ``partial: true`` +
+        the missing subtree names — explicitly partial, never silently
+        wrong, never an error."""
+        at = time.time() if at is None else at
+        engine = self.sampler.query
+        partial, missing = await self.fleet_partial(expr, at, timeout_s)
+        out = {
+            "result_type": "vector",
+            "at": round(at, 3),
+            "result": engine.finalize(partial),
+            "fleet": True,
+            "node": self.node,
+            "nodes": len(self.nodes),
+        }
+        if missing:
+            out["partial"] = True
+            out["missing"] = sorted(set(missing))
+        # Degradation journals on TRANSITIONS of the missing set — a
+        # steady dark leaf under a polling dashboard is one incident,
+        # and recovery back to full answers closes it.
+        missing_now = frozenset(out.get("missing") or ())
+        if missing_now != self._partial_missing and self.journal is not None:
+            if missing_now:
+                self.journal.record(
+                    "query", "minor", "query",
+                    f"fleet query answered partial: missing "
+                    f"{', '.join(sorted(missing_now))}",
+                    expr=expr[:120],
+                )
+            else:
+                self.journal.record(
+                    "query", "info", "query",
+                    "fleet queries answering in full again",
+                )
+        self._partial_missing = missing_now
+        return out
 
     # ------------------------------ views -------------------------------
 
@@ -680,6 +883,11 @@ class FederationUplink:
         self.connected = False
         self.connects = 0
         self.resyncs = 0
+        # Distributed-query service stats: TPWQ sub-queries answered on
+        # this stream and the TPWR bytes shipped — the "never raw
+        # points" bound the fed-query soak pins.
+        self.queries_answered = 0
+        self.query_bytes = 0
         self.last_error: str | None = None
         self._task: asyncio.Task | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -797,29 +1005,104 @@ class FederationUplink:
             # downstream's sample interval (no dark/recovered flap).
             interval = max(0.25, self.sampler.cfg.sample_interval_s)
             heartbeat = min(2.0, max(2 * interval, 0.25))
-            while True:
-                ts = time.time()
-                v, fields, rows = self._payload(ts)
-                frame, _was_key = self.enc.encode(v, fields, rows, ts)
-                rec = encode_varint(len(frame)) + frame
-                writer.write(b"%x\r\n" % len(rec) + rec + b"\r\n")
-                await writer.drain()
-                # The upstream only ever writes a response to END the
-                # stream (400 on a refused frame, or its own shutdown):
-                # any readable data means this stream is done.
-                with contextlib.suppress(asyncio.TimeoutError):
-                    data = await asyncio.wait_for(reader.read(4096), 0.001)
-                    raise ConnectionError(
-                        "upstream ended stream"
-                        if data
-                        else "upstream closed connection"
-                    )
-                await self.sampler.wait_tick(timeout_s=heartbeat)
+            # Reader side: the upstream either pushes TPWQ sub-query
+            # frames down this socket (answered inline as interleaved
+            # TPWR records) or writes an HTTP response to END the
+            # stream — the reader task owns both cases and closes the
+            # writer on stream end so the tick loop fails fast.
+            wlock = asyncio.Lock()
+            qtask = asyncio.create_task(
+                self._serve_queries(reader, writer, wlock)
+            )
+            try:
+                while True:
+                    ts = time.time()
+                    v, fields, rows = self._payload(ts)
+                    frame, _was_key = self.enc.encode(v, fields, rows, ts)
+                    rec = encode_varint(len(frame)) + frame
+                    async with wlock:
+                        writer.write(b"%x\r\n" % len(rec) + rec + b"\r\n")
+                        await writer.drain()
+                    if qtask.done():
+                        exc = qtask.exception()
+                        raise exc if exc is not None else ConnectionError(
+                            "upstream ended stream"
+                        )
+                    await self.sampler.wait_tick(timeout_s=heartbeat)
+            finally:
+                qtask.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await qtask
         finally:
             self._writer = None
             self.connected = False
             with contextlib.suppress(Exception):
                 writer.close()
+
+    async def _serve_queries(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        wlock: asyncio.Lock,
+    ) -> None:
+        """Read the upstream side of the uplink socket: TPWQ sub-query
+        records are evaluated (locally at a leaf; fanned further down
+        through this node's own hub at an aggregator) and answered as
+        interleaved chunked TPWR records; anything else — an HTTP
+        response, garbage — means the stream is over, so the writer is
+        closed to fail the tick loop promptly."""
+        buf = bytearray()
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    raise ConnectionError("upstream closed connection")
+                buf += data
+                try:
+                    records = split_records(buf)
+                except ValueError:
+                    raise ConnectionError("upstream ended stream")
+                for rec in records:
+                    if rec[:4] != QUERY_REQ_MAGIC:
+                        raise ConnectionError("upstream ended stream")
+                    qid, expr, at, timeout_s = decode_query_request(rec)
+                    reply = await self._answer_query(qid, expr, at, timeout_s)
+                    out = encode_varint(len(reply)) + reply
+                    self.queries_answered += 1
+                    self.query_bytes += len(out)
+                    async with wlock:
+                        writer.write(b"%x\r\n" % len(out) + out + b"\r\n")
+                        await writer.drain()
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    async def _answer_query(
+        self, qid: int, expr: str, at: float, timeout_s: float
+    ) -> bytes:
+        """One TPWQ → TPWR: partial-evaluate over local data (and, at an
+        aggregator, this node's own subtree). Evaluation failures ship
+        as explicit error results — the upstream degrades to partial
+        instead of tearing the stream down."""
+        try:
+            engine = getattr(self.sampler, "query", None)
+            if engine is None:
+                raise QueryError("query engine unavailable")
+            if self.hub is not None:
+                partial, missing = await self.hub.fleet_partial(
+                    expr, at, max(0.25, timeout_s * 0.8)
+                )
+                return encode_query_result(
+                    qid,
+                    {"partial": partial, "missing": missing},
+                    partial=bool(missing),
+                )
+            partial = engine.partial_eval(expr, at=at)
+            return encode_query_result(qid, {"partial": partial, "missing": []})
+        except Exception as e:
+            return encode_query_result(
+                qid, None, error=f"{type(e).__name__}: {e}"
+            )
 
     def to_json(self) -> dict:
         st = self.enc.stats
@@ -835,5 +1118,7 @@ class FederationUplink:
             "delta_frames": st["delta_frames"],
             "delta_bytes": st["delta_bytes"],
             "keyframe_bytes": st["keyframe_bytes"],
+            "queries_answered": self.queries_answered,
+            "query_bytes": self.query_bytes,
             **({"last_error": self.last_error} if self.last_error else {}),
         }

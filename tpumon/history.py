@@ -1,17 +1,18 @@
-"""History: in-process ring buffers + optional Prometheus-backed range data.
+"""History: the in-process ring/TSDB store behind /api/history.
 
 Reference parity (monitor_server.js:117-154 ``getHistoryMetrics``): 30-min
 window / 30-s step curves for cpu, memory, disk and accelerator series,
 rendered as ``{labels: [HH:mm], data: [...]}`` per series (SURVEY §2.3).
 
 Differences (deliberate, SURVEY §3.3 + §5.8):
-- The six PromQL range queries the reference awaited **sequentially** are
-  issued in **parallel**, and they are re-keyed from ``DCGM_FI_DEV_*`` to
-  the ``tpu_*`` / ``tpumon_*`` series our own exporter publishes.
-- A Prometheus outage (or no Prometheus configured at all) degrades to an
-  **in-process ring buffer** the sampler feeds every tick, so the
-  dashboard always has history (the reference returns empty series,
-  monitor_server.js:139).
+- The reference delegated history to an **external Prometheus** (six
+  sequential PromQL range queries, empty series on outage,
+  monitor_server.js:117-154). That sidecar dependency is retired: the
+  sampler records every series into the in-process columnar TSDB each
+  tick, /api/history renders directly from it, and rich expressions run
+  through the in-tree query engine (tpumon.query, ``/api/query``,
+  docs/query.md). ``prometheus_url`` is accepted but deprecated (a
+  warning, not a behavior).
 - ``gpuTemp`` was collected but never rendered by the reference
   (monitor_server.js:134 vs monitor.html:523-526); here temperature is a
   first-class rendered series.
@@ -32,14 +33,20 @@ import contextlib
 import fnmatch
 import json
 import os
+import sys
 import tempfile
 import time
 from array import array
 
 from tpumon import tsdb
-from tpumon.collectors.prometheus import PrometheusClient
 
-# PromQL re-keying (SURVEY §5.8): all queries ride tpumon's own exporter.
+# The fleet series contract /api/history serves (SURVEY §5.8 re-keying).
+# Keys are the ring series the sampler records each tick; values are the
+# *equivalent PromQL* over tpumon's own /metrics exporter — kept as
+# documentation for deployments that also scrape us with an external
+# Prometheus, and as the provenance of each series' aggregation choice.
+# In-process, these names evaluate directly (tpumon.query:
+# ``avg_over_time(mxu[5m])``, ``topk(5, rate(chip.hbm))``, ...).
 PROM_QUERIES: dict[str, str] = {
     "cpu": "avg(tpumon_host_cpu_pct)",
     "memory": "avg(tpumon_host_memory_pct)",
@@ -119,7 +126,7 @@ class RingSeries:
 
     __slots__ = (
         "window_s", "long_window_s", "coarse_step_s", "fine", "down",
-        "_mid", "_coarse", "version", "slot",
+        "_mid", "_coarse", "version", "slot", "rec",
     )
 
     def __init__(
@@ -163,6 +170,11 @@ class RingSeries:
         )
         self.down.append(self._coarse)
         self.version = 0
+        # Recording-rule accumulators (tpumon.query.RuleAccum) for the
+        # registered rules whose family matches this series' name —
+        # None when no rule matches, so the per-append guard is one
+        # attribute load on the unmatched (common) path.
+        self.rec = None
 
     def __repr__(self) -> str:
         return (
@@ -189,6 +201,9 @@ class RingSeries:
             self._mid.observe(ts, value)
         if self.long_window_s > self.window_s:
             self._coarse.observe(ts, value)
+        if self.rec is not None:
+            for a in self.rec:
+                a.observe(ts, value)
         self.version += 1
 
     def add_batch(self, ts_list, values) -> bool:
@@ -214,6 +229,13 @@ class RingSeries:
             self._mid.observe_batch(ts_q, val_q)
         if self.long_window_s > self.window_s:
             self._coarse.observe_batch(ts_q, val_q)
+        if self.rec is not None:
+            # val_q is the array('f') column: values observed by the
+            # rule accumulators are exactly the stored (f32) values.
+            for a in self.rec:
+                obs = a.observe
+                for i in range(n):
+                    obs(ts_q[i], val_q[i])
         self.version += 1
         return True
 
@@ -330,8 +352,25 @@ class RingHistory:
         self._coarse_store = tsdb.AccumStore(coarse_step_s)
         self._slot_series: list[RingSeries] = []
         self._memo: dict[tuple, tuple[int, dict]] = {}
+        # Registered recording rules (tpumon.query.RuleSet): append-time
+        # aggregate accumulators attached per matching series. None =
+        # no rules, zero per-append cost.
+        self.rules = None
 
-    def _make_series(self) -> RingSeries:
+    def set_recording_rules(self, ruleset) -> None:
+        """Register recording rules (tpumon.query.RuleSet) and attach
+        accumulators to every existing matching series. Accumulation
+        starts NOW — history is not backfilled (the same contract as
+        Prometheus recording rules)."""
+        self.rules = ruleset
+        for name, s in self.series.items():
+            s.rec = (
+                ruleset.attach(name, ring_slot=s.slot)
+                if ruleset is not None
+                else None
+            )
+
+    def _make_series(self, name: str) -> RingSeries:
         if self._mid_store is not None:
             slot = self._mid_store.add_slot()
             assert self._coarse_store.add_slot() == slot
@@ -345,6 +384,8 @@ class RingHistory:
             mid_window_s=self.mid_window_s,
             slot_stores=(slot, self._mid_store, self._coarse_store),
         )
+        if self.rules is not None:
+            s.rec = self.rules.attach(name, ring_slot=s.slot)
         self._slot_series.append(s)
         return s
 
@@ -356,7 +397,7 @@ class RingHistory:
         series objects) — re-resolve then."""
         s = self.series.get(name)
         if s is None:
-            s = self.series[name] = self._make_series()
+            s = self.series[name] = self._make_series(name)
         return s
 
     def record(self, name: str, value: float | None, ts: float | None = None) -> None:
@@ -444,16 +485,20 @@ class RingHistory:
             self.mutations += 1
 
     def _accum_many(self, tsq: float, val_q, series_list) -> None:
-        """Per-batch downsample accumulation for slot-backed series:
-        one accum_many call per tier level over the shared state
-        columns, closed buckets appended through each series' own
-        downsample tier (f32-quantized exactly like Downsample.flush)."""
+        """Per-batch downsample + recording-rule accumulation for
+        slot-backed series: one accum_many call per tier level over the
+        shared state columns (closed buckets appended through each
+        series' own downsample tier, f32-quantized exactly like
+        Downsample.flush), then one rule-store call per registered
+        recording rule over the SAME slots/values arrays — matched
+        series update their open sub-bucket summaries in the kernel,
+        unmatched series cost a slot_map lookup (tpumon.query)."""
         levels: list[tuple[tsdb.AccumStore, str]] = []
         if self._mid_store is not None:
             levels.append((self._mid_store, "_mid"))
         if self.long_window_s > self.window_s:
             levels.append((self._coarse_store, "_coarse"))
-        if not levels:
+        if not levels and self.rules is None:
             return
         slots = array("i", [s.slot for s in series_list])
         by_slot = self._slot_series
@@ -461,6 +506,8 @@ class RingHistory:
             for slot, fts, fmean in tsdb.accum_many(tsq, val_q, slots, store):
                 d = getattr(by_slot[slot], attr)
                 d.tier.append(fts, tsdb.quantize_val(fmean))
+        if self.rules is not None:
+            self.rules.accum_batch(tsq, val_q, slots)
 
     def record_series(self, name: str, ts_list, values) -> None:
         """Record N (ts, value) pairs into ONE series in a single call
@@ -485,7 +532,7 @@ class RingHistory:
             return
         s = self.series.get(name)
         if s is None:
-            s = self.series[name] = self._make_series()
+            s = self.series[name] = self._make_series(name)
         s.coarse.extend((float(t), float(v)) for t, v in points)
         self.mutations += 1
 
@@ -783,7 +830,7 @@ class HistorySnapshotter:
         replay_fine: dict[str, list] = {}
         replay_coarse: dict[str, list] = {}
         for d in dumps:
-            s = ring._make_series()
+            s = ring._make_series(d["name"])
             if self._adoptable(s, d):
                 self._adopt(s, d, now)
                 if s.count_points() or any(x.bn for x in s.down):
@@ -909,8 +956,14 @@ class HistorySnapshotter:
 
 
 class HistoryService:
-    """Serves the /api/history contract from Prometheus when available,
-    falling back per-series to the ring buffer."""
+    """Serves the /api/history contract from the in-process ring/TSDB.
+
+    The external-Prometheus path is retired (the paper's fourth
+    collector, monitor_server.js:117-154): the sampler records every
+    contract series each tick, so history is always local — and rich
+    expressions over the same store go through the in-tree query
+    engine (tpumon.query, ``/api/query``). ``prometheus_url`` is kept
+    as an accepted-but-deprecated knob so existing configs load."""
 
     def __init__(
         self,
@@ -922,8 +975,18 @@ class HistoryService:
         self.ring = ring
         self.window_s = window_s
         self.step_s = step_s
-        self.prom = PrometheusClient(prometheus_url) if prometheus_url else None
-        self.last_prom_ok: bool | None = None
+        # Retired dependency: warn once, then behave exactly like an
+        # unconfigured instance (the ring has served this contract
+        # since PR 5; collectors/prometheus.py is gone).
+        self.prometheus_deprecated = bool(prometheus_url)
+        if prometheus_url:
+            print(
+                "tpumon: prometheus_url is deprecated and ignored — "
+                "/api/history serves the in-process TSDB and rich "
+                "queries run in-tree via /api/query (docs/query.md)",
+                file=sys.stderr,
+                flush=True,
+            )
 
     def clamp_window(self, window_s: float) -> float:
         return min(max(window_s, 60.0), self.ring.long_window_s)
@@ -942,34 +1005,6 @@ class HistoryService:
         ("cpu", "mxu") and per-chip ("chip.<id>.<metric>") alike, so
         ``series=chip.*`` selects the drill-down curves only."""
         return series is None or fnmatch.fnmatchcase(name, series)
-
-    async def _prom_series(
-        self, window_s: float, step_s: float, series: str | None = None
-    ) -> dict[str, dict] | None:
-        if self.prom is None:
-            return None
-        names = [n for n in PROM_QUERIES if self._matches(n, series)]
-        if not names:
-            return None
-        results = await asyncio.gather(
-            *(
-                self.prom.query_range(PROM_QUERIES[n], window_s, step_s)
-                for n in names
-            )
-        )
-        out: dict[str, dict] = {}
-        any_ok = False
-        for name, series_list in zip(names, results):
-            if not series_list:
-                continue
-            any_ok = True
-            s = series_list[0]
-            out[name] = {
-                "labels": [format_label(t, window_s) for t in s.times],
-                "data": [round(v, 2) for v in s.values],
-            }
-        self.last_prom_ok = any_ok
-        return out if any_ok else None
 
     def snapshot_ring(
         self, window_s: float | None = None, series: str | None = None
@@ -1020,26 +1055,6 @@ class HistoryService:
     async def snapshot(
         self, window_s: float | None = None, series: str | None = None
     ) -> dict:
-        if self.prom is None:
-            return self.snapshot_ring(window_s=window_s, series=series)
-        window = self.clamp_window(window_s) if window_s else self.window_s
-        step = self.step_for(window)
-        prom = await self._prom_series(window, step, series)
-        out: dict = {
-            "source": "prometheus" if prom else "ring",
-            "window_s": window,
-            "step_s": step,
-        }
-        if series is not None:
-            out["series"] = series
-        # Per-series fallback: Prometheus result wins, ring fills gaps.
-        for name in PROM_QUERIES:
-            if not self._matches(name, series):
-                continue
-            if prom and name in prom:
-                out[name] = prom[name]
-            else:
-                out[name] = self.ring.snapshot_series(name, step, window_s=window)
-        self._add_prefixed(out, "per_chip", "chip.", step, window, series)
-        self._add_prefixed(out, "per_slice", "slice.", step, window, series)
-        return out
+        """Async alias kept for callers written against the old
+        Prometheus-or-ring contract; the answer is always the ring."""
+        return self.snapshot_ring(window_s=window_s, series=series)

@@ -21,9 +21,26 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 SO_PATH = os.path.join(_DIR, "libtpumon_host.so")
 TSDB_SO_PATH = os.path.join(_DIR, "libtpumon_tsdb.so")
 ABI_VERSION = 1
-TSDB_ABI_VERSION = 1
+TSDB_ABI_VERSION = 2
 
 OK_CPU, OK_MEM, OK_DISK = 1, 2, 4
+
+
+class RuleStoreStruct(ctypes.Structure):
+    """Mirror of tsdbkern.cpp's TpumonRuleStore: one recording-rule
+    store's geometry + column pointers, passed as a single argument so
+    the per-tick call marshals one pointer instead of nineteen values
+    (tpumon.query.RuleStore caches an instance per store)."""
+
+    _fields_ = [
+        ("sub", ctypes.c_double),
+        ("nsub", ctypes.c_int32),
+        ("map_len", ctypes.c_int32),
+        ("slot_map", ctypes.POINTER(ctypes.c_int32)),
+        ("hh", ctypes.POINTER(ctypes.c_int32)),
+        ("open", ctypes.POINTER(ctypes.c_double)),
+        ("hist", ctypes.POINTER(ctypes.c_double)),
+    ]
 
 
 class HostSampleStruct(ctypes.Structure):
@@ -153,6 +170,17 @@ class TsdbKernel:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
         lib.tpumon_tsdb_seal_encode.restype = ctypes.c_int64
+        lib.tpumon_tsdb_rule_accum.argtypes = [
+            ctypes.c_int64, ctypes.c_double, _PF, _PI32,
+            ctypes.POINTER(RuleStoreStruct),
+        ]
+        lib.tpumon_tsdb_rule_accum.restype = ctypes.c_int64
+        lib.tpumon_tsdb_rule_accum_multi.argtypes = [
+            ctypes.c_int64, ctypes.c_double, _PF, _PI32,
+            ctypes.POINTER(ctypes.POINTER(RuleStoreStruct)),
+            ctypes.c_int32,
+        ]
+        lib.tpumon_tsdb_rule_accum_multi.restype = ctypes.c_int64
         self._lib = lib
 
     def quantize(
@@ -211,6 +239,61 @@ class TsdbKernel:
             _pd(flush_ts), _pd(flush_mean),
         )
         return [(flush_slot[i], flush_ts[i], flush_mean[i]) for i in range(nf)]
+
+    def rule_accum(self, ts: float, val_q: array, slots: array, store) -> int:
+        """Recording-rule accumulation (tpumon.query.RuleStore): update
+        every matched series' open sub-bucket summary row for one
+        shared-timestamp batch — the ring's existing (slots, f32
+        values) arrays go straight in; store columns update in place.
+        Returns the matched-series count. The store-side pointers are
+        cached on the store (its arrays only move on add_slot) so the
+        steady-state per-tick cost is the FFI call plus two casts."""
+        ref = self._store_struct(store)
+        return self._lib.tpumon_tsdb_rule_accum(
+            len(slots), ts, _pf(val_q),
+            ctypes.cast(slots.buffer_info()[0], _PI32),
+            ref[0],
+        )
+
+    @staticmethod
+    def _store_struct(store):
+        """(byref, struct) for a RuleStore, cached on the store — its
+        arrays only move on add_slot, which clears the cache."""
+        ref = store._kptrs
+        if ref is None:
+            from tpumon.query import RULE_SUB_BUCKETS
+
+            st = RuleStoreStruct(
+                sub=store.sub_s,
+                nsub=RULE_SUB_BUCKETS,
+                map_len=len(store.slot_map),
+                slot_map=ctypes.cast(store.slot_map.buffer_info()[0], _PI32),
+                hh=ctypes.cast(store.hh.buffer_info()[0], _PI32),
+                open=_pd(store.open),
+                hist=_pd(store.hist),
+            )
+            ref = store._kptrs = ctypes.byref(st), st  # keep st alive
+        return ref
+
+    def rule_accum_multi(self, ts: float, val_q: array, slots: array, ruleset) -> int:
+        """EVERY registered rule's accumulation in one FFI round trip —
+        the per-tick entry point (tpumon.query.RuleSet.accum_batch).
+        The struct-pointer vector is cached on the ruleset and rebuilt
+        whenever any store's arrays moved."""
+        vec = ruleset._kmulti
+        if vec is None or any(r.store._kptrs is None for r in ruleset.rules):
+            ptrs = [
+                ctypes.pointer(self._store_struct(r.store)[1])
+                for r in ruleset.rules
+            ]
+            vec = ruleset._kmulti = (
+                (ctypes.POINTER(RuleStoreStruct) * len(ptrs))(*ptrs)
+            )
+        return self._lib.tpumon_tsdb_rule_accum_multi(
+            len(slots), ts, _pf(val_q),
+            ctypes.cast(slots.buffer_info()[0], _PI32),
+            vec, len(vec),
+        )
 
     def seal_encode(
         self, head_ts: array, head_val: array

@@ -189,7 +189,105 @@ int64_t tpumon_tsdb_seal_encode(int64_t n, const double* head_ts,
   return pos;
 }
 
+// Recording-rule store descriptor (tpumon/query.py RuleStore): the
+// data pointer + geometry packed into one struct so the per-tick call
+// marshals a single pointer (ctypes argument conversion dominated a
+// flat-argument spelling). Python caches one of these per store and
+// rebuilds it when add_slot reallocates the arrays. `data` is
+// ROW-MAJOR: one sub-bucket summary = 10 consecutive doubles
+// [bucket-index (NaN = empty), n, sum, min, max, first_ts, first_v,
+// last_ts, last_v, increase] — ~2 cache lines per matched series per
+// tick, which is what makes the batched update memory-cheap at fleet
+// series counts.
+typedef struct {
+  double sub;               // sub-bucket width (window / 16)
+  int32_t nsub;             // closed-history rows per slot (ring size)
+  int32_t map_len;          // length of slot_map
+  const int32_t* slot_map;  // ring slot -> rule slot (-1 = unmatched)
+  int32_t* hh;              // per rule slot: next hist-ring write pos
+  double* open;             // ONE open row per slot (dense, hot)
+  double* hist;             // nsub closed rows per slot (cold)
+} TpumonRuleStore;
+
+enum {
+  RK_BIDX = 0, RK_N = 1, RK_SUM = 2, RK_MN = 3, RK_MX = 4,
+  RK_FTS = 5, RK_FV = 6, RK_LTS = 7, RK_LV = 8, RK_INC = 9,
+  RK_STRIDE = 10,
+};
+
+// Recording-rule accumulation: one shared-timestamp update of every
+// matched series' OPEN sub-bucket row in ONE call per rule per tick.
+// slots[] are the ring's global series slots for the tick's batch (the
+// same array accum_many takes); st->slot_map translates them to rule
+// slots (-1 = not matched, the overwhelmingly common case — one load +
+// compare per series). The open rows are densely packed (80 B/series),
+// so the steady-state working set is tiny and cache-resident; the cold
+// hist ring is only touched on a bucket rollover (once per sub-bucket
+// width). Mirrors RuleStore._observe_prebucketed bit-for-bit (same
+// float adds in the same order). Returns the matched count.
+int64_t tpumon_tsdb_rule_accum(int64_t n, double ts, const float* vals,
+                               const int32_t* slots,
+                               const TpumonRuleStore* st) {
+  double b = py_floordiv(ts, st->sub);  // shared ts: one bucket for all
+  int32_t nsub = st->nsub;
+  int64_t matched = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int32_t g = slots[i];
+    if (g < 0 || g >= st->map_len) continue;
+    int32_t r = st->slot_map[g];
+    if (r < 0) continue;
+    matched++;
+    double v = (double)vals[i];  // f32 -> f64 exact; matches Python float
+    double* row = st->open + (int64_t)r * RK_STRIDE;
+    if (row[RK_BIDX] == b) {
+      row[RK_N] += 1.0;
+      row[RK_SUM] += v;
+      if (v < row[RK_MN]) {
+        row[RK_MN] = v;
+      } else if (v > row[RK_MX]) {
+        row[RK_MX] = v;
+      }
+      double d = v - row[RK_LV];
+      row[RK_INC] += (d >= 0.0) ? d : v;
+      row[RK_LTS] = ts;
+      row[RK_LV] = v;
+      continue;
+    }
+    if (row[RK_BIDX] == row[RK_BIDX]) {  // closed bucket: bank it
+      int32_t h = st->hh[r];
+      memcpy(st->hist + ((int64_t)r * nsub + h) * RK_STRIDE, row,
+             RK_STRIDE * sizeof(double));
+      st->hh[r] = (h + 1) % nsub;
+    }
+    row[RK_BIDX] = b;
+    row[RK_N] = 1.0;
+    row[RK_SUM] = v;
+    row[RK_MN] = v;
+    row[RK_MX] = v;
+    row[RK_FTS] = ts;
+    row[RK_LTS] = ts;
+    row[RK_FV] = v;
+    row[RK_LV] = v;
+    row[RK_INC] = 0.0;
+  }
+  return matched;
+}
+
+// All registered rules in ONE call per tick: the ctypes FFI + pointer
+// casts dominate a per-rule spelling (the C loops themselves are a few
+// µs), so the per-tick entry point takes the whole rule list.
+int64_t tpumon_tsdb_rule_accum_multi(int64_t n, double ts, const float* vals,
+                                     const int32_t* slots,
+                                     const TpumonRuleStore* const* stores,
+                                     int32_t nstores) {
+  int64_t matched = 0;
+  for (int32_t s = 0; s < nstores; s++) {
+    matched += tpumon_tsdb_rule_accum(n, ts, vals, slots, stores[s]);
+  }
+  return matched;
+}
+
 // Version tag so Python can detect ABI drift (independent of hostmon's).
-int tpumon_tsdbkern_abi_version(void) { return 1; }
+int tpumon_tsdbkern_abi_version(void) { return 2; }
 
 }  // extern "C"

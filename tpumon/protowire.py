@@ -802,6 +802,117 @@ class DeltaStreamDecoder:
         return self._done(ts, seq, False)
 
 
+# ---------------------- distributed query frames -----------------------
+#
+# Fleet-query push-down over the federation tree (tpumon.federation,
+# docs/query.md "Distributed evaluation"): the upstream hub writes a
+# TPWQ request down an OPEN ingest stream (the same long-lived chunked
+# POST the downstream pushes delta frames on — same auth, same resync
+# contract: a dropped stream drops its in-flight queries and the hub
+# answers partial), and the downstream interleaves a TPWR partial-result
+# record into its upload. Both ride the varint-length-prefixed record
+# framing of the ingest stream. Layout:
+#
+#   request:  TPWQ <u8 ver> varint qid <f64 at> <f64 timeout_s>
+#             varint len + utf-8 expression
+#   result:   TPWR <u8 ver> varint qid <u8 flags: 1=partial 2=error>
+#             varint len + utf-8 JSON payload
+#
+# The result payload is the mergeable partial-aggregate state
+# (tpumon.query.partial_eval: group sums/counts/min/max, topk row sets,
+# quantile sketches) — never raw points; an error result carries
+# {"error": msg}. Truncation anywhere raises ValueError (the stream is
+# dropped and resyncs, exactly like a refused delta frame).
+
+QUERY_REQ_MAGIC = b"TPWQ"
+QUERY_RES_MAGIC = b"TPWR"
+QUERY_FRAME_VERSION = 1
+
+_QRES_PARTIAL = 1
+_QRES_ERROR = 2
+
+
+def encode_query_request(
+    qid: int, expr: str, at: float, timeout_s: float
+) -> bytes:
+    out = bytearray(QUERY_REQ_MAGIC)
+    out.append(QUERY_FRAME_VERSION)
+    out += encode_varint(qid)
+    out += struct.pack("<d", at)
+    out += struct.pack("<d", timeout_s)
+    raw = expr.encode("utf-8")
+    out += encode_varint(len(raw)) + raw
+    return bytes(out)
+
+
+def decode_query_request(blob: bytes) -> tuple[int, str, float, float]:
+    """(qid, expr, at, timeout_s); ValueError on anything malformed."""
+    if blob[: len(QUERY_REQ_MAGIC)] != QUERY_REQ_MAGIC:
+        raise ValueError("bad query request magic")
+    if len(blob) < 5:
+        raise ValueError("truncated query request header")
+    if blob[4] != QUERY_FRAME_VERSION:
+        raise ValueError(f"unsupported query frame version {blob[4]}")
+    qid, pos = decode_varint(blob, 5)
+    if pos + 16 > len(blob):
+        raise ValueError("truncated query request timestamps")
+    at, timeout_s = struct.unpack_from("<dd", blob, pos)
+    pos += 16
+    ln, pos = decode_varint(blob, pos)
+    if pos + ln != len(blob):
+        raise ValueError("truncated query request expression")
+    return qid, blob[pos : pos + ln].decode("utf-8"), at, timeout_s
+
+
+def encode_query_result(
+    qid: int,
+    payload: dict | None,
+    partial: bool = False,
+    error: str | None = None,
+) -> bytes:
+    import json as _json
+
+    flags = (_QRES_PARTIAL if partial else 0) | (_QRES_ERROR if error else 0)
+    body = _json.dumps(
+        {"error": error} if error is not None else (payload or {}),
+        separators=(",", ":"),
+    ).encode("utf-8")
+    out = bytearray(QUERY_RES_MAGIC)
+    out.append(QUERY_FRAME_VERSION)
+    out += encode_varint(qid)
+    out.append(flags)
+    out += encode_varint(len(body)) + body
+    return bytes(out)
+
+
+def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict]:
+    """(qid, partial, error, payload); ValueError on anything malformed."""
+    import json as _json
+
+    if blob[: len(QUERY_RES_MAGIC)] != QUERY_RES_MAGIC:
+        raise ValueError("bad query result magic")
+    if len(blob) < 5:
+        raise ValueError("truncated query result header")
+    if blob[4] != QUERY_FRAME_VERSION:
+        raise ValueError(f"unsupported query frame version {blob[4]}")
+    qid, pos = decode_varint(blob, 5)
+    if pos >= len(blob):
+        raise ValueError("truncated query result flags")
+    flags = blob[pos]
+    pos += 1
+    ln, pos = decode_varint(blob, pos)
+    if pos + ln != len(blob):
+        raise ValueError("truncated query result payload")
+    try:
+        payload = _json.loads(blob[pos : pos + ln])
+    except ValueError as e:
+        raise ValueError(f"corrupt query result payload: {e}")
+    if not isinstance(payload, dict):
+        raise ValueError("query result payload must be an object")
+    error = payload.get("error") if flags & _QRES_ERROR else None
+    return qid, bool(flags & _QRES_PARTIAL), error, payload
+
+
 def decode_message(buf: bytes, max_depth: int = 16) -> Message:
     """Decode protobuf bytes into a Message tree.
 

@@ -28,9 +28,10 @@ from tpumon.collectors import Collector, Sample, run_collector
 from tpumon.config import Config
 from tpumon.events import EventJournal
 from tpumon.history import RingHistory
+from tpumon.query import QueryEngine, QueryError, RecordingRule, RuleSet
 from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
 from tpumon.snapshot import EpochClock
-from tpumon.topology import ChipSample, slice_views
+from tpumon.topology import ChipSample, attribute_pods, slice_views
 from tpumon.tracing import SpanTracer, quantiles
 
 
@@ -203,12 +204,54 @@ class Sampler:
         # on a standalone monitor.
         self.federation = None
         self.uplink = None
+        # In-tree query engine (tpumon.query, docs/query.md): one per
+        # process, over this sampler's ring — /api/query[_range], the
+        # expression alert rules' vocabulary, the `tpumon query` CLI
+        # and the distributed federation planner all go through it.
+        # The augmenter wires pod attribution in as a derived label
+        # (``by (pod)`` over chip series) without the engine importing
+        # any collector.
+        self.query = QueryEngine(
+            self.history,
+            default_range_s=cfg.query_default_range_s,
+            lookback_s=cfg.query_lookback_s,
+            augment=self._query_augmenter,
+        )
+        rules: list[RecordingRule] = []
+        for text in cfg.recording_rules:
+            try:
+                rules.append(RecordingRule(text))
+            except QueryError as e:
+                # A bad rule must be an incident, not a silent no-op:
+                # the operator configured an aggregate that will never
+                # be maintained.
+                self.journal.record(
+                    "query", "serious", "query",
+                    f"recording rule {text!r} rejected: {e}", rule=text,
+                )
+        if rules:
+            self.history.set_recording_rules(RuleSet(rules))
         # Chaos wrappers and peer federations record their own journal
         # events; hand them the shared journal (duck-typed so the
         # collector layer stays import-free of the sampler).
         for c in (host, accel, k8s, serving):
             if c is not None and hasattr(c, "set_journal"):
                 c.set_journal(self.journal)
+
+    def _query_augmenter(self):
+        """Per-evaluation label hook for the query engine: chip-family
+        labels gain ``pod`` from the current pod→chip attribution —
+        computed once per evaluation, not per series."""
+        owners = attribute_pods(self.chips(), self.pods())
+
+        def augment(family: str, labels: dict) -> None:
+            cid = labels.get("chip")
+            if cid is not None:
+                pod = owners.get(cid)
+                if pod is not None:
+                    labels["pod"] = pod
+
+        return augment
 
     @property
     def epoch(self) -> int:

@@ -13,11 +13,22 @@ Route map (SURVEY §2.3, re-keyed for TPU):
                         replaces /api/gpu/metrics)
   /api/gpu/metrics      reference-shaped compat view over the same chips
   /api/k8s/pods         pod table
-  /api/history          curves (Prometheus or ring buffer); ?window=30m|3h|24h
+  /api/history          curves from the in-process TSDB; ?window=30m|3h|24h
                         selects the span (mid/coarse ring tiers beyond
                         30 min); ?series=<glob> restricts to matching
                         series (e.g. series=chip.* for the per-chip
                         drill-down curves at 256 chips)
+  /api/query            instant query in the in-tree PromQL subset
+                        (tpumon.query, docs/query.md): ?query=<expr>
+                        [&time=<ts>]; ?fleet=1 on an aggregator/root
+                        plans a DISTRIBUTED evaluation over the
+                        federation tree (partial aggregates merged,
+                        dark subtrees degrade to an explicit partial
+                        marker); bare GET returns engine info
+  /api/query_range      the same expressions on a step grid:
+                        ?query=<expr>&window=30m&step=30s[&end=<ts>] —
+                        per-(series, window) point fetches are shared
+                        across grid steps
   /api/alerts           last alert evaluation (sampler-owned, not
                         recomputed per request — fixes SURVEY §5.2),
                         + silenced list and active silences
@@ -90,6 +101,7 @@ from tpumon.exporter import render_exporter
 from tpumon.history import HistoryService
 from tpumon.profiler import ProfileBusy, ProfilerService
 from tpumon.protowire import WIRE_FRAME_CTYPE, encode_wire_frame
+from tpumon.query import QueryError
 from tpumon.sampler import Sampler
 from tpumon.snapshot import ExporterCache, RenderCache
 from tpumon.topology import attribute_pods, chips_to_wire
@@ -408,6 +420,110 @@ class MonitorServer:
         )
         return self._etagged(key, ("events",), build, if_none_match, evictable=True)
 
+    # ------------------------- query engine routes -------------------------
+
+    async def _query_request(
+        self, query: str, if_none_match: str | None, auth: str | None
+    ) -> tuple[int, str, bytes, dict]:
+        """GET /api/query: one instant evaluation (tpumon.query).
+        Local evaluations ride the epoch render cache ("samples" moves
+        once per tick, so a polling dashboard reuses the bytes between
+        ticks) with the expression in the evictable cache key; fleet
+        evaluations await remote partials and are never cached."""
+        params = parse_query(query)
+        src = params.get("query")
+        engine = self.sampler.query
+        if src is None:
+            # Bare GET: engine info (functions, rules, cache stats) —
+            # the discoverability payload, and what keeps the
+            # registered-routes-answer lint meaningful.
+            return self._etagged(
+                "/api/query#info",
+                ("samples",),
+                lambda: json.dumps(engine.to_json()).encode(),
+                if_none_match,
+            )
+        src = urllib.parse.unquote_plus(src)
+        at = None
+        if "time" in params:
+            try:
+                at = float(params["time"])
+            except ValueError:
+                raise HttpError(400, f"bad time {params['time']!r}")
+        if params.get("fleet") in ("1", "true"):
+            # A fleet query fans TPWQ sub-queries across the whole tree
+            # per request with no cache — expensive like /api/profile,
+            # and gated the same way when a token is configured.
+            self._check_auth(auth)
+            hub = getattr(self.sampler, "federation", None)
+            if hub is None:
+                raise HttpError(
+                    400,
+                    "fleet=1 needs federation_role aggregator|root "
+                    "(this node has no downstream tree)",
+                )
+            try:
+                payload = await hub.fleet_query(
+                    src, at=at, timeout_s=self.cfg.query_fleet_timeout_s
+                )
+            except QueryError as e:
+                raise HttpError(400, str(e))
+            return 200, "application/json", json.dumps(payload).encode(), {}
+        try:
+            return self._etagged(
+                f"/api/query?q={src}&t={'' if at is None else at}",
+                ("samples",),
+                lambda: json.dumps(engine.instant(src, at=at)).encode(),
+                if_none_match,
+                evictable=True,
+            )
+        except QueryError as e:
+            raise HttpError(400, str(e))
+
+    def _query_range_request(
+        self, query: str, if_none_match: str | None
+    ) -> tuple[int, str, bytes, dict]:
+        """GET /api/query_range: step-grid evaluation over the trailing
+        window, same caching contract as /api/history (window clamped
+        to the ring's retention; key evictable)."""
+        params = parse_query(query)
+        src = params.get("query")
+        engine = self.sampler.query
+        if src is None:
+            return self._etagged(
+                "/api/query#info",
+                ("samples",),
+                lambda: json.dumps(engine.to_json()).encode(),
+                if_none_match,
+            )
+        src = urllib.parse.unquote_plus(src)
+        window_s = parse_duration(params.get("window", "30m"), default=-1.0)
+        step_s = parse_duration(params.get("step", "30s"), default=-1.0)
+        if window_s <= 0:
+            raise HttpError(400, f"bad window {params.get('window')!r}")
+        if step_s <= 0:
+            raise HttpError(400, f"bad step {params.get('step')!r}")
+        window_s = self.history.clamp_window(window_s)
+        end = None
+        if "end" in params:
+            try:
+                end = float(params["end"])
+            except ValueError:
+                raise HttpError(400, f"bad end {params['end']!r}")
+        try:
+            return self._etagged(
+                f"/api/query_range?q={src}&w={window_s}&s={step_s}"
+                f"&e={'' if end is None else end}",
+                ("samples",),
+                lambda: json.dumps(
+                    engine.range_query(src, window_s, step_s, end=end)
+                ).encode(),
+                if_none_match,
+                evictable=True,
+            )
+        except QueryError as e:
+            raise HttpError(400, str(e))
+
     def realtime_payload(self) -> dict:
         """The push payload: everything the dashboard's fast loop needs."""
         return {
@@ -657,6 +773,7 @@ class MonitorServer:
                     "/", "/monitor.html", "/index.html", "/dashboard",
                     "/logo.svg", "/chartcore.js", "/dashboard.js",
                     "/metrics", "/api/health", "/api/history",
+                    "/api/query", "/api/query_range",
                     "/api/events", "/api/federation/ingest",
                     "/api/profile", "/api/stream", "/api/trace/export",
                     "/api/silence", "/api/unsilence",
@@ -781,6 +898,10 @@ class MonitorServer:
 
         if path == "/api/events":
             return self._events_request(query, if_none_match)
+        if path == "/api/query":
+            return await self._query_request(query, if_none_match, auth)
+        if path == "/api/query_range":
+            return self._query_range_request(query, if_none_match)
 
         payload = None
         if path == "/api/history":
@@ -797,31 +918,29 @@ class MonitorServer:
                     ch.isalnum() or ch in "._*?[]-/:" for ch in series
                 ):
                     raise HttpError(400, f"bad series glob {series!r}")
-            if self.history.prom is None:
-                # Ring-only mode: the payload is a pure function of the
-                # ring's contents, which only grow when a tick records
-                # ("samples" moves on every poll) — cacheable per window.
-                # Quantize the clamped window to its render-step grid
-                # (step_for targets ~60 points, so windows within one
-                # step render identically anyway): arbitrary ?window=
-                # values collapse onto a few keys instead of cycling
-                # the bounded eviction. The BODY is built from the same
-                # quantized window, so key ⇔ payload stays exact.
-                wq = None
-                if window_s:
-                    w = self.history.clamp_window(window_s)
-                    step = self.history.step_for(w)
-                    wq = max(60.0, round(w / step) * step)
-                return self._etagged(
-                    f"/api/history?w={wq or ''}&s={series or ''}",
-                    ("samples",),
-                    lambda: json.dumps(
-                        self.history.snapshot_ring(window_s=wq, series=series)
-                    ).encode(),
-                    if_none_match,
-                    evictable=True,
-                )
-            payload = await self.history.snapshot(window_s=window_s, series=series)
+            # The payload is a pure function of the ring's contents,
+            # which only grow when a tick records ("samples" moves on
+            # every poll) — cacheable per window. Quantize the clamped
+            # window to its render-step grid (step_for targets ~60
+            # points, so windows within one step render identically
+            # anyway): arbitrary ?window= values collapse onto a few
+            # keys instead of cycling the bounded eviction. The BODY is
+            # built from the same quantized window, so key ⇔ payload
+            # stays exact.
+            wq = None
+            if window_s:
+                w = self.history.clamp_window(window_s)
+                step = self.history.step_for(w)
+                wq = max(60.0, round(w / step) * step)
+            return self._etagged(
+                f"/api/history?w={wq or ''}&s={series or ''}",
+                ("samples",),
+                lambda: json.dumps(
+                    self.history.snapshot_ring(window_s=wq, series=series)
+                ).encode(),
+                if_none_match,
+                evictable=True,
+            )
         elif path == "/api/health":
             payload = self._api_health()
         elif path == "/api/trace/export":

@@ -722,6 +722,9 @@ function makeDashboard(doc, net, env, mkSurface) {
       const card = $("federation-card");
       const fleet = res ? res.fleet : null;
       const uplink = res ? res.uplink : null;
+      // A fleet block means this node aggregates a downstream tree:
+      // the hottest-chips query upgrades to distributed (fleet=1).
+      topchipsFleet = !!fleet;
       if (!res || (!fleet && !uplink)) {
         card.style.display = "none";
         return;
@@ -760,6 +763,49 @@ function makeDashboard(doc, net, env, mkSurface) {
         ? (uplink.connected ? "connected" : "down") : "–";
       $("fed-uplink").style.color =
         uplink && !uplink.connected ? "var(--red)" : "";
+    });
+  }
+
+  /* --------------------------- hottest chips --------------------------- */
+  /* GET /api/query — the in-tree query engine (docs/query.md): a topk
+   * over per-chip 5 m duty means. On an aggregator/root with a
+   * downstream tree the same expression is planned as a DISTRIBUTED
+   * query (fleet=1 merges partial aggregates from the leaves), so the
+   * card works at fleet scale without shipping raw points. Hidden when
+   * no chip.* series exist (chips absent or per-chip history off). */
+  var topchipsFleet = false;  // flips on once /api/federation shows a hub
+  function fetchTopChips() {
+    /* No-spaces spelling: every character is URL-safe, so the query
+     * string needs no encoding step. */
+    const expr = "topk(5,avg_over_time(chip.mxu[5m]))";
+    const qs = "/api/query?query=" + expr +
+               (topchipsFleet ? "&fleet=1" : "");
+    net.getJson(qs, res => {
+      const card = $("topchips-card");
+      const rows = res && res.result ? res.result : [];
+      if (!rows.length) { card.style.display = "none"; return; }
+      card.style.display = "";
+      /* Always set (not only on partial): a recovered tree must clear
+       * a previous cycle's "partial: missing ..." note. */
+      $("topchips-tag").textContent = res.partial
+        ? "partial: missing " + (res.missing || []).join(", ")
+        : expr;
+      const body = $("topchips-body");
+      body.replaceChildren();
+      for (const row of rows) {
+        const labels = row.labels || {};
+        const tr = doc.mk("tr");
+        const mk = t => {
+          const td = doc.mk("td");
+          td.textContent = t;
+          return td;
+        };
+        tr.appendChild(mk(labels.chip || "–"));
+        tr.appendChild(mk(labels.host || "–"));
+        tr.appendChild(mk(labels.pod || "–"));
+        tr.appendChild(mk(row.value == null ? "–" : row.value.toFixed(1) + "%"));
+        body.appendChild(tr);
+      }
     });
   }
 
@@ -806,6 +852,7 @@ function makeDashboard(doc, net, env, mkSurface) {
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
     fetchAlerts(); fetchServing(); fetchFederation(); fetchHealth();
+    fetchTopChips();
     fetchTrace();
     fetchEvents();
     updateTime();
@@ -816,7 +863,7 @@ function makeDashboard(doc, net, env, mkSurface) {
     fetchRealtime: fetchRealtime, fetchHistory: fetchHistory,
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
     fetchServing: fetchServing, fetchFederation: fetchFederation,
-    fetchHealth: fetchHealth,
+    fetchHealth: fetchHealth, fetchTopChips: fetchTopChips,
     fetchTrace: fetchTrace, fetchEvents: fetchEvents,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
