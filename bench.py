@@ -1600,6 +1600,122 @@ async def _bench_query() -> dict:
     }
 
 
+async def _bench_slo(
+    topology: str = "v5p-256", iters: int = 60, warmup: int = 5
+) -> dict:
+    """SLO engine overhead (docs/slo.md): live-sampler tick p50 with 8
+    objectives (bad-condition eval + slo.bad append per tick; the
+    burn/budget window aggregates ride recording rules on a short/24
+    cadence) vs none — A/B interleaved min-of-rounds at the flagship
+    256-chip shape, the two configs differing ONLY in TPUMON_SLOS.
+    Acceptance ≤ 2%, the recording-rules bar."""
+    slos = []
+    for i in range(8):
+        # Alternate never-bad and always-bad conditions over the live
+        # fleet series so both the good and bad record paths, and the
+        # window aggregates over each, are in the measurement.
+        expr = "mxu > 1000" if i % 2 else "hbm >= 0"
+        slos.append({
+            "name": f"bench_{i}", "expr": expr, "target": 0.99,
+            "window": "1h", "fast": ["5s", "30s"], "slow": ["15s", "60s"],
+        })
+    # Paired interleave, not the observability phase's separate-run A/B:
+    # the effect under test (~0.3 ms of a ~16 ms tick) is below the
+    # box-load drift between two multi-second bring-ups, so BOTH
+    # samplers run in one process and alternate two-tick slices. The
+    # overhead of record is p50(SLO stage) / p50(baseline tick) — the
+    # stage is the ONLY on/off difference in the tick path, and a
+    # direct stage measurement doesn't lose the ~0.3 ms signal in the
+    # difference of two noisy multi-ms tick p50s (both operands stay
+    # in full results for the cross-check).
+    s_on, srv_on, _ = await _serve_bench_app(
+        f"fake:{topology}", TPUMON_SLOS=json.dumps(slos))
+    s_off, srv_off, _ = await _serve_bench_app(f"fake:{topology}")
+    stage_ms: list[float] = []
+    try:
+        assert s_on.slo is not None and len(s_on.slo.compiled) == 8
+        assert s_off.slo is None
+        inner_observe = s_on.slo.observe
+
+        def timed_observe(ts=None):
+            t0 = time.perf_counter()
+            changed = inner_observe(ts)
+            stage_ms.append((time.perf_counter() - t0) * 1e3)
+            return changed
+
+        s_on.slo.observe = timed_observe
+        for s in (s_on, s_off):
+            for _ in range(warmup):
+                await s.tick_fast()
+        del stage_ms[:]
+        on_ms: list[float] = []
+        off_ms: list[float] = []
+        for _round in range(iters):
+            for s, acc in ((s_on, on_ms), (s_off, off_ms)):
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    await s.tick_fast()
+                    acc.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        await srv_on.stop()
+        await srv_off.stop()
+    on, off, stage = _p50(on_ms), _p50(off_ms), _p50(stage_ms)
+    out = {
+        "slo_on_tick_p50_ms": round(on, 3),
+        "slo_off_tick_p50_ms": round(off, 3),
+        "slo_stage_p50_ms": round(stage, 3),
+        "slo_eval_overhead_tick_pct": (
+            round(100.0 * stage / off, 2) if off > 0 else None
+        ),
+    }
+    out.update(_bench_traffic_sim())
+    return out
+
+
+def _bench_traffic_sim(total: int = 1000) -> dict:
+    """Multi-tenant traffic-driver throughput: wall seconds to submit
+    AND drain 1000 requests of the chat+rag+batch scenario mix through
+    a small engine (tenant accounting on the hot path, rag behind a
+    shared prefix). Backpressure-respecting: submissions pause while
+    the queue is full, so nothing is rejected and every request's
+    completion is part of the measurement."""
+    from tpumon.loadgen.serving import ServingEngine
+    from tpumon.loadgen.traffic import TenantSpec, TrafficSim
+
+    engine = ServingEngine()
+    tenants = [
+        TenantSpec(name="chat", scenario="chat", max_new=8),
+        TenantSpec(name="rag", scenario="rag", prompt_chunks=3, max_new=8),
+        TenantSpec(name="batch", scenario="batch", max_new=16),
+    ]
+    sim = TrafficSim(engine, tenants, seed=7)
+    # Warm the jits (prefill + decode) outside the timed window; its
+    # completion predates t0, so it must not ride the reported counts.
+    sim.fire("chat")
+    while engine.step():
+        pass
+    warm = engine.completed_total
+    order = ("chat", "chat", "rag", "batch")  # chat-heavy mix
+    t0 = time.perf_counter()
+    submitted = 0
+    while submitted < total:
+        with engine._lock:
+            room = engine.max_queue - len(engine._queue)
+        for _ in range(max(0, min(room, total - submitted))):
+            sim.fire(order[submitted % len(order)])
+            submitted += 1
+        engine.step()
+    while engine.step():
+        pass
+    wall_s = time.perf_counter() - t0
+    completed = engine.completed_total - warm
+    return {
+        "traffic_sim_1k_requests_wall_s": round(wall_s, 3),
+        "traffic_sim_requests_per_sec": round(completed / wall_s, 1),
+        "traffic_sim_completed": completed,
+    }
+
+
 def _note(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}", file=sys.stderr)
 
@@ -1663,6 +1779,12 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                     "query_rules_tick_ms", "query_plain_tick_ms",
                     "query_fed_2048_topk_p50_ms",
                     "query_fed_bytes_per_query_per_leaf")),
+    "slo": (420, ("slo_on_tick_p50_ms", "slo_off_tick_p50_ms",
+                  "slo_stage_p50_ms",
+                  "slo_eval_overhead_tick_pct",
+                  "traffic_sim_1k_requests_wall_s",
+                  "traffic_sim_requests_per_sec",
+                  "traffic_sim_completed")),
     "kernels": (700, ("mxu_matmul_pallas_tflops", "mxu_matmul_xla_tflops",
                       "mxu_matmul_vs_xla",
                       "int8_matmul_pallas_tflops", "int8_matmul_xla_tflops",
@@ -1741,11 +1863,11 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "history_record_p50_us", "history_query_30m_p50_ms",
     "history_resident_bytes_per_point",
     # ingest spine (batch append + native kernel + binary peer wire,
-    # docs/perf.md; py-fallback, bytes comparisons and the per-chip
-    # micro-record number — superseded by ingest_tick_256_p50_ms, the
-    # live-sampler version of the same story — live in full results)
+    # docs/perf.md; py-fallback, bytes comparisons, the per-chip
+    # micro-record number and the wire decode p50 — superseded by
+    # ingest_tick_256_p50_ms, the live-sampler version of the same
+    # story — live in full results)
     "ingest_batch_p50_us", "ingest_tick_256_p50_ms",
-    "wire_binary_decode_p50_us",
     # federation (flat peer fan-out + the push-based aggregator tree,
     # docs/federation.md; the 64-chip flat number, keyframe bytes, chip
     # counts and the delta-vs-keyframe ratio live in full results)
@@ -1754,11 +1876,18 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "federation_delta_bytes_per_tick",
     "federation_resync_ms",
     # query engine (in-tree PromQL subset, docs/query.md; the raw
-    # history-walk comparison, per-config rule tick operands and the
-    # per-leaf TPWR byte cost live in full results)
-    "query_instant_p50_ms", "query_range_30m_p50_ms",
+    # history-walk comparison, the range-grid p50, per-config rule
+    # tick operands and the per-leaf TPWR byte cost live in full
+    # results — the instant p50 and the append-time-rules overhead
+    # are the numbers of record)
+    "query_instant_p50_ms",
     "query_rules_append_overhead_pct",
     "query_fed_2048_topk_p50_ms",
+    # slo (burn-rate engine tick overhead + multi-tenant traffic-sim
+    # throughput, docs/slo.md; the on/off tick operands and the
+    # completed-request count live in full results)
+    "slo_eval_overhead_tick_pct",
+    "traffic_sim_1k_requests_wall_s",
     # kernels
     "mxu_matmul_pallas_tflops", "mxu_matmul_vs_xla",
     "int8_matmul_pallas_tflops", "int8_matmul_vs_xla",
@@ -1843,6 +1972,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(_bench_federation_tree())
     if name == "query":
         return asyncio.run(_bench_query())
+    if name == "slo":
+        return asyncio.run(_bench_slo())
     if name == "kernels":
         if not on_tpu:
             # Keep the documented key set stable off-TPU: explicit nulls,

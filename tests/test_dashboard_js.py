@@ -45,6 +45,7 @@ GET_ENDPOINTS = [
     ("/api/alerts", ""),
     ("/api/serving", ""),
     ("/api/federation", ""),
+    ("/api/slo", ""),
     ("/api/health", ""),
     ("/api/query", "query=topk(5,avg_over_time(chip.mxu[5m]))"),
     ("/api/trace", ""),
@@ -612,6 +613,72 @@ def test_federation_card_renders_fleet_view(js):
     assert doc2.el("federation-card")["style"]["display"] == ""
     assert doc2.el("fed-uplink")["textContent"] == "down"
     assert doc2.el("fed-uplink")["style"]["color"] == "var(--red)"
+
+
+def test_slo_card_hidden_without_objectives(js, payloads):
+    """No configured objectives (the real server's empty payload) or a
+    down server: the burn-down card stays hidden, never throws."""
+    d, doc, net, env, surf = mkdash(js, payloads)
+    d["fetchSlo"]()
+    assert doc.el("slo-card")["style"]["display"] == "none"
+    d2, doc2, _, _, _ = mkdash(js, {})
+    d2["fetchSlo"]()
+    assert doc2.el("slo-card")["style"]["display"] == "none"
+
+
+SLO_PAYLOAD = {
+    "slos": [
+        {"name": "chat_ttft", "tenant": "chat", "target": 0.99,
+         "window_s": 3600.0, "bad": 1.0,
+         "budget": {"bad_fraction": 0.2, "used": 20.0,
+                    "remaining": -19.0},
+         "burn": {
+             "fast": {"short_s": 1.0, "long_s": 3.0, "threshold": 14.4,
+                      "short": 100.0, "long": 93.3, "firing": True},
+             "slow": {"short_s": 2.0, "long_s": 6.0, "threshold": 6.0,
+                      "short": 100.0, "long": 46.7, "firing": True},
+         }},
+        {"name": "batch_goodput", "tenant": "", "target": 0.9,
+         "window_s": 3600.0, "bad": 0.0,
+         "budget": {"bad_fraction": 0.0, "used": 0.0, "remaining": 1.0},
+         "burn": {
+             "fast": {"short_s": 1.0, "long_s": 3.0, "threshold": 14.4,
+                      "short": 0.0, "long": None, "firing": False},
+             "slow": {"short_s": 2.0, "long_s": 6.0, "threshold": 6.0,
+                      "short": 0.0, "long": 0.0, "firing": False},
+         }},
+    ],
+    "evaluated_at": 1700000000.0,
+}
+
+
+def test_slo_card_renders_burn_down(js):
+    """The burn-down card: one row per objective with budget remaining
+    and both burn pairs, firing windows marked and counted in the tag
+    (docs/slo.md)."""
+    d, doc, net, env, surf = mkdash(js, {"/api/slo": SLO_PAYLOAD})
+    d["fetchSlo"]()
+    assert doc.el("slo-card")["style"]["display"] == ""
+    assert doc.el("slo-tag")["textContent"] == "2 burning"
+    assert doc.el("slo-tag")["style"]["color"] == "var(--red)"
+    rows = doc.el("slo-body")["_children"]
+    assert len(rows) == 2
+    burning = all_text(rows[0])
+    assert "chat_ttft" in burning and "chat" in burning
+    assert "99.00%" in burning
+    assert "-1900.0%" in burning  # exhausted budget, shown not clamped
+    assert "100.0x / 93.3x ● FIRING" in burning
+    healthy = all_text(rows[1])
+    assert "batch_goodput" in healthy
+    assert "100.0%" in healthy  # budget untouched
+    assert "0.0x / –" in healthy  # warmup long window renders as dash
+    assert "FIRING" not in healthy
+    # Recovery clears the tag.
+    calm = {"slos": [SLO_PAYLOAD["slos"][1]], "evaluated_at": 1.0}
+    d2, doc2, _, _, _ = mkdash(js, {"/api/slo": calm})
+    d2["fetchSlo"]()
+    assert doc2.el("slo-tag")["textContent"] == "1 objective(s)"
+    assert doc2.el("slo-tag")["style"]["color"] == ""
 
 
 SERVING = {

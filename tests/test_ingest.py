@@ -206,10 +206,15 @@ def test_record_batch_matches_record(force_python):
 def test_record_batch_kernel_matches_python():
     """accum_many (the one-call-per-tick downsample path) is bit-exact
     across kernel and fallback, including bucket flushes for series
-    that skip ticks."""
+    that skip ticks. The batch must clear ACCUM_KERNEL_MIN or the
+    size heuristic would route both runs through the fallback and the
+    kernel path would go untested."""
     def run() -> RingHistory:
         ring = RingHistory()
-        names = [f"s{i}" for i in range(9)]
+        # 1/5 of the series skip each tick, so the live batch is
+        # ~48*4/5 = 38 series — comfortably above the heuristic.
+        assert tsdb.ACCUM_KERNEL_MIN <= 38
+        names = [f"s{i}" for i in range(48)]
         for tick in range(150):
             ts = 1_700_000_000.0 + tick
             pairs = [

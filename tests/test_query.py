@@ -519,6 +519,48 @@ def test_compile_env_alerting_none_semantics():
 # --------------------------- engine plumbing ---------------------------
 
 
+def test_eval_condition_matches_generic_truthiness():
+    """eval_condition (the SLO engine's short-circuit bad-condition
+    path) must agree with bool(eval_compiled(...)) under the
+    vector-non-emptiness / scalar-truthiness collapse on every shape —
+    including the ones it fast-paths (selector vs constant, both
+    orders, negative constants, matchers) and the ones it must NOT
+    fast-path (and/or label intersection, vector-vector comparisons,
+    arithmetic)."""
+    ring = RingHistory(1800)
+    at = 1_700_000_000.0
+    ring.record("serving.a.ttft_p95_ms", 900.0, ts=at)
+    ring.record("serving.b.ttft_p95_ms", 100.0, ts=at)
+    ring.record("mxu", 50.0, ts=at)
+    ring.record("temp", -5.0, ts=at)
+    engine = QueryEngine(ring)
+    exprs = [
+        "mxu > 10", "mxu > 100", "10 < mxu", "1000 < mxu",
+        "mxu == 50", "mxu != 50", "temp < -1", "temp > -1",
+        'serving.ttft_p95_ms{tenant="a"} > 800',
+        'serving.ttft_p95_ms{tenant="b"} > 800',
+        'serving.ttft_p95_ms{tenant="nope"} > 0',
+        "absent_series > 0", "absent_series <= 0",
+        # fall-through shapes (and/or intersect BY LABELS, not truth)
+        'serving.ttft_p95_ms{tenant="a"} > 800 and '
+        'serving.ttft_p95_ms{tenant="b"} < 800',
+        "mxu > 10 and mxu < 100", "mxu > 100 or mxu < 10",
+        "mxu > temp", "mxu - 50", "mxu - 49", "3 > 2", "2 > 3",
+    ]
+    for src in exprs:
+        node = parse(src)
+        v = engine.eval_compiled(node, at=at)
+        if isinstance(v, list):
+            expect = bool(v)
+        else:
+            expect = bool(v) and v == v and v is not None
+        assert engine.eval_condition(node, at=at) is expect, src
+    # Shared-context use (the SLO engine's call shape) agrees too.
+    ctx = engine.context(at=at)
+    assert engine.eval_condition(parse("mxu > 10"), ctx=ctx) is True
+    assert engine.eval_condition(parse("mxu > 100"), ctx=ctx) is False
+
+
 def test_compiled_expression_cache_is_bounded():
     ring = RingHistory(1800)
     engine = QueryEngine(ring)

@@ -1,0 +1,417 @@
+"""SLO engine (tpumon.slo, docs/slo.md): objective validation, the
+burn-rate math against hand-computed budgets (incl. warmup on windows
+longer than the data, budget exhaustion, recovery hysteresis), the
+both-windows-must-fire / either-window-clears state machine with its
+journal event pairs, alert-engine integration, and the tenant-tag
+propagation chain from a real ServingEngine Request through the
+serving distiller and sampler into a ``serving.<tenant>.*`` TSDB
+series selected by a ``{tenant="..."}`` matcher."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from tpumon.alerts import AlertEngine
+from tpumon.events import EventJournal
+from tpumon.history import RingHistory
+from tpumon.query import QueryEngine
+from tpumon.slo import SLOEngine, SLOSpec, parse_slos
+
+T0 = 1_700_000_000.0
+
+
+def mk(spec_raw):
+    ring = RingHistory(1800)
+    q = QueryEngine(ring)
+    journal = EventJournal(512)
+    specs, errors = parse_slos([spec_raw])
+    assert errors == [], errors
+    return ring, q, journal, SLOEngine(specs, q, ring, journal)
+
+
+# ----------------------------- validation ------------------------------
+
+
+def test_parse_rejects_bad_objectives():
+    bad = [
+        ({"name": "a.b", "expr": "x > 1", "target": 0.9}, "must match"),
+        ({"name": "", "expr": "x > 1", "target": 0.9}, "must match"),
+        ({"name": "a", "expr": "x >", "target": 0.9}, "bad expr"),
+        ({"name": "a", "expr": "x > 1", "target": 1.0}, "target must be"),
+        ({"name": "a", "expr": "x > 1", "target": 0.0}, "target must be"),
+        ({"name": "a", "expr": "x > 1", "target": 0.9,
+          "window": "soon"}, "window"),
+        ({"name": "a", "expr": "x > 1", "target": 0.9,
+          "fast": ["10s"]}, "wants \\[short, long\\]"),
+        ({"name": "a", "expr": "x > 1", "target": 0.9,
+          "fast": ["30s", "10s"]}, "must be below"),
+        ({"name": "a", "expr": "x > 1", "target": 0.9,
+          "clear_ratio": 1.5}, "clear_ratio"),
+        ({"name": "a", "expr": "x > 1", "target": 0.9,
+          "frobnicate": 1}, "unknown keys"),
+        ("not-an-object", "must be an object"),
+    ]
+    for raw, match in bad:
+        with pytest.raises(ValueError, match=match):
+            SLOSpec.parse(raw)
+
+
+def test_parse_slos_collects_errors_and_drops_duplicates():
+    specs, errors = parse_slos([
+        {"name": "ok", "expr": "x > 1", "target": 0.99},
+        {"name": "bad name!", "expr": "x > 1", "target": 0.99},
+        {"name": "dup", "expr": "x > 1", "target": 0.99},
+        {"name": "dup", "expr": "x > 2", "target": 0.99},
+    ])
+    assert [s.name for s in specs] == ["ok"]
+    assert len(errors) == 2
+    assert any("dup" in e for e in errors)
+
+
+def test_sre_workbook_window_derivation_for_30d():
+    spec = SLOSpec.parse(
+        {"name": "a", "expr": "x > 1", "target": 0.999, "window": "30d"})
+    assert spec.fast == (300.0, 3600.0)     # 5m / 1h
+    assert spec.slow == (1800.0, 21600.0)   # 30m / 6h
+    assert spec.fast_burn == 14.4 and spec.slow_burn == 6.0
+
+
+def test_rule_texts_cover_every_window_once():
+    specs, _ = parse_slos([
+        {"name": "a", "expr": "x > 1", "target": 0.99, "window": "1h",
+         "fast": ["2s", "6s"], "slow": ["4s", "12s"]},
+        {"name": "b", "expr": "x > 2", "target": 0.99, "window": "1h",
+         "fast": ["2s", "6s"], "slow": ["4s", "12s"]},
+    ])
+    eng = SLOEngine(specs, None, None, None)
+    assert eng.rule_texts() == [
+        "slo.bad[2s]", "slo.bad[4s]", "slo.bad[6s]", "slo.bad[12s]",
+        "slo.bad[3600s]",
+    ]
+
+
+# ------------------------- burn math (hand-computed) --------------------
+
+
+FRACTION_SPEC = {
+    # Non-comparison expr: the series value IS the bad fraction.
+    "name": "frac", "expr": "slo_input", "target": 0.9, "window": "10m",
+    "fast": ["2s", "6s"], "slow": ["4s", "12s"],
+    "fast_burn": 5.0, "slow_burn": 3.0,
+}
+
+
+def feed(ring, q, eng, values, t0=T0, dt=1.0, series="slo_input"):
+    h = ring.handle(series)
+    t = t0
+    for v in values:
+        if v is not None:
+            ring.record_batch([(h, v)], ts=t)
+        eng.observe(t)
+        t += dt
+    return t - dt  # ts of the last observe
+
+
+def test_burn_rates_match_hand_computed_window_means():
+    ring, q, journal, eng = mk(FRACTION_SPEC)
+    # 1 Hz: [0, 0, 0, 0, 1, 1, 1] — observe after each point.
+    last = feed(ring, q, eng, [0, 0, 0, 0, 1, 1, 1])
+    row = eng.to_json()["slos"][0]
+    # Windows are closed [t-w, t]: 2s window at t holds the points at
+    # t-2, t-1, t  -> [1, 1, 1]; 6s window holds 7 points -> 3/7 bad.
+    budget = 0.1
+    assert row["burn"]["fast"]["short"] == pytest.approx(1.0 / budget)
+    assert row["burn"]["fast"]["long"] == pytest.approx(
+        (3 / 7) / budget, abs=1e-3)
+    # The bad series itself landed in the ring (1 point per tick).
+    assert "slo.frac.bad" in ring.series
+    # Budget over the whole 10m window (warmup: only 7 points exist).
+    assert row["budget"]["bad_fraction"] == pytest.approx(3 / 7, abs=1e-3)
+    assert row["budget"]["used"] == pytest.approx((3 / 7) / budget, abs=0.01)
+    assert row["budget"]["remaining"] == pytest.approx(
+        1 - (3 / 7) / budget, abs=0.01)
+    assert last == T0 + 6
+
+
+def test_warmup_no_data_makes_no_transitions():
+    ring, q, journal, eng = mk(FRACTION_SPEC)
+    # Fraction semantics: absent data is unknown — nothing recorded,
+    # no burn values, no transitions either way.
+    eng.observe(T0)
+    row = eng.to_json()["slos"][0]
+    assert row["bad"] is None
+    assert row["burn"]["fast"]["short"] is None
+    assert row["budget"]["remaining"] is None
+    assert eng.alert_rows() == []
+    assert "slo.frac.bad" not in ring.series
+    assert [e for e in journal.events() if e["kind"] == "slo"] == []
+
+
+def test_condition_semantics_absent_data_is_good():
+    ring, q, journal, eng = mk({
+        "name": "cond", "expr": "svc > 5", "target": 0.9, "window": "10m",
+        "fast": ["2s", "6s"], "slow": ["4s", "12s"],
+    })
+    h = ring.handle("svc")
+    eng.observe(T0)  # no data: condition false -> good tick, recorded
+    assert eng.to_json()["slos"][0]["bad"] == 0.0
+    ring.record_batch([(h, 3.0)], ts=T0 + 1)
+    eng.observe(T0 + 1)
+    assert eng.to_json()["slos"][0]["bad"] == 0.0
+    ring.record_batch([(h, 7.5)], ts=T0 + 2)
+    eng.observe(T0 + 2)
+    assert eng.to_json()["slos"][0]["bad"] == 1.0
+
+
+def test_budget_exhaustion_goes_negative():
+    ring, q, journal, eng = mk(FRACTION_SPEC)
+    feed(ring, q, eng, [1.0] * 30)
+    row = eng.to_json()["slos"][0]
+    # Sustained 100% bad at 10% budget: burning 10x, budget -9 deep.
+    assert row["budget"]["used"] == pytest.approx(10.0)
+    assert row["budget"]["remaining"] == pytest.approx(-9.0)
+
+
+def test_fire_requires_both_windows_and_clear_takes_either():
+    ring, q, journal, eng = mk(FRACTION_SPEC)
+    # thresholds: fast fires at burn >= 5 (avg bad >= 0.5 at 10%
+    # budget) on BOTH the 2s and 6s windows; clears below 4.5 (0.45)
+    # on EITHER.
+    last = feed(ring, q, eng, [0.0] * 13)
+    assert eng.alert_rows() == []
+    # Short burst: 2s window saturates but the 6s window stays below
+    # 0.5 — must NOT fire (the long window suppresses blips).
+    last = feed(ring, q, eng, [1.0, 1.0, 1.0], t0=last + 1)
+    row = eng.to_json()["slos"][0]["burn"]["fast"]
+    assert row["short"] == pytest.approx(10.0)
+    assert row["long"] < 5.0
+    assert not row["firing"]
+    # Sustain: the long window crosses too -> fires.
+    last = feed(ring, q, eng, [1.0] * 5, t0=last + 1)
+    assert eng.to_json()["slos"][0]["burn"]["fast"]["firing"]
+    assert {r["window"] for r in eng.alert_rows()} >= {"fast"}
+    # Hysteresis hold: park the level so both windows sit between the
+    # clear line (0.45) and the fire line (0.5) — still firing.
+    last = feed(ring, q, eng, [0.475] * 20, t0=last + 1)
+    row = eng.to_json()["slos"][0]["burn"]["fast"]
+    assert 4.5 <= row["short"] < 5.0
+    assert 4.5 <= row["long"] < 5.0
+    assert row["firing"], "burn inside the hysteresis band must hold state"
+    # Recovery: back to full burn, then a sharp stop — the 2s window
+    # drains below the clear line while the 6s window is still well
+    # above it, and that ALONE clears (either-window semantics).
+    last = feed(ring, q, eng, [1.0] * 8, t0=last + 1)
+    last = feed(ring, q, eng, [0.0, 0.0], t0=last + 1)
+    row = eng.to_json()["slos"][0]["burn"]["fast"]
+    assert row["short"] < 4.5 <= row["long"]
+    assert not row["firing"]
+    events = [e for e in journal.events()
+              if e["kind"] == "slo" and e["window"] == "fast"]
+    assert [e["state"] for e in events] == ["fired", "resolved"]
+    assert events[0]["seq"] < events[1]["seq"]
+    assert events[0]["severity"] == "critical"
+    assert events[1]["severity"] == "info"
+
+
+def test_firing_alert_resolves_when_all_window_data_vanishes():
+    """Fraction-mode objective: if the source series disappears while
+    firing, the windows eventually drain to no-data — the alert must
+    resolve (source-down alerts own the outage), not page forever on
+    stale in-memory state."""
+    ring, q, journal, eng = mk(FRACTION_SPEC)
+    last = feed(ring, q, eng, [0.0] * 13)
+    last = feed(ring, q, eng, [1.0] * 8, t0=last + 1)
+    assert eng.to_json()["slos"][0]["burn"]["fast"]["firing"]
+    # Source vanishes: observe ticks continue, nothing is recorded.
+    last = feed(ring, q, eng, [None] * 40, t0=last + 1)
+    row = eng.to_json()["slos"][0]["burn"]["fast"]
+    assert row["short"] is None and row["long"] is None
+    assert not row["firing"]
+    states = [e["state"] for e in journal.events()
+              if e["kind"] == "slo" and e["window"] == "fast"]
+    assert states == ["fired", "resolved"]
+
+
+def test_alert_engine_serves_burn_rows():
+    engine = AlertEngine()
+    rows = [
+        {"name": "chat_ttft", "tenant": "chat", "window": "fast",
+         "short_s": 2.0, "long_s": 6.0, "threshold": 14.4},
+        {"name": "chat_ttft", "tenant": "chat", "window": "slow",
+         "short_s": 4.0, "long_s": 12.0, "threshold": 6.0},
+    ]
+    out = engine.evaluate(slos=rows)
+    crit_keys = {a["key"] for a in out["critical"]}
+    minor_keys = {a["key"] for a in out["minor"]}
+    assert "slo.chat_ttft.burn.fast" in crit_keys
+    assert "slo.chat_ttft.burn.slow" in minor_keys
+    # Recovery resolves through the normal alert lifecycle.
+    out = engine.evaluate(slos=[])
+    assert out["critical"] == [] and out["minor"] == []
+    states = [e["state"] for e in engine.events
+              if e["key"] == "slo.chat_ttft.burn.fast"]
+    assert states == ["fired", "resolved"]
+
+
+# ----------------- tenant tag propagation (real engine) -----------------
+
+
+def test_tenant_tag_propagates_request_to_query_matcher():
+    """Request.tenant -> engine accounting -> /metrics gauges ->
+    serving distiller -> sampler -> serving.<tenant>.* series ->
+    {tenant=...} matcher, end to end."""
+    from tpumon.collectors import Sample
+    from tpumon.collectors.serving import distill_serving_metrics
+    from tpumon.config import load_config
+    from tpumon.loadgen.serving import ServingEngine
+    from tpumon.sampler import Sampler
+
+    eng = ServingEngine()
+    for _ in range(3):
+        eng.submit([1, 2, 3, 4], max_new=2, tenant="chat")
+    eng.submit([5, 6, 7], max_new=2, tenant="rag")
+    eng.submit([9, 9], max_new=2)  # untagged: excluded from tenants
+    while eng.step():
+        pass
+    text = eng.metrics_text()
+    assert 'tpumon_serving_tenant_requests{tenant="chat"} 3' in text
+    assert 'tpumon_serving_tenant_completed{tenant="rag"} 1' in text
+    assert 'tpumon_serving_tenant_ttft_p95_ms{tenant="chat"}' in text
+
+    t1 = time.time()
+    d1 = distill_serving_metrics(text, now=t1)
+    assert d1["tenants"]["chat"]["requests_total"] == 3
+    assert d1["tenants"]["chat"]["ttft_p95_ms"] > 0
+    # Second scrape: windowed goodput/error rates from counter deltas.
+    d2 = distill_serving_metrics(eng.metrics_text(), prev=d1, now=t1 + 5)
+    assert d2["tenants"]["chat"]["goodput_rps"] == pytest.approx(0.0)
+    assert d2["tenants"]["chat"]["error_rate"] == 0.0
+
+    cfg = load_config(env={"TPUMON_ANOMALY_DETECT": "0"})
+    sampler = Sampler(cfg)
+    sampler.latest["serving"] = Sample(
+        source="serving", ok=True, data=[{"target": "t", "ok": True, **d1}])
+    ts = time.time()
+    sampler._record_history(ts)
+    assert "serving.chat.ttft_p95_ms" in sampler.history.series
+    hit = sampler.query.instant(
+        'serving.ttft_p95_ms{tenant="chat"}', at=ts)
+    assert len(hit["result"]) == 1
+    assert hit["result"][0]["labels"] == {"tenant": "chat"}
+    assert hit["result"][0]["value"] == pytest.approx(
+        d1["tenants"]["chat"]["ttft_p95_ms"])
+    miss = sampler.query.instant(
+        'serving.ttft_p95_ms{tenant="nope"}', at=ts)
+    assert miss["result"] == []
+
+
+# -------------------------- server + CLI surfaces -----------------------
+
+
+SOAK_SLOS = json.dumps([{
+    "name": "chat_ttft", "tenant": "chat",
+    "expr": 'serving.ttft_p95_ms{tenant="chat"} > 800',
+    "target": 0.99, "window": "1h",
+    "fast": ["2s", "6s"], "slow": ["4s", "12s"],
+}])
+
+
+def test_api_slo_route_exporter_and_cli(capsys):
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    cfg = load_config(env={
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+        "TPUMON_COLLECTORS": "host,accel",
+        "TPUMON_SLOS": SOAK_SLOS,
+    })
+    sampler, server = build(cfg)
+    assert sampler.slo is not None
+
+    async def scenario():
+        await sampler.tick_all()
+        status, ctype, body = await server.handle("GET", "/api/slo")
+        assert status == 200
+        payload = json.loads(body)
+        assert [s["name"] for s in payload["slos"]] == ["chat_ttft"]
+        row = payload["slos"][0]
+        assert row["tenant"] == "chat"
+        assert row["burn"]["fast"]["threshold"] == 14.4
+        # Condition over absent data: good ticks, zero burn.
+        assert row["bad"] == 0.0
+        assert not row["burn"]["fast"]["firing"]
+        status, _, body = await server.handle("GET", "/metrics")
+        text = body.decode()
+        assert 'tpumon_slo_target{slo="chat_ttft",tenant="chat"}' in text
+        assert "tpumon_slo_burn_firing" in text
+        assert "tpumon_slo_budget_remaining" in text
+        # /api/health carries the summary block.
+        assert sampler.health_json()["slo"] == {
+            "objectives": 1, "firing": [],
+        }
+        # CLI over the real HTTP surface.
+        from tpumon.slo import slo_cli
+
+        await server.start()
+        port = server.port
+        rc = await asyncio.to_thread(
+            slo_cli, ["--url", f"127.0.0.1:{port}"])
+        assert rc == 0
+        rc = await asyncio.to_thread(
+            slo_cli, ["--url", f"127.0.0.1:{port}", "--json"])
+        assert rc == 0
+        await server.stop()
+
+    asyncio.run(scenario())
+    out = capsys.readouterr().out
+    assert "chat_ttft" in out
+    assert '"slos"' in out  # the --json run
+
+
+def test_dotted_tenant_label_journals_once_never_lands():
+    """A foreign serving stack may expose a dotted tenant label the
+    traffic driver would have rejected: the sampler cannot name its
+    series, so it journals the gap (once) instead of silently letting
+    SLOs over that tenant never fire."""
+    from tpumon.collectors import Sample
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    cfg = load_config(env={"TPUMON_ANOMALY_DETECT": "0"})
+    sampler = Sampler(cfg)
+    bad = {"target": "t", "ok": True,
+           "tenants": {"team.a": {"ttft_p95_ms": 10.0}}}
+    sampler.latest["serving"] = Sample(source="serving", ok=True, data=[bad])
+    ts = time.time()
+    sampler._record_history(ts)
+    sampler._record_history(ts + 1)
+    assert not any(n.startswith("serving.team") for n in
+                   sampler.history.series)
+    skipped = [e for e in sampler.journal.events()
+               if e["kind"] == "slo" and e.get("tenant") == "team.a"]
+    assert len(skipped) == 1
+    assert skipped[0]["severity"] == "minor"
+
+
+def test_rejected_objective_journals_not_crashes():
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    cfg = load_config(env={
+        "TPUMON_ANOMALY_DETECT": "0",
+        "TPUMON_SLOS": json.dumps([
+            {"name": "ok", "expr": "x > 1", "target": 0.99},
+            {"name": "bad target", "expr": "x > 1", "target": 0.5},
+        ]),
+    })
+    sampler = Sampler(cfg)
+    assert sampler.slo is not None
+    assert len(sampler.slo.compiled) == 1
+    rejected = [e for e in sampler.journal.events() if e["kind"] == "slo"]
+    assert len(rejected) == 1
+    assert rejected[0]["severity"] == "serious"

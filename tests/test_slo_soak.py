@@ -1,0 +1,220 @@
+"""Closed-loop SLO soak (ISSUE 13 acceptance): a live monitor scraping
+a real ServingEngine driven by the seeded multi-tenant traffic mix,
+where injecting a serving-path fault — chaos ``slow`` on the serving
+collector PLUS the traffic driver's scheduler-degradation knob — fires
+the fast-window SLO burn alert within 10 sampler ticks, and lifting
+the fault clears it within 20 ticks. Asserted through the public
+surfaces: ``/api/slo``, the ``/api/alerts`` stream, and the ``slo``
+journal event pair (fired → resolved) in seq order. No unit seams: the
+whole chain is Request.tenant → engine tenant gauges → serving
+collector → ``serving.chat.*`` TSDB series → compiled burn-rate
+expressions → AlertEngine → HTTP."""
+
+import asyncio
+import json
+import time
+
+from tests.test_server_api import get_json
+from tpumon.app import build
+from tpumon.collectors.chaos import ChaosCollector, Fault
+from tpumon.config import load_config
+from tpumon.loadgen.serving import ServingEngine
+from tpumon.loadgen.traffic import TenantSpec, TrafficSim
+
+# Tick / threshold / stall geometry: degraded first-tokens cost ~1 s
+# stalled steps, so TTFT crosses 700 ms within the FIRST stall of the
+# fault; two bad ticks fill the 3 s long window past 14.4x burn, and
+# the serving scrape runs at twice the tick rate so gauge staleness
+# costs at most half a tick. Healthy TTFT on the demo model is tens of
+# ms — an order of magnitude of headroom below the threshold.
+SAMPLE_INTERVAL_S = 0.5
+SERVING_INTERVAL_S = 0.25
+TTFT_THRESHOLD_MS = 700.0
+DEGRADE_STALL_S = 1.0
+
+SLOS = [{
+    "name": "chat_ttft",
+    "tenant": "chat",
+    "expr": f'serving.ttft_p95_ms{{tenant="chat"}} > {TTFT_THRESHOLD_MS:g}',
+    "target": 0.99,
+    "window": "1h",
+    # Second-scale burn windows so fault -> page -> un-page fits in a
+    # test; thresholds stay the production 14.4x / 6x.
+    "fast": ["1s", "3s"],
+    "slow": ["2s", "6s"],
+}]
+
+
+async def wait_until(fn, what: str, timeout_s: float = 30.0):
+    """Poll ``fn`` until truthy. fn may do blocking HTTP against the
+    in-process server, so it runs via to_thread — a blocking call on
+    the event-loop thread would deadlock against the server it is
+    polling."""
+    t0 = time.monotonic()
+    while True:
+        v = await asyncio.to_thread(fn)
+        if v:
+            return v
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError(f"slo soak: timed out waiting for {what}")
+        await asyncio.sleep(0.05)
+
+
+def test_slo_soak_fault_pages_and_recovery_unpages():
+    # --- serving side: engine + multi-tenant sim + /metrics ----------
+    engine = ServingEngine()
+    # Recency window for the per-tenant latency gauges: short enough
+    # that recovery is visible within the soak's 20-tick budget.
+    engine.tenant_window_s = 2.0
+    from tpumon.loadgen.serving import start_metrics_server
+
+    metrics_server, port = start_metrics_server(engine)
+    sim = TrafficSim(engine, [
+        TenantSpec(name="chat", scenario="chat", rps=6.0, max_new=4),
+        TenantSpec(name="rag", scenario="rag", rps=1.0,
+                   prompt_chunks=3, max_new=4),
+        TenantSpec(name="batch", scenario="batch", rps=0.5, max_new=8),
+    ], seed=42)
+
+    cfg = load_config(env={
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+        "TPUMON_COLLECTORS": "host,accel,serving",
+        "TPUMON_SERVING_TARGETS": f"http://127.0.0.1:{port}/metrics",
+        "TPUMON_SAMPLE_INTERVAL_S": str(SAMPLE_INTERVAL_S),
+        "TPUMON_SERVING_INTERVAL_S": str(SERVING_INTERVAL_S),
+        "TPUMON_ANOMALY_DETECT": "0",
+        "TPUMON_SLOS": json.dumps(SLOS),
+        # Chaos wraps the serving collector from the start (the
+        # serving-path fault rides it mid-soak); 0 ms slow = inert
+        # until the fault phase raises it.
+        "TPUMON_CHAOS": "slow:serving:0",
+        "TPUMON_CHAOS_SEED": "42",
+    })
+    sampler, server = build(cfg)
+    assert isinstance(sampler.serving, ChaosCollector)
+    assert sampler.slo is not None
+
+    async def scenario():
+        sim.start()
+        # Warm the engine outside the judged window: the first
+        # prefill/decode jits take seconds and would read as a latency
+        # regression. Wait for real chat completions AND for the
+        # compile-era queue backlog to drain (every backlogged request
+        # carries its queue wait as a multi-second TTFT — judged ticks
+        # over those would fire a warmup-era burn alert), then let the
+        # compile-era TTFTs age out of the tenant recency window.
+        await wait_until(
+            lambda: engine.tenants.get("chat")
+            and engine.tenants["chat"].completed >= 3,
+            "chat traffic flowing", timeout_s=60.0)
+        await wait_until(
+            lambda: len(engine._queue) == 0,
+            "compile-era queue backlog to drain", timeout_s=60.0)
+        await asyncio.sleep(engine.tenant_window_s + 0.5)
+
+        await sampler.start()
+        await server.start()
+        mport = server.port
+
+        def slo_row():
+            return get_json(mport, "/api/slo")["slos"][0]
+
+        def fast_firing():
+            return slo_row()["burn"]["fast"]["firing"]
+
+        def ticks():
+            return sampler.watchdogs["fast"].ticks
+
+        # --- healthy phase ------------------------------------------
+        # Per-tenant series flowing and queryable via {tenant=...}.
+        await wait_until(
+            lambda: "serving.chat.ttft_p95_ms" in sampler.history.series,
+            "per-tenant serving series")
+        hit = await asyncio.to_thread(
+            get_json, mport,
+            '/api/query?query=serving.ttft_p95_ms{tenant="chat"}')
+        assert len(hit["result"]) == 1
+        assert hit["result"][0]["labels"] == {"tenant": "chat"}
+        # Enough good history to fill the long fast window, burn ~0.
+        await wait_until(
+            lambda: slo_row()["burn"]["fast"]["long"] == 0.0,
+            "clean baseline over the long window")
+        baseline = await asyncio.to_thread(slo_row)
+        assert not baseline["burn"]["fast"]["firing"]
+        assert baseline["bad"] == 0.0
+
+        # --- fault phase --------------------------------------------
+        # Journal high-water mark: the judged fired/resolved pair is
+        # the one the FAULT produces — a transient the warmup phase
+        # journaled (and resolved; the baseline asserts not-firing)
+        # must not count against the closed loop.
+        pre_fault = (await asyncio.to_thread(
+            get_json, mport, "/api/events?kind=slo"))["events"]
+        seq0 = max((e["seq"] for e in pre_fault), default=0)
+        # The serving-path fault: scrapes slow down (chaos) AND the
+        # scheduler degrades (queues grow, TTFT balloons).
+        sampler.serving.set_faults([Fault(mode="slow", param=150.0)])
+        sim.degrade(DEGRADE_STALL_S)
+        t_fault = ticks()
+        await wait_until(fast_firing, "fast-window burn alert",
+                         timeout_s=30.0)
+        fired_after = ticks() - t_fault
+        assert fired_after <= 10, (
+            f"fast burn alert took {fired_after} ticks (budget 10)")
+        row = await asyncio.to_thread(slo_row)
+        assert row["burn"]["fast"]["short"] >= 14.4
+        assert row["burn"]["fast"]["long"] >= 14.4
+        # The page reached the alert stream (critical bucket).
+        alerts = await asyncio.to_thread(get_json, mport, "/api/alerts")
+        crit = {a["key"]: a for a in alerts["critical"]}
+        assert "slo.chat_ttft.burn.fast" in crit
+        assert "chat" in crit["slo.chat_ttft.burn.fast"]["title"]
+        # ... and the journal (kind=slo, state=fired).
+        events = (await asyncio.to_thread(
+            get_json, mport, "/api/events?kind=slo"))["events"]
+        fired = [e for e in events
+                 if e["seq"] > seq0 and e.get("window") == "fast"
+                 and e.get("state") == "fired"]
+        assert len(fired) == 1
+        # Chaos-slowed scrapes still land (the monitor keeps seeing).
+        assert sampler.latest["serving"].ok
+
+        # --- recovery phase -----------------------------------------
+        sim.degrade(0)
+        sampler.serving.set_faults([])
+        t_rec = ticks()
+        await wait_until(lambda: not fast_firing(),
+                         "fast burn alert to clear", timeout_s=30.0)
+        cleared_after = ticks() - t_rec
+        assert cleared_after <= 20, (
+            f"recovery took {cleared_after} ticks (budget 20)")
+        # Journal holds the fault's fired -> resolved pair in seq order.
+        events = (await asyncio.to_thread(
+            get_json, mport, "/api/events?kind=slo"))["events"]
+        fast_events = [e for e in events
+                       if e["seq"] > seq0 and e.get("window") == "fast"]
+        states = [e["state"] for e in fast_events]
+        assert states[:1] == ["fired"] and "resolved" in states
+        seqs = [e["seq"] for e in fast_events]
+        assert seqs == sorted(seqs)
+        # The alert stream un-paged too (resolve may ride the next
+        # evaluation tick after the SLO state flips).
+        await wait_until(
+            lambda: "slo.chat_ttft.burn.fast" not in {
+                a["key"]
+                for a in get_json(mport, "/api/alerts")["critical"]
+            },
+            "critical bucket to clear")
+
+        await server.stop()
+        await sampler.stop()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        sim.stop()
+        metrics_server.shutdown()
+        metrics_server.server_close()

@@ -1,0 +1,127 @@
+"""Server-side TLS (the PR 7 follow-up): --tls-cert/--tls-key wrap the
+listener in an ssl.SSLContext, so the SLO/alerting surface isn't
+plaintext. Exercised against the checked-in self-signed fixture cert
+(tests/fixtures/tls/, CN=tpumon-test, SAN IP:127.0.0.1 — valid ~100
+years so the suite never starts failing on a calendar date)."""
+
+import asyncio
+import json
+import os
+import ssl
+import urllib.request
+
+import pytest
+
+from tpumon.app import build
+from tpumon.config import load_config
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "tls")
+CERT = os.path.join(FIXTURES, "cert.pem")
+KEY = os.path.join(FIXTURES, "key.pem")
+
+
+def mk_cfg(**extra):
+    return load_config(env={
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+        "TPUMON_COLLECTORS": "host,accel",
+        **extra,
+    })
+
+
+def test_https_terminates_on_the_listener():
+    cfg = mk_cfg(TPUMON_TLS_CERT=CERT, TPUMON_TLS_KEY=KEY)
+    sampler, server = build(cfg)
+
+    async def scenario():
+        await sampler.tick_all()
+        await server.start()
+        port = server.port
+        client = ssl.create_default_context(cafile=CERT)
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}{path}", timeout=10,
+                context=client,
+            ) as r:
+                return r.status, json.load(r)
+
+        status, health = await asyncio.to_thread(get, "/api/health")
+        assert status == 200
+        assert health["sources"]["accel"]["ok"]
+        status, slo = await asyncio.to_thread(get, "/api/slo")
+        assert status == 200 and slo == {"slos": [], "evaluated_at": None}
+
+        # A client that does not trust the self-signed cert is refused
+        # during the handshake — the listener really is TLS, not
+        # plaintext with a cert lying around.
+        def get_untrusted():
+            urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/api/health", timeout=10,
+                context=ssl.create_default_context(),
+            )
+
+        with pytest.raises(Exception) as exc:
+            await asyncio.to_thread(get_untrusted)
+        assert "certificate" in str(exc.value).lower() or isinstance(
+            exc.value, ssl.SSLError)
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_combined_pem_key_defaults_to_cert(tmp_path):
+    combined = tmp_path / "combined.pem"
+    with open(KEY) as kf, open(CERT) as cf:
+        combined.write_text(kf.read() + cf.read())
+    cfg = mk_cfg(TPUMON_TLS_CERT=str(combined))
+    sampler, server = build(cfg)
+
+    async def scenario():
+        await sampler.tick_all()
+        await server.start()
+        client = ssl.create_default_context(cafile=CERT)
+
+        def get():
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{server.port}/api/health",
+                timeout=10, context=client,
+            ) as r:
+                return r.status
+
+        assert await asyncio.to_thread(get) == 200
+        await server.stop()
+
+    asyncio.run(scenario())
+
+
+def test_key_without_cert_refuses_to_start():
+    cfg = mk_cfg(TPUMON_TLS_KEY=KEY)
+    sampler, server = build(cfg)
+
+    async def scenario():
+        with pytest.raises(ValueError, match="tls_key is set but"):
+            await server.start()
+
+    asyncio.run(scenario())
+
+
+def test_plain_http_client_is_not_served_by_a_tls_listener():
+    cfg = mk_cfg(TPUMON_TLS_CERT=CERT, TPUMON_TLS_KEY=KEY)
+    sampler, server = build(cfg)
+
+    async def scenario():
+        await sampler.tick_all()
+        await server.start()
+
+        def get_plain():
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/health", timeout=5)
+
+        with pytest.raises(Exception):
+            await asyncio.to_thread(get_plain)
+        await server.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,212 @@
+"""Multi-tenant traffic simulator (tpumon.loadgen.traffic): seeded
+replay, scenario shapes, the diurnal rate profile, and the
+scheduler-degradation knob. The sim is duck-typed over the engine, so
+everything here runs against a recording stub — no model, no jax
+compile; the real-engine integration lives in tests/test_slo.py
+(tenant propagation) and tests/test_slo_soak.py (the closed loop)."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tpumon.loadgen.traffic import TenantSpec, TrafficSim
+
+
+class StubEngine:
+    """Records submissions; never holds work (step() -> False)."""
+
+    def __init__(self, vocab=512, prefill_len=16):
+        self.cfg = SimpleNamespace(
+            model=SimpleNamespace(vocab=vocab), prefill_len=prefill_len)
+        self.max_queue = 64
+        self.submitted: list[tuple] = []
+        self.steps = 0
+
+    def submit(self, prompt, max_new=16, temperature=0.0, top_k=0,
+               tenant=""):
+        self.submitted.append(
+            (tenant, tuple(prompt), max_new, temperature))
+        return SimpleNamespace(tenant=tenant, prompt=list(prompt))
+
+    def step(self):
+        self.steps += 1
+        return False
+
+    def stream(self, tenant):
+        return [s for s in self.submitted if s[0] == tenant]
+
+
+def mk_sim(tenants, seed=42, engine=None, **kw):
+    return TrafficSim(engine or StubEngine(), tenants, seed=seed, **kw)
+
+
+CHAT = TenantSpec(name="chat", scenario="chat", rps=5.0)
+RAG = TenantSpec(name="rag", scenario="rag", rps=1.0, prompt_chunks=4)
+BATCH = TenantSpec(name="batch", scenario="batch", rps=0.5)
+
+
+def test_seeded_runs_replay_identically():
+    a, b = mk_sim([CHAT, RAG, BATCH]), mk_sim([CHAT, RAG, BATCH])
+    for sim in (a, b):
+        for _ in range(25):
+            sim.fire("chat")
+            sim.fire("rag")
+            sim.fire("batch")
+    assert a.engine.submitted == b.engine.submitted
+    # A different seed produces a different stream (the RNG is real).
+    c = mk_sim([CHAT, RAG, BATCH], seed=7)
+    for _ in range(25):
+        c.fire("chat")
+    assert c.engine.stream("chat") != a.engine.stream("chat")[:25]
+
+
+def test_adding_a_tenant_never_perturbs_another():
+    """Per-tenant RNGs are seeded by (seed, name): the chat stream is
+    identical whether or not batch traffic exists alongside it."""
+    alone = mk_sim([CHAT])
+    mixed = mk_sim([CHAT, BATCH])
+    for _ in range(20):
+        alone.fire("chat")
+        mixed.fire("chat")
+        mixed.fire("batch")
+    assert alone.engine.stream("chat") == mixed.engine.stream("chat")
+
+
+def test_scenario_shapes():
+    sim = mk_sim([CHAT, RAG, BATCH])
+    p = sim.engine.cfg.prefill_len
+    for _ in range(10):
+        sim.fire("chat")
+        sim.fire("rag")
+        sim.fire("batch")
+    chat = sim.engine.stream("chat")
+    rag = sim.engine.stream("rag")
+    batch = sim.engine.stream("batch")
+    # chat: short prompts (within one chunk), sampled, latency-shaped.
+    assert all(2 <= len(s[1]) <= p for s in chat)
+    assert all(s[2] == 16 and s[3] == pytest.approx(0.7) for s in chat)
+    # rag: long prompts behind a shared per-tenant prefix — every
+    # request's first (chunks-1)*p tokens are identical (the prefix
+    # cache's hit case), with a per-request tail.
+    shared_len = (4 - 1) * p
+    assert all(len(s[1]) > shared_len for s in rag)
+    prefixes = {s[1][:shared_len] for s in rag}
+    assert len(prefixes) == 1
+    tails = {s[1][shared_len:] for s in rag}
+    assert len(tails) > 1
+    # batch: offline bulk — big max_new, greedy.
+    assert all(s[2] == 64 and s[3] == 0.0 for s in batch)
+
+
+def test_diurnal_rate_profile_is_deterministic():
+    spec = TenantSpec(name="t", rps=2.0, diurnal_amp=0.5,
+                      diurnal_period_s=100.0)
+    sim = mk_sim([spec])
+    rate = sim._rate_fn(spec)
+    assert rate(0.0) == pytest.approx(2.0)
+    assert rate(25.0) == pytest.approx(3.0)   # peak: rps * (1 + amp)
+    assert rate(75.0) == pytest.approx(1.0)   # trough
+    # Full-swing amp clamps at zero rather than going negative.
+    deep = TenantSpec(name="d", rps=2.0, diurnal_amp=1.5,
+                      diurnal_period_s=100.0)
+    assert mk_sim([deep])._rate_fn(deep)(75.0) == 0.0
+    # time_scale compresses sim time: scale 100 reaches the peak at
+    # wall t=0.25.
+    scaled = mk_sim([spec], time_scale=100.0)
+    assert scaled._rate_fn(spec)(0.25) == pytest.approx(3.0)
+
+
+def test_degradation_knob_stalls_steps_and_releases():
+    sim = mk_sim([CHAT])
+    t0 = time.monotonic()
+    sim._step()
+    assert time.monotonic() - t0 < 0.05
+    sim.degrade(0.05)
+    assert sim.degraded
+    t0 = time.monotonic()
+    sim._step()
+    assert time.monotonic() - t0 >= 0.05
+    sim.degrade(0)
+    assert not sim.degraded
+    assert sim.engine.steps == 2
+    # The knob clamps at SET time, so the reported state is the
+    # effective fault (not a silently-milder one).
+    sim.degrade(5.0)
+    assert sim._stall_s == TrafficSim.MAX_STALL_S
+    assert sim.to_json()["stall_s"] == TrafficSim.MAX_STALL_S
+
+
+def test_pump_drives_seeded_arrivals_live():
+    """End to end over the shared ArrivalPump: a hot tenant submits at
+    roughly its rate, a zero-rate tenant never fires, and stop joins
+    the thread."""
+    hot = TenantSpec(name="hot", rps=200.0)
+    cold = TenantSpec(name="cold", rps=0.0)
+    sim = mk_sim([hot, cold])
+    sim.start()
+    deadline = time.monotonic() + 5.0
+    while (not sim.engine.stream("hot")) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sim.stop()
+    assert sim._thread is None
+    assert len(sim.engine.stream("hot")) >= 1
+    assert sim.engine.stream("cold") == []
+    assert all(s[0] == "hot" for s in sim.engine.submitted)
+    j = sim.to_json()
+    assert j["tenants"]["hot"]["submitted"] == len(sim.engine.stream("hot"))
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="at least one"):
+        mk_sim([])
+    with pytest.raises(ValueError, match="duplicate"):
+        mk_sim([CHAT, TenantSpec(name="chat")])
+    with pytest.raises(ValueError, match="unknown scenario"):
+        mk_sim([TenantSpec(name="x", scenario="video")])
+    # Dot-free by the series-naming contract: a dotted tenant would
+    # mis-split serving.<tenant>.<metric> and its SLOs could silently
+    # never fire.
+    with pytest.raises(ValueError, match="dot-free"):
+        mk_sim([TenantSpec(name="team.a")])
+    with pytest.raises(ValueError, match="dot-free"):
+        mk_sim([TenantSpec(name="")])
+
+
+def test_paused_source_produces_no_catch_up_burst():
+    """A source whose rate() is 0 for a span must yield ZERO arrivals
+    for it — not a thundering herd on resume. The pump re-anchors a
+    paused source's clock, so only post-resume time generates load."""
+    from tpumon.loadgen.serving import ArrivalPump, ArrivalSource
+
+    engine = StubEngine()
+    fired = []
+    resume_at = time.monotonic() + 0.4
+    src = ArrivalSource(
+        # paused for the first ~0.4s, then 50 rps (deterministic
+        # 20 ms gaps, so any same-instant cluster IS the bug, not
+        # Poisson clustering)
+        rate=lambda rel: 0.0 if time.monotonic() < resume_at else 50.0,
+        fire=lambda rel: fired.append(time.monotonic()),
+        interval=lambda rate: 1.0 / rate,
+    )
+    stop = threading.Event()
+    ArrivalPump(engine, [src]).run(stop, duration=0.6)
+    assert fired, "source never resumed"
+    # No catch-up burst covering the 0.4 s pause (~20 arrivals): the
+    # resume fires one immediate arrival, then 20 ms-spaced ones.
+    burst = [t for t in fired if t - fired[0] < 0.01]
+    assert len(burst) <= 2, f"{len(burst)} arrivals fired as a resume burst"
+    assert fired[0] >= resume_at
+
+
+def test_stop_is_idempotent_and_threadsafe():
+    sim = mk_sim([CHAT])
+    sim.start()
+    sim.stop()
+    sim.stop()  # second stop is a no-op, not an error
+    assert not any(
+        t.name.startswith("Thread-") and t is sim._thread
+        for t in threading.enumerate()
+    )

@@ -57,6 +57,7 @@ README = "README.md"
 EVENTS_DOC = "docs/events.md"
 FEDERATION_DOC = "docs/federation.md"
 QUERY_DOC = "docs/query.md"
+SLO_DOC = "docs/slo.md"
 
 # journal.record("<kind>" — restricted to journal receivers so
 # RingHistory.record("cpu", ...) never matches (same contract as the
@@ -531,20 +532,30 @@ def check(project: Project) -> list[Finding]:
                 )
             )
 
-    # --- federation exporter gauges (ISSUE 8 satellite) ---
+    # --- federation / SLO exporter gauges (ISSUE 8 / 13 satellites) ---
+    # Prefix -> the doc that must carry the family's row (README.md is
+    # accepted for either): operator-facing exporter contracts may not
+    # drift from their docs.
     fed_doc = project.file(FEDERATION_DOC)
-    fed_text = (fed_doc.text if fed_doc else "") + readme_text
+    slo_doc = project.file(SLO_DOC)
+    pinned_prefixes = (
+        ("tpumon_federation_", FEDERATION_DOC,
+         (fed_doc.text if fed_doc else "") + readme_text),
+        ("tpumon_slo_", SLO_DOC,
+         (slo_doc.text if slo_doc else "") + readme_text),
+    )
     for name, line in sorted(exporter_metric_families(project).items()):
-        if name.startswith("tpumon_federation_") and name not in fed_text:
-            findings.append(
-                Finding(
-                    check="registry.metric-undocumented",
-                    path=EXPORTER,
-                    line=line,
-                    message=(
-                        f"federation exporter family {name!r} is not "
-                        f"documented in docs/federation.md or README.md"
-                    ),
+        for prefix, doc_rel, doc_text in pinned_prefixes:
+            if name.startswith(prefix) and name not in doc_text:
+                findings.append(
+                    Finding(
+                        check="registry.metric-undocumented",
+                        path=EXPORTER,
+                        line=line,
+                        message=(
+                            f"exporter family {name!r} is not "
+                            f"documented in {doc_rel} or README.md"
+                        ),
+                    )
                 )
-            )
     return findings

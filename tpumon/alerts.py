@@ -693,6 +693,43 @@ class AlertEngine:
 
         return _tri_rule("serving.kv", t.kv_pool_pct, None, emit)
 
+    # ------------- SLO burn-rate rule (tpumon.slo, docs/slo.md) -----------
+
+    def _slo_alerts(self, slos: list[dict] | None) -> list[Alert]:
+        """One alert per firing burn window, pre-evaluated by the SLO
+        engine (both-windows-must-fire with recovery hysteresis lives
+        THERE — this rule only presents the result): the fast pair is
+        the page (critical), the slow pair the ticket (minor)."""
+        alerts: list[Alert] = []
+        for row in slos or []:
+            name = row.get("name", "?")
+            speed = row.get("window", "fast")
+            tenant = row.get("tenant") or ""
+            tenant_note = f" (tenant {tenant})" if tenant else ""
+            fast = speed == "fast"
+            alerts.append(
+                Alert(
+                    severity="critical" if fast else "minor",
+                    title=f"SLO {name} burning "
+                    f"{'fast' if fast else 'slow'}{tenant_note}",
+                    desc=f"Error budget burning ≥"
+                    f"{row.get('threshold', 0):g}x over both the "
+                    f"{row.get('short_s', 0):g}s and "
+                    f"{row.get('long_s', 0):g}s windows",
+                    fix="The objective is consuming budget far faster "
+                    "than it earns it: check /api/slo for the burn "
+                    "curves and the tenant's serving.<tenant>.* series "
+                    "for the regressing signal (TTFT/TPOT/errors); "
+                    "docs/slo.md has the window math."
+                    if fast else
+                    "Sustained slow burn: not page-worthy yet, but the "
+                    "budget will exhaust within the SLO window at this "
+                    "rate — file a ticket and watch /api/slo.",
+                    key=f"slo.{name}.burn.{speed}",
+                )
+            )
+        return alerts
+
     # ------------- anomaly rule (tpumon.anomaly EWMA detectors) -----------
 
     def _anomaly_alerts(self, anomalies: list[dict] | None) -> list[Alert]:
@@ -729,6 +766,7 @@ class AlertEngine:
         serving: list[dict] | None = None,
         sources: list[dict] | None = None,
         anomalies: list[dict] | None = None,
+        slos: list[dict] | None = None,
         update_pod_state: bool = True,
         now: float | None = None,
     ) -> dict[str, list[dict]]:
@@ -737,6 +775,7 @@ class AlertEngine:
         alerts += self._host_alerts(host)
         alerts += self._source_alerts(sources)
         alerts += self._anomaly_alerts(anomalies)
+        alerts += self._slo_alerts(slos)
         # Attribution uses the freshest pod view available: this
         # evaluation's pods, else the last healthy scrape's baseline.
         owner_pods = (

@@ -271,6 +271,12 @@ def main(argv: list[str] | None = None) -> int:
         from tpumon.query import query_cli
 
         return query_cli(argv[1:])
+    if argv and argv[0] == "slo":
+        # ``tpumon slo`` — objectives, budget remaining and burn rates
+        # from a running server's /api/slo (tpumon.slo; docs/slo.md).
+        from tpumon.slo import slo_cli
+
+        return slo_cli(argv[1:])
     path = None
     overrides = {}
     serve_loadgen = False
@@ -445,6 +451,18 @@ def main(argv: list[str] | None = None) -> int:
             # append-time aggregates for O(1) instant reads
             # (tpumon.query, docs/query.md).
             overrides["recording_rules"] = take(arg)
+        elif arg == "--slos":
+            # SLO objectives as a JSON list (tpumon.slo, docs/slo.md):
+            # '[{"name":"chat_ttft","expr":"...","target":0.99,
+            # "window":"30d"}]' — config files take the same objects
+            # under the `slos` key.
+            overrides["slos"] = take(arg)
+        elif arg == "--tls-cert":
+            # Server-side TLS: PEM cert chain terminating HTTPS on the
+            # listener (tls_key defaults to the same file).
+            overrides["tls_cert"] = take(arg)
+        elif arg == "--tls-key":
+            overrides["tls_key"] = take(arg)
         elif arg in ("-h", "--help"):
             print(
                 "usage: python -m tpumon [-c CONFIG.{json,toml}] [--port N] "
@@ -468,6 +486,8 @@ def main(argv: list[str] | None = None) -> int:
                 "[--history-per-chip N] "
                 "[--wire-binary on|off] [--ingest-kernel on|off] "
                 "[--recording-rules chip.mxu[5m],...] "
+                "[--slos JSON] "
+                "[--tls-cert CERT.pem] [--tls-key KEY.pem] "
                 "[--trace-ring N] "
                 "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"
@@ -480,6 +500,8 @@ def main(argv: list[str] | None = None) -> int:
                 "       python -m tpumon query 'expr' [--url HOST:8888] "
                 "[--range 30m --step 30s] [--fleet] [--json]   (in-tree "
                 "PromQL-subset queries, docs/query.md)\n"
+                "       python -m tpumon slo [--url HOST:8888] [--json]   "
+                "(SLO budgets + burn rates, docs/slo.md)\n"
                 "Env: TPUMON_PORT, TPUMON_PROMETHEUS_URL, TPUMON_ACCEL_BACKEND, ..."
             )
             return 0

@@ -205,6 +205,49 @@ def distill_serving_metrics(
         out["kv_pages_used_pct"] = (
             100.0 * (pg_total[1] - pg_free[1]) / pg_total[1])
 
+    # Per-tenant serving signals (tpumon.loadgen.traffic / ServingEngine
+    # tenant accounting): the SLO engine's raw material. Latency
+    # quantiles copy through; goodput (completed req/s) and error rate
+    # (rejected / submitted) are windowed between scrapes via counter
+    # deltas, so they track CURRENT traffic like the other rates here.
+    tenants: dict[str, dict] = {}
+    for metric, field_name in (
+        ("tpumon_serving_tenant_ttft_p50_ms", "ttft_p50_ms"),
+        ("tpumon_serving_tenant_ttft_p95_ms", "ttft_p95_ms"),
+        ("tpumon_serving_tenant_tpot_p50_ms", "tpot_p50_ms"),
+        ("tpumon_serving_tenant_tpot_p95_ms", "tpot_p95_ms"),
+        ("tpumon_serving_tenant_requests", "requests_total"),
+        ("tpumon_serving_tenant_completed", "completed_total"),
+        ("tpumon_serving_tenant_rejected", "rejected_total"),
+    ):
+        for candidate in (metric, metric + "_total"):
+            for s in by_name.get(candidate, ()):
+                tenant = s.labels.get("tenant")
+                if tenant:
+                    tenants.setdefault(tenant, {})[field_name] = s.value
+            if candidate in by_name:
+                break
+    if tenants:
+        prev_tenants = (prev or {}).get("tenants") or {}
+        for tenant, row in tenants.items():
+            was = prev_tenants.get(tenant)
+            dt = (now - prev["ts"]) if prev and prev.get("ts") else 0.0
+            if was and dt > 0 and "completed_total" in row and (
+                    "completed_total" in was):
+                dc = row["completed_total"] - was["completed_total"]
+                if dc >= 0:
+                    row["goodput_rps"] = dc / dt
+            if was and "requests_total" in row and "requests_total" in was:
+                dreq = row["requests_total"] - was["requests_total"]
+                drej = (row.get("rejected_total", 0)
+                        - was.get("rejected_total", 0))
+                if dreq > 0 and 0 <= drej <= dreq:
+                    row["error_rate"] = drej / dreq
+                elif dreq == 0 and drej == 0:
+                    # Idle window: no submissions, nothing erred.
+                    row["error_rate"] = 0.0
+        out["tenants"] = tenants
+
     # Training targets (tpumon_train_* families).
     for field_name, metric in TRAIN_GAUGES.items():
         got = _sum_samples(by_name, (metric,))

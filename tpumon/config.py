@@ -115,6 +115,14 @@ class Config:
     # --- serving ---
     port: int = 8888  # same default as the reference (monitor_server.js:10)
     host: str = "0.0.0.0"
+    # Server-side TLS (the PR 7 follow-up): PEM certificate chain + key
+    # terminating HTTPS on the listener, so the SLO/alerting surface —
+    # and eventually the actuation routes — isn't plaintext on the pod
+    # network. tls_key defaults to tls_cert (single combined PEM).
+    # Uplinks already speak https:// in federate_up; with these set the
+    # server side can terminate them.
+    tls_cert: str | None = None
+    tls_key: str | None = None
 
     # --- history (reference: 30m window / 30s step, monitor_server.js:38) ---
     # DEPRECATED: the external-Prometheus history path is retired — the
@@ -279,6 +287,16 @@ class Config:
     # an explicit partial instead of an error.
     query_fleet_timeout_s: float = 2.0
 
+    # --- SLO objectives (tpumon.slo; docs/slo.md) ---
+    # Each entry: {"name", "expr", "target", "window", "tenant"?,
+    # "fast"?/"slow"? window pairs, "fast_burn"?/"slow_burn"?/
+    # "clear_ratio"?}. ``expr`` is the bad-event condition in the query
+    # language; the engine records slo.<name>.bad per tick and serves
+    # multi-window burn-rate alerts from it (GET /api/slo,
+    # tpumon_slo_* gauges, `tpumon slo`). As an env/CLI value the list
+    # is JSON (TPUMON_SLOS='[{"name": ...}]').
+    slos: tuple = ()
+
     # --- SSE delta stream (tpumon.server, docs/perf.md) ---
     # The /api/stream push emits delta frames (only changed fields,
     # keyed by snapshot epoch); a full keyframe recurs every this many
@@ -379,6 +397,8 @@ _SCALAR_FIELDS: dict[str, type] = {
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "auth_token": str,
     "workload_dir": str,
+    "tls_cert": str,
+    "tls_key": str,
 }
 # Config-file/env key -> Config field for duration-valued settings
 # ("30m"-style strings accepted via parse_duration).
@@ -440,6 +460,17 @@ def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
             cfg_kw[key] = {str(k): int(v) for k, v in value.items()}
         elif key == "collect_deadlines":
             cfg_kw[key] = {str(k): float(v) for k, v in value.items()}
+        elif key == "slos":
+            # SLO objectives (tpumon.slo, docs/slo.md): a list of
+            # objects in config files; env/CLI pass the list as JSON.
+            # Structural validation happens in slo.parse_slos at
+            # startup (per-entry, journaled) — here we only coerce.
+            if isinstance(value, str):
+                value = json.loads(value) if value.strip() else []
+            if not isinstance(value, (list, tuple)):
+                raise ValueError(
+                    f"slos: want a list of objective objects, got {value!r}")
+            cfg_kw[key] = tuple(value)
         elif key == "thresholds":
             cfg_kw["_thresholds_raw"] = value
         else:

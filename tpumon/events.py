@@ -65,6 +65,8 @@ KINDS = (
                   # (tpumon.query / tpumon.federation)
     "server",     # HTTP server lifecycle (tpumon.app)
     "silence",    # alert silence added / removed (tpumon.alerts)
+    "slo",        # SLO engine: burn-rate alert fired / resolved,
+                  # rejected objective (tpumon.slo)
     "watchdog",   # sampler loop overrun / swallowed exception
 )
 

@@ -449,6 +449,59 @@ def _render_federation(sampler: Sampler) -> str:
     return w.render() if w.families else ""
 
 
+def _render_slo(sampler: Sampler) -> str:
+    """SLO block (tpumon.slo, docs/slo.md): per-objective error-budget
+    remaining, instantaneous bad fraction, and the fast/slow burn rates
+    with their firing state — the gauges an external pager or Grafana
+    burn-down panel consumes. Absent entirely when no objectives are
+    configured. Family names are documented in docs/slo.md's metrics
+    table, which the tpulint registry pass pins."""
+    slo = getattr(sampler, "slo", None)
+    if slo is None:
+        return ""
+    rows = slo.exporter_rows()
+    if not rows:
+        return ""
+    w = MetricsWriter()
+    target = w.gauge("tpumon_slo_target", "Configured objective target")
+    remaining = w.gauge(
+        "tpumon_slo_budget_remaining",
+        "Error budget remaining over the SLO window (1=untouched, "
+        "<0=exhausted)",
+    )
+    bad = w.gauge(
+        "tpumon_slo_bad_fraction",
+        "Instantaneous bad-event fraction (this tick's slo.<name>.bad)",
+    )
+    burn = w.gauge(
+        "tpumon_slo_burn_rate",
+        "Error-budget burn rate per alert window (multiples of the "
+        "budget-neutral rate; labels: slo, window=fast|slow, span=short|long)",
+    )
+    firing = w.gauge(
+        "tpumon_slo_burn_firing",
+        "Burn-rate alert state per window pair (1=firing)",
+    )
+    for row in rows:
+        labels = {"slo": row["name"]}
+        if row.get("tenant"):
+            labels["tenant"] = row["tenant"]
+        target.add(labels, row["target"])
+        rem = (row.get("budget") or {}).get("remaining")
+        if rem is not None:
+            remaining.add(labels, rem)
+        if row.get("bad") is not None:
+            bad.add(labels, row["bad"])
+        for speed, b in (row.get("burn") or {}).items():
+            for span in ("short", "long"):
+                if b.get(span) is not None:
+                    burn.add({**labels, "window": speed, "span": span},
+                             b[span])
+            firing.add({**labels, "window": speed},
+                       1.0 if b.get("firing") else 0.0)
+    return w.render()
+
+
 def _render_events(sampler: Sampler) -> str:
     """Event journal + anomaly detector block (tpumon.events /
     tpumon.anomaly): lifetime per-(kind, severity) event counters —
@@ -492,6 +545,8 @@ EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("serving", ("serving",)),
     ("self", ("host", "accel", "k8s", "serving", "alerts", "samples")),
     ("trace", ("samples",)),
+    # SLO budget/burn gauges move only when the published SLO view does.
+    ("slo", ("slo",)),
     # Journal counters + anomaly gauges move only when the journal does.
     ("events", ("events",)),
     # Aggregator-tree gauges: "federation" moves as downstream frames
@@ -506,6 +561,7 @@ _RENDERERS = {
     "pods": _render_pods,
     "serving": _render_serving,
     "self": _render_self,
+    "slo": _render_slo,
     "events": _render_events,
     "federation": _render_federation,
 }

@@ -523,6 +523,13 @@ class Request:
     enqueued: float
     temperature: float = 0.0  # 0 = greedy (deterministic)
     top_k: int = 0  # 0 = full vocab
+    # Multi-tenant attribution (tpumon.loadgen.traffic): the tag rides
+    # the request through admission and completion so the engine's
+    # per-tenant latency/goodput accounting — and from there the
+    # monitor's ``serving.<tenant>.*`` TSDB series — can tell a chat
+    # tenant's regression from a batch tenant's backlog. "" = untagged
+    # (every pre-tenant caller), excluded from per-tenant metrics.
+    tenant: str = ""
     ttft_s: float | None = None
     first_tok_t: float | None = None  # monotonic at first emit (TPOT)
     output: list[int] = field(default_factory=list)
@@ -555,6 +562,29 @@ class Request:
     def finish_stream(self) -> None:
         if self.stream is not None:
             self.stream.put(None)
+
+
+@dataclass
+class _TenantStats:
+    """Per-tenant serving accounting (guarded by the engine lock).
+
+    Latency samples carry their observation time so the quantile
+    gauges can be computed over a *recency* window
+    (``ServingEngine.tenant_window_s``) rather than a fixed count — a
+    tenant whose traffic recovered must see its p95 recover once the
+    regression ages out, which is what lets the SLO soak's burn alert
+    clear (docs/slo.md)."""
+
+    submitted: int = 0
+    completed: int = 0
+    rejected: int = 0
+    cancelled: int = 0
+    tokens: int = 0
+    ttft: deque = field(default_factory=lambda: deque(maxlen=512))
+    tpot: deque = field(default_factory=lambda: deque(maxlen=512))
+
+    def recent(self, series: deque, window_s: float, now: float) -> list:
+        return [v for t, v in series if now - t <= window_s]
 
 
 @dataclass
@@ -999,6 +1029,12 @@ class ServingEngine:
         # seconds per output token after the first.
         self._ttft_recent: deque[float] = deque(maxlen=512)
         self._tpot_recent: deque[float] = deque(maxlen=512)
+        # Per-tenant accounting (guarded by _lock), keyed by the
+        # Request.tenant tag; untagged requests ("") are not tracked.
+        # tenant_window_s bounds the recency window the per-tenant
+        # quantile gauges are computed over.
+        self.tenants: dict[str, _TenantStats] = {}
+        self.tenant_window_s = 60.0
         # Optional tpumon.loadgen.report.WorkloadReporter: when attached,
         # step() time counts as declared device activity (source:
         # workload in the monitor's counter chain).
@@ -1135,10 +1171,20 @@ class ServingEngine:
 
     # -- submission ---------------------------------------------------------
 
+    def _tenant_locked(self, req: Request) -> "_TenantStats | None":
+        """The request's tenant stats record (caller holds the lock);
+        None for untagged requests."""
+        if not req.tenant:
+            return None
+        st = self.tenants.get(req.tenant)
+        if st is None:
+            st = self.tenants[req.tenant] = _TenantStats()
+        return st
+
     def submit(self, prompt: list[int], max_new: int = 16,
                temperature: float = 0.0, top_k: int = 0,
                stream: bool = False,
-               stop_tokens: tuple = ()) -> Request:
+               stop_tokens: tuple = (), tenant: str = "") -> Request:
         """Enqueue a request. When the queue is full the request is
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
@@ -1155,17 +1201,23 @@ class ServingEngine:
                       max_new=max_new, enqueued=time.monotonic(),
                       temperature=float(temperature), top_k=int(top_k),
                       stream=queue.Queue() if stream else None,
-                      stop_tokens=tuple(int(t) for t in stop_tokens))
+                      stop_tokens=tuple(int(t) for t in stop_tokens),
+                      tenant=str(tenant))
         infeasible = self.paged and self._pages_needed(
             req) > self.allocator.num_pages - 1
         with self._lock:
             # Cancelled entries must not consume queue capacity.
             self._purge_cancelled_locked()
+            tst = self._tenant_locked(req)
+            if tst is not None:
+                tst.submitted += 1
             if len(self._queue) >= self.max_queue or infeasible:
                 # Queue full, or (paged) the reservation can never be
                 # satisfied by the whole pool — rejecting beats wedging
                 # the queue head forever.
                 self.rejected_total += 1
+                if tst is not None:
+                    tst.rejected += 1
                 req.finish_stream()
                 req.done.set()
                 return req
@@ -1202,6 +1254,9 @@ class ServingEngine:
         for r in self._queue:
             if r.cancelled.is_set():
                 self.cancelled_total += 1
+                tst = self._tenant_locked(r)
+                if tst is not None:
+                    tst.cancelled += 1
                 r.finish_stream()
                 r.done.set()
             else:
@@ -1449,6 +1504,9 @@ class ServingEngine:
             req.ttft_s = now - req.enqueued
             req.first_tok_t = now
             self._observe_ttft(req.ttft_s)
+            tst = self._tenant_locked(req)
+            if tst is not None:
+                tst.ttft.append((now, req.ttft_s))
             req.emit([first])
             self.tokens_total += 1
         self._slots[slot] = req
@@ -1480,10 +1538,16 @@ class ServingEngine:
         self._release_slot_pages(slot)
         with self._lock:
             self.completed_total += 1
+            tst = self._tenant_locked(req)
+            if tst is not None:
+                tst.completed += 1
+                tst.tokens += len(req.output)
             if req.first_tok_t is not None and len(req.output) > 1:
-                self._tpot_recent.append(
-                    (time.monotonic() - req.first_tok_t)
-                    / (len(req.output) - 1))
+                tpot = ((time.monotonic() - req.first_tok_t)
+                        / (len(req.output) - 1))
+                self._tpot_recent.append(tpot)
+                if tst is not None:
+                    tst.tpot.append((time.monotonic(), tpot))
         req.finish_stream()
         req.done.set()
 
@@ -1497,6 +1561,9 @@ class ServingEngine:
         self._release_slot_pages(slot)
         with self._lock:
             self.cancelled_total += 1
+            tst = self._tenant_locked(req)
+            if tst is not None:
+                tst.cancelled += 1
         req.finish_stream()
         req.done.set()
 
@@ -1856,6 +1923,18 @@ class ServingEngine:
             spec_rounds = self.spec_rounds_total
             spec_proposed = self.spec_proposed_total
             spec_accepted = self.spec_accepted_total
+            now_mono = time.monotonic()
+            tw = self.tenant_window_s
+            tenant_rows = [
+                (
+                    name,
+                    st.submitted, st.completed, st.rejected,
+                    st.cancelled, st.tokens,
+                    st.recent(st.ttft, tw, now_mono),
+                    st.recent(st.tpot, tw, now_mono),
+                )
+                for name, st in sorted(self.tenants.items())
+            ]
         w = MetricsWriter()
         w.counter("jetstream_generate_tokens",
                   "tokens generated (prefill first-token + decode)"
@@ -1897,6 +1976,46 @@ class ServingEngine:
                 w.gauge(fam + "_p95_ms",
                         "recent-window per-request p95"
                         ).add(value=round(q[1] * unit, 3))
+        if tenant_rows:
+            # Per-tenant serving signals (tpumon.loadgen.traffic): the
+            # SLO engine's inputs. Counters are lifetime (the collector
+            # derives windowed goodput/error rates from scrape deltas);
+            # latency quantiles cover the tenant_window_s recency
+            # window, so a recovered tenant's p95 actually recovers.
+            reqs = w.counter("tpumon_serving_tenant_requests",
+                             "requests submitted per tenant")
+            comp = w.counter("tpumon_serving_tenant_completed",
+                             "requests finished per tenant")
+            rej = w.counter("tpumon_serving_tenant_rejected",
+                            "requests dropped by backpressure per tenant")
+            canc = w.counter("tpumon_serving_tenant_cancelled",
+                             "requests cancelled per tenant")
+            toks = w.counter("tpumon_serving_tenant_tokens",
+                             "tokens emitted per tenant")
+            tg: dict[str, object] = {}
+            for fam in ("tpumon_serving_tenant_ttft_p50_ms",
+                        "tpumon_serving_tenant_ttft_p95_ms",
+                        "tpumon_serving_tenant_tpot_p50_ms",
+                        "tpumon_serving_tenant_tpot_p95_ms"):
+                tg[fam] = w.gauge(
+                    fam, "recent-window per-tenant latency quantile")
+            for (name, sub, done, rj, cn, tk, ttfts, tpots) in tenant_rows:
+                labels = {"tenant": name}
+                reqs.add(labels, sub)
+                comp.add(labels, done)
+                rej.add(labels, rj)
+                canc.add(labels, cn)
+                toks.add(labels, tk)
+                for fam_base, series in (
+                    ("tpumon_serving_tenant_ttft", ttfts),
+                    ("tpumon_serving_tenant_tpot", tpots),
+                ):
+                    q = quantiles(series)
+                    if q is not None:
+                        tg[fam_base + "_p50_ms"].add(
+                            labels, round(q[0] * 1e3, 3))
+                        tg[fam_base + "_p95_ms"].add(
+                            labels, round(q[1] * 1e3, 3))
         from tpumon.loadgen.quant import QTensor, param_bytes
 
         weight_bytes = param_bytes(self.params)
@@ -2073,6 +2192,81 @@ def start_metrics_server(engine: ServingEngine, port: int = 0,
     return server, server.server_address[1]
 
 
+@dataclass
+class ArrivalSource:
+    """One Poisson arrival process for ``ArrivalPump``.
+
+    ``rate(rel_t)`` returns the source's current arrivals/sec at
+    ``rel_t`` seconds into the run (<= 0 pauses the source);
+    ``fire(rel_t)`` submits one request; ``interval(rate)`` draws the
+    next inter-arrival gap in seconds. The caller owns the RNG behind
+    ``fire``/``interval``, so the draw order — and with it seeded
+    replayability — is the caller's contract, not the pump's.
+    """
+
+    rate: object  # Callable[[float], float]
+    fire: object  # Callable[[float], None]
+    interval: object  # Callable[[float], float]
+    next_at: float = 0.0  # absolute monotonic due time (pump-owned)
+    paused: bool = False  # rate() was <= 0 last pass (pump-owned)
+
+
+class ArrivalPump:
+    """The arrival/step pump shared by the demo ``_arrival_loop`` and
+    the multi-tenant traffic driver (tpumon.loadgen.traffic): drain
+    every source's due arrivals, step the engine, and sleep only while
+    idle. Extracted from the old inline Poisson loop so traffic.py
+    composes it instead of copy-pasting; with a single constant-rate
+    source the scheduling (RNG draw order, catch-up semantics, idle
+    sleep policy) is bit-compatible with the pre-extraction loop.
+
+    ``step`` replaces ``engine.step`` when given — the traffic driver
+    routes its scheduler-degradation knob through this seam.
+    """
+
+    def __init__(self, engine: "ServingEngine",
+                 sources: "list[ArrivalSource]", step=None):
+        self.engine = engine
+        self.sources = list(sources)
+        self.step = step if step is not None else engine.step
+
+    def run(self, stop: threading.Event, duration: float = 0.0) -> None:
+        t0 = time.monotonic()
+        for s in self.sources:
+            s.next_at = t0
+        while not stop.is_set():
+            now = time.monotonic()
+            rel = now - t0
+            if duration and rel >= duration:
+                return
+            for s in self.sources:
+                # Catch-up against one ``now``: a burst due in the past
+                # all fires this pass, exactly like the old loop.
+                while True:
+                    rate = s.rate(rel)
+                    if rate <= 0:
+                        s.paused = True
+                        break
+                    if s.paused:
+                        # Pause -> active transition: re-anchor the
+                        # clock so the pause produced ZERO arrivals —
+                        # without this, next_at stays frozen in the
+                        # past and this pass would fire a synthetic
+                        # catch-up burst covering the whole pause.
+                        s.paused = False
+                        s.next_at = max(s.next_at, now)
+                    if now < s.next_at:
+                        break
+                    s.fire(rel)
+                    s.next_at += s.interval(rate)
+            if not self.step():
+                waits = [
+                    max(0.0, s.next_at - now)
+                    for s in self.sources if s.rate(rel) > 0
+                ]
+                time.sleep(0.05 if not waits else min(0.05, min(waits)))
+
+
 def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
                   stop: threading.Event, duration: float = 0.0,
                   seed: int = 0, temperature: float = 0.0,
@@ -2083,6 +2277,10 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
     When the engine has a prefix cache, arrivals model real traffic's
     shared system prompt: every request starts with the same
     two-chunk prefix plus a random tail, so the cache actually hits.
+
+    One ``ArrivalSource`` over the shared pump; the RNG draw order per
+    arrival (prompt length, tail tokens, then the exponential gap) is
+    the pre-extraction loop's, so seeded runs replay identically.
     """
     import random
 
@@ -2092,22 +2290,17 @@ def _arrival_loop(engine: ServingEngine, rps: float, max_new: int,
         srng = random.Random(seed ^ 0x5A5)
         shared = [srng.randrange(engine.cfg.model.vocab)
                   for _ in range(2 * engine.cfg.prefill_len)]
-    t0 = time.monotonic()
-    next_arrival = t0
-    while not stop.is_set():
-        now = time.monotonic()
-        if duration and now - t0 >= duration:
-            return
-        while rps > 0 and now >= next_arrival:
-            n = rng.randint(2, engine.cfg.prefill_len)
-            tail = [rng.randrange(engine.cfg.model.vocab)
-                    for _ in range(n)]
-            engine.submit(shared + tail, max_new=max_new,
-                          temperature=temperature, top_k=top_k)
-            next_arrival += rng.expovariate(rps)
-        if not engine.step():
-            time.sleep(0.05 if rps <= 0 else
-                       min(0.05, max(0.0, next_arrival - now)))
+
+    def fire(_rel: float) -> None:
+        n = rng.randint(2, engine.cfg.prefill_len)
+        tail = [rng.randrange(engine.cfg.model.vocab)
+                for _ in range(n)]
+        engine.submit(shared + tail, max_new=max_new,
+                      temperature=temperature, top_k=top_k)
+
+    src = ArrivalSource(rate=lambda _t: rps, fire=fire,
+                        interval=rng.expovariate)
+    ArrivalPump(engine, [src]).run(stop, duration=duration)
 
 
 def start_background(rps: float = 0.5, max_new: int = 16,

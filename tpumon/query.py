@@ -202,6 +202,18 @@ class Neg:
         self.arg = arg
 
 
+def _const_value(node) -> float | None:
+    """The node's compile-time constant value (Num, possibly under
+    Neg), or None when it isn't one — the eval_condition fast path's
+    shape test."""
+    if isinstance(node, Num):
+        return node.v
+    if isinstance(node, Neg):
+        v = _const_value(node.arg)
+        return None if v is None else -v
+    return None
+
+
 class _Parser:
     def __init__(self, src: str):
         self.src = src
@@ -377,11 +389,32 @@ def parse_series_name(name: str) -> tuple[str, dict[str, str]]:
 
       chip.<id>.<metric>        -> ("chip.<metric>", {chip, host})
       slice.<node>.<id>.<stat>  -> ("slice.<stat>",  {node, slice})
+      serving.<tenant>.<metric> -> ("serving.<metric>", {tenant})
+      slo.<name>.<metric>       -> ("slo.<metric>",  {slo})
       anything else             -> (name, {})
 
     ``host`` is the chip id's host component (``host-0/chip-3``).
-    Limitation: a federation node name containing dots mis-splits the
-    slice form (the hub's series contract puts node first)."""
+    Tenant names and SLO names are dot-free by contract (the traffic
+    driver and the SLO engine both validate), so the serving/slo forms
+    split unambiguously. Limitation: a federation node name containing
+    dots mis-splits the slice form (the hub's series contract puts
+    node first)."""
+    if name.startswith("serving."):
+        rest = name[8:]
+        tenant, _, metric = rest.partition(".")
+        if tenant and metric and "." not in metric:
+            return f"serving.{metric}", {"tenant": tenant}
+        # Multi-dot metric tails (none exist today) fall through to
+        # the verbatim form rather than guessing a split.
+        if tenant and metric:
+            return name, {}
+    elif name.startswith("slo."):
+        rest = name[4:]
+        slo, _, metric = rest.partition(".")
+        if slo and metric and "." not in metric:
+            return f"slo.{metric}", {"slo": slo}
+        if slo and metric:
+            return name, {}
     if name.startswith("chip."):
         rest = name[5:]
         cid, _, metric = rest.rpartition(".")
@@ -838,17 +871,43 @@ class RuleSet:
 # ----------------------------- evaluation ------------------------------
 
 
+_UNRESOLVED = object()
+
+
 class _Ctx:
-    __slots__ = ("engine", "at", "win_cache", "exclude", "augment")
+    __slots__ = (
+        "engine", "at", "win_cache", "exclude", "lookback_s", "_augment")
 
     def __init__(self, engine: "QueryEngine", at: float, exclude=None):
         self.engine = engine
         self.at = at
         self.win_cache: dict = {}
         self.exclude = exclude
-        # Resolved once per evaluation: the label augmenter (pod
-        # attribution) must not be recomputed per series.
-        self.augment = engine.augment() if engine.augment is not None else None
+        # Instant-selector staleness override for THIS evaluation
+        # (None = the engine's lookback_s). The SLO engine tightens it
+        # for fraction-mode bad-event samples: a per-tick sample read
+        # from data older than the objective's shortest burn window is
+        # not a current observation — it must read as absent, or a
+        # vanished source would keep "reporting" its last value for the
+        # whole 5-minute default lookback and a firing burn alert could
+        # never drain to resolution (tests/test_slo.py).
+        self.lookback_s: float | None = None
+        # The label augmenter (pod attribution — O(chips) to build)
+        # resolves lazily on first selector match and at most once per
+        # evaluation: expressions that never touch an augmentable
+        # family (the SLO engine's per-tick slo.bad/serving.* reads)
+        # never pay for the attribution walk.
+        self._augment = _UNRESOLVED
+
+    @property
+    def augment(self):
+        if self._augment is _UNRESOLVED:
+            self._augment = (
+                self.engine.augment()
+                if self.engine.augment is not None
+                else None
+            )
+        return self._augment
 
 
 def _labels_key(labels: dict) -> tuple:
@@ -865,6 +924,13 @@ class QueryEngine:
     the federation planner all go through it."""
 
     _COMPILE_CAP = 256
+
+    # Labels an augmenter may ADD to derived labels (the sampler's pod
+    # attribution). Matchers referencing any of these must resolve
+    # per evaluation (the attribution changes tick to tick); matchers
+    # over naming-derived labels only are resolvable once per series
+    # set and ride the selector cache below.
+    AUGMENT_LABELS = frozenset({"pod"})
 
     def __init__(
         self,
@@ -884,6 +950,20 @@ class QueryEngine:
         self.augment = augment
         self._compiled: dict[str, object] = {}
         self._names: dict[str, tuple[str, dict]] = {}
+        # Family -> candidate series names (the _matching pre-filter):
+        # a selector eval walks only its family's series instead of the
+        # whole ring. Invalidated whenever the ring's series set can
+        # have changed (new series appeared / snapshot restore replaced
+        # the objects). Matchers/augment/exclude still run per eval —
+        # only the family scan is cached.
+        self._family_cache: dict[str, list[str]] = {}
+        self._family_gen: tuple | None = None
+        # (family, matchers) -> [(name, family, base labels)] for
+        # selectors whose matchers touch only naming-derived labels:
+        # those can be resolved once per series set instead of per
+        # eval (the SLO engine's per-tick hot path). Augment/exclude
+        # still run per eval on the cached rows' label copies.
+        self._sel_cache: dict[tuple, list] = {}
         self.compiles = 0
         self.evals = 0
 
@@ -907,21 +987,79 @@ class QueryEngine:
             hit = self._names[name] = parse_series_name(name)
         return hit
 
+    def _family_names(self, fam: str) -> list[str]:
+        """Series names whose derived family matches ``fam`` (exact or
+        glob) — the O(all series) scan, cached per family until the
+        ring's series set moves. Sorted, so _matching's output order
+        (the parity-pinned fold order) is already deterministic."""
+        gen = (len(self.ring.series), getattr(self.ring, "generation", None))
+        if gen != self._family_gen:
+            self._family_cache.clear()
+            self._sel_cache.clear()
+            self._family_gen = gen
+        names = self._family_cache.get(fam)
+        if names is None:
+            import fnmatch
+
+            glob = _has_glob(fam)
+            names = [
+                name
+                for name in self.ring.series
+                if (
+                    fnmatch.fnmatchcase(self._series_labels(name)[0], fam)
+                    if glob
+                    else self._series_labels(name)[0] == fam
+                )
+            ]
+            names.sort()
+            if len(self._family_cache) >= self._COMPILE_CAP:
+                self._family_cache.clear()
+            self._family_cache[fam] = names
+        return names
+
     def _matching(self, sel: Selector, ctx: _Ctx) -> list[tuple[str, dict]]:
         """(series name, labels) pairs matching the selector, sorted by
-        name — the deterministic fold order the parity tests pin."""
-        fam = sel.family
-        glob = _has_glob(fam)
-        out: list[tuple[str, dict]] = []
-        import fnmatch
+        name — the deterministic fold order the parity tests pin.
 
-        for name in self.ring.series:
-            family, base = self._series_labels(name)
-            if glob:
-                if not fnmatch.fnmatchcase(family, fam):
+        Matchers over naming-derived labels resolve against the cached
+        pre-filtered rows (_sel_cache); a matcher that references an
+        augmenter-added label (AUGMENT_LABELS — pod attribution moves
+        tick to tick) forces the per-eval path."""
+        out: list[tuple[str, dict]] = []
+        cacheable = not any(
+            label in self.AUGMENT_LABELS for label, _, _ in sel.matchers
+        )
+        if cacheable:
+            key = (sel.family, sel.matchers)
+            self._family_names(sel.family)  # validates the gen / caches
+            rows = self._sel_cache.get(key)
+            if rows is None:
+                rows = []
+                for name in self._family_names(sel.family):
+                    family, base = self._series_labels(name)
+                    if all(
+                        _match_one(base.get(label), op, want)
+                        for label, op, want in sel.matchers
+                    ):
+                        rows.append((name, family, base))
+                if len(self._sel_cache) >= self._COMPILE_CAP:
+                    self._sel_cache.clear()
+                self._sel_cache[key] = rows
+            if ctx.augment is None and ctx.exclude is None:
+                # Hottest path (no per-eval label derivation at all):
+                # hand out fresh label dicts, keep the cached bases
+                # immutable.
+                return [(name, dict(base)) for name, _, base in rows]
+            for name, family, base in rows:
+                labels = dict(base)
+                if ctx.augment is not None:
+                    ctx.augment(family, labels)
+                if ctx.exclude is not None and ctx.exclude(family, labels):
                     continue
-            elif family != fam:
-                continue
+                out.append((name, labels))
+            return out
+        for name in self._family_names(sel.family):
+            family, base = self._series_labels(name)
             labels = dict(base)
             if ctx.augment is not None:
                 ctx.augment(family, labels)
@@ -936,6 +1074,24 @@ class QueryEngine:
                 out.append((name, labels))
         out.sort(key=lambda p: p[0])
         return out
+
+    def _matching_names(self, sel: Selector, ctx: _Ctx):
+        """Matching series names only, no label materialization — the
+        eval_condition hot path, which discards labels. Identical
+        match set to _matching: when an exclude filter or a matcher
+        over an augmenter-added label is in play (both can change the
+        match set per evaluation), it defers to _matching."""
+        if ctx.exclude is None and not any(
+            label in self.AUGMENT_LABELS for label, _, _ in sel.matchers
+        ):
+            key = (sel.family, sel.matchers)
+            self._family_names(sel.family)  # validates the series gen
+            rows = self._sel_cache.get(key)
+            if rows is None:
+                self._matching(sel, ctx)  # builds + caches the rows
+                rows = self._sel_cache[key]
+            return [name for name, _, _ in rows]
+        return [name for name, _ in self._matching(sel, ctx)]
 
     # --------------------------- point access ---------------------------
 
@@ -963,12 +1119,32 @@ class QueryEngine:
         ctx.win_cache[key] = (ts, vals)
         return ts, vals
 
+    # The store quantizes timestamps to 1 ms (round-half-up), so a
+    # point recorded at ``at`` can land up to 0.5 ms in at's future;
+    # instant reads tolerate exactly that round-up, or a query at the
+    # record instant would miss its own point on a coin-flip of the
+    # microsecond fraction.
+    _TS_QUANT_EPS = 1e-3
+
     def _instant_value(self, ctx: _Ctx, name: str) -> float | None:
-        ts, vals = self._window_points(ctx, name, self.lookback_s)
-        hi = bisect_right(ts, ctx.at)
+        rs = self.ring.series[name]
+        at = ctx.at + self._TS_QUANT_EPS
+        lookback = (
+            self.lookback_s if ctx.lookback_s is None else ctx.lookback_s)
+        last_ts = rs.fine.last_ts()
+        if last_ts is not None and last_ts <= at:
+            # ``at`` is at/after the newest fine point: the answer is
+            # the tail point, read O(1) off the head columns — no
+            # lookback-window fetch (the per-tick instant-selector hot
+            # path; historical ``at`` takes the window walk below).
+            if last_ts < ctx.at - lookback:
+                return None
+            return rs.fine.last()[1]
+        ts, vals = self._window_points(ctx, name, lookback)
+        hi = bisect_right(ts, at)
         if not hi:
             return None
-        if ts[hi - 1] < ctx.at - self.lookback_s:
+        if ts[hi - 1] < ctx.at - lookback:
             return None
         return vals[hi - 1]
 
@@ -1255,6 +1431,72 @@ class QueryEngine:
         return out
 
     # ----------------------------- public API ---------------------------
+
+    def context(self, at: float | None = None, exclude=None) -> _Ctx:
+        """An evaluation context reusable across several eval_compiled
+        calls at the same instant: the label augmenter (pod
+        attribution — O(chips) to build) and the per-(series, window)
+        point fetches are shared instead of redone per expression."""
+        return _Ctx(self, time.time() if at is None else at,
+                    exclude=exclude)
+
+    def eval_compiled(self, node, at: float | None = None, exclude=None,
+                      ctx: _Ctx | None = None):
+        """Evaluate an already-compiled AST node at one instant and
+        return the raw value (scalar, or [(labels, value), ...] vector)
+        — the per-tick hot path for callers that compile once per
+        config (the SLO engine's burn-rate expressions, docs/slo.md)
+        and must not depend on the bounded compile cache."""
+        if ctx is None:
+            ctx = self.context(at, exclude)
+        self.evals += 1
+        return self._eval(node, ctx)
+
+    def eval_condition(self, node, at: float | None = None,
+                       ctx: _Ctx | None = None) -> bool:
+        """Boolean evaluation of a compiled condition: True when any
+        sample satisfies it (absent data never fires — the alert
+        engine's None contract). Semantically identical to
+        ``bool(eval_compiled(node))`` with vector-non-emptiness /
+        scalar-truthiness collapse, but the common per-tick shape — a
+        single comparison between an instant selector and a constant —
+        short-circuits on the first satisfying sample without
+        materializing label vectors (the SLO engine's bad-condition
+        hot path; bench.py's ``slo`` phase pins the ≤2% tick bound
+        this serves). Every other shape — and/or (whose vector
+        operands intersect/union BY LABELS in _eval_bin, not by
+        truthiness), arithmetic, vector-vector comparisons — falls
+        through to the generic evaluator, so the fast path can never
+        disagree with it (tests/test_query.py pins the parity)."""
+        if ctx is None:
+            ctx = self.context(at)
+        if isinstance(node, Bin):
+            cmp = self._CMP.get(node.op)
+            if cmp is not None:
+                sel = const = None
+                flip = False
+                if (isinstance(node.lhs, Selector)
+                        and node.lhs.range_s is None):
+                    sel, const = node.lhs, _const_value(node.rhs)
+                elif (isinstance(node.rhs, Selector)
+                        and node.rhs.range_s is None):
+                    sel, const = node.rhs, _const_value(node.lhs)
+                    flip = True
+                if sel is not None and const is not None:
+                    self.evals += 1
+                    for name in self._matching_names(sel, ctx):
+                        v = self._instant_value(ctx, name)
+                        if v is None:
+                            continue
+                        if cmp(const, v) if flip else cmp(v, const):
+                            return True
+                    return False
+        v = self.eval_compiled(node, ctx=ctx)
+        if isinstance(v, list):
+            return bool(v)
+        if v is None or v != v:  # None / NaN: absent never fires
+            return False
+        return bool(v)
 
     def instant(self, src: str, at: float | None = None, exclude=None) -> dict:
         """Evaluate ``src`` at one instant; returns the /api/query

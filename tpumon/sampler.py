@@ -30,6 +30,7 @@ from tpumon.events import EventJournal
 from tpumon.history import RingHistory
 from tpumon.query import QueryEngine, QueryError, RecordingRule, RuleSet
 from tpumon.resilience import DEADLINE_ERROR, CircuitBreaker, LoopWatchdog
+from tpumon.slo import SLOEngine, parse_slos
 from tpumon.snapshot import EpochClock
 from tpumon.topology import ChipSample, attribute_pods, slice_views
 from tpumon.tracing import SpanTracer, quantiles
@@ -155,6 +156,9 @@ class Sampler:
         # hold chip.<id>.* ring series, and which the cap refused.
         self._perchip_tracked: set[str] = set()
         self._perchip_skipped: set[str] = set()
+        # Serving tenants whose label can't name a series (dots) —
+        # journaled once each, never silently dropped.
+        self._tenant_skipped: set[str] = set()
         # Batch-ingest handle caches (ROADMAP item 5 / docs/perf.md
         # "ingest spine"): series are resolved ONCE — per-chip series
         # names are formatted once per chip ever (not 4 f-strings per
@@ -229,6 +233,26 @@ class Sampler:
                     "query", "serious", "query",
                     f"recording rule {text!r} rejected: {e}", rule=text,
                 )
+        # SLO engine (tpumon.slo, docs/slo.md): error budgets +
+        # multi-window burn-rate alerts over compiled query-language
+        # expressions, evaluated per fast tick. None when no objectives
+        # are configured. A rejected objective is an incident — the
+        # operator declared an SLO that will never be watched.
+        self.slo: SLOEngine | None = None
+        slo_specs, slo_errors = parse_slos(cfg.slos)
+        for err in slo_errors:
+            self.journal.record(
+                "slo", "serious", "slo", f"slo objective rejected: {err}",
+            )
+        if slo_specs:
+            self.slo = SLOEngine(
+                slo_specs, self.query, self.history, self.journal)
+            # The burn/budget windows ride the recording-rule store:
+            # every avg_over_time the engine re-evaluates per tick is
+            # an O(sub-buckets) head-state merge, never a point walk —
+            # which is what holds slo_eval_overhead_tick_pct ≤ 2%.
+            for text in self.slo.rule_texts():
+                rules.append(RecordingRule(text))
         if rules:
             self.history.set_recording_rules(RuleSet(rules))
         # Chaos wrappers and peer federations record their own journal
@@ -241,13 +265,19 @@ class Sampler:
     def _query_augmenter(self):
         """Per-evaluation label hook for the query engine: chip-family
         labels gain ``pod`` from the current pod→chip attribution —
-        computed once per evaluation, not per series."""
-        owners = attribute_pods(self.chips(), self.pods())
+        computed at most once per evaluation, and only when a matched
+        series actually carries a chip label (the attribution walk is
+        O(chips); per-tick evaluations over serving/slo series must
+        not pay it — bench.py's ``slo`` phase pins that)."""
+        owners_box: list[dict] = []
 
         def augment(family: str, labels: dict) -> None:
             cid = labels.get("chip")
             if cid is not None:
-                pod = owners.get(cid)
+                if not owners_box:
+                    owners_box.append(
+                        attribute_pods(self.chips(), self.pods()))
+                pod = owners_box[0].get(cid)
                 if pod is not None:
                     labels["pod"] = pod
 
@@ -321,6 +351,22 @@ class Sampler:
             **(
                 {"anomaly": self.anomaly.to_json()}
                 if self.anomaly is not None and self.anomaly.detectors
+                else {}
+            ),
+            # SLO engine summary (tpumon.slo): objective count + which
+            # burn windows are currently firing; the full budget/burn
+            # table lives on /api/slo.
+            **(
+                {
+                    "slo": {
+                        "objectives": len(self.slo.compiled),
+                        "firing": [
+                            f"{r['name']}/{r['window']}"
+                            for r in self.slo.alert_rows()
+                        ],
+                    }
+                }
+                if self.slo is not None
                 else {}
             ),
             # Aggregator-tree health (tpumon.federation): downstream
@@ -601,6 +647,41 @@ class Sampler:
             vals = [s[key] for s in serving if s.get(key) is not None]
             if vals:
                 add((handle(name), agg(vals)))
+        # Per-tenant serving series (the SLO engine's denominators):
+        # serving.<tenant>.{ttft_p95_ms,tpot_p95_ms,goodput_rps,
+        # error_rate}, queryable via {tenant="..."} matchers
+        # (query.parse_series_name derives the label from the naming
+        # contract). Latency worst-of-targets, goodput summed, error
+        # rate worst-of-targets — one tenant's regression must not be
+        # averaged away by a healthy replica.
+        tenant_vals: dict[tuple[str, str], list[float]] = {}
+        for s in serving:
+            for tenant, row in (s.get("tenants") or {}).items():
+                if "." in tenant or not tenant:
+                    # A dot would mis-split serving.<tenant>.<metric>
+                    # (the traffic driver validates; a foreign serving
+                    # stack may not). Skipping silently would let an
+                    # SLO over this tenant never fire — journal it
+                    # once per tenant.
+                    if tenant not in self._tenant_skipped:
+                        self._tenant_skipped.add(tenant)
+                        self.journal.record(
+                            "slo", "minor", "serving",
+                            f"serving tenant label {tenant!r} is not "
+                            f"dot-free: its serving.<tenant>.* series "
+                            f"cannot be recorded, SLOs over it will "
+                            f"never fire",
+                            tenant=tenant,
+                        )
+                    continue
+                for key in ("ttft_p95_ms", "tpot_p95_ms",
+                            "goodput_rps", "error_rate"):
+                    v = row.get(key)
+                    if v is not None:
+                        tenant_vals.setdefault((tenant, key), []).append(v)
+        for (tenant, key), vals in tenant_vals.items():
+            agg = sum if key == "goodput_rps" else max
+            add((handle(f"serving.{tenant}.{key}"), agg(vals)))
         if batch:
             self.history.record_batch(batch, ts=ts)
         self._journal_out_of_order()
@@ -722,6 +803,7 @@ class Sampler:
             serving=self.serving_data() or None,
             sources=self.source_health(),
             anomalies=self.anomaly.active() if self.anomaly is not None else None,
+            slos=self.slo.alert_rows() if self.slo is not None else None,
         )
         self._notify_new_events()
         # Alerts section fingerprint: timeline position, the active set
@@ -835,6 +917,14 @@ class Sampler:
             if self.anomaly is not None:
                 with tr.span("anomaly"):
                     self.anomaly.observe(self._anomaly_series(), ts)
+            # SLO evaluation after history (this tick's serving series
+            # are in the ring) and before alerts (a burn alert that
+            # fires this tick pages this tick). The section bumps only
+            # when the published budget/burn/alert view moved.
+            if self.slo is not None:
+                with tr.span("slo"):
+                    if self.slo.observe(ts):
+                        self.clock.bump("slo")
             with tr.span("alerts"):
                 self._evaluate_alerts()
             # Journal publish: everything the tick recorded (breaker

@@ -32,6 +32,10 @@ Route map (SURVEY §2.3, re-keyed for TPU):
   /api/alerts           last alert evaluation (sampler-owned, not
                         recomputed per request — fixes SURVEY §5.2),
                         + silenced list and active silences
+  /api/slo              SLO objectives (tpumon.slo, docs/slo.md):
+                        per-objective error-budget remaining and
+                        multi-window burn rates with firing state —
+                        empty "slos" list when none are configured
   /api/silence          POST {"key": <prefix>, "duration": "1h"} mutes
                         matching alerts (buckets + webhooks; timeline
                         still records); /api/unsilence removes a mute
@@ -223,6 +227,12 @@ class MonitorServer:
             # keeps uplink/staleness stats fresh per tick. Standalone
             # instances render once ("standalone") and cache forever.
             "/api/federation": (("federation", "samples"), self._api_federation),
+            # SLO burn-down view (tpumon.slo, docs/slo.md): "slo"
+            # bumps only when an objective's published budget/burn/
+            # alert state moved, so a polling dashboard reuses the
+            # bytes between changes. Renders {"slos": []} once and
+            # caches forever when no objectives are configured.
+            "/api/slo": (("slo",), self._api_slo),
         }
         # SSE epoch sections (see RT_SECTIONS): the trace strip rides
         # the payload only when tracing is on, and only then may the
@@ -355,6 +365,15 @@ class MonitorServer:
         if hub is not None:
             out.update(hub.to_json())
         return out
+
+    def _api_slo(self) -> dict:
+        """SLO objectives (tpumon.slo): budget remaining + fast/slow
+        burn rates per objective; an empty list when none configured
+        (the route always answers — the lint's liveness contract)."""
+        slo = self.sampler.slo
+        if slo is None:
+            return {"slos": [], "evaluated_at": None}
+        return slo.to_json()
 
     def _api_trace(self) -> dict:
         """Self-trace view: ring stats, per-stage p50/p95/max, per-route
@@ -1173,9 +1192,28 @@ class MonitorServer:
 
     # ------------------------------ lifecycle ------------------------------
 
+    def _ssl_context(self):
+        """Server-side TLS (the PR 7 follow-up): terminate HTTPS on the
+        listener when --tls-cert is configured, so the SLO/alerting
+        surface isn't plaintext. tls_key defaults to tls_cert (one
+        combined PEM). Returns None when TLS is off."""
+        if not self.cfg.tls_cert:
+            if self.cfg.tls_key:
+                raise ValueError(
+                    "tls_key is set but tls_cert is not — the server "
+                    "cannot terminate TLS without a certificate")
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(
+            self.cfg.tls_cert, self.cfg.tls_key or self.cfg.tls_cert)
+        return ctx
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._client, host=self.cfg.host, port=self.cfg.port
+            self._client, host=self.cfg.host, port=self.cfg.port,
+            ssl=self._ssl_context(),
         )
 
     @property

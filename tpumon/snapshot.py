@@ -41,9 +41,12 @@ from dataclasses import dataclass
 # (tpumon.federation): bumped as downstream delta frames land and on
 # dark/recover transitions, so /api/federation re-renders only when
 # the fleet view actually moved.
+# "slo" versions the SLO engine's published view (tpumon.slo): bumped
+# once per tick when an objective's budget/burn/alert state moved, so
+# /api/slo and the tpumon_slo_* exporter block re-render only then.
 SECTIONS = (
     "host", "accel", "k8s", "serving", "alerts", "samples", "events",
-    "federation",
+    "federation", "slo",
 )
 
 

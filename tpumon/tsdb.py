@@ -698,15 +698,26 @@ class SlotDownsample(Downsample):
         self._store.bn[self._slot] = float(v)
 
 
+# Minimum batch size for the native accum_many path: the kernel call's
+# fixed cost (three flush-buffer allocations + six pointer casts + the
+# FFI round trip, ~13 µs measured) crosses the pure-Python loop
+# (~0.4 µs/series) near 32 series. Below it — e.g. the SLO engine's
+# per-tick slo.<name>.bad append, a handful of series — the fallback is
+# strictly faster; both paths are bit-exact (tests/test_ingest.py), so
+# the switch is invisible to state.
+ACCUM_KERNEL_MIN = 32
+
+
 def accum_many(
     ts_q: float, val_q: array, slots: array, store: AccumStore
 ) -> list[tuple[int, float, float]]:
     """One point per series at a shared quantized timestamp, accumulated
     into ``store``'s columns; returns closed buckets as (slot, mid_ts,
     raw mean) — the multi-series mirror of Downsample.observe_batch.
-    One C call when the kernel is loaded."""
+    One C call when the kernel is loaded and the batch is large enough
+    to amortize the call (ACCUM_KERNEL_MIN)."""
     k = kernel()
-    if k is not None:
+    if k is not None and len(slots) >= ACCUM_KERNEL_MIN:
         return k.accum_many(ts_q, val_q, slots, store)
     step = store.step_s
     bnew = int(ts_q // step)

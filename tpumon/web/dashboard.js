@@ -766,6 +766,55 @@ function makeDashboard(doc, net, env, mkSurface) {
     });
   }
 
+  /* ---------------------------- SLO burn-down -------------------------- */
+  /* GET /api/slo — per-objective error budget + multi-window burn rates
+   * (tpumon.slo, docs/slo.md). Hidden when no objectives are
+   * configured: the route always answers, with an empty slos list. */
+  function fetchSlo() {
+    net.getJson("/api/slo", res => {
+      const card = $("slo-card");
+      const rows = res && res.slos ? res.slos : [];
+      if (!rows.length) { card.style.display = "none"; return; }
+      card.style.display = "";
+      let firing = 0;
+      const body = $("slo-body");
+      body.replaceChildren();
+      const burnText = b => {
+        if (!b) return "–";
+        const s = b.short == null ? "–" : b.short.toFixed(1) + "x";
+        const l = b.long == null ? "–" : b.long.toFixed(1) + "x";
+        return s + " / " + l + (b.firing ? " ● FIRING" : "");
+      };
+      for (const row of rows) {
+        const tr = doc.mk("tr");
+        const mk = (t, hot) => {
+          const td = doc.mk("td");
+          td.textContent = t;
+          if (hot) td.style.color = "var(--red)";
+          return td;
+        };
+        const budget = row.budget || {};
+        const rem = budget.remaining;
+        const fast = row.burn ? row.burn.fast : null;
+        const slow = row.burn ? row.burn.slow : null;
+        if (fast && fast.firing) firing += 1;
+        if (slow && slow.firing) firing += 1;
+        tr.appendChild(mk(row.name));
+        tr.appendChild(mk(row.tenant || "–"));
+        tr.appendChild(mk((row.target * 100).toFixed(2) + "%"));
+        tr.appendChild(mk(
+          rem == null ? "–" : (rem * 100).toFixed(1) + "%",
+          rem != null && rem < 0.1));
+        tr.appendChild(mk(burnText(fast), !!(fast && fast.firing)));
+        tr.appendChild(mk(burnText(slow), !!(slow && slow.firing)));
+        body.appendChild(tr);
+      }
+      $("slo-tag").textContent = firing
+        ? firing + " burning" : rows.length + " objective(s)";
+      $("slo-tag").style.color = firing ? "var(--red)" : "";
+    });
+  }
+
   /* --------------------------- hottest chips --------------------------- */
   /* GET /api/query — the in-tree query engine (docs/query.md): a topk
    * over per-chip 5 m duty means. On an aggregator/root with a
@@ -852,6 +901,7 @@ function makeDashboard(doc, net, env, mkSurface) {
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
     fetchAlerts(); fetchServing(); fetchFederation(); fetchHealth();
+    fetchSlo();
     fetchTopChips();
     fetchTrace();
     fetchEvents();
@@ -864,6 +914,7 @@ function makeDashboard(doc, net, env, mkSurface) {
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
     fetchServing: fetchServing, fetchFederation: fetchFederation,
     fetchHealth: fetchHealth, fetchTopChips: fetchTopChips,
+    fetchSlo: fetchSlo,
     fetchTrace: fetchTrace, fetchEvents: fetchEvents,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
