@@ -1,6 +1,8 @@
-"""Known-bad fixture: an undocumented federation gauge."""
+"""Known-bad fixture: undocumented federation + actuation gauges."""
 
 
 def render(w):
     g = w.gauge("tpumon_federation_ghost_gauge", "documented nowhere")
     g.add({}, 1.0)
+    a = w.gauge("tpumon_actuate_ghost_gauge", "documented nowhere")
+    a.add({}, 1.0)

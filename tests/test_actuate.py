@@ -1,0 +1,848 @@
+"""Actuation engine unit tests (tpumon/actuate.py, docs/actuation.md):
+spec parsing/rejection, the guarded state machine (fire/clear holds,
+cooldown, global rate limit), dry-run state-freeze, shed-cap clamping,
+drain bookkeeping — and the ServingEngine actuation surface (shed
+pacing determinism, the distinct `shed` terminal status staying OUT of
+the collector's per-tenant error rate, live capacity nudges, and
+drain-and-requeue's stream/TTFT invariants). The closed loop over a
+live monitor is tests/test_actuate_soak.py."""
+
+import jax  # noqa: F401  (device bring-up before the engine tests)
+
+from tpumon.actuate import (
+    ActuationEngine,
+    ActuationSpec,
+    EngineActuator,
+    parse_actuations,
+)
+from tpumon.collectors.serving import distill_serving_metrics
+from tpumon.events import EventJournal
+from tpumon.history import RingHistory
+from tpumon.loadgen.model import ModelConfig
+from tpumon.loadgen.serving import SHED_CAP, ServeConfig, ServingEngine
+from tpumon.query import QueryEngine
+
+CFG = ServeConfig(
+    model=ModelConfig(vocab=97, d_model=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=128, max_seq=32,
+                      compute_dtype="float32"),
+    slots=2, prefill_len=8,
+)
+
+T0 = 1_700_000_000.0
+
+
+# ------------------------------ spec parsing ------------------------------
+
+
+def test_parse_rejects_bad_specs_keeps_good_ones():
+    specs, errors = parse_actuations([
+        {"name": "ok", "when": "cpu > 90", "action": "shed"},
+        {"name": "bad.dot", "when": "cpu > 90", "action": "shed"},
+        {"name": "noexpr", "when": "cpu >", "action": "shed"},
+        {"name": "what", "when": "cpu > 90", "action": "scale_the_moon"},
+        {"name": "frac", "when": "cpu > 90", "action": "shed",
+         "fraction": 1.5},
+        {"name": "keys", "when": "cpu > 90", "action": "shed",
+         "prefill_budget": 2},  # capacity key on a shed action
+        {"name": "cap0", "when": "cpu > 90", "action": "capacity"},
+        "not-a-dict",
+    ])
+    assert [s.name for s in specs] == ["ok"]
+    assert len(errors) == 7
+    joined = " ".join(errors)
+    for frag in ("bad.dot", "noexpr", "scale_the_moon", "fraction",
+                 "unknown keys", "prefill_budget"):
+        assert frag in joined, (frag, errors)
+
+
+def test_parse_rejects_duplicate_names():
+    specs, errors = parse_actuations([
+        {"name": "p", "when": "cpu > 1", "action": "shed"},
+        {"name": "p", "when": "cpu > 2", "action": "drain"},
+    ])
+    assert specs == []
+    assert any("duplicate" in e for e in errors)
+
+
+def test_spec_defaults_and_duration_cooldown():
+    spec = ActuationSpec.parse(
+        {"name": "p", "when": "cpu > 1", "action": "shed",
+         "cooldown_s": "1m"})
+    assert spec.cooldown_s == 60.0
+    assert spec.fire_hold == 2 and spec.clear_hold == 2
+    assert spec.tenant == "*" and spec.fraction == 0.25
+
+
+# --------------------------- state-machine rig ---------------------------
+
+
+class RecordingActuator:
+    """Records every verb; capacity() serves a fixed baseline."""
+
+    def __init__(self):
+        self.calls = []
+
+    def shed(self, tenant, fraction):
+        self.calls.append(("shed", tenant, round(fraction, 4)))
+        return fraction
+
+    def unshed(self, tenant):
+        self.calls.append(("unshed", tenant))
+
+    def capacity(self):
+        return {"prefill_budget": 1, "admit_lookahead": 0}
+
+    def nudge(self, prefill_budget=None, admit_lookahead=None):
+        self.calls.append(("nudge", prefill_budget, admit_lookahead))
+        return {"prefill_budget": prefill_budget or 1,
+                "admit_lookahead": 0 if admit_lookahead is None
+                else admit_lookahead}
+
+    def drain(self, s):
+        self.calls.append(("drain", s))
+
+    def undrain(self, s):
+        self.calls.append(("undrain", s))
+
+
+def rig(raw_specs, **kw):
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, errors = parse_actuations(raw_specs)
+    assert not errors, errors
+    act = RecordingActuator()
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal,
+                          actuator=act, **kw)
+    return eng, ring, journal, act
+
+
+def feed(ring, name, value, ts):
+    ring.record_batch([(ring.handle(name), value)], ts=ts)
+
+
+def states(journal):
+    return [e.get("state") for e in journal.after(0, kind="actuate")]
+
+
+# ------------------------- hysteresis / cooldown -------------------------
+
+
+def test_fire_and_clear_holds():
+    eng, ring, journal, act = rig([{
+        "name": "p", "when": "cpu > 90", "action": "shed", "tenant": "t",
+        "fraction": 0.2, "cooldown_s": 0, "fire_hold": 3, "clear_hold": 2,
+    }])
+    pol = eng.policies[0]
+    # Two hot ticks: armed but held (fire_hold 3).
+    for i in range(2):
+        feed(ring, "cpu", 95.0, T0 + i)
+        eng.observe(T0 + i)
+    assert pol.state == "armed" and act.calls == []
+    # A cool tick resets the hold entirely.
+    feed(ring, "cpu", 10.0, T0 + 2)
+    eng.observe(T0 + 2)
+    assert pol.state == "idle"
+    # Three consecutive hot ticks fire.
+    for i in range(3, 6):
+        feed(ring, "cpu", 95.0, T0 + i)
+        eng.observe(T0 + i)
+    assert pol.state == "fired"
+    assert act.calls == [("shed", "t", 0.2)]
+    # One clearing tick holds (clear_hold 2); the second reverts.
+    feed(ring, "cpu", 10.0, T0 + 6)
+    eng.observe(T0 + 6)
+    assert pol.state == "fired"
+    feed(ring, "cpu", 10.0, T0 + 7)
+    eng.observe(T0 + 7)
+    assert pol.state == "idle"
+    assert act.calls[-1] == ("unshed", "t")
+    # Two arming episodes (the cool tick reset the first), one fire,
+    # one revert.
+    assert states(journal) == ["armed", "armed", "fired", "reverted"]
+    # Journal attrs carry the audit trail: expression + observed value.
+    fired = [e for e in journal.after(0, kind="actuate")
+             if e["state"] == "fired"][0]
+    assert fired["expr"] == "cpu > 90"
+    assert fired["value"] == 95.0
+    assert fired["policy"] == "p" and fired["action"] == "shed"
+
+
+def test_cooldown_suppresses_refire_once_per_episode():
+    eng, ring, journal, act = rig([{
+        "name": "p", "when": "cpu > 90", "action": "shed",
+        "cooldown_s": 100.0, "fire_hold": 1, "clear_hold": 1,
+    }])
+    feed(ring, "cpu", 95.0, T0)
+    eng.observe(T0)  # armed
+    eng.observe(T0 + 1)  # fired (hold satisfied on the 2nd hot tick)
+    feed(ring, "cpu", 10.0, T0 + 2)
+    eng.observe(T0 + 2)  # reverted
+    # Condition returns inside the cooldown: suppressed, ONCE, for the
+    # whole armed episode — not one journal event per tick.
+    feed(ring, "cpu", 95.0, T0 + 3)
+    for i in range(3, 8):
+        eng.observe(T0 + i)
+    assert eng.policies[0].suppressed == 1
+    assert states(journal).count("suppressed") == 1
+    assert len([c for c in act.calls if c[0] == "shed"]) == 1
+    # Past the cooldown the held policy finally fires.
+    eng.observe(T0 + 102)
+    assert eng.policies[0].state == "fired"
+    assert len([c for c in act.calls if c[0] == "shed"]) == 2
+
+
+def test_global_rate_limit_blocks_and_never_blocks_reverts():
+    eng, ring, journal, act = rig(
+        [
+            {"name": "a", "when": "cpu > 90", "action": "shed",
+             "tenant": "a", "cooldown_s": 0, "fire_hold": 1,
+             "clear_hold": 1},
+            {"name": "b", "when": "cpu > 90", "action": "shed",
+             "tenant": "b", "cooldown_s": 0, "fire_hold": 1,
+             "clear_hold": 1},
+        ],
+        max_actions=1, window_s=1000.0,
+    )
+    feed(ring, "cpu", 95.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    by_name = {p.spec.name: p for p in eng.policies}
+    # Budget 1: exactly one policy fired, the other was rate-limited.
+    assert sorted(p.state for p in eng.policies) == ["armed", "fired"]
+    limited = [p for p in eng.policies if p.state == "armed"][0]
+    assert limited.rate_limited == 1
+    assert "rate-limited" in states(journal)
+    # The fired policy's revert goes through even with the budget spent.
+    feed(ring, "cpu", 10.0, T0 + 2)
+    eng.observe(T0 + 2)
+    assert by_name["a"].state == "idle" or by_name["b"].state == "idle"
+    assert any(c[0] == "unshed" for c in act.calls)
+    assert eng.to_json()["actions_in_window"] == 1
+    assert eng.to_json()["max_actions"] == 1
+    assert eng.to_json()["window_s"] == 1000.0
+
+
+def test_shed_fraction_clamped_to_engine_cap():
+    eng, ring, journal, act = rig(
+        [{"name": "p", "when": "cpu > 90", "action": "shed",
+          "fraction": 0.9, "cooldown_s": 0, "fire_hold": 1,
+          "clear_hold": 1}],
+        shed_max_fraction=0.35,
+    )
+    feed(ring, "cpu", 95.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    assert act.calls == [("shed", "*", 0.35)]
+
+
+def test_overlapping_shed_policies_combine_and_relax():
+    """Two shed policies on the SAME tenant: the engine holds one
+    fraction per tenant, so the actuation layer must combine (shed at
+    the max of every fired policy) and a revert must relax to the
+    remaining max — never remove the throttle out from under a policy
+    that is still fired."""
+    eng, ring, journal, act = rig([
+        {"name": "mild", "when": "slow_burn > 0", "action": "shed",
+         "tenant": "chat", "fraction": 0.25, "cooldown_s": 0,
+         "fire_hold": 1, "clear_hold": 1},
+        {"name": "hard", "when": "fast_burn > 0", "action": "shed",
+         "tenant": "chat", "fraction": 0.6, "cooldown_s": 0,
+         "fire_hold": 1, "clear_hold": 1},
+    ], shed_max_fraction=0.75)
+    # Both conditions hold; both policies fire.
+    feed(ring, "slow_burn", 1.0, T0)
+    feed(ring, "fast_burn", 1.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    assert [c for c in act.calls if c[0] == "shed"] == [
+        ("shed", "chat", 0.25), ("shed", "chat", 0.6)]
+    # The aggressive policy clears first: the tenant RELAXES to the
+    # mild policy's 0.25, it is not unshed.
+    feed(ring, "fast_burn", 0.0, T0 + 2)
+    eng.observe(T0 + 2)
+    by_name = {p.spec.name: p for p in eng.policies}
+    assert by_name["hard"].state == "idle"
+    assert by_name["mild"].state == "fired"
+    assert act.calls[-1] == ("shed", "chat", 0.25)
+    assert "relaxed to 0.25" in by_name["hard"].last
+    # The mild policy clears last: only now is the throttle removed.
+    feed(ring, "slow_burn", 0.0, T0 + 3)
+    eng.observe(T0 + 3)
+    assert act.calls[-1] == ("unshed", "chat")
+
+
+class StatefulCapacityActuator(RecordingActuator):
+    """capacity() reflects live nudges — the shape a real engine has,
+    and what the overlapping-capacity regression needs (a fixed
+    baseline would mask a later policy capturing an earlier policy's
+    nudged values as its revert target)."""
+
+    def __init__(self):
+        super().__init__()
+        self.state = {"prefill_budget": 1, "admit_lookahead": 0}
+
+    def capacity(self):
+        return dict(self.state)
+
+    def nudge(self, prefill_budget=None, admit_lookahead=None):
+        self.calls.append(("nudge", prefill_budget, admit_lookahead))
+        if prefill_budget is not None:
+            self.state["prefill_budget"] = prefill_budget
+        if admit_lookahead is not None:
+            self.state["admit_lookahead"] = admit_lookahead
+        return dict(self.state)
+
+
+def test_overlapping_capacity_policies_share_true_baseline():
+    """Two capacity policies fired together must not corrupt each
+    other's revert target: the TRUE pre-actuation baseline is captured
+    once (at the first fire — a later policy reading capacity() live
+    would capture the first one's nudge), one policy's revert re-layers
+    the still-fired policies' nudges, and the last revert restores the
+    real baseline."""
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, errors = parse_actuations([
+        {"name": "a", "when": "a_sig > 0", "action": "capacity",
+         "prefill_budget": 2, "cooldown_s": 0, "fire_hold": 1,
+         "clear_hold": 1},
+        {"name": "b", "when": "b_sig > 0", "action": "capacity",
+         "prefill_budget": 4, "cooldown_s": 0, "fire_hold": 1,
+         "clear_hold": 1},
+    ])
+    assert not errors
+    act = StatefulCapacityActuator()
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal,
+                          actuator=act)
+    by_name = {p.spec.name: p for p in eng.policies}
+    # a fires first (budget 1 -> 2), then b (2 -> 4).
+    feed(ring, "a_sig", 1.0, T0)
+    feed(ring, "b_sig", 0.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    assert by_name["a"].state == "fired"
+    assert act.state["prefill_budget"] == 2
+    feed(ring, "b_sig", 1.0, T0 + 2)
+    eng.observe(T0 + 2)
+    eng.observe(T0 + 3)
+    assert by_name["b"].state == "fired"
+    assert act.state["prefill_budget"] == 4
+    # a clears while b is still fired: b's nudge survives — the engine
+    # restores the baseline then re-layers b, never parking capacity at
+    # a's pre-fire value out from under b.
+    feed(ring, "a_sig", 0.0, T0 + 4)
+    eng.observe(T0 + 4)
+    assert by_name["a"].state == "idle" and by_name["b"].state == "fired"
+    assert act.state["prefill_budget"] == 4
+    assert "re-layered" in by_name["a"].last
+    # b clears last: the TRUE baseline (1, not a's nudged 2) returns.
+    feed(ring, "b_sig", 0.0, T0 + 5)
+    eng.observe(T0 + 5)
+    assert by_name["b"].state == "idle"
+    assert act.state == {"prefill_budget": 1, "admit_lookahead": 0}
+
+
+def test_overlapping_drain_policies_refcount_slices():
+    """A slice drained by two fired policies stays drained until the
+    LAST one reverts — one policy's clear must not undrain a slice
+    another still-fired policy is holding dark."""
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, errors = parse_actuations([
+        {"name": "a", "when": "a_sig > 0", "action": "drain",
+         "slice": "sX", "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1},
+        {"name": "b", "when": "b_sig > 0", "action": "drain",
+         "slice": "sX", "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1},
+    ])
+    assert not errors
+    act = RecordingActuator()
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal,
+                          actuator=act)
+    by_name = {p.spec.name: p for p in eng.policies}
+    feed(ring, "a_sig", 1.0, T0)
+    feed(ring, "b_sig", 1.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    assert by_name["a"].state == "fired" and by_name["b"].state == "fired"
+    # Drained once, not per policy (the hold is refcounted).
+    assert act.calls.count(("drain", "sX")) == 1
+    # a reverts while b still holds the slice: NO undrain.
+    feed(ring, "a_sig", 0.0, T0 + 2)
+    eng.observe(T0 + 2)
+    assert by_name["a"].state == "idle" and by_name["b"].state == "fired"
+    assert ("undrain", "sX") not in act.calls
+    assert "still drained by other policies: sX" in by_name["a"].last
+    # b reverts last: now the slice undrains, exactly once.
+    feed(ring, "b_sig", 0.0, T0 + 3)
+    eng.observe(T0 + 3)
+    assert act.calls.count(("undrain", "sX")) == 1
+
+
+def test_capacity_reverts_to_prefire_baseline():
+    eng, ring, journal, act = rig([{
+        "name": "cap", "when": "avg_over_time(queue_depth[30s]) > 8",
+        "action": "capacity", "prefill_budget": 4, "admit_lookahead": 4,
+        "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1,
+    }])
+    # The trend window rides a recording rule, never a point walk.
+    assert eng.rule_texts() == ["queue_depth[30s]"]
+    for i in range(3):
+        feed(ring, "queue_depth", 20.0, T0 + i)
+        eng.observe(T0 + i)
+    assert eng.policies[0].state == "fired"
+    assert ("nudge", 4, 4) in act.calls
+    for i in range(3, 40):
+        feed(ring, "queue_depth", 0.0, T0 + i)
+        eng.observe(T0 + i)
+    assert eng.policies[0].state == "idle"
+    # Revert nudged back to the captured baseline, not a hardcoded one.
+    assert act.calls[-1] == ("nudge", 1, 0)
+
+
+def test_drain_targets_current_darks_and_reverts_exactly_those():
+    darks = ["s1", "s3"]
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, errors = parse_actuations([{
+        "name": "d", "when": "federation.dark > 0", "action": "drain",
+        "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1,
+    }])
+    assert not errors
+    act = RecordingActuator()
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal,
+                          actuator=act, dark_slices=lambda: list(darks))
+    eng.observe(T0)  # records federation.dark=2, arms
+    eng.observe(T0 + 1)
+    assert act.calls == [("drain", "s1"), ("drain", "s3")]
+    # Recovery: darks empty -> condition clears -> undrain the SAME set
+    # (even though nothing is dark NOW — the fired set is remembered).
+    darks.clear()
+    eng.observe(T0 + 2)
+    assert eng.policies[0].state == "idle"
+    assert act.calls[-2:] == [("undrain", "s1"), ("undrain", "s3")]
+    # A None provider result means "no fleet here" (standalone
+    # monitor): the per-tick federation.dark record is skipped
+    # entirely, not written as 0.0.
+    ring2 = RingHistory(window_s=600)
+    eng2 = ActuationEngine(specs, QueryEngine(ring2), ring2,
+                           EventJournal(64), actuator=RecordingActuator(),
+                           dark_slices=lambda: None)
+    eng2.observe(T0)
+    assert "federation.dark" not in ring2.series
+
+
+def test_fired_policy_with_explicit_clear_reverts_on_vanished_data():
+    """A fired policy whose explicit `clear` expression reads NO data
+    at all (collector died, source drained) must revert through the
+    normal clear_hold — not wedge fired forever because absent maps to
+    False for both expressions. Same staleness class slo.py hardens;
+    the safe direction for a remedy is revert."""
+    eng, ring, journal, act = rig([{
+        "name": "p", "when": "avg_over_time(sig[30s]) > 5",
+        "clear": "avg_over_time(sig[30s]) < 2", "action": "shed",
+        "tenant": "t", "cooldown_s": 0, "fire_hold": 1, "clear_hold": 2,
+    }])
+    pol = eng.policies[0]
+    feed(ring, "sig", 10.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    assert pol.state == "fired"
+    # Present-but-not-clearing data holds the remedy (8 is neither > 5
+    # after the window drains below... keep it simple: still > 5).
+    feed(ring, "sig", 10.0, T0 + 2)
+    eng.observe(T0 + 2)
+    assert pol.state == "fired"
+    # The series vanishes: 90s later every window read is empty. Two
+    # absent ticks (clear_hold 2) revert instead of wedging.
+    eng.observe(T0 + 95)
+    assert pol.state == "fired" and pol.clear_count == 1
+    eng.observe(T0 + 96)
+    assert pol.state == "idle"
+    assert act.calls[-1] == ("unshed", "t")
+
+
+def test_rule_texts_register_matcher_carrying_selectors():
+    """A per-tenant trend condition must ride a recording rule like a
+    bare one: rules are per-family with per-matched-series state, so
+    `{tenant="chat"}` reads are rule-served too — skipping them would
+    send the condition to a per-tick point walk."""
+    ring = RingHistory(window_s=600)
+    specs, errors = parse_actuations([{
+        "name": "p",
+        "when": 'avg_over_time(serving.ttft_p95_ms{tenant="chat"}[5m])'
+                ' > 500',
+        "action": "shed", "tenant": "chat",
+    }])
+    assert not errors
+    eng = ActuationEngine(specs, QueryEngine(ring), ring,
+                          EventJournal(64))
+    assert eng.rule_texts() == ["serving.ttft_p95_ms[300s]"]
+
+
+def test_dark_provider_not_called_without_dark_reading_policies():
+    """A shed/capacity-only policy set must not pay the per-tick
+    hub.slices() walk or the federation.dark TSDB append — the
+    provider is not even called unless a drain policy or a
+    federation.dark condition exists."""
+    calls = []
+
+    def provider():
+        calls.append(1)
+        return ["s1"]
+
+    ring = RingHistory(window_s=600)
+    specs, _ = parse_actuations([{
+        "name": "p", "when": "cpu > 90", "action": "shed"}])
+    eng = ActuationEngine(specs, QueryEngine(ring), ring,
+                          EventJournal(64), actuator=RecordingActuator(),
+                          dark_slices=provider)
+    eng.observe(T0)
+    assert calls == [] and "federation.dark" not in ring.series
+    # A drain policy (or a federation.dark condition) flips it on.
+    specs2, _ = parse_actuations([{
+        "name": "d", "when": "federation.dark > 0", "action": "drain"}])
+    eng2 = ActuationEngine(specs2, QueryEngine(ring), ring,
+                           EventJournal(64), actuator=RecordingActuator(),
+                           dark_slices=provider)
+    eng2.observe(T0)
+    assert calls == [1] and "federation.dark" in ring.series
+
+
+def test_placement_domains_synced_into_engine_before_any_fire():
+    """The drain family's production wiring: the policy engine keeps
+    the serving engine's placement-domain namespace synced to the
+    fleet's (set_slices), so requests carry a slice attribution BEFORE
+    a drain ever fires — without it the drain verbs journal success
+    while nothing is ever attributed, aborted, or requeued."""
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, _ = parse_actuations([{
+        "name": "d", "when": "federation.dark > 0", "action": "drain",
+        "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1}])
+    serving = ServingEngine(cfg=CFG)
+    domains = ["s1", "s0"]
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal,
+                          dark_slices=lambda: [],
+                          placement_domains=lambda: list(domains))
+    eng.bind_engine(serving)
+    eng.observe(T0)
+    # Synced (sorted) with NO policy fired — attribution is the
+    # prerequisite, not the remedy.
+    assert serving.slices == ("s0", "s1")
+    r = serving.submit([1, 2, 3], max_new=1)
+    serving.drain()
+    assert r.slice in ("s0", "s1")
+    # A domain appears: re-synced. An empty read (fleet view warming
+    # up) keeps the last known namespace.
+    domains.append("s2")
+    eng.observe(T0 + 1)
+    assert serving.slices == ("s0", "s1", "s2")
+    domains.clear()
+    eng.observe(T0 + 2)
+    assert serving.slices == ("s0", "s1", "s2")
+    assert any(e.get("state") == "domains"
+               for e in journal.after(0, kind="actuate"))
+    # Dry-run drain policies sync nothing (engine state frozen).
+    specs_dry, _ = parse_actuations([{
+        "name": "d", "when": "federation.dark > 0", "action": "drain",
+        "dry_run": True}])
+    serving2 = ServingEngine(cfg=CFG)
+    eng2 = ActuationEngine(specs_dry, QueryEngine(ring), ring,
+                           EventJournal(64), dark_slices=lambda: [],
+                           placement_domains=lambda: ["s0"])
+    eng2.bind_engine(serving2)
+    eng2.observe(T0)
+    assert serving2.slices == ()
+
+
+# -------------------------------- dry-run --------------------------------
+
+
+def test_dry_run_journals_intent_but_freezes_engine_state():
+    """The acceptance wording: a dry-run policy journals intent but
+    provably changes no engine state — asserted against a REAL
+    ServingEngine behind the real EngineActuator."""
+    ring = RingHistory(window_s=600)
+    journal = EventJournal(512)
+    specs, _ = parse_actuations([{
+        "name": "p", "when": "cpu > 90", "action": "shed",
+        "tenant": "chat", "cooldown_s": 0, "fire_hold": 1,
+        "clear_hold": 1, "dry_run": True,
+    }])
+    serving = ServingEngine(cfg=CFG)
+    eng = ActuationEngine(specs, QueryEngine(ring), ring, journal)
+    eng.bind_engine(serving)
+    assert isinstance(eng.actuator, EngineActuator)
+    feed(ring, "cpu", 95.0, T0)
+    eng.observe(T0)
+    eng.observe(T0 + 1)
+    fired = [e for e in journal.after(0, kind="actuate")
+             if e.get("state") == "fired"]
+    assert len(fired) == 1 and fired[0]["dry_run"] is True
+    assert "(dry-run)" in fired[0]["msg"]
+    # Intent reads like the live action would...
+    assert "shed tenant chat" in fired[0]["msg"]
+    # ...but nothing reached the engine.
+    assert serving.shed_fractions() == {}
+    assert serving.shed_total == 0
+    # Dry-run fires never consume the global action budget.
+    assert eng.to_json()["actions_in_window"] == 0
+    row = eng.to_json()["policies"][0]
+    assert row["dry_run"] is True and row["fired"] == 1
+    # Unbound engines are implicitly dry (intent-only), surfaced on the
+    # payload the dashboard card badges.
+    unbound = ActuationEngine(specs, QueryEngine(ring), ring,
+                              EventJournal(64))
+    unbound.observe(T0)
+    assert unbound.to_json()["engine_bound"] is False
+
+
+def test_slo_paging_series_gated_on_actuation():
+    """slo.<name>.paging exists FOR actuation conditions: an SLOEngine
+    with record_paging off (the default — the sampler flips it on only
+    when policies are configured) must not pay a per-objective TSDB
+    append every tick for a series nothing reads."""
+    from tpumon.slo import SLOEngine, parse_slos
+
+    ring = RingHistory(window_s=600)
+    q = QueryEngine(ring)
+    specs, errors = parse_slos([
+        {"name": "chat_ttft", "expr": "ttft > 100", "target": 0.99,
+         "window": "1h"}])
+    assert not errors
+    eng = SLOEngine(specs, q, ring, EventJournal(64))
+    feed(ring, "ttft", 50.0, T0)
+    eng.observe(T0)
+    assert not any(s.endswith(".paging") for s in ring.series)
+    eng.record_paging = True
+    eng.observe(T0 + 1)
+    assert "slo.chat_ttft.paging" in ring.series
+
+
+# --------------------------- payload / exporter ---------------------------
+
+
+def test_payload_shape_and_exporter_rows():
+    eng, ring, journal, act = rig([{
+        "name": "p", "when": "cpu > 90", "action": "shed",
+        "cooldown_s": 0, "fire_hold": 1, "clear_hold": 1,
+    }])
+    changed = eng.observe(T0)
+    assert changed  # first publish
+    assert eng.observe(T0 + 1) is False  # idle, nothing moved
+    out = eng.to_json()
+    assert out["evaluated_at"] == T0 + 1
+    row = out["policies"][0]
+    for key in ("name", "action", "when", "state", "dry_run", "value",
+                "last", "last_ts", "fired", "reverted", "suppressed",
+                "rate_limited"):
+        assert key in row, key
+    # The exporter block renders every tpumon_actuate_* family.
+    from tpumon.exporter import _render_actuate
+
+    class S:
+        actuate = eng
+
+    text = _render_actuate(S())
+    for fam in ("tpumon_actuate_policy_state",
+                "tpumon_actuate_policy_dry_run",
+                "tpumon_actuate_fired_total",
+                "tpumon_actuate_reverted_total",
+                "tpumon_actuate_suppressed_total",
+                "tpumon_actuate_rate_limited_total",
+                "tpumon_actuate_actions_in_window"):
+        assert fam in text, fam
+    assert 'policy="p"' in text
+    assert _render_actuate(type("S2", (), {"actuate": None})()) == ""
+
+
+# ---------------------- ServingEngine actuation surface ----------------------
+
+
+def test_engine_shed_pacing_is_deterministic_and_capped():
+    eng = ServingEngine(cfg=CFG)
+    assert eng.set_shed("chat", 0.5) == 0.5
+    reqs = [eng.submit([1, 2, 3], max_new=2, tenant="chat")
+            for _ in range(10)]
+    shed = [r for r in reqs if r.status == "shed"]
+    # fraction 0.5 sheds EXACTLY every 2nd submission — no RNG.
+    assert [r.status for r in reqs] == ["", "shed"] * 5
+    assert len(shed) == 5 and eng.shed_total == 5
+    for r in shed:
+        assert r.done.is_set() and not r.output
+    eng.drain()
+    assert sum(1 for r in reqs if r.status == "completed") == 5
+    # Tenant accounting: sheds are their own column, never rejections.
+    tst = eng.tenants["chat"]
+    assert tst.shed == 5 and tst.rejected == 0
+    # Engine-side last-resort cap, then full removal.
+    assert eng.set_shed("chat", 2.0) == SHED_CAP
+    assert eng.set_shed("chat", 0.0) == 0.0
+    assert eng.shed_fractions() == {}
+    # "*" sheds tenants without their own entry.
+    eng.set_shed("*", 1.0)
+    r = eng.submit([1], max_new=1, tenant="other")
+    r2 = eng.submit([1], max_new=1, tenant="other")
+    assert "shed" in (r.status, r2.status)
+
+
+def test_shed_accumulator_resets_between_episodes():
+    """Removing a shed throttle clears the pacing accumulators it
+    drove — a "*" throttle paces under each tenant's OWN name, so the
+    next episode must start at a fresh accumulator (deterministic
+    pacing is per-episode) and nothing may leak across episodes."""
+    eng = ServingEngine(cfg=CFG)
+    eng.set_shed("*", 0.5)
+    r = eng.submit([1, 2], max_new=1, tenant="chat")  # acc 0.5: passes
+    assert r.status == ""
+    eng.drain()
+    eng.set_shed("*", 0.0)
+    assert eng._shed_acc == {}  # the "*"-paced accumulator is gone
+    # Fresh episode at 0.9: the FIRST submission accumulates to 0.9
+    # (< 1.0) and passes; a stale 0.5 carry-over would shed it.
+    eng.set_shed("*", 0.9)
+    r2 = eng.submit([1, 2], max_new=1, tenant="chat")
+    assert r2.status == ""
+    eng.drain()
+    # A tenant-specific throttle's accumulator survives "*" removal.
+    eng.set_shed("chat", 0.5)
+    eng.submit([1, 2], max_new=1, tenant="chat")  # acc under "chat"
+    eng.drain()
+    eng.set_shed("*", 0.0)
+    assert "chat" in eng._shed_acc
+    eng.set_shed("chat", 0.0)
+    assert eng._shed_acc == {}
+
+
+def test_shed_never_pollutes_tenant_error_rate():
+    """The satellite regression: shed at admission must not count
+    toward the tenant's error_rate (it would re-fire the SLO that
+    triggered the shed) — end to end through the engine's /metrics
+    exposition and the serving collector's distillation."""
+    eng = ServingEngine(cfg=CFG, max_queue=4)
+    for _ in range(3):
+        eng.submit([1, 2], max_new=1, tenant="chat")
+    eng.drain()
+    d0 = distill_serving_metrics(eng.metrics_text(), now=1000.0)
+    assert d0["tenants"]["chat"]["shed_total"] == 0
+    # Shed half the next window's traffic.
+    eng.set_shed("chat", 0.5)
+    for _ in range(8):
+        eng.submit([1, 2], max_new=1, tenant="chat")
+        eng.drain()  # drain as we go: nothing queues, nothing rejects
+    d1 = distill_serving_metrics(eng.metrics_text(), prev=d0, now=1010.0)
+    row = d1["tenants"]["chat"]
+    assert row["shed_total"] == 4
+    assert row["error_rate"] == 0.0  # sheds excluded from BOTH sides
+    assert "tpumon_serving_tenant_shed" in eng.metrics_text()
+    assert "tpumon_serving_requests_shed" in eng.metrics_text()
+    # Contrast: real rejections DO count. Fill the queue past capacity
+    # with shedding off.
+    eng.set_shed("chat", 0.0)
+    for _ in range(12):
+        eng.submit([1, 2], max_new=1, tenant="chat")
+    eng.drain()
+    d2 = distill_serving_metrics(eng.metrics_text(), prev=d1, now=1020.0)
+    row2 = d2["tenants"]["chat"]
+    assert row2["rejected_total"] > d1["tenants"]["chat"].get(
+        "rejected_total", 0)
+    assert row2["error_rate"] > 0.0
+
+
+def test_engine_nudge_capacity_live():
+    eng = ServingEngine(cfg=CFG)
+    base = eng.nudge_capacity()
+    assert base == {"prefill_budget": 1, "admit_lookahead": 0}
+    eff = eng.nudge_capacity(prefill_budget=4)
+    assert eff["prefill_budget"] == 4
+    # The engine still serves correctly with the nudged budget (the
+    # knob never reached a trace).
+    r = eng.submit([3, 1, 4, 1, 5], max_new=4)
+    eng.drain()
+    assert r.status == "completed" and len(r.output) == 5
+    eng.nudge_capacity(**base)
+    assert eng.cfg.prefill_chunk_budget == 1
+    # Floors: a nonsense nudge clamps instead of wedging the scheduler.
+    assert eng.nudge_capacity(prefill_budget=-3)["prefill_budget"] == 1
+
+
+def test_drain_and_requeue_stream_and_ttft_invariants():
+    """Drain-and-requeue: the aborted request re-admits at the queue
+    head, regenerates a bit-identical token prefix (keyed sampling),
+    never double-delivers stream tokens, and observes TTFT exactly
+    once (on the original admission)."""
+    eng = ServingEngine(cfg=CFG)
+    eng.set_slices(["s0", "s1"])
+    r = eng.submit([5, 6, 7, 8, 9], max_new=6, temperature=0.8,
+                   stream=True)
+    delivered = []
+    for _ in range(200):
+        eng.step()
+        while not r.stream.empty():
+            t = r.stream.get_nowait()
+            if t is not None:
+                delivered.append(t)
+        if len(delivered) >= 2:
+            break
+    assert r.slice in ("s0", "s1")
+    prefix = list(delivered)
+    eng.drain_slice(r.slice)
+    assert eng.drained_slices() == (("s0",) if prefix and r.slice == "s0"
+                                    else eng.drained_slices())
+    eng.drain()
+    while True:
+        t = r.stream.get()
+        if t is None:
+            break
+        delivered.append(t)
+    assert r.status == "completed"
+    assert r.requeues == 1 and eng.requeued_total == 1
+    # Bit-identical prefix across the requeue, and exactly-once stream.
+    assert r.output[:len(prefix)] == prefix
+    assert delivered == r.output
+    # TTFT observed once across both runs.
+    assert sum(eng._ttft_counts) + eng._ttft_inf == 1
+    assert "tpumon_serving_requests_requeued" in eng.metrics_text()
+
+
+def test_drained_domain_avoided_until_undrained():
+    eng = ServingEngine(cfg=CFG)
+    eng.set_slices(["s0", "s1"])
+    eng.drain_slice("s0")
+    reqs = [eng.submit([i + 1, i + 2], max_new=1) for i in range(4)]
+    eng.drain()
+    assert all(r.slice == "s1" for r in reqs)
+    eng.undrain_slice("s0")
+    assert eng.drained_slices() == ()
+    reqs2 = [eng.submit([i + 1, i + 2], max_new=1) for i in range(4)]
+    eng.drain()
+    assert {r.slice for r in reqs2} == {"s0", "s1"}
+    # set_slices drops drain marks for renamed domains.
+    eng.drain_slice("s1")
+    eng.set_slices(["a", "b"])
+    assert eng.drained_slices() == ()
+
+
+def test_all_drained_fallback_then_rehome_on_undrain():
+    """With EVERY domain drained, placement falls back (liveness: the
+    sweep must not requeue-thrash a request it has nowhere to send);
+    the mark persists, so the moment any domain is undrained the
+    per-step sweep re-homes the stragglers."""
+    eng = ServingEngine(cfg=CFG)
+    eng.set_slices(["s0", "s1"])
+    eng.drain_slice("s0")
+    eng.drain_slice("s1")
+    r = eng.submit([7, 8, 9], max_new=8)
+    for _ in range(3):
+        eng.step()
+    # Fallback-parked on a drained domain, NOT requeue-thrashed.
+    assert r.slice in ("s0", "s1")
+    assert r.requeues == 0 and r.status == ""
+    parked = r.slice
+    other = "s1" if parked == "s0" else "s0"
+    # A domain frees: the persistent mark now re-homes the request.
+    eng.undrain_slice(other)
+    eng.drain()
+    assert r.status == "completed"
+    assert r.requeues == 1 and r.slice == other

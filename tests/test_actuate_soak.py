@@ -1,0 +1,303 @@
+"""Closed-loop actuation soak (ISSUE 14 acceptance): the PR 13 chaos
+soak grown actuated. A live monitor scrapes a real ServingEngine with a
+small bounded queue, driven by the seeded multi-tenant mix; the
+injected serving-path fault (scheduler stall + chaos ``slow`` on the
+serving collector) overflows the queue, rejections inflate the chat
+tenant's error rate, and the fast-window SLO burn alert pages. With
+actuation live, the page itself triggers the remedy: a global shed
+(whose shed completions are NEVER errors — the satellite accounting
+fix, without which the remedy would latch the very SLO that fired it)
+plus a capacity nudge, rejections stop while the fault is STILL
+active, and the alert clears measurably faster than the same fault
+un-actuated. Both arms run in one test against the same warm engine:
+episode A holds the engine untouched behind ``dry_run`` (the journaled
+intent IS the PR 13 no-actuation baseline), episode B acts for real.
+Asserted through the public surfaces: ``/api/slo``, ``/api/actuate``,
+and the journal's seq order — slo fired < actuate fired < slo resolved
+< actuate reverted. No unit seams anywhere in the chain:
+Request.tenant → engine tenant gauges → serving collector →
+``serving.chat.error_rate`` TSDB series → compiled burn expressions →
+SLO page-state series → actuation policies → EngineActuator →
+ServingEngine."""
+
+import asyncio
+import json
+import time
+
+from tests.test_server_api import get_json
+from tpumon.actuate import EngineActuator
+from tpumon.app import build
+from tpumon.collectors.chaos import ChaosCollector, Fault
+from tpumon.config import load_config
+from tpumon.loadgen.serving import ServingEngine, start_metrics_server
+from tpumon.loadgen.traffic import TenantSpec, TrafficSim
+
+# Tick / fault geometry. The engine queue is bounded at 8; the 0.25 s
+# per-step stall caps completion throughput at ~3 req/s against a
+# ~11.5 rps offered load, so the queue overflows within ~1 s of the
+# fault and rejections inflate the windowed per-tenant error rate. The
+# serving scrape interval EQUALS the tick so every error-rate window
+# spans ~2 stalled pump iterations — a shorter window would alias
+# against the stall-paced submission bursts and flap the bad-event
+# series (a window between bursts sees zero rejections). The shed
+# policy drops 0.8 of ALL admissions, taking offered load well below
+# the degraded capacity: rejections cease while the stall is still
+# active — recovery no longer waits for the fault to lift.
+SAMPLE_INTERVAL_S = 0.5
+SERVING_INTERVAL_S = 0.5
+DEGRADE_STALL_S = 0.25
+MAX_QUEUE = 8
+ERROR_RATE_MAX = 0.05
+# Ticks the fault is held PAST the page before lifting, identical in
+# both episodes: the un-actuated arm structurally cannot clear earlier
+# (rejections flow until the lift), the actuated arm can.
+HOLD_TICKS = 6
+
+SLOS = [{
+    "name": "chat_errors",
+    "tenant": "chat",
+    "expr": f'serving.error_rate{{tenant="chat"}} > {ERROR_RATE_MAX:g}',
+    "target": 0.99,
+    "window": "1h",
+    # Second-scale burn windows so fault -> page -> un-page fits in a
+    # test; thresholds stay the production 14.4x / 6x.
+    "fast": ["1s", "3s"],
+    "slow": ["2s", "6s"],
+}]
+
+# Both policies key off the SLO engine's recorded page-state series
+# (docs/actuation.md): the shed on the page alone, the capacity nudge
+# only while the queue trend corroborates (a recording-rule window,
+# never a point walk) — so both actions journal seq-AFTER the page.
+# `and` intersects vectors BY LABELS (docs/query.md): the paging side
+# must collapse to the no-label vector `sum()` yields before it can
+# meet the label-less queue_depth series. The trend window is 6s > 2,
+# deliberately loose: the PAGING gate is what guards against spurious
+# fires (healthy queue avg is well under 2 and paging is 0 anyway);
+# the trend's job is corroboration-through-a-recording-rule. A tight
+# bar (2s > 6, then 4s > 4) flaked under full-suite load: in the
+# ACTUATED episode the shed collapses the queue within a tick or two
+# of the page, and at the page instant the pegged-at-8 ticks are only
+# ~a third of a short window (avg ≈ 3.8 < 4) — one chaos-slowed
+# scrape lagging queue_depth closed the window before the nudge
+# fired. The 6s window stays > 2 from page time until well after the
+# shed drains the queue, in both episodes.
+PAGE = 'slo.paging{slo="chat_errors"} > 0'
+ACTUATIONS = [
+    {"name": "shed_load", "when": PAGE, "action": "shed",
+     "tenant": "*", "fraction": 0.8, "cooldown_s": 0,
+     "fire_hold": 1, "clear_hold": 4},
+    {"name": "grow_budget",
+     "when": ('sum(slo.paging{slo="chat_errors"}) > 0'
+              " and avg_over_time(queue_depth[6s]) > 2"),
+     "action": "capacity", "prefill_budget": 4, "cooldown_s": 0,
+     "fire_hold": 1, "clear_hold": 4},
+]
+
+
+async def wait_until(fn, what: str, timeout_s: float = 30.0):
+    """Poll ``fn`` until truthy off the event-loop thread (a blocking
+    HTTP call on the loop would deadlock against the server)."""
+    t0 = time.monotonic()
+    while True:
+        v = await asyncio.to_thread(fn)
+        if v:
+            return v
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError(f"actuate soak: timed out waiting for {what}")
+        await asyncio.sleep(0.05)
+
+
+def test_actuated_recovery_beats_unactuated_baseline():
+    engine = ServingEngine(max_queue=MAX_QUEUE)
+    # Short recency window so recovery is visible within the budgets.
+    engine.tenant_window_s = 2.0
+    metrics_server, port = start_metrics_server(engine)
+    sim = TrafficSim(engine, [
+        TenantSpec(name="chat", scenario="chat", rps=10.0, max_new=4),
+        TenantSpec(name="rag", scenario="rag", rps=1.0,
+                   prompt_chunks=3, max_new=4),
+        TenantSpec(name="batch", scenario="batch", rps=0.5, max_new=8),
+    ], seed=42)
+
+    cfg = load_config(env={
+        "TPUMON_PORT": "0",
+        "TPUMON_HOST": "127.0.0.1",
+        "TPUMON_ACCEL_BACKEND": "fake:v5e-8",
+        "TPUMON_K8S_MODE": "none",
+        "TPUMON_COLLECTORS": "host,accel,serving",
+        "TPUMON_SERVING_TARGETS": f"http://127.0.0.1:{port}/metrics",
+        "TPUMON_SAMPLE_INTERVAL_S": str(SAMPLE_INTERVAL_S),
+        "TPUMON_SERVING_INTERVAL_S": str(SERVING_INTERVAL_S),
+        "TPUMON_ANOMALY_DETECT": "0",
+        "TPUMON_SLOS": json.dumps(SLOS),
+        "TPUMON_ACTUATIONS": json.dumps(ACTUATIONS),
+        # The policy asks for 0.8; the config clamp must not bite it
+        # (the clamp's own math is unit-tested).
+        "TPUMON_SHED_MAX_FRACTION": "0.85",
+        "TPUMON_CHAOS": "slow:serving:0",
+        "TPUMON_CHAOS_SEED": "42",
+    })
+    sampler, server = build(cfg)
+    assert isinstance(sampler.serving, ChaosCollector)
+    assert sampler.slo is not None
+    assert sampler.actuate is not None
+    # Bind the in-process engine behind the narrow actuator interface
+    # (app.run does exactly this for --serve-loadgen).
+    sampler.actuate.bind_engine(engine)
+    assert isinstance(sampler.actuate.actuator, EngineActuator)
+
+    async def scenario():
+        sim.start()
+        # Warm outside the judged window: first prefill/decode jits
+        # take seconds; backlogged compile-era requests carry their
+        # queue wait as multi-second TTFTs and the overflowed queue as
+        # rejections. Wait for flow, drain, then age the window out.
+        await wait_until(
+            lambda: engine.tenants.get("chat")
+            and engine.tenants["chat"].completed >= 3,
+            "chat traffic flowing", timeout_s=60.0)
+        await wait_until(
+            lambda: len(engine._queue) == 0,
+            "compile-era queue backlog to drain", timeout_s=60.0)
+        await asyncio.sleep(engine.tenant_window_s + 0.5)
+
+        await sampler.start()
+        await server.start()
+        mport = server.port
+
+        def slo_row():
+            return get_json(mport, "/api/slo")["slos"][0]
+
+        def fast_firing():
+            return slo_row()["burn"]["fast"]["firing"]
+
+        def ticks():
+            return sampler.watchdogs["fast"].ticks
+
+        def events(kind):
+            return get_json(mport, f"/api/events?kind={kind}")["events"]
+
+        def policy_rows():
+            return {r["name"]: r
+                    for r in get_json(mport, "/api/actuate")["policies"]}
+
+        await wait_until(
+            lambda: "serving.chat.error_rate" in sampler.history.series,
+            "per-tenant serving series")
+
+        async def episode(label):
+            """Inject the fault, hold it HOLD_TICKS past the page, lift
+            it; return (page seq floor, ticks from page to un-page)."""
+            await wait_until(
+                lambda: slo_row()["burn"]["fast"]["long"] == 0.0,
+                f"{label}: clean baseline", timeout_s=60.0)
+            assert not await asyncio.to_thread(fast_firing)
+            seq0 = max(
+                (e["seq"] for e in await asyncio.to_thread(
+                    lambda: events("slo") + events("actuate"))),
+                default=0)
+            sampler.serving.set_faults([Fault(mode="slow", param=150.0)])
+            sim.degrade(DEGRADE_STALL_S)
+            t_fault = ticks()
+            await wait_until(fast_firing, f"{label}: fast-window page",
+                             timeout_s=30.0)
+            t_page = ticks()
+            assert t_page - t_fault <= 10, (
+                f"{label}: page took {t_page - t_fault} ticks (budget 10)")
+            await wait_until(lambda: ticks() - t_page >= HOLD_TICKS,
+                             f"{label}: fault hold", timeout_s=30.0)
+            sim.degrade(0)
+            sampler.serving.set_faults([])
+            await wait_until(lambda: not fast_firing(),
+                             f"{label}: page to clear", timeout_s=30.0)
+            recovery = ticks() - t_page
+            # Episode teardown: every policy back to idle (reverts
+            # journaled), so the next episode starts from scratch.
+            await wait_until(
+                lambda: all(r["state"] == "idle"
+                            for r in policy_rows().values()),
+                f"{label}: policies idle", timeout_s=30.0)
+            return seq0, recovery
+
+        # --- episode A: the un-actuated baseline (dry-run) ----------
+        sampler.actuate.dry_run = True
+        seq_a, recovery_baseline = await episode("baseline")
+        # Intent was journaled (the policy DID fire, dry)...
+        a_fired = [e for e in await asyncio.to_thread(events, "actuate")
+                   if e["seq"] > seq_a and e.get("state") == "fired"]
+        assert any(e["policy"] == "shed_load" for e in a_fired)
+        assert all(e.get("dry_run") for e in a_fired)
+        # ...but provably nothing reached the engine.
+        assert engine.shed_total == 0
+        assert engine.shed_fractions() == {}
+        assert engine.cfg.prefill_chunk_budget == 1
+        assert engine.requeued_total == 0
+
+        # --- episode B: the loop closed for real ---------------------
+        sampler.actuate.dry_run = False
+        seq_b, recovery_actuated = await episode("actuated")
+
+        # The headline: measurably faster recovery, zero human steps.
+        assert recovery_actuated < recovery_baseline, (
+            f"actuated recovery ({recovery_actuated} ticks) not faster "
+            f"than un-actuated baseline ({recovery_baseline} ticks)")
+        # The un-actuated arm cannot clear before the lift at
+        # page+HOLD_TICKS; the actuated arm recovers DURING the fault.
+        assert recovery_baseline > HOLD_TICKS
+        assert recovery_actuated <= 20 and recovery_baseline <= 20
+
+        # The remedy actually ran: admissions were shed (as their own
+        # terminal status — never errors), capacity was nudged and both
+        # were reverted on recovery.
+        assert engine.shed_total > 0
+        assert engine.shed_fractions() == {}          # reverted
+        assert engine.cfg.prefill_chunk_budget == 1   # baseline restored
+        rows = await asyncio.to_thread(policy_rows)
+        assert rows["shed_load"]["fired"] >= 1
+        assert rows["shed_load"]["reverted"] >= 1
+        assert rows["grow_budget"]["fired"] >= 1
+        assert not rows["shed_load"]["dry_run"]
+
+        # Journal seq order tells the closed-loop story end to end:
+        # slo fired < both actuations fired < slo resolved < shed
+        # reverted — observation, remedy, recovery, revert.
+        slo_ev = [e for e in await asyncio.to_thread(events, "slo")
+                  if e["seq"] > seq_b and e.get("window") == "fast"]
+        act_ev = [e for e in await asyncio.to_thread(events, "actuate")
+                  if e["seq"] > seq_b]
+        page_seq = next(e["seq"] for e in slo_ev if e["state"] == "fired")
+        resolved_seq = next(
+            e["seq"] for e in slo_ev if e["state"] == "resolved")
+        shed_seq = next(e["seq"] for e in act_ev
+                        if e["policy"] == "shed_load"
+                        and e["state"] == "fired")
+        grow_seq = next(e["seq"] for e in act_ev
+                        if e["policy"] == "grow_budget"
+                        and e["state"] == "fired")
+        revert_seq = next(e["seq"] for e in act_ev
+                          if e["policy"] == "shed_load"
+                          and e["state"] == "reverted")
+        assert page_seq < shed_seq < resolved_seq < revert_seq
+        assert page_seq < grow_seq
+        # None of episode B's performed actions were dry.
+        fired_b = [e for e in act_ev if e["state"] == "fired"]
+        assert fired_b and all(not e.get("dry_run") for e in fired_b)
+        # The fired events carry the audit trail: the triggering
+        # expression and the action detail.
+        shed_fired = next(e for e in fired_b if e["policy"] == "shed_load")
+        assert shed_fired["expr"] == PAGE
+        assert "shed tenant *" in shed_fired["msg"]
+        # Chaos-slowed scrapes still landed throughout (the monitor
+        # kept seeing while it acted).
+        assert sampler.latest["serving"].ok
+
+        await server.stop()
+        await sampler.stop()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        sim.stop()
+        metrics_server.shutdown()
+        metrics_server.server_close()

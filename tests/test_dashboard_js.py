@@ -46,6 +46,7 @@ GET_ENDPOINTS = [
     ("/api/serving", ""),
     ("/api/federation", ""),
     ("/api/slo", ""),
+    ("/api/actuate", ""),
     ("/api/health", ""),
     ("/api/query", "query=topk(5,avg_over_time(chip.mxu[5m]))"),
     ("/api/trace", ""),
@@ -679,6 +680,74 @@ def test_slo_card_renders_burn_down(js):
     d2["fetchSlo"]()
     assert doc2.el("slo-tag")["textContent"] == "1 objective(s)"
     assert doc2.el("slo-tag")["style"]["color"] == ""
+
+
+def test_actuate_card_hidden_without_policies(js, payloads):
+    """No configured policies (the real server's empty payload) or a
+    down server: the Actuation card stays hidden, never throws."""
+    d, doc, net, env, surf = mkdash(js, payloads)
+    d["fetchActuate"]()
+    assert doc.el("actuate-card")["style"]["display"] == "none"
+    d2, doc2, _, _, _ = mkdash(js, {})
+    d2["fetchActuate"]()
+    assert doc2.el("actuate-card")["style"]["display"] == "none"
+
+
+ACTUATE_PAYLOAD = {
+    "policies": [
+        {"name": "shed_chat", "action": "shed",
+         "when": 'slo.paging{slo="chat_ttft"} > 0', "state": "fired",
+         "dry_run": False, "value": 1.0,
+         "last": "fired · shed tenant chat at 0.50", "last_ts": 100.0,
+         "fired": 3, "reverted": 2, "suppressed": 1, "rate_limited": 0},
+        {"name": "grow_budget", "action": "capacity",
+         "when": "avg_over_time(queue_depth[30s]) > 8", "state": "idle",
+         "dry_run": True, "value": None, "last": "", "last_ts": None,
+         "fired": 0, "reverted": 0, "suppressed": 0, "rate_limited": 0},
+    ],
+    "dry_run": False,
+    "engine_bound": True,
+    "actions_in_window": 1,
+    "evaluated_at": 1700000000.0,
+}
+
+
+def test_actuate_card_renders_policy_state(js):
+    """The Actuation card (docs/actuation.md): one row per policy with
+    condition, observed value, last journaled transition and guard
+    counters; firing policies marked and counted in the tag, dry-run
+    policies badged."""
+    d, doc, net, env, surf = mkdash(js, {"/api/actuate": ACTUATE_PAYLOAD})
+    d["fetchActuate"]()
+    assert doc.el("actuate-card")["style"]["display"] == ""
+    assert doc.el("actuate-tag")["textContent"] == "1 active · DRY-RUN"
+    assert doc.el("actuate-tag")["style"]["color"] == "var(--red)"
+    rows = doc.el("actuate-body")["_children"]
+    assert len(rows) == 2
+    hot = all_text(rows[0])
+    assert "shed_chat" in hot and "fired" in hot
+    assert 'slo.paging{slo="chat_ttft"} > 0' in hot
+    assert "shed tenant chat at 0.50" in hot
+    assert "3 / 2" in hot  # fired / reverted
+    # The fired state cell is marked hot.
+    state_td = rows[0]["_children"][2]
+    assert state_td["style"]["color"] == "var(--red)"
+    idle = all_text(rows[1])
+    assert "grow_budget (dry-run)" in idle
+    assert "–" in idle  # no observed value yet
+    # Calm state: no firing policy, neutral tag; unbound engine badged.
+    calm = {"policies": [ACTUATE_PAYLOAD["policies"][1]],
+            "engine_bound": False, "evaluated_at": 1.0}
+    d2, doc2, _, _, _ = mkdash(js, {"/api/actuate": calm})
+    d2["fetchActuate"]()
+    assert doc2.el("actuate-tag")["textContent"] == (
+        "1 policy · no engine · DRY-RUN")
+    assert doc2.el("actuate-tag")["style"]["color"] == ""
+    # The SSE realtime path renders the same card (streamData.actuate).
+    d3, doc3, _, _, _ = mkdash(js, {})
+    d3["renderActuate"](tojs(ACTUATE_PAYLOAD))
+    assert doc3.el("actuate-card")["style"]["display"] == ""
+    assert len(doc3.el("actuate-body")["_children"]) == 2
 
 
 SERVING = {

@@ -86,7 +86,8 @@ def test_realtime_schema_single_source_of_truth(js_scan):
     resolver = payload_lint.Resolver(Project(ROOT))
     shape = resolver.func_shape(payload_lint.SERVER, "realtime_payload")
     assert shape.kind == "dict" and shape.closed
-    assert set(shape.keys) == {"host", "accel", "alerts", "trace", "events"}
+    assert set(shape.keys) == {
+        "host", "accel", "alerts", "trace", "events", "actuate"}
     # Every top-level key the server pushes is rendered by the page.
     top_reads = {p[0] for r, p in js_scan.reads if r == payload_lint.REALTIME}
     assert set(shape.keys) <= top_reads

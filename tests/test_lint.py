@@ -160,6 +160,11 @@ def test_registry_checker_fires_on_fixture():
     msgs = " ".join(f.message for f in _fixture("registry_bad", only=("registry",)))
     assert "mystery_fn" in msgs and "made_up" in msgs
     assert "not_a_function" not in msgs  # rows outside ## Functions ignored
+    # Every pinned exporter prefix fires independently (an actuation
+    # gauge undocumented in docs/actuation.md is a finding even though
+    # the federation ghost already flagged the same file).
+    assert "tpumon_federation_ghost_gauge" in msgs
+    assert "tpumon_actuate_ghost_gauge" in msgs
 
 
 # ---------------------------- suppressions ----------------------------

@@ -58,6 +58,7 @@ EVENTS_DOC = "docs/events.md"
 FEDERATION_DOC = "docs/federation.md"
 QUERY_DOC = "docs/query.md"
 SLO_DOC = "docs/slo.md"
+ACTUATION_DOC = "docs/actuation.md"
 
 # journal.record("<kind>" — restricted to journal receivers so
 # RingHistory.record("cpu", ...) never matches (same contract as the
@@ -159,15 +160,22 @@ def accepted_config_keys(project: Project) -> dict[str, int]:
                     if s is not None:
                         out[s] = elt.lineno
     # The _apply_mapping specials (mapping-valued keys handled by
-    # dedicated elif branches): any string compared against ``key``.
+    # dedicated elif branches): any string compared against ``key``,
+    # including ``key in ("slos", "actuations")`` membership tuples.
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.FunctionDef) and node.name == "_apply_mapping":
             for cmp in ast.walk(node):
                 if isinstance(cmp, ast.Compare):
                     for c in cmp.comparators:
-                        s = const_str(c)
-                        if s is not None and not s.startswith("_"):
-                            out.setdefault(s, c.lineno)
+                        elts = (
+                            c.elts
+                            if isinstance(c, (ast.Tuple, ast.List, ast.Set))
+                            else [c]
+                        )
+                        for e in elts:
+                            s = const_str(e)
+                            if s is not None and not s.startswith("_"):
+                                out.setdefault(s, e.lineno)
     return out
 
 
@@ -532,17 +540,20 @@ def check(project: Project) -> list[Finding]:
                 )
             )
 
-    # --- federation / SLO exporter gauges (ISSUE 8 / 13 satellites) ---
-    # Prefix -> the doc that must carry the family's row (README.md is
-    # accepted for either): operator-facing exporter contracts may not
-    # drift from their docs.
+    # --- federation / SLO / actuation exporter gauges (ISSUE 8 / 13 /
+    # 14 satellites) --- Prefix -> the doc that must carry the family's
+    # row (README.md is accepted for any): operator-facing exporter
+    # contracts may not drift from their docs.
     fed_doc = project.file(FEDERATION_DOC)
     slo_doc = project.file(SLO_DOC)
+    act_doc = project.file(ACTUATION_DOC)
     pinned_prefixes = (
         ("tpumon_federation_", FEDERATION_DOC,
          (fed_doc.text if fed_doc else "") + readme_text),
         ("tpumon_slo_", SLO_DOC,
          (slo_doc.text if slo_doc else "") + readme_text),
+        ("tpumon_actuate_", ACTUATION_DOC,
+         (act_doc.text if act_doc else "") + readme_text),
     )
     for name, line in sorted(exporter_metric_families(project).items()):
         for prefix, doc_rel, doc_text in pinned_prefixes:

@@ -134,7 +134,7 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
     return sampler, server
 
 
-async def run(cfg: Config) -> None:
+async def run(cfg: Config, loadgen_engine=None) -> None:
     sampler, server = build(cfg)
     journal = sampler.journal
     # Event-journal persistence restores FIRST: the state snapshot's
@@ -179,6 +179,17 @@ async def run(cfg: Config) -> None:
             f"restored monitor state from {cfg.state_path}",
             path=cfg.state_path,
         )
+    # Close the loop (tpumon.actuate, docs/actuation.md): when this
+    # process runs the serving loadgen AND actuation policies are
+    # configured, bind the in-process engine behind the narrow actuator
+    # interface — shed/capacity/drain actions drive it directly. With
+    # no engine in-process the policies still evaluate and journal
+    # intent (dry-run semantics), so a misdeclared deployment is
+    # visible, not silent. AFTER the restores: bind_engine journals,
+    # and a fresh record before them would consume a seq a restored
+    # event may carry, which ingest's dedup-by-seq would silently drop.
+    if loadgen_engine is not None and sampler.actuate is not None:
+        sampler.actuate.bind_engine(loadgen_engine)
     snapshotter = None
     if cfg.history_snapshot_path:
         from tpumon.history import HistorySnapshotter
@@ -457,6 +468,15 @@ def main(argv: list[str] | None = None) -> int:
             # "window":"30d"}]' — config files take the same objects
             # under the `slos` key.
             overrides["slos"] = take(arg)
+        elif arg == "--actuations":
+            # Actuation policies as a JSON list (tpumon.actuate,
+            # docs/actuation.md): '[{"name":"shed-chat","when":"...",
+            # "action":"shed","tenant":"chat","fraction":0.25}]' —
+            # config files take the same objects under `actuations`.
+            overrides["actuations"] = take(arg)
+        elif arg == "--actuate-dry-run":
+            # Every policy journals intent without driving the engine.
+            overrides["actuate_dry_run"] = "1"
         elif arg == "--tls-cert":
             # Server-side TLS: PEM cert chain terminating HTTPS on the
             # listener (tls_key defaults to the same file).
@@ -487,6 +507,7 @@ def main(argv: list[str] | None = None) -> int:
                 "[--wire-binary on|off] [--ingest-kernel on|off] "
                 "[--recording-rules chip.mxu[5m],...] "
                 "[--slos JSON] "
+                "[--actuations JSON] [--actuate-dry-run] "
                 "[--tls-cert CERT.pem] [--tls-key KEY.pem] "
                 "[--trace-ring N] "
                 "[--events-ring N] [--events-log FILE] "
@@ -510,6 +531,7 @@ def main(argv: list[str] | None = None) -> int:
             return 2
     cfg = load_config(path=path, overrides=overrides)
     loadgen_stop = None
+    loadgen_engine = None
     if serve_loadgen:
         # Start only once the config is known-good, and *append* to the
         # resolved target list so file/env-configured serving targets
@@ -525,7 +547,7 @@ def main(argv: list[str] | None = None) -> int:
             )
             return 2
         try:
-            _, url, loadgen_stop = start_background(
+            loadgen_engine, url, loadgen_stop = start_background(
                 ckpt_dir=loadgen_ckpt, quantize=loadgen_quant,
                 spec_len=loadgen_spec, prefix_cache=loadgen_prefix,
                 kv_layout=loadgen_kv, pool_pages=loadgen_pool,
@@ -548,7 +570,7 @@ def main(argv: list[str] | None = None) -> int:
             collectors=collectors,
         )
     try:
-        asyncio.run(run(cfg))
+        asyncio.run(run(cfg, loadgen_engine=loadgen_engine))
     finally:
         if loadgen_stop is not None:
             loadgen_stop.set()  # drains the arrival loop, closes /metrics

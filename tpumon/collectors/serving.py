@@ -219,6 +219,7 @@ def distill_serving_metrics(
         ("tpumon_serving_tenant_requests", "requests_total"),
         ("tpumon_serving_tenant_completed", "completed_total"),
         ("tpumon_serving_tenant_rejected", "rejected_total"),
+        ("tpumon_serving_tenant_shed", "shed_total"),
     ):
         for candidate in (metric, metric + "_total"):
             for s in by_name.get(candidate, ()):
@@ -241,9 +242,18 @@ def distill_serving_metrics(
                 dreq = row["requests_total"] - was["requests_total"]
                 drej = (row.get("rejected_total", 0)
                         - was.get("rejected_total", 0))
-                if dreq > 0 and 0 <= drej <= dreq:
-                    row["error_rate"] = drej / dreq
-                elif dreq == 0 and drej == 0:
+                # Sheds leave BOTH sides of the error-rate fraction
+                # (tpumon.actuate): a shed is the remedy for an SLO
+                # burn — counting it as an error would re-fire the
+                # very SLO that triggered the shed, and leaving it in
+                # the denominator would dilute the real error rate of
+                # the traffic that actually ran.
+                dshed = (row.get("shed_total", 0)
+                         - was.get("shed_total", 0))
+                deff = dreq - max(0, dshed)
+                if deff > 0 and 0 <= drej <= deff:
+                    row["error_rate"] = drej / deff
+                elif deff == 0 and drej == 0:
                     # Idle window: no submissions, nothing erred.
                     row["error_rate"] = 0.0
         out["tenants"] = tenants

@@ -297,6 +297,28 @@ class Config:
     # is JSON (TPUMON_SLOS='[{"name": ...}]').
     slos: tuple = ()
 
+    # --- SLO-driven actuation (tpumon.actuate; docs/actuation.md) ---
+    # Each entry: {"name", "when", "action": "shed"|"capacity"|"drain",
+    # per-action params, "clear"?, "cooldown_s"?, "fire_hold"?,
+    # "clear_hold"?, "dry_run"?}. ``when`` is a query-language
+    # condition (like the SLO bad-event expressions); the engine
+    # evaluates every policy once per fast tick and drives the bound
+    # actuator through journaled, guarded transitions. As an env/CLI
+    # value the list is JSON (TPUMON_ACTUATIONS='[{"name": ...}]').
+    actuations: tuple = ()
+    # Global dry-run: every policy journals intent without acting
+    # (per-policy "dry_run" does the same for one policy).
+    actuate_dry_run: bool = False
+    # Global guard: at most this many performed actions per
+    # actuate_window_s across ALL policies — a misconfigured policy set
+    # cannot thrash the serving engine. Reverts are never rate-limited.
+    actuate_max_actions: int = 10
+    actuate_window_s: float = 60.0
+    # Hard cap any shed policy's fraction is clamped to — a
+    # misconfigured policy can never shed a whole tenant (the serving
+    # engine holds its own last-resort ceiling on top).
+    shed_max_fraction: float = 0.5
+
     # --- SSE delta stream (tpumon.server, docs/perf.md) ---
     # The /api/stream push emits delta frames (only changed fields,
     # keyed by snapshot epoch); a full keyframe recurs every this many
@@ -392,6 +414,10 @@ _SCALAR_FIELDS: dict[str, type] = {
     "ingest_kernel": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "query_fleet_timeout_s": float,
     "sse_keyframe_every": int,
+    "actuate_dry_run": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
+    "actuate_max_actions": int,
+    "actuate_window_s": float,
+    "shed_max_fraction": float,
     "webhook_min_severity": str,
     "webhook_timeout_s": float,
     "access_log": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
@@ -460,16 +486,18 @@ def _apply_mapping(cfg_kw: dict[str, Any], raw: Mapping[str, Any]) -> None:
             cfg_kw[key] = {str(k): int(v) for k, v in value.items()}
         elif key == "collect_deadlines":
             cfg_kw[key] = {str(k): float(v) for k, v in value.items()}
-        elif key == "slos":
-            # SLO objectives (tpumon.slo, docs/slo.md): a list of
+        elif key in ("slos", "actuations"):
+            # SLO objectives (tpumon.slo, docs/slo.md) and actuation
+            # policies (tpumon.actuate, docs/actuation.md): lists of
             # objects in config files; env/CLI pass the list as JSON.
-            # Structural validation happens in slo.parse_slos at
-            # startup (per-entry, journaled) — here we only coerce.
+            # Structural validation happens in slo.parse_slos /
+            # actuate.parse_actuations at startup (per-entry,
+            # journaled) — here we only coerce.
             if isinstance(value, str):
                 value = json.loads(value) if value.strip() else []
             if not isinstance(value, (list, tuple)):
                 raise ValueError(
-                    f"slos: want a list of objective objects, got {value!r}")
+                    f"{key}: want a list of objects, got {value!r}")
             cfg_kw[key] = tuple(value)
         elif key == "thresholds":
             cfg_kw["_thresholds_raw"] = value

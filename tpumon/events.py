@@ -50,6 +50,9 @@ from collections import deque
 # README.md's event table and docs/events.md (tests/test_events_doc.py
 # enforces both directions).
 KINDS = (
+    "actuate",    # actuation engine: policy armed / fired / reverted /
+                  # suppressed / rate-limited, actuator bound
+                  # (tpumon.actuate)
     "alert",      # alert engine: fired / resolved (tpumon.alerts)
     "anomaly",    # EWMA detector fired / cleared (tpumon.anomaly)
     "breaker",    # circuit-breaker state transition (tpumon.sampler)

@@ -502,6 +502,63 @@ def _render_slo(sampler: Sampler) -> str:
     return w.render()
 
 
+def _render_actuate(sampler: Sampler) -> str:
+    """Actuation block (tpumon.actuate, docs/actuation.md): per-policy
+    state machine position + lifetime transition counters, plus the
+    engine's global guard state — what an operator graphs to answer
+    "is the monitor acting, and how often is the rate limit biting".
+    Absent entirely when no policies are configured. Family names are
+    documented in docs/actuation.md's metrics table, which the tpulint
+    registry pass pins."""
+    actuate = getattr(sampler, "actuate", None)
+    if actuate is None:
+        return ""
+    rows = actuate.exporter_rows()
+    if not rows:
+        return ""
+    w = MetricsWriter()
+    state = w.gauge(
+        "tpumon_actuate_policy_state",
+        "Policy state machine position (0=idle, 1=armed, 2=fired)",
+    )
+    dry = w.gauge(
+        "tpumon_actuate_policy_dry_run",
+        "1 when the policy journals intent without acting",
+    )
+    fired = w.counter(
+        "tpumon_actuate_fired_total", "Actions performed (or, dry-run, "
+        "intended) per policy",
+    )
+    reverted = w.counter(
+        "tpumon_actuate_reverted_total",
+        "Automatic reverts after the triggering condition cleared",
+    )
+    suppressed = w.counter(
+        "tpumon_actuate_suppressed_total",
+        "Fire attempts suppressed by the per-policy cooldown",
+    )
+    limited = w.counter(
+        "tpumon_actuate_rate_limited_total",
+        "Fire attempts refused by the global actions-per-window limit",
+    )
+    for row in rows:
+        labels = {"policy": row["name"], "action": row["action"]}
+        state.add(labels,
+                  {"idle": 0.0, "armed": 1.0, "fired": 2.0}[row["state"]])
+        dry.add(labels, 1.0 if row["dry_run"] else 0.0)
+        fired.add(labels, row["fired"])
+        reverted.add(labels, row["reverted"])
+        suppressed.add(labels, row["suppressed"])
+        limited.add(labels, row["rate_limited"])
+    g = w.gauge(
+        "tpumon_actuate_actions_in_window",
+        "Performed actions inside the current rate-limit window "
+        "(at max_actions the engine refuses new fires)",
+    )
+    g.add({}, actuate.actions_in_window)
+    return w.render()
+
+
 def _render_events(sampler: Sampler) -> str:
     """Event journal + anomaly detector block (tpumon.events /
     tpumon.anomaly): lifetime per-(kind, severity) event counters —
@@ -547,6 +604,8 @@ EXPORTER_SECTIONS: tuple[tuple[str, tuple[str, ...]], ...] = (
     ("trace", ("samples",)),
     # SLO budget/burn gauges move only when the published SLO view does.
     ("slo", ("slo",)),
+    # Actuation policy gauges move only when a policy row does.
+    ("actuate", ("actuate",)),
     # Journal counters + anomaly gauges move only when the journal does.
     ("events", ("events",)),
     # Aggregator-tree gauges: "federation" moves as downstream frames
@@ -562,6 +621,7 @@ _RENDERERS = {
     "serving": _render_serving,
     "self": _render_self,
     "slo": _render_slo,
+    "actuate": _render_actuate,
     "events": _render_events,
     "federation": _render_federation,
 }

@@ -46,7 +46,7 @@ import sys
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from functools import partial
 
 import jax
@@ -61,6 +61,12 @@ from tpumon.metrics_text import MetricsWriter
 TTFT_BUCKETS_S = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
+
+# Last-resort ceiling on any per-tenant admission-shed fraction
+# (set_shed clamps to it): whatever the actuation layer is configured
+# to, the engine itself can never be told to shed a whole tenant —
+# some live traffic always survives to prove recovery.
+SHED_CAP = 0.95
 
 
 @dataclass(frozen=True)
@@ -530,6 +536,24 @@ class Request:
     # tenant's regression from a batch tenant's backlog. "" = untagged
     # (every pre-tenant caller), excluded from per-tenant metrics.
     tenant: str = ""
+    # Terminal status, set exactly once when the request leaves the
+    # engine: "completed" | "rejected" | "cancelled" | "shed" ("" while
+    # in flight). ``shed`` is the actuation layer's admission shed
+    # (tpumon.actuate) — a deliberate remedial drop that must never be
+    # distilled into a tenant's error rate (the error rate is what
+    # triggered the shed; counting sheds there would latch the SLO).
+    status: str = ""
+    # dp-replica placement domain this request was attributed to at
+    # slot assignment (engine.slices round-robin); None untracked.
+    slice: str | None = None
+    # Drain-and-requeue accounting: how many times a slice drain
+    # aborted this request mid-flight and re-admitted it.
+    requeues: int = 0
+    # Stream tokens already delivered before a requeue: the re-run
+    # regenerates a bit-identical prefix (sampling is keyed per
+    # (rid, token index) — docs/perf.md scheduler section), which must
+    # not reach the consumer's stream twice.
+    _replay_n: int = 0
     ttft_s: float | None = None
     first_tok_t: float | None = None  # monotonic at first emit (TPOT)
     output: list[int] = field(default_factory=list)
@@ -550,9 +574,12 @@ class Request:
         self.cancelled.set()
 
     def emit(self, tokens: list[int]) -> None:
-        self.output.extend(tokens)
-        if self.stream is not None:
-            for t in tokens:
+        for t in tokens:
+            self.output.append(t)
+            # Replay suppression after a drain-requeue: the rebuilt
+            # prefix is bit-identical (keyed sampling), so only tokens
+            # past the already-delivered count reach the stream.
+            if self.stream is not None and len(self.output) > self._replay_n:
                 self.stream.put(t)
 
     def hit_stop(self) -> bool:
@@ -579,6 +606,11 @@ class _TenantStats:
     completed: int = 0
     rejected: int = 0
     cancelled: int = 0
+    # Admission sheds (tpumon.actuate): a distinct terminal status —
+    # NOT rejections — so the collector's error-rate distillation can
+    # exclude them (a shed is the remedy for an error-rate SLO burn;
+    # counting it as an error would re-fire the very SLO that shed).
+    shed: int = 0
     tokens: int = 0
     ttft: deque = field(default_factory=lambda: deque(maxlen=512))
     tpot: deque = field(default_factory=lambda: deque(maxlen=512))
@@ -1035,6 +1067,24 @@ class ServingEngine:
         # quantile gauges are computed over.
         self.tenants: dict[str, _TenantStats] = {}
         self.tenant_window_s = 60.0
+        # --- actuation surface (tpumon.actuate, docs/actuation.md) ---
+        # Per-tenant admission-shed fractions ("*" = every request) and
+        # the deterministic pacing accumulators behind them (fraction
+        # 0.5 sheds exactly every 2nd submission — reproducible, no
+        # RNG), both guarded by _lock. shed_total/requeued_total feed
+        # the tpumon_serving_requests_{shed,requeued} counters.
+        self._shed: dict[str, float] = {}
+        self._shed_acc: dict[str, float] = {}
+        self.shed_total = 0
+        self.requeued_total = 0
+        # dp-replica placement domains (set_slices): admitted requests
+        # are attributed round-robin; drain_slice marks a domain
+        # drained — its in-flight requests abort-and-requeue at the
+        # next step (the sweep runs on the step thread, like request
+        # cancellation) and new placements avoid it until undrained.
+        self.slices: tuple[str, ...] = ()
+        self._slice_rr = 0
+        self._drained: set[str] = set()
         # Optional tpumon.loadgen.report.WorkloadReporter: when attached,
         # step() time counts as declared device activity (source:
         # workload in the monitor's counter chain).
@@ -1211,6 +1261,29 @@ class ServingEngine:
             tst = self._tenant_locked(req)
             if tst is not None:
                 tst.submitted += 1
+            # Actuation shed (tpumon.actuate): a per-tenant admission
+            # throttle. Deterministic pacing — the fraction accumulates
+            # and sheds on overflow, so fraction f drops exactly
+            # round(n*f) of n submissions, reproducibly. A shed is its
+            # own terminal status, never a rejection (error-rate math).
+            frac = (
+                self._shed[req.tenant]
+                if req.tenant in self._shed
+                else self._shed.get("*", 0.0)
+            )
+            if frac > 0.0:
+                acc = self._shed_acc.get(req.tenant, 0.0) + frac
+                if acc >= 1.0:
+                    acc -= 1.0
+                    self._shed_acc[req.tenant] = acc
+                    self.shed_total += 1
+                    if tst is not None:
+                        tst.shed += 1
+                    req.status = "shed"
+                    req.finish_stream()
+                    req.done.set()
+                    return req
+                self._shed_acc[req.tenant] = acc
             if len(self._queue) >= self.max_queue or infeasible:
                 # Queue full, or (paged) the reservation can never be
                 # satisfied by the whole pool — rejecting beats wedging
@@ -1218,12 +1291,107 @@ class ServingEngine:
                 self.rejected_total += 1
                 if tst is not None:
                     tst.rejected += 1
+                req.status = "rejected"
                 req.finish_stream()
                 req.done.set()
                 return req
             self._queue.append(req)
             self.requests_total += 1
         return req
+
+    # -- actuation surface (tpumon.actuate, docs/actuation.md) --------------
+
+    def set_shed(self, tenant: str, fraction: float) -> float:
+        """Set the admission-shed fraction for ``tenant`` ("*" = every
+        tenant without its own entry); <= 0 removes the throttle.
+        Clamped to SHED_CAP — whatever the actuation layer asks for,
+        some live traffic always survives to prove recovery. Returns
+        the effective fraction."""
+        frac = min(float(fraction), SHED_CAP)
+        with self._lock:
+            if frac <= 0.0:
+                self._shed.pop(tenant, None)
+                if tenant == "*":
+                    # "*"-paced tenants accumulate under their OWN
+                    # names: drop every accumulator not owned by a
+                    # tenant-specific throttle, so the next episode
+                    # starts at a fresh accumulator (deterministic
+                    # pacing is per-episode) and nothing leaks.
+                    for t in [t for t in self._shed_acc
+                              if t not in self._shed]:
+                        self._shed_acc.pop(t, None)
+                else:
+                    self._shed_acc.pop(tenant, None)
+                return 0.0
+            self._shed[tenant] = frac
+            return frac
+
+    def shed_fractions(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._shed)
+
+    def nudge_capacity(self, prefill_budget: int | None = None,
+                       admit_lookahead: int | None = None) -> dict:
+        """Adjust the scheduler knobs live (the capacity-nudge action):
+        prefill chunk dispatches per step and — paged engines only —
+        the admission lookahead window. Safe to swap mid-flight: the
+        jitted kernels closed over the ORIGINAL ServeConfig (the knobs
+        never reach a trace), and both fields are read fresh each step.
+        Returns the effective values, the actuator's revert baseline."""
+        kw = {}
+        if prefill_budget is not None:
+            kw["prefill_chunk_budget"] = max(1, int(prefill_budget))
+        if admit_lookahead is not None and self.paged:
+            kw["admit_lookahead"] = max(0, int(admit_lookahead))
+        if kw:
+            self.cfg = dc_replace(self.cfg, **kw)
+        return {"prefill_budget": self.cfg.prefill_chunk_budget,
+                "admit_lookahead": self.cfg.admit_lookahead}
+
+    def set_slices(self, names) -> None:
+        """Declare the dp-replica placement domains requests are
+        attributed to (round-robin at slot assignment). Renaming drops
+        drain marks for domains that no longer exist."""
+        with self._lock:
+            self.slices = tuple(str(n) for n in names)
+            self._slice_rr = 0
+            self._drained &= set(self.slices)
+
+    def drain_slice(self, name: str) -> None:
+        """Mark a placement domain drained: its in-flight requests
+        abort-and-requeue at the next step (the sweep runs on the step
+        thread, like request cancellation — docs/actuation.md), and new
+        placements avoid it until ``undrain_slice``."""
+        with self._lock:
+            self._drained.add(str(name))
+
+    def undrain_slice(self, name: str) -> None:
+        with self._lock:
+            self._drained.discard(str(name))
+
+    def drained_slices(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._drained))
+
+    def _requeue_slot(self, slot: int) -> None:
+        """Drain-and-requeue one slot: abort the request mid-flight,
+        free its slot (and paged pages) and re-admit it at the queue
+        HEAD, so the recompute — prefix-cheap when the prompt is in the
+        prefix cache — starts ahead of fresh arrivals. The re-run
+        regenerates a bit-identical token prefix (sampling is keyed per
+        (rid, token index)); ``_replay_n`` keeps already-delivered
+        stream tokens from reaching the consumer twice."""
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._prefill_work[slot] = None
+        self._release_slot_pages(slot)
+        req.slice = None
+        req.requeues += 1
+        req._replay_n = max(req._replay_n, len(req.output))
+        req.output = []
+        with self._lock:
+            self.requeued_total += 1
+            self._queue.appendleft(req)
 
     # -- engine loop --------------------------------------------------------
 
@@ -1257,6 +1425,7 @@ class ServingEngine:
                 tst = self._tenant_locked(r)
                 if tst is not None:
                     tst.cancelled += 1
+                r.status = "cancelled"
                 r.finish_stream()
                 r.done.set()
             else:
@@ -1366,6 +1535,17 @@ class ServingEngine:
         garbage-write parking of the slot's position."""
         n = len(req.prompt)
         p = self.cfg.prefill_len
+        # Placement-domain attribution (tpumon.actuate drain-and-
+        # requeue): round-robin over the non-drained domains; when
+        # every domain is drained, placement proceeds anyway (refusing
+        # admission would wedge the queue) and the per-step drain
+        # sweep re-homes the request as soon as any domain is
+        # undrained while the mark persists.
+        if self.slices and req.slice is None:
+            avail = [s for s in self.slices if s not in self._drained]
+            pool = avail or list(self.slices)
+            req.slice = pool[self._slice_rr % len(pool)]
+            self._slice_rr += 1
         work = _PrefillWork(req=req, n=n, next_c0=shared_n * p,
                             pages=pages, shared_n=shared_n)
         if self.paged:
@@ -1501,12 +1681,16 @@ class ServingEngine:
             jnp.full((1,), req.top_k, jnp.int32))[0])
         now = time.monotonic()
         with self._lock:
-            req.ttft_s = now - req.enqueued
-            req.first_tok_t = now
-            self._observe_ttft(req.ttft_s)
-            tst = self._tenant_locked(req)
-            if tst is not None:
-                tst.ttft.append((now, req.ttft_s))
+            # A drain-requeued re-run replays its first token: its TTFT
+            # was observed on the ORIGINAL admission and must not be
+            # counted (or re-timed) again.
+            if req.ttft_s is None:
+                req.ttft_s = now - req.enqueued
+                req.first_tok_t = now
+                self._observe_ttft(req.ttft_s)
+                tst = self._tenant_locked(req)
+                if tst is not None:
+                    tst.ttft.append((now, req.ttft_s))
             req.emit([first])
             self.tokens_total += 1
         self._slots[slot] = req
@@ -1536,6 +1720,7 @@ class ServingEngine:
         assert req is not None
         self._slots[slot] = None
         self._release_slot_pages(slot)
+        req.status = "completed"
         with self._lock:
             self.completed_total += 1
             tst = self._tenant_locked(req)
@@ -1559,6 +1744,7 @@ class ServingEngine:
         self._slots[slot] = None
         self._prefill_work[slot] = None
         self._release_slot_pages(slot)
+        req.status = "cancelled"
         with self._lock:
             self.cancelled_total += 1
             tst = self._tenant_locked(req)
@@ -1588,6 +1774,24 @@ class ServingEngine:
                     self._abort_prefill(slot)
                 else:
                     self._complete(slot)
+        # Drain sweep (tpumon.actuate): requests attributed to a domain
+        # marked drained abort-and-requeue — same step-thread seam as
+        # cancellation. The sweep runs EVERY step while marks persist,
+        # so a request the all-drained placement fallback parked on a
+        # drained domain re-homes as soon as any domain is undrained.
+        # With no un-drained domain to requeue TO, nothing is swept
+        # (a requeue would just be re-parked: an abort/re-prefill
+        # thrash loop that never completes) — liveness beats placement
+        # purity, matching the fallback's contract.
+        if self._drained:
+            with self._lock:
+                drained = set(self._drained)
+                has_home = any(s not in drained for s in self.slices)
+            if drained and has_home:
+                for slot in range(self.cfg.slots):
+                    req = self._slots[slot]
+                    if req is not None and req.slice in drained:
+                        self._requeue_slot(slot)
         self._prefill_tick()
         # Decode batch: slots still mid-prefill are excluded (their
         # first token doesn't exist yet; the batched dispatch computes
@@ -1912,6 +2116,8 @@ class ServingEngine:
             queue = len(self._queue)
             rejected = self.rejected_total
             cancelled = self.cancelled_total
+            shed = self.shed_total
+            requeued = self.requeued_total
             counts = list(self._ttft_counts)
             inf = self._ttft_inf
             ttft_sum = self._ttft_sum
@@ -1929,7 +2135,7 @@ class ServingEngine:
                 (
                     name,
                     st.submitted, st.completed, st.rejected,
-                    st.cancelled, st.tokens,
+                    st.cancelled, st.shed, st.tokens,
                     st.recent(st.ttft, tw, now_mono),
                     st.recent(st.tpot, tw, now_mono),
                 )
@@ -1950,6 +2156,14 @@ class ServingEngine:
                   "requests cancelled before their first token "
                   "(while queued or mid-prefill)"
                   ).add(value=cancelled)
+        w.counter("tpumon_serving_requests_shed",
+                  "requests shed at admission by the actuation layer "
+                  "(tpumon.actuate; a remedial drop, never an error)"
+                  ).add(value=shed)
+        w.counter("tpumon_serving_requests_requeued",
+                  "in-flight requests aborted and re-admitted by a "
+                  "slice drain (tpumon.actuate)"
+                  ).add(value=requeued)
         w.counter("tpumon_serving_decode_steps", "fused decode steps"
                   ).add(value=steps)
         w.gauge("jetstream_queue_size", "requests waiting for a slot"
@@ -1990,6 +2204,10 @@ class ServingEngine:
                             "requests dropped by backpressure per tenant")
             canc = w.counter("tpumon_serving_tenant_cancelled",
                              "requests cancelled per tenant")
+            shd = w.counter("tpumon_serving_tenant_shed",
+                            "requests shed at admission per tenant "
+                            "(excluded from error-rate math — a shed "
+                            "is the remedy, not the fault)")
             toks = w.counter("tpumon_serving_tenant_tokens",
                              "tokens emitted per tenant")
             tg: dict[str, object] = {}
@@ -1999,12 +2217,13 @@ class ServingEngine:
                         "tpumon_serving_tenant_tpot_p95_ms"):
                 tg[fam] = w.gauge(
                     fam, "recent-window per-tenant latency quantile")
-            for (name, sub, done, rj, cn, tk, ttfts, tpots) in tenant_rows:
+            for (name, sub, done, rj, cn, sh, tk, ttfts, tpots) in tenant_rows:
                 labels = {"tenant": name}
                 reqs.add(labels, sub)
                 comp.add(labels, done)
                 rej.add(labels, rj)
                 canc.add(labels, cn)
+                shd.add(labels, sh)
                 toks.add(labels, tk)
                 for fam_base, series in (
                     ("tpumon_serving_tenant_ttft", ttfts),

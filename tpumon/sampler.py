@@ -22,6 +22,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from tpumon import tsdb
+from tpumon.actuate import ActuationEngine, parse_actuations
 from tpumon.alerts import AlertEngine
 from tpumon.anomaly import AnomalyBank, AnomalyConfig
 from tpumon.collectors import Collector, Sample, run_collector
@@ -253,6 +254,37 @@ class Sampler:
             # which is what holds slo_eval_overhead_tick_pct ≤ 2%.
             for text in self.slo.rule_texts():
                 rules.append(RecordingRule(text))
+        # Actuation engine (tpumon.actuate, docs/actuation.md): guarded
+        # policies over the same compiled-expression machinery, driving
+        # a bound serving engine (tpumon.app wires --serve-loadgen;
+        # unbound = journal-intent-only). A rejected policy is an
+        # incident — the operator declared a remedy that will never run.
+        self.actuate: ActuationEngine | None = None
+        act_specs, act_errors = parse_actuations(cfg.actuations)
+        for err in act_errors:
+            self.journal.record(
+                "actuate", "serious", "actuate",
+                f"actuation policy rejected: {err}",
+            )
+        if act_specs:
+            self.actuate = ActuationEngine(
+                act_specs, self.query, self.history, self.journal,
+                dark_slices=self._dark_slices,
+                placement_domains=self._placement_domains,
+                dry_run=cfg.actuate_dry_run,
+                max_actions=cfg.actuate_max_actions,
+                window_s=cfg.actuate_window_s,
+                shed_max_fraction=cfg.shed_max_fraction,
+            )
+            # Trend conditions (avg_over_time(queue_depth[w])) ride the
+            # recording-rule store like the SLO windows — bench.py's
+            # ``actuate`` phase pins the ≤1% tick bound this buys.
+            for text in self.actuate.rule_texts():
+                rules.append(RecordingRule(text))
+            if self.slo is not None:
+                # slo.<name>.paging is recorded for actuation
+                # conditions only — see SLOEngine.record_paging.
+                self.slo.record_paging = True
         if rules:
             self.history.set_recording_rules(RuleSet(rules))
         # Chaos wrappers and peer federations record their own journal
@@ -261,6 +293,39 @@ class Sampler:
         for c in (host, accel, k8s, serving):
             if c is not None and hasattr(c, "set_journal"):
                 c.set_journal(self.journal)
+
+    def _dark_slices(self) -> list[str] | None:
+        """Placement domains the federation tree currently marks
+        dark/unreachable — the drain policies' trigger input (recorded
+        as the ``federation.dark`` series each actuation tick). None on
+        a standalone monitor (no hub): the actuation engine then skips
+        the per-tick series record entirely — a monitor with no fleet
+        must not pay for (or fake) a fleet series on every tick."""
+        hub = self.federation
+        if hub is None:
+            return None
+        return sorted({
+            str(r.get("slice_id"))
+            for r in hub.slices()
+            if r.get("slice_id") and r.get("health") != "ok"
+        })
+
+    def _placement_domains(self) -> list[str] | None:
+        """ALL fleet placement domains — dark or not — the actuation
+        engine syncs into the serving engine (set_slices) so requests
+        carry a slice attribution before any drain fires. Federated:
+        the hub's slice namespace (the same names `_dark_slices`
+        reports, so drain targets always match). Standalone: the local
+        accel topology's slice ids. None/[] = nothing known yet (the
+        engine keeps its last synced namespace)."""
+        hub = self.federation
+        if hub is not None:
+            return sorted({
+                str(r.get("slice_id"))
+                for r in hub.slices()
+                if r.get("slice_id")
+            })
+        return sorted({v.slice_id for v in self.slices() if v.slice_id})
 
     def _query_augmenter(self):
         """Per-evaluation label hook for the query engine: chip-family
@@ -367,6 +432,24 @@ class Sampler:
                     }
                 }
                 if self.slo is not None
+                else {}
+            ),
+            # Actuation engine summary (tpumon.actuate): policy count +
+            # which policies currently hold a fired action; the full
+            # state table lives on /api/actuate.
+            **(
+                {
+                    "actuate": {
+                        "policies": len(self.actuate.policies),
+                        "dry_run": self.actuate.dry_run,
+                        "engine_bound": self.actuate.actuator is not None,
+                        "fired": [
+                            p.spec.name for p in self.actuate.policies
+                            if p.state == "fired"
+                        ],
+                    }
+                }
+                if self.actuate is not None
                 else {}
             ),
             # Aggregator-tree health (tpumon.federation): downstream
@@ -925,6 +1008,14 @@ class Sampler:
                 with tr.span("slo"):
                     if self.slo.observe(ts):
                         self.clock.bump("slo")
+            # Actuation AFTER the SLO engine (its page-state series is
+            # this tick's — a policy keyed on it acts the same tick the
+            # page fires) and before alerts/events so every transition
+            # it journals publishes this tick.
+            if self.actuate is not None:
+                with tr.span("actuate"):
+                    if self.actuate.observe(ts):
+                        self.clock.bump("actuate")
             with tr.span("alerts"):
                 self._evaluate_alerts()
             # Journal publish: everything the tick recorded (breaker

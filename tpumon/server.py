@@ -36,6 +36,11 @@ Route map (SURVEY §2.3, re-keyed for TPU):
                         per-objective error-budget remaining and
                         multi-window burn rates with firing state —
                         empty "slos" list when none are configured
+  /api/actuate          actuation engine (tpumon.actuate,
+                        docs/actuation.md): per-policy state
+                        (idle/armed/fired), guard counters, dry-run
+                        flags and the last journaled transition —
+                        empty "policies" list when none are configured
   /api/silence          POST {"key": <prefix>, "duration": "1h"} mutes
                         matching alerts (buckets + webhooks; timeline
                         still records); /api/unsilence removes a mute
@@ -122,7 +127,9 @@ WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 # so the per-tick trace timeline the payload carries refreshes even
 # when no data section moved; with tracing off the payload has no
 # per-tick content, so unchanged data must keep producing heartbeats.
-RT_SECTIONS = ("host", "accel", "k8s", "alerts", "events")
+# "actuate" rides the same way: a policy firing reaches the
+# dashboard's Actuation card as a delta frame on the very next tick.
+RT_SECTIONS = ("host", "accel", "k8s", "alerts", "events", "actuate")
 
 
 def parse_query(query: str) -> dict[str, str]:
@@ -233,6 +240,12 @@ class MonitorServer:
             # bytes between changes. Renders {"slos": []} once and
             # caches forever when no objectives are configured.
             "/api/slo": (("slo",), self._api_slo),
+            # Actuation engine (tpumon.actuate, docs/actuation.md):
+            # "actuate" bumps only when a policy's published state/
+            # value/last-transition row moved. Renders
+            # {"policies": []} once and caches forever when no
+            # policies are configured.
+            "/api/actuate": (("actuate",), self._api_actuate),
         }
         # SSE epoch sections (see RT_SECTIONS): the trace strip rides
         # the payload only when tracing is on, and only then may the
@@ -374,6 +387,16 @@ class MonitorServer:
         if slo is None:
             return {"slos": [], "evaluated_at": None}
         return slo.to_json()
+
+    def _api_actuate(self) -> dict:
+        """Actuation engine (tpumon.actuate): per-policy state machine
+        rows, guard counters and the last journaled transition; an
+        empty policy list when none configured (the route always
+        answers — the lint's liveness contract)."""
+        actuate = self.sampler.actuate
+        if actuate is None:
+            return {"policies": [], "evaluated_at": None}
+        return actuate.to_json()
 
     def _api_trace(self) -> dict:
         """Self-trace view: ring stats, per-stage p50/p95/max, per-route
@@ -562,6 +585,10 @@ class MonitorServer:
                 "seq": self.sampler.journal.seq,
                 "recent": self.sampler.journal.recent(20),
             },
+            # Actuation card (tpumon.actuate): the full /api/actuate
+            # body — small (a row per policy) and delta-friendly (rows
+            # only change on state/value transitions).
+            "actuate": self._api_actuate(),
         }
 
     # ------------------------------ SSE stream -----------------------------

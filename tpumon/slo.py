@@ -225,6 +225,14 @@ class _Compiled:
         self.stale_s = min(spec.fast[0], spec.slow[0])
         self.series = f"slo.{spec.name}.bad"
         self.handle = None  # resolved lazily against the live ring
+        # Page-state series (the actuation engine's trigger,
+        # docs/actuation.md): slo.<name>.paging is 1.0 while the FAST
+        # window pair burns (the paging pair — slow tickets don't
+        # actuate), 0.0 otherwise, recorded every tick so a policy
+        # condition like ``slo.paging{slo="x"} > 0`` reads live state
+        # rather than the alert engine's internals.
+        self.page_series = f"slo.{spec.name}.paging"
+        self.page_handle = None
         sel = f'slo.bad{{slo="{spec.name}"}}'
         self.window_nodes = {
             speed: tuple(
@@ -285,6 +293,12 @@ class SLOEngine:
         self.history = history
         self.journal = journal
         self.compiled = [_Compiled(s) for s in specs]
+        # The slo.<name>.paging series exists FOR actuation conditions
+        # (docs/actuation.md); the sampler flips this on when policies
+        # are configured — a monitor with SLOs but no actuations must
+        # not pay a per-objective TSDB append every tick for a series
+        # nothing reads.
+        self.record_paging = False
         self.evaluated_at: float | None = None
         self._payload: dict | None = None
 
@@ -451,6 +465,22 @@ class SLOEngine:
                     "budget": c.budget,
                     "burn": c.burn,
                 }
+        # Page-state series AFTER the burn state machine so the value
+        # reflects THIS tick's verdict (recording it with the bad batch
+        # above would lag the fire/clear by one tick — an actuation
+        # policy keyed on it would shed one tick late, and keep
+        # shedding one tick past recovery).
+        page_batch = []
+        if self.record_paging:
+            for c in self.compiled:
+                if c.page_handle is None or (
+                        self.history.series.get(c.page_series)
+                        is not c.page_handle):
+                    c.page_handle = self.history.handle(c.page_series)
+                page_batch.append(
+                    (c.page_handle, 1.0 if c.firing["fast"] else 0.0))
+        if page_batch:
+            self.history.record_batch(page_batch, ts=ts)
         first = self._payload is None
         self.evaluated_at = ts
         if changed or first:

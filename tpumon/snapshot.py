@@ -44,9 +44,13 @@ from dataclasses import dataclass
 # "slo" versions the SLO engine's published view (tpumon.slo): bumped
 # once per tick when an objective's budget/burn/alert state moved, so
 # /api/slo and the tpumon_slo_* exporter block re-render only then.
+# "actuate" versions the actuation engine's published view
+# (tpumon.actuate): bumped when a policy's state/value/action record
+# moved, so /api/actuate, the SSE actuation card and the
+# tpumon_actuate_* exporter block re-render only then.
 SECTIONS = (
     "host", "accel", "k8s", "serving", "alerts", "samples", "events",
-    "federation", "slo",
+    "federation", "slo", "actuate",
 )
 
 

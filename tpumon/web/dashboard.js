@@ -386,6 +386,7 @@ function makeDashboard(doc, net, env, mkSurface) {
     renderChips(streamData.accel);
     renderTrace(streamData.trace);
     renderEvents(streamData.events);
+    renderActuate(streamData.actuate);
     const al = streamData.alerts;
     if (al) {
       $("n-minor").textContent = al.minor ?? 0;
@@ -815,6 +816,54 @@ function makeDashboard(doc, net, env, mkSurface) {
     });
   }
 
+  /* ------------------------------ actuation ---------------------------- */
+  /* The closed loop (tpumon/actuate.py, docs/actuation.md): per-policy
+   * state machine rows + the last journaled transition. Primary feed is
+   * the SSE realtime payload ("actuate" key — a firing policy repaints
+   * on the very next tick); fetchActuate is the polling fallback.
+   * Hidden when no policies are configured: the route always answers,
+   * with an empty policies list. */
+  function renderActuate(res) {
+    const card = $("actuate-card");
+    if (!card) return;
+    const rows = res && res.policies ? res.policies : [];
+    if (!rows.length) { card.style.display = "none"; return; }
+    card.style.display = "";
+    let firing = 0;
+    let dry = 0;
+    const body = $("actuate-body");
+    body.replaceChildren();
+    for (const row of rows) {
+      const tr = doc.mk("tr");
+      const mk = (t, hot) => {
+        const td = doc.mk("td");
+        td.textContent = t;
+        if (hot) td.style.color = "var(--red)";
+        return td;
+      };
+      if (row.state === "fired") firing += 1;
+      if (row.dry_run) dry += 1;
+      tr.appendChild(mk(row.name + (row.dry_run ? " (dry-run)" : "")));
+      tr.appendChild(mk(row.action));
+      tr.appendChild(mk(row.state, row.state === "fired"));
+      tr.appendChild(mk(row.when));
+      tr.appendChild(mk(row.value == null ? "–" : String(row.value)));
+      tr.appendChild(mk(row.last || "–"));
+      tr.appendChild(mk(row.fired + " / " + row.reverted));
+      body.appendChild(tr);
+    }
+    $("actuate-tag").textContent =
+      (firing ? firing + " active" : rows.length + " polic" +
+        (rows.length === 1 ? "y" : "ies")) +
+      (res.engine_bound ? "" : " · no engine") +
+      (dry ? " · DRY-RUN" : "");
+    $("actuate-tag").style.color = firing ? "var(--red)" : "";
+  }
+
+  function fetchActuate() {
+    net.getJson("/api/actuate", renderActuate);
+  }
+
   /* --------------------------- hottest chips --------------------------- */
   /* GET /api/query — the in-tree query engine (docs/query.md): a topk
    * over per-chip 5 m duty means. On an aggregator/root with a
@@ -901,7 +950,7 @@ function makeDashboard(doc, net, env, mkSurface) {
   function fetchAll() {
     fetchRealtime(); fetchHistory(); fetchPods();
     fetchAlerts(); fetchServing(); fetchFederation(); fetchHealth();
-    fetchSlo();
+    fetchSlo(); fetchActuate();
     fetchTopChips();
     fetchTrace();
     fetchEvents();
@@ -914,7 +963,8 @@ function makeDashboard(doc, net, env, mkSurface) {
     fetchPods: fetchPods, fetchAlerts: fetchAlerts,
     fetchServing: fetchServing, fetchFederation: fetchFederation,
     fetchHealth: fetchHealth, fetchTopChips: fetchTopChips,
-    fetchSlo: fetchSlo,
+    fetchSlo: fetchSlo, fetchActuate: fetchActuate,
+    renderActuate: renderActuate,
     fetchTrace: fetchTrace, fetchEvents: fetchEvents,
     fetchAll: fetchAll, updateTime: updateTime,
     onStreamFrame: onStreamFrame, setWindow: setWindow,
