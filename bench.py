@@ -1378,6 +1378,156 @@ async def _bench_federation_tree(
     }
 
 
+async def _bench_hetero(
+    n_tpu: int = 8, n_gpu: int = 4, iters: int = 25, warmup: int = 5,
+) -> dict:
+    """Heterogeneous fleet (ISSUE 15, docs/federation.md "Mixed
+    fleets"): 8 fake TPU leaves (v5p-64) + 4 fake GPU nodes
+    (dgx-h100-8) pushing into one aggregator → root tree. Numbers of
+    record:
+
+      hetero_root_scrape_p50_ms     root tick + GET /api/federation on
+                                    the MIXED fleet (acceptance: <= 1.1x
+                                    the TPU-only number measured on the
+                                    same tree before the GPU uplinks
+                                    start — the GPU family must ride the
+                                    accelerator-generic path, not a
+                                    slow side channel)
+      hetero_by_accel_query_p50_ms  distributed per-family ranking at
+                                    the root — topk(3,
+                                    avg_over_time(chip.mxu[5s])) by
+                                    (accel) — partial aggregates only
+    """
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    def mk(**env):
+        base = {
+            "TPUMON_PORT": "0", "TPUMON_HOST": "127.0.0.1",
+            "TPUMON_K8S_MODE": "none", "TPUMON_COLLECTORS": "accel",
+            "TPUMON_HISTORY_PER_CHIP": "0",
+            "TPUMON_FEDERATION_DARK_AFTER_S": "30",
+        }
+        base.update(env)
+        return build(load_config(env=base))
+
+    nodes = []
+    try:
+        root_s, root_srv = mk(
+            TPUMON_ACCEL_BACKEND="none", TPUMON_FEDERATION_ROLE="root",
+            TPUMON_FEDERATION_NODE="root",
+        )
+        await root_s.tick_fast()
+        await root_srv.start()
+        nodes.append((root_s, root_srv))
+        agg_s, agg_srv = mk(
+            TPUMON_ACCEL_BACKEND="none",
+            TPUMON_FEDERATION_ROLE="aggregator",
+            TPUMON_FEDERATION_NODE="agg0",
+            TPUMON_FEDERATE_UP=f"http://127.0.0.1:{root_srv.port}",
+        )
+        await agg_s.tick_fast()
+        await agg_srv.start()
+        await agg_s.uplink.start()
+        nodes.append((agg_s, agg_srv))
+
+        def leaf(name, backend):
+            # Leaves keep per-chip history ON (unlike the pure-scrape
+            # tree bench): the by-(accel) fleet query reads chip.mxu
+            # at the leaves.
+            s, srv = mk(
+                TPUMON_ACCEL_BACKEND=backend,
+                TPUMON_FEDERATION_NODE=name,
+                TPUMON_FEDERATE_UP=f"http://127.0.0.1:{agg_srv.port}",
+                TPUMON_HISTORY_PER_CHIP="256",
+            )
+            nodes.append((s, srv))
+            return s
+
+        tpu_leaves = [
+            leaf(f"tpu{i}", f"fake:v5p-64@tpu{i}") for i in range(n_tpu)
+        ]
+        gpu_leaves = [
+            leaf(f"gpu{i}", f"gpufake:dgx-h100-8@gpu{i}")
+            for i in range(n_gpu)
+        ]
+        for lf in tpu_leaves + gpu_leaves:
+            await lf.tick_fast()
+
+        url = f"http://127.0.0.1:{root_srv.port}/api/federation"
+
+        def fetch() -> dict:
+            with urllib.request.urlopen(url) as r:
+                return json.loads(r.read())
+
+        async def settle():
+            for _ in range(4):
+                await asyncio.sleep(0.005)
+
+        async def scrape_cycle(leaves) -> tuple[list[float], dict]:
+            cycle_ms: list[float] = []
+            data: dict = {}
+            for i in range(warmup + iters):
+                await asyncio.gather(*(lf.tick_fast() for lf in leaves))
+                await settle()
+                await agg_s.tick_fast()
+                await settle()
+                t0 = time.perf_counter()
+                await root_s.tick_fast()
+                data = await asyncio.to_thread(fetch)
+                if i >= warmup:
+                    cycle_ms.append((time.perf_counter() - t0) * 1e3)
+            return cycle_ms, data
+
+        # --- TPU-only baseline: the GPU uplinks haven't started, so
+        # the tree is exactly the pre-ISSUE-15 shape. ---
+        for lf in tpu_leaves:
+            await lf.uplink.start()
+        base_ms, data = await scrape_cycle(tpu_leaves)
+        assert data["fleet"]["chips"] == n_tpu * 64, data["fleet"]
+
+        # --- Mixed: the GPU nodes join the same tree. ---
+        for lf in gpu_leaves:
+            await lf.uplink.start()
+        mixed_ms, data = await scrape_cycle(tpu_leaves + gpu_leaves)
+        by_accel = data["fleet"]["by_accel"]
+        assert by_accel.get("gpu", {}).get("chips") == n_gpu * 8, by_accel
+        assert by_accel.get("tpu", {}).get("chips") == n_tpu * 64, by_accel
+
+        # --- per-family fleet ranking, distributed (never raw points) --
+        expr = "topk(3, avg_over_time(chip.mxu[5s])) by (accel)"
+        q_ms: list[float] = []
+        partitions: set[str] = set()
+        for _ in range(15):
+            await asyncio.gather(
+                *(lf.tick_fast() for lf in tpu_leaves + gpu_leaves)
+            )
+            await settle()
+            t0 = time.perf_counter()
+            out = await root_s.federation.fleet_query(expr, timeout_s=10.0)
+            q_ms.append((time.perf_counter() - t0) * 1e3)
+            partitions = {
+                r["labels"].get("accel") for r in out["result"]
+            }
+        assert partitions == {"tpu", "gpu"}, out
+    finally:
+        for sampler, server in nodes:
+            with contextlib.suppress(Exception):
+                await sampler.stop()
+            with contextlib.suppress(Exception):
+                await server.stop()
+
+    base = _p50(base_ms)
+    mixed = _p50(mixed_ms)
+    return {
+        "hetero_root_scrape_p50_ms": round(mixed, 3),
+        "hetero_root_scrape_tpu_only_p50_ms": round(base, 3),
+        "hetero_vs_tpu_only": round(mixed / base, 3) if base else None,
+        "hetero_chips": n_tpu * 64 + n_gpu * 8,
+        "hetero_by_accel_query_p50_ms": round(_p50(q_ms), 3),
+    }
+
+
 async def _bench_query() -> dict:
     """In-tree query engine (docs/query.md). Numbers of record:
 
@@ -1975,6 +2125,11 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                               "federation_keyframe_bytes",
                               "federation_delta_vs_keyframe_pct",
                               "federation_resync_ms")),
+    "hetero": (300, ("hetero_root_scrape_p50_ms",
+                     "hetero_root_scrape_tpu_only_p50_ms",
+                     "hetero_vs_tpu_only",
+                     "hetero_chips",
+                     "hetero_by_accel_query_p50_ms")),
     "query": (300, ("query_instant_p50_ms", "query_range_30m_p50_ms",
                     "query_history_walk_p50_ms",
                     "query_rules_append_overhead_pct",
@@ -2068,11 +2223,10 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # ~0% tick overhead lives in full results)
     "events_append_p50_us",
     # history engine (columnar store, docs/perf.md history section;
-    # the vs-deque ratio, json-write comparison and the snapshot
-    # write/restore times live in the full results file — the summary
-    # line's byte budget is pinned)
+    # the vs-deque ratio, resident-bytes/point, json-write comparison
+    # and the snapshot write/restore times live in the full results
+    # file — the summary line's byte budget is pinned)
     "history_record_p50_us", "history_query_30m_p50_ms",
-    "history_resident_bytes_per_point",
     # ingest spine (batch append + native kernel + binary peer wire,
     # docs/perf.md; py-fallback, bytes comparisons, the per-chip
     # micro-record number and the wire decode p50 — superseded by
@@ -2086,6 +2240,11 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "federation_2048_root_scrape_p50_ms",
     "federation_delta_bytes_per_tick",
     "federation_resync_ms",
+    # hetero (mixed TPU/GPU tree, docs/federation.md "Mixed fleets";
+    # the TPU-only baseline operand, the ≤1.1x ratio and the chip
+    # count live in full results)
+    "hetero_root_scrape_p50_ms",
+    "hetero_by_accel_query_p50_ms",
     # query engine (in-tree PromQL subset, docs/query.md; the raw
     # history-walk comparison, the range-grid p50, per-config rule
     # tick operands and the per-leaf TPWR byte cost live in full
@@ -2121,10 +2280,10 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     "serving_paged_kernel_vs_gather",
     # serving_concurrency (chunked-prefill scheduler vs the sequential
     # stop-the-world baseline at 128-way concurrency; the conc32
-    # numbers, per-scheduler operands and ratios live in full results)
+    # numbers, the sequential-baseline operand, per-scheduler operands
+    # and ratios live in full results)
     "serving_conc128_tokens_per_sec",
     "serving_conc128_ttft_p95_ms",
-    "serving_conc128_ttft_p95_sequential_ms",
 )
 
 SUMMARY_MAX_BYTES = 1800
@@ -2186,6 +2345,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(both_scales())
     if name == "federation_tree":
         return asyncio.run(_bench_federation_tree())
+    if name == "hetero":
+        return asyncio.run(_bench_hetero())
     if name == "query":
         return asyncio.run(_bench_query())
     if name == "slo":

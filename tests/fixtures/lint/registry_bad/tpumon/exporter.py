@@ -1,4 +1,4 @@
-"""Known-bad fixture: undocumented federation + actuation gauges."""
+"""Known-bad fixture: undocumented federation + actuation + accel gauges."""
 
 
 def render(w):
@@ -6,3 +6,8 @@ def render(w):
     g.add({}, 1.0)
     a = w.gauge("tpumon_actuate_ghost_gauge", "documented nowhere")
     a.add({}, 1.0)
+    # ISSUE 15: tpu_* chip/slice families are pinned to
+    # docs/federation.md's mixed-fleet table — an accel-labeled family
+    # nobody documented must fire registry.metric-undocumented.
+    t = w.gauge("tpu_ghost_accel_gauge", "documented nowhere")
+    t.add({"accel": "gpu"}, 1.0)

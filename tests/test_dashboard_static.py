@@ -161,8 +161,9 @@ def test_example_configs_load():
             assert cfg.port == 8888
             loaded += 1
     # 5 deployment shapes + the chaos soak + the v5p-256 federation
-    # shape + the v5p-2048 aggregator-tree shape
-    assert loaded == 8
+    # shape + the v5p-2048 aggregator-tree shape + the mixed TPU/GPU
+    # fleet's GPU leaf (ISSUE 15)
+    assert loaded == 9
 
 
 def test_topology_map_wired(script):

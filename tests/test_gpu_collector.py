@@ -1,0 +1,285 @@
+"""GPU collector family (ISSUE 15, tpumon/collectors/gpu.py +
+gpu_fake.py): nvidia-smi CSV and DCGM exposition parsers normalizing
+into the accelerator-generic ChipSample (SM%→duty, VRAM→HBM,
+NVLink→ICI, provenance in counter_source, accel_kind="gpu"), the fake
+DGX geometries mirroring accel_fake, the accel_backend factory grammar,
+and honest-degraded behavior when the binary/exporter is absent."""
+
+import asyncio
+import time
+
+import pytest
+
+from tpumon.collectors.accel import make_accel_collector
+from tpumon.collectors.gpu import (
+    DcgmCollector,
+    NvidiaSmiCollector,
+    normalize_gpu_kind,
+    parse_dcgm_text,
+    parse_nvidia_smi_csv,
+)
+from tpumon.collectors.gpu_fake import (
+    GPU_FAKE_TOPOLOGIES,
+    VRAM_BYTES_BY_KIND,
+    FakeGpuCollector,
+)
+from tpumon.config import load_config
+from tpumon.topology import accel_terms, slice_views
+
+SMI_OUTPUT = """\
+0, NVIDIA A100-SXM4-80GB, 93, 40536, 81920, 61
+1, NVIDIA A100-SXM4-80GB, 5, 1024, 81920, [N/A]
+2, NVIDIA H100 80GB HBM3, [N/A], [N/A], 81920, 48
+"""
+
+DCGM_OUTPUT = """\
+# HELP DCGM_FI_DEV_GPU_UTIL GPU utilization
+# TYPE DCGM_FI_DEV_GPU_UTIL gauge
+DCGM_FI_DEV_GPU_UTIL{gpu="0",UUID="GPU-x",modelName="NVIDIA H100 80GB HBM3",Hostname="node1"} 77
+DCGM_FI_DEV_FB_USED{gpu="0",modelName="NVIDIA H100 80GB HBM3",Hostname="node1"} 40000
+DCGM_FI_DEV_FB_FREE{gpu="0",Hostname="node1"} 41920
+DCGM_FI_DEV_GPU_TEMP{gpu="0",Hostname="node1"} 55
+DCGM_FI_PROF_NVLINK_TX_BYTES{gpu="0",Hostname="node1"} 123456789
+DCGM_FI_PROF_NVLINK_RX_BYTES{gpu="0",Hostname="node1"} 98765432
+DCGM_FI_DEV_XID_ERRORS{gpu="0",Hostname="node1"} 0
+DCGM_FI_DEV_GPU_UTIL{gpu="1",modelName="NVIDIA H100 80GB HBM3",Hostname="node1"} 12
+DCGM_FI_DEV_XID_ERRORS{gpu="1",Hostname="node1"} 74
+DCGM_FI_DEV_GPU_UTIL{gpu="2",modelName="NVIDIA H100 80GB HBM3",Hostname="node1"} 33
+DCGM_FI_DEV_XID_ERRORS{gpu="2",Hostname="node1"} 13
+"""
+
+
+def test_normalize_gpu_kind():
+    assert normalize_gpu_kind("NVIDIA A100-SXM4-80GB") == "a100"
+    assert normalize_gpu_kind("NVIDIA H100 80GB HBM3") == "h100"
+    assert normalize_gpu_kind("Tesla V100-SXM2-16GB") == "v100"
+    # Token-bounded: an L40S is not an L4, an A100 is not an A10.
+    assert normalize_gpu_kind("NVIDIA L40S") == "l40s"
+    assert normalize_gpu_kind("NVIDIA L4") == "l4"
+    assert normalize_gpu_kind("NVIDIA A10G") == "a10g"
+    assert normalize_gpu_kind("Weird Device") == "Weird Device"
+
+
+def test_parse_nvidia_smi_csv():
+    chips = parse_nvidia_smi_csv(SMI_OUTPUT, "dgx-0")
+    assert [c.chip_id for c in chips] == [
+        "dgx-0/gpu-0", "dgx-0/gpu-1", "dgx-0/gpu-2",
+    ]
+    c0 = chips[0]
+    # The reference's record (monitor_server.js:90) under ChipSample
+    # names: utilization → duty, memoryUsed/Total (MiB) → hbm bytes.
+    assert c0.kind == "a100" and c0.accel_kind == "gpu"
+    assert c0.mxu_duty_pct == 93.0
+    assert c0.hbm_used == 40536 * 2**20
+    assert c0.hbm_total == 81920 * 2**20
+    assert c0.temp_c == 61.0
+    assert c0.counter_source == "nvidia-smi"
+    # [N/A] cells are honest Nones, not zeros.
+    assert chips[1].temp_c is None
+    assert chips[2].mxu_duty_pct is None and chips[2].hbm_used is None
+    # Garbage lines are skipped, not fatal.
+    assert parse_nvidia_smi_csv("not,a,row\n\n", "h") == []
+
+
+def test_parse_dcgm_text():
+    chips = parse_dcgm_text(DCGM_OUTPUT)
+    assert [c.chip_id for c in chips] == [
+        "node1/gpu-0", "node1/gpu-1", "node1/gpu-2",
+    ]
+    c0 = chips[0]
+    assert c0.kind == "h100" and c0.accel_kind == "gpu"
+    assert c0.mxu_duty_pct == 77.0
+    assert c0.hbm_used == 40000 * 2**20
+    assert c0.hbm_total == (40000 + 41920) * 2**20  # FB_USED + FB_FREE
+    assert c0.temp_c == 55.0
+    assert c0.ici_tx_bytes == 123456789
+    assert c0.ici_rx_bytes == 98765432
+    assert c0.ici_link_health == 0
+    assert c0.counter_source == "dcgm"
+    # Only NVLink/bus XIDs (62/74/79) degrade link health; a benign
+    # application-level XID (13: a crashed user process — DCGM keeps
+    # the LAST code forever) must NOT read as a link problem, or a
+    # healthy GPU pages serious until driver reload.
+    assert chips[1].ici_link_health == 7  # XID 74: NVLink error
+    assert chips[2].ici_link_health == 0  # XID 13: benign, healthy link
+    assert chips[1].hbm_total is None  # no FB rows → honest None
+
+
+def test_fake_gpu_geometries():
+    for topo, (kind, hosts, per_host, hps) in GPU_FAKE_TOPOLOGIES.items():
+        chips = FakeGpuCollector(topology=topo, clock=lambda: 500.0).chips()
+        assert len(chips) == hosts * per_host, topo
+        assert all(c.accel_kind == "gpu" and c.kind == kind for c in chips)
+        assert all(c.hbm_total == VRAM_BYTES_BY_KIND[kind] for c in chips)
+        assert all(
+            0 <= c.mxu_duty_pct <= 100 and 0 < c.hbm_used <= c.hbm_total
+            for c in chips
+        )
+    # Multi-node shape: 4 hosts in 2-node partitions → 2 slices.
+    pod = FakeGpuCollector(topology="superpod-32", clock=lambda: 500.0)
+    views = slice_views(pod.chips())
+    assert [v.slice_id for v in views] == ["gpu-0.0", "gpu-0.1"]
+    assert all(v.reporting_chips == 16 and v.accel_kind == "gpu"
+               for v in views)
+
+
+def test_fake_gpu_fault_injection_mirrors_tpu_fake():
+    g = FakeGpuCollector(topology="dgx-a100-8", clock=lambda: 500.0)
+    g.kill_host("gpu-node-0")
+    assert g.chips() == []
+    g.revive_host("gpu-node-0")
+    g.set_override("gpu-node-0/gpu-3", mxu_duty_pct=1.5, ici_link_health=9)
+    over = {c.chip_id: c for c in g.chips()}["gpu-node-0/gpu-3"]
+    assert over.mxu_duty_pct == 1.5 and over.ici_link_health == 9
+    with pytest.raises(ValueError):
+        FakeGpuCollector(topology="dgx-nope")
+
+
+def test_factory_backend_grammar():
+    def mk(backend):
+        return make_accel_collector(
+            load_config(env={"TPUMON_ACCEL_BACKEND": backend})
+        )
+
+    col = mk("gpufake:dgx-h100-8@n7+faults")
+    assert isinstance(col, FakeGpuCollector)
+    assert col.topology == "dgx-h100-8" and col.host_prefix == "n7"
+    assert col.fault_episodes is True
+    s = asyncio.run(col.collect())
+    assert s.ok and len(s.data) == 8 and s.data[0].host == "n7-0"
+
+    smi = mk("nvidia-smi:/opt/bin/nvidia-smi")
+    assert isinstance(smi, NvidiaSmiCollector)
+    assert smi.smi_path == "/opt/bin/nvidia-smi"
+    assert isinstance(mk("nvidia-smi"), NvidiaSmiCollector)
+
+    dcgm = mk("dcgm:http://gpu-node:9400")
+    assert isinstance(dcgm, DcgmCollector)
+    assert dcgm.url == "http://gpu-node:9400/metrics"
+
+    with pytest.raises(ValueError):
+        mk("gpufake:not-a-topology")
+
+
+def test_nvidia_smi_missing_binary_degrades_honestly():
+    s = asyncio.run(
+        NvidiaSmiCollector(smi_path="/nonexistent/nvidia-smi").collect()
+    )
+    assert s.ok is False and s.data == []
+    assert "not found" in (s.error or "")
+
+
+def test_dcgm_unreachable_degrades_honestly():
+    c = DcgmCollector(url="http://127.0.0.1:1/metrics", timeout_s=0.2)
+    s = asyncio.run(c.collect())
+    assert s.ok is False and s.data == []
+    assert "dcgm" in (s.error or "")
+
+
+def test_accel_terms_vocabulary():
+    assert accel_terms("tpu") == {"duty": "MXU", "mem": "HBM", "link": "ICI"}
+    assert accel_terms("gpu") == {"duty": "SM", "mem": "VRAM", "link": "NVLink"}
+    # Unknown/absent kinds read as TPU — the pre-accel_kind default.
+    assert accel_terms(None)["mem"] == "HBM"
+    assert accel_terms("npu")["duty"] == "MXU"
+
+
+def test_gpu_chips_through_alert_engine_speak_gpu_terms():
+    """Kind-aware alert text (ISSUE 15 satellite): the same rule keys
+    fire, but a GPU chip's title/desc say VRAM/NVLink, not HBM/ICI."""
+    from tpumon.alerts import AlertEngine
+    from tpumon.config import Thresholds
+
+    g = FakeGpuCollector(topology="dgx-a100-8", clock=lambda: 500.0)
+    g.set_override(
+        "gpu-node-0/gpu-0",
+        hbm_used=int(80 * 1024**3 * 0.97),
+        ici_link_health=10,
+    )
+    engine = AlertEngine(Thresholds())
+    chips = g.chips()
+    out = engine.evaluate(chips=chips, host=None, pods=None)
+    flat = [a for sev in ("critical", "serious", "minor") for a in out[sev]]
+    titles = {a["title"] for a in flat}
+    assert "VRAM pressure on gpu-node-0/gpu-0" in titles
+    assert "NVLink link down on gpu-node-0/gpu-0" in titles
+    # Keys keep the stable TPU-native namespace (silences survive).
+    keys = {a["key"] for a in flat}
+    assert "chip.gpu-node-0/gpu-0.hbm.critical" in keys
+    assert "chip.gpu-node-0/gpu-0.ici_down" in keys
+
+
+def test_exporter_slice_accel_label_stable_across_outage():
+    """The tpu_slice_* gauges' `accel` label must not flip on/off when
+    a slice goes from reporting to expected-but-absent — that would
+    fork the Prometheus series identity exactly when an absence alert
+    needs reporting_chips to drop to 0 on the SAME series."""
+    from tpumon.config import load_config
+    from tpumon.exporter import render_exporter
+    from tpumon.metrics_text import parse_metrics_text, samples_by_name
+    from tpumon.sampler import Sampler
+
+    gpu = FakeGpuCollector(topology="dgx-a100-8", clock=lambda: 800.0)
+    cfg = load_config(env={
+        "TPUMON_COLLECTORS": "accel", "TPUMON_K8S_MODE": "none",
+        "TPUMON_EXPECTED_SLICE_CHIPS": '{"gpu-0": 8}',
+    })
+    sampler = Sampler(cfg, accel=gpu)
+    asyncio.run(sampler.tick_fast())
+
+    def slice_samples():
+        by = samples_by_name(parse_metrics_text(render_exporter(sampler)))
+        return {
+            tuple(sorted(s.labels.items())): s.value
+            for s in by.get("tpu_slice_reporting_chips", [])
+        }
+
+    healthy = slice_samples()
+    key = (("accel", "gpu"), ("slice", "gpu-0"))
+    assert healthy[key] == 8.0
+    # Outage: every chip vanishes; the slice survives as an
+    # expected-but-absent view — SAME series, value 0.
+    gpu.kill_host("gpu-node-0")
+    asyncio.run(sampler.tick_fast())
+    dark = slice_samples()
+    assert dark[key] == 0.0, dark
+
+
+def test_query_accel_label_stable_across_failed_scrape():
+    """The chip-series `accel` query label keeps its last-known family
+    when the collector fails a scrape: `{accel="gpu"}` alert/SLO
+    matchers must keep matching still-in-lookback GPU series
+    mid-incident instead of silently evaluating empty."""
+    from tpumon.collectors import Sample
+    from tpumon.config import load_config
+    from tpumon.sampler import Sampler
+
+    gpu = FakeGpuCollector(topology="dgx-a100-8", clock=lambda: 800.0)
+
+    class Flaky:
+        name = "accel"
+        fail = False
+
+        async def collect(self):
+            if self.fail:
+                return Sample(source="accel", ok=False, data=[],
+                              error="nvidia-smi exit 1")
+            return Sample(source="accel", ok=True, data=gpu.chips())
+
+    flaky = Flaky()
+    cfg = load_config(env={
+        "TPUMON_COLLECTORS": "accel", "TPUMON_K8S_MODE": "none",
+    })
+    sampler = Sampler(cfg, accel=flaky)
+    asyncio.run(sampler.tick_fast())
+    at = time.time()
+    ok = sampler.query.instant('count(chip.mxu{accel="gpu"})', at=at)
+    assert ok["result"][0]["value"] == 8.0
+    # One failed scrape: chips() is empty this tick, but the per-chip
+    # series are still within lookback and must stay gpu-labeled.
+    flaky.fail = True
+    asyncio.run(sampler.tick_fast())
+    assert sampler.chips() == []
+    out = sampler.query.instant(
+        'count(chip.mxu{accel="gpu"})', at=time.time())
+    assert out["result"][0]["value"] == 8.0, out

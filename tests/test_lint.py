@@ -165,6 +165,9 @@ def test_registry_checker_fires_on_fixture():
     # the federation ghost already flagged the same file).
     assert "tpumon_federation_ghost_gauge" in msgs
     assert "tpumon_actuate_ghost_gauge" in msgs
+    # ISSUE 15: the accelerator chip/slice families (tpu_*, accel
+    # label) are pinned to docs/federation.md's mixed-fleet table.
+    assert "tpu_ghost_accel_gauge" in msgs
 
 
 # ---------------------------- suppressions ----------------------------

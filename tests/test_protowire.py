@@ -286,3 +286,101 @@ def test_delta_stream_intlist_row_goes_none():
     res = dec.apply(enc.encode(1, fields, rows3, ts=3.0)[0])
     _, _, ref = pw.decode_wire_frame(pw.encode_wire_frame(1, fields, rows3))
     assert res["cols"] == ref
+
+
+# ------------- accel_kind wire column back-compat (ISSUE 15) ------------
+
+
+def test_wire_frame_with_accel_kind_truncation_at_every_prefix():
+    """The appended accel_kind column (topology.WIRE_FIELDS[-1]) rides
+    the real chip frame: build one from live fake chips (TPU + GPU so
+    the string dictionary has two entries), round-trip it, and raise
+    ValueError at EVERY truncation prefix — the same harness the other
+    ctypes are pinned under."""
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.collectors.gpu_fake import FakeGpuCollector
+    from tpumon.topology import WIRE_FIELDS, chips_from_wire, chips_to_wire
+
+    chips = (
+        FakeTpuCollector(topology="v5e-4", clock=lambda: 1000.0).chips()
+        + FakeGpuCollector(topology="dgx-a100-8", clock=lambda: 1000.0).chips()
+    )
+    w = chips_to_wire(chips)
+    assert w["fields"] == list(WIRE_FIELDS)
+    assert w["fields"][-1] == "accel_kind"
+    ak = w["fields"].index("accel_kind")
+    assert {row[ak] for row in w["rows"]} == {"tpu", "gpu"}
+    frame = pw.encode_wire_frame(w["v"], w["fields"], w["rows"])
+    v, fields, cols = pw.decode_wire_frame(frame)
+    assert fields[-1] == "accel_kind"
+    assert cols[-1] == [row[ak] for row in w["rows"]]
+    assert chips_from_wire({"v": v, "fields": fields,
+                            "rows": [list(r) for r in zip(*cols)]}) == chips
+    for cut in range(len(frame)):
+        with pytest.raises(ValueError):
+            pw.decode_wire_frame(frame[:cut])
+
+
+def test_pre_accel_kind_peer_frames_decode_unchanged():
+    """Back-compat regression (ISSUE 15 satellite): a pre-accel_kind
+    peer's JSON payload and binary frame — checked in as fixtures, NOT
+    re-generated, so an encoder change can't silently launder a wire
+    break — decode to the same chips as today's encoder, every chip
+    defaulting to accel_kind='tpu'. Bit-exactness both ways: today's
+    encoder over the old field list reproduces the old frame byte for
+    byte."""
+    import base64
+    import json
+    import os
+
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.topology import chips_from_wire, chips_to_wire
+
+    path = os.path.join(
+        os.path.dirname(__file__), "fixtures", "wire_pre_accel_kind.json"
+    )
+    with open(path) as f:
+        fix = json.load(f)
+    old_frame = base64.b64decode(fix["frame_b64"])
+
+    # Binary and JSON forms agree with each other...
+    v, fields, cols = pw.decode_wire_frame(old_frame)
+    assert [v, fields] == [fix["json_wire"]["v"], fix["json_wire"]["fields"]]
+    assert "accel_kind" not in fields
+    chips = chips_from_wire(fix["json_wire"])
+    assert chips == chips_from_wire(
+        {"v": v, "fields": fields, "rows": [list(r) for r in zip(*cols)]}
+    )
+    # ...default the appended column...
+    assert chips and all(c.accel_kind == "tpu" for c in chips)
+    # ...match what the fixture's generator collector produces today
+    # (same chips, modulo the appended field the old peer couldn't say)...
+    today = FakeTpuCollector(topology="v5e-4", clock=lambda: 1000.0).chips()
+    assert chips == today
+    # ...and today's encoder over the old layout is bit-exact with the
+    # checked-in frame (append-only really did leave the prefix alone).
+    w = chips_to_wire(today)
+    old_rows = [row[:-1] for row in w["rows"]]
+    assert pw.encode_wire_frame(w["v"], w["fields"][:-1], old_rows) == old_frame
+
+
+def test_delta_stream_from_pre_accel_kind_sender_replays():
+    """A pre-upgrade LEAF keeps streaming TPWK/TPWD frames in the old
+    16-field layout; the decoder replays them bit-exactly and the
+    materialized chips default to accel_kind='tpu' — old peers
+    federate/merge unchanged."""
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.topology import chips_from_columns, chips_to_wire
+
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    dec = pw.DeltaStreamDecoder()
+    for t in (1000.0, 1001.0, 1002.0):
+        chips = FakeTpuCollector(topology="v5e-4", clock=lambda: t).chips()
+        w = chips_to_wire(chips)
+        old_fields = w["fields"][:-1]
+        old_rows = [r[:-1] for r in w["rows"]]
+        frame, _ = enc.encode(w["v"], old_fields, old_rows, ts=t)
+        res = dec.apply(frame)
+        got = chips_from_columns(res["fields"], res["cols"])
+        assert got == chips  # accel_kind defaulted to "tpu" everywhere
+        assert all(c.accel_kind == "tpu" for c in got)

@@ -717,3 +717,101 @@ def test_query_cache_key_is_evictable_not_unbounded():
         assert len(server.cache._evictable) <= server.cache.MAX_EVICTABLE
 
     asyncio.run(scenario())
+
+
+# ------------------- accelerator-family labels (ISSUE 15) ---------------
+
+
+def _accel_ring_and_engine():
+    """Mixed fleet ring: 3 TPU + 3 GPU chips, one chip.mxu point each,
+    plus an augmenter deriving accel from the chip id — the same shape
+    the sampler's augmenter produces from live ChipSamples."""
+    ring = RingHistory(1800)
+    at = 1_700_000_000.0
+    vals = {
+        "t0/c0": 10.0, "t0/c1": 40.0, "t1/c0": 30.0,
+        "g0/gpu-0": 25.0, "g0/gpu-1": 5.0, "g1/gpu-0": 35.0,
+    }
+    for cid, v in vals.items():
+        ring.record(f"chip.{cid}.mxu", v, ts=at)
+
+    def augmenter():
+        def fn(family, labels):
+            cid = labels.get("chip")
+            if cid is not None:
+                labels["accel"] = "gpu" if "/gpu-" in cid else "tpu"
+
+        return fn
+
+    return ring, QueryEngine(ring, augment=augmenter), vals, at
+
+
+def _fam(cid: str) -> str:
+    return "gpu" if "/gpu-" in cid else "tpu"
+
+
+def test_accel_matchers_match_brute_force():
+    """{accel="gpu"} matchers and by (accel) group-bys agree with an
+    independent brute force over the same values (ISSUE 15 acceptance:
+    alert/query/SLO engines all evaluate through this path)."""
+    _ring, engine, vals, at = _accel_ring_and_engine()
+    gpu_vals = [v for cid, v in vals.items() if _fam(cid) == "gpu"]
+    out = engine.instant('avg(chip.mxu{accel="gpu"})', at=at)["result"]
+    assert len(out) == 1
+    assert out[0]["value"] == pytest.approx(sum(gpu_vals) / len(gpu_vals))
+    # != matcher selects the complement.
+    out = engine.instant('count(chip.mxu{accel!="gpu"})', at=at)["result"]
+    assert out[0]["value"] == 3.0
+    grouped = {
+        r["labels"]["accel"]: r["value"]
+        for r in engine.instant("count(chip.mxu) by (accel)", at=at)["result"]
+    }
+    assert grouped == {"tpu": 3.0, "gpu": 3.0}
+    # Condition path (the alert/SLO engines' entry point) sees them too.
+    assert engine.eval_condition(
+        parse('chip.mxu{accel="gpu"} > 30'), at=at) is True
+    assert engine.eval_condition(
+        parse('chip.mxu{accel="gpu"} > 40'), at=at) is False
+
+
+def test_topk_by_accel_matches_brute_force():
+    """Per-group topk (topk(k, v) by (accel)) returns each family's k
+    best rows with full labels — checked against a brute force."""
+    _ring, engine, vals, at = _accel_ring_and_engine()
+    rows = engine.instant("topk(2, chip.mxu) by (accel)", at=at)["result"]
+    got: dict[str, list[float]] = {}
+    for r in rows:
+        assert r["labels"]["chip"]  # full labels survive the ranking
+        got.setdefault(r["labels"]["accel"], []).append(r["value"])
+    brute: dict[str, list[float]] = {}
+    for cid, v in vals.items():
+        brute.setdefault(_fam(cid), []).append(v)
+    assert set(got) == {"tpu", "gpu"}
+    for fam, xs in brute.items():
+        assert sorted(got[fam], reverse=True) == sorted(xs, reverse=True)[:2]
+    # Ungrouped topk is unchanged by the grouping support.
+    flat = engine.instant("topk(2, chip.mxu)", at=at)["result"]
+    assert [r["value"] for r in flat] == sorted(vals.values(), reverse=True)[:2]
+
+
+def test_topk_by_partial_merge_equals_local():
+    """The distributed algebra for grouped topk: splitting the chips
+    across two 'leaves' and merging their partials equals the local
+    answer — per-group k-sets are locally complete, so any merge order
+    is correct (the fleet `by (accel)` query's correctness claim)."""
+    _ring, engine, vals, at = _accel_ring_and_engine()
+    for expr in (
+        "topk(2, chip.mxu) by (accel)",
+        "bottomk(1, chip.mxu) by (accel)",
+        "topk(2, chip.mxu)",
+    ):
+        names = sorted(vals)
+        half = set(names[: len(names) // 2])
+        p1 = engine.partial_eval(
+            expr, at=at, exclude=lambda f, lb: lb.get("chip") in half)
+        p2 = engine.partial_eval(
+            expr, at=at, exclude=lambda f, lb: lb.get("chip") not in half)
+        merged = QueryEngine.finalize(QueryEngine.merge_partials([p1, p2]))
+        local = engine.instant(expr, at=at)["result"]
+        key = lambda r: (tuple(sorted(r["labels"].items())), r["value"])
+        assert sorted(merged, key=key) == sorted(local, key=key), expr

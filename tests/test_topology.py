@@ -136,3 +136,54 @@ def test_attribute_stable_when_low_index_chips_vanish():
     surviving = [_chip("h0", i) for i in range(4, 8)]  # p2's chips only
     out = attribute_pods(surviving, pods)
     assert all(v == "a/p2" for v in out.values()) and len(out) == 4
+
+
+# ---------------- accelerator families (ISSUE 15) ----------------------
+
+
+def test_accel_kind_defaults_and_json_roundtrip():
+    from tpumon.collectors.accel_peers import chip_from_json
+
+    c = chip(0)
+    assert c.accel_kind == "tpu"  # the pre-upgrade meaning of every chip
+    j = c.to_json()
+    assert j["accel_kind"] == "tpu"
+    assert chip_from_json(j).accel_kind == "tpu"
+    g = chip(1, accel_kind="gpu", kind="a100")
+    assert chip_from_json(g.to_json()).accel_kind == "gpu"
+    # A pre-accel_kind peer's JSON omits the key entirely: default tpu.
+    old = c.to_json()
+    del old["accel_kind"]
+    assert chip_from_json(old).accel_kind == "tpu"
+
+
+def test_slice_view_accel_kind():
+    views = slice_views(
+        [chip(0), chip(1, accel_kind="gpu", kind="a100", slice_id="g0")],
+        expected={"ghost": 4},
+    )
+    by_id = {v.slice_id: v for v in views}
+    assert by_id["s0"].accel_kind == "tpu"
+    assert by_id["g0"].accel_kind == "gpu"
+    assert by_id["ghost"].accel_kind is None  # no chips, no family claim
+    assert by_id["g0"].to_json()["accel_kind"] == "gpu"
+    assert by_id["ghost"].to_json()["accel_kind"] is None
+
+
+def test_wire_fields_append_only_contract():
+    """accel_kind must stay the LAST wire column (append-only is what
+    lets pre-upgrade peers decode new frames and new readers default
+    old frames — the ISSUE 15 wire contract)."""
+    from tpumon.topology import WIRE_FIELDS, chips_from_wire, chips_to_wire
+
+    assert WIRE_FIELDS[-1] == "accel_kind"
+    chips = [chip(0), chip(1, accel_kind="gpu", kind="h100")]
+    w = chips_to_wire(chips)
+    assert chips_from_wire(w) == chips
+    old = {
+        "v": w["v"],
+        "fields": w["fields"][:-1],
+        "rows": [r[:-1] for r in w["rows"]],
+    }
+    back = chips_from_wire(old)
+    assert [c.accel_kind for c in back] == ["tpu", "tpu"]

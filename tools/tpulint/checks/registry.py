@@ -554,6 +554,13 @@ def check(project: Project) -> list[Finding]:
          (slo_doc.text if slo_doc else "") + readme_text),
         ("tpumon_actuate_", ACTUATION_DOC,
          (act_doc.text if act_doc else "") + readme_text),
+        # Accelerator families (ISSUE 15): the `tpu_*` chip/slice
+        # gauges carry the `accel` label and serve BOTH families under
+        # the docs/federation.md "Mixed fleets" normalization — that
+        # table is the contract a GPU operator reads, so every literal
+        # family must have a row there (or in README.md).
+        ("tpu_", FEDERATION_DOC,
+         (fed_doc.text if fed_doc else "") + readme_text),
     )
     for name, line in sorted(exporter_metric_families(project).items()):
         for prefix, doc_rel, doc_text in pinned_prefixes:

@@ -37,7 +37,7 @@ from typing import Iterable
 from tpumon.config import Thresholds, TriLevel
 from tpumon.events import EventJournal
 from tpumon.query import compile_env
-from tpumon.topology import ChipSample, SliceView, attribute_pods
+from tpumon.topology import ChipSample, SliceView, accel_terms, attribute_pods
 
 SEVERITIES = ("minor", "serious", "critical")
 
@@ -317,16 +317,21 @@ class AlertEngine:
         in the same language it queries with."""
         t = self.t
 
+        # Alert KEYS keep the TPU-native namespace (chip.<id>.hbm.* —
+        # silences and the timeline depend on stable keys); the
+        # human-facing title/desc speak the chip's own family terms
+        # (HBM vs VRAM, MXU vs SM, ICI vs NVLink — accel_terms).
         def hbm_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
+            mem = accel_terms(c.accel_kind)["mem"]
             return Alert(
                 severity=sev,
-                title=f"HBM pressure on {c.chip_id}",
-                desc=f"HBM at {v:.1f}% "
+                title=f"{mem} pressure on {c.chip_id}",
+                desc=f"{mem} at {v:.1f}% "
                 f"({(c.hbm_used or 0) / 2**30:.1f} / "
                 f"{(c.hbm_total or 0) / 2**30:.1f} GiB){pod_note}",
                 fix="Reduce batch size or sequence length, shard the "
                 "model over more chips, or enable rematerialization "
-                "(jax.checkpoint) to trade FLOPs for HBM.",
+                f"(jax.checkpoint) to trade FLOPs for {mem}.",
                 key=f"chip.{c.chip_id}.hbm.{sev}",
             )
 
@@ -346,10 +351,12 @@ class AlertEngine:
         # without computing (wedged collective, host input stall,
         # deadlock).
         def stalled_emit(c: ChipSample, env: dict, pod_note: str) -> Alert:
+            terms = accel_terms(c.accel_kind)
             return Alert(
                 severity="serious",
                 title=f"Chip {c.chip_id} stalled",
-                desc=f"HBM {env['chip.hbm']:.0f}% committed but MXU duty "
+                desc=f"{terms['mem']} {env['chip.hbm']:.0f}% committed "
+                f"but {terms['duty']} duty "
                 f"cycle only {c.mxu_duty_pct:.1f}%{pod_note}",
                 fix="The job holds memory but isn't computing: look for "
                 "a host-side input bottleneck, a hung collective "
@@ -362,13 +369,14 @@ class AlertEngine:
         # engine owns this derivation so a producer that sets only the
         # score still raises the critical alert.
         def link_down_emit(c: ChipSample, env: dict, pod_note: str) -> Alert:
+            link = accel_terms(c.accel_kind)["link"]
             return Alert(
                 severity="critical",
-                title=f"ICI link down on {c.chip_id}",
+                title=f"{link} link down on {c.chip_id}",
                 desc="Inter-chip interconnect link reports down; "
                 f"collectives crossing it will hang or fail.{pod_note}",
                 fix="Drain the slice and file a hardware case; a single "
-                "bad ICI link poisons every collective in the slice.",
+                f"bad {link} link poisons every collective in the slice.",
                 key=f"chip.{c.chip_id}.ici_down",
             )
 
@@ -376,10 +384,11 @@ class AlertEngine:
         # minor, 6-9 persistent -> serious; 10 is the critical
         # link-down rule above.
         def ici_health_emit(c: ChipSample, v: float, sev: str, pod_note: str) -> Alert:
+            link = accel_terms(c.accel_kind)["link"]
             return Alert(
                 severity=sev,
-                title=f"ICI link degraded on {c.chip_id}",
-                desc=f"Worst ICI link health score "
+                title=f"{link} link degraded on {c.chip_id}",
+                desc=f"Worst {link} link health score "
                 f"{c.ici_link_health}/10 "
                 f"({'persistent' if c.ici_link_health > 5 else 'transient'} "
                 f"problem){pod_note}",

@@ -18,7 +18,7 @@ import time
 from tpumon.collectors.accel import make_accel_collector
 from tpumon.collectors.host import HostCollector
 from tpumon.config import load_config
-from tpumon.topology import ChipSample, slice_views
+from tpumon.topology import ChipSample, accel_terms, slice_views
 
 
 def _bar(pct: float | None, width: int = 20) -> str:
@@ -50,10 +50,19 @@ def render(chips: list[ChipSample], host: dict, ici_rates: dict | None = None) -
         lines.append(
             f"slice {v.slice_id}: {v.reporting_chips} chip(s) on "
             f"{len(v.hosts)} host(s)"
+            + (f" · {v.accel_kind}" if v.accel_kind == "gpu" else "")
         )
+    # Column headers speak the fleet's own family terms (MXU/HBM/ICI vs
+    # SM/VRAM/NVLink); a mixed table falls back to the neutral words.
+    families = {c.accel_kind for c in chips}
+    if len(families) == 1:
+        terms = accel_terms(next(iter(families)))
+        duty_h, mem_h, link_h = terms["duty"], terms["mem"], terms["link"]
+    else:
+        duty_h, mem_h, link_h = "duty", "mem", "link"
     header = (
-        f"{'chip':<24} {'kind':<5} {'MXU%':>6}  {'':20} "
-        f"{'HBM':>12} {'HBM%':>6}  {'temp':>5}  {'ICI tx':>10}  {'link':>5}"
+        f"{'chip':<24} {'kind':<5} {duty_h + '%':>6}  {'':20} "
+        f"{mem_h:>12} {mem_h + '%':>6}  {'temp':>5}  {link_h + ' tx':>10}  {'link':>5}"
     )
     lines.append(header)
     for c in chips:

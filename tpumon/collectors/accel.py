@@ -11,6 +11,13 @@ Backend selection (Config.accel_backend):
   identities when several fake-backed instances federate (real
   deployments get distinct identities from their hostnames); "+faults"
   enables periodic ICI-degradation/throttle episodes (demo mode).
+- "gpufake:<topology>[@<host_prefix>][+faults]": synthetic GPU nodes
+  (dgx-a100-8 / dgx-h100-8 / superpod-32) — the second accelerator
+  family (ISSUE 15), same ChipSample normalization, accel_kind="gpu".
+- "nvidia-smi[:<path>]": real GPU chips via the nvidia-smi CSV
+  shell-out (the reference's L1b path, monitor_server.js:83-95).
+- "dcgm:<url>": real GPU chips scraped from a DCGM exporter (the
+  reference's L0 deployment path).
 - "none": disabled.
 """
 
@@ -37,8 +44,8 @@ def make_accel_collector(cfg: Config) -> Collector:
     backend = cfg.accel_backend
     if backend == "none":
         local: Collector | None = None
-    elif backend.startswith("fake:"):
-        spec = backend.split(":", 1)[1]
+    elif backend.startswith(("fake:", "gpufake:")):
+        kind, spec = backend.split(":", 1)
         kw = {}
         if spec.endswith("+faults"):
             spec = spec[: -len("+faults")]
@@ -46,7 +53,23 @@ def make_accel_collector(cfg: Config) -> Collector:
         topology, _, prefix = spec.partition("@")
         if prefix:
             kw["host_prefix"] = prefix
-        local = FakeTpuCollector(topology=topology, **kw)
+        if kind == "gpufake":
+            from tpumon.collectors.gpu_fake import FakeGpuCollector
+
+            local = FakeGpuCollector(topology=topology, **kw)
+        else:
+            local = FakeTpuCollector(topology=topology, **kw)
+    elif backend == "nvidia-smi" or backend.startswith("nvidia-smi:"):
+        from tpumon.collectors.gpu import NvidiaSmiCollector
+
+        _, _, smi_path = backend.partition(":")
+        local = NvidiaSmiCollector(
+            **({"smi_path": smi_path} if smi_path else {})
+        )
+    elif backend.startswith("dcgm:"):
+        from tpumon.collectors.gpu import DcgmCollector
+
+        local = DcgmCollector(url=backend.split(":", 1)[1])
     elif backend in ("auto", "jax"):
         local = JaxTpuCollector(workload_dir=cfg.workload_dir or None)
     else:

@@ -84,6 +84,10 @@ def chip_from_json(d: dict) -> ChipSample:
         ici_link_up=d.get("ici_link_up"),
         ici_link_health=d.get("ici_link_health"),
         throttle_score=d.get("throttle_score"),
+        counter_source=d.get("counter_source"),
+        # Pre-accel_kind peers omit the key: their chips read as TPU
+        # (the pre-upgrade meaning of every chip in the fleet).
+        accel_kind=d.get("accel_kind") or "tpu",
     )
 
 

@@ -216,7 +216,11 @@ class Config:
 
     # --- collectors ---
     collectors: tuple[str, ...] = ("host", "accel", "k8s", "serving")
-    # accel backend: "auto" | "jax" | "fake:<topology>" | "none"
+    # accel backend: "auto" | "jax" | "fake:<topology>" | "none", plus
+    # the GPU family (ISSUE 15): "gpufake:<topology>" (dgx-a100-8 /
+    # dgx-h100-8 / superpod-32), "nvidia-smi[:<path>]" (CSV shell-out),
+    # "dcgm:<url>" (DCGM-exporter scrape) — all normalize into the same
+    # ChipSample schema with accel_kind="gpu".
     accel_backend: str = "auto"
     # host cpu count: 0 => auto-detect (reference hardcoded 8, monitor_server.js:76)
     cpu_count: int = 0

@@ -5,7 +5,9 @@ DCGM exporter :9400 and its DCGM_FI_DEV_* series (README.md:130-136,
 monitor_server.js:128-134) — with one in-process ``/metrics`` endpoint
 publishing:
 
-- ``tpu_*``       per-chip gauges/counters (labels: chip, host, slice, kind)
+- ``tpu_*``       per-chip gauges/counters (labels: chip, host, slice,
+  kind, accel — the accelerator family, "tpu" | "gpu"; GPU chips ride
+  the same families under the docs/federation.md normalization)
 - ``tpumon_host_*``  host gauges (so history PromQL needs no node-exporter)
 - ``tpumon_*``       self-metrics (sample counts/latency — SURVEY §5.1)
 - ``tpumon_serving_*`` distilled serving signals per target
@@ -76,17 +78,31 @@ def _render_accel(sampler: Sampler) -> str:
     w = MetricsWriter()
     chips = sampler.chips()
     if chips:
-        duty = w.gauge("tpu_mxu_duty_cycle_pct", "TensorCore/MXU duty cycle percent")
-        used = w.gauge("tpu_hbm_used_bytes", "HBM bytes in use")
-        total = w.gauge("tpu_hbm_total_bytes", "HBM capacity bytes")
-        used_pct = w.gauge("tpu_hbm_used_pct", "HBM used percent")
+        # Family names stay the TPU-native spellings (renaming would
+        # break every recorded series and shipped Grafana board); GPU
+        # chips ride the same families under the normalization of
+        # docs/federation.md "Mixed fleets" (SM%→duty, VRAM→HBM,
+        # NVLink→ICI), distinguished by the ``accel`` label.
+        duty = w.gauge(
+            "tpu_mxu_duty_cycle_pct",
+            "TensorCore/MXU (GPU: SM) duty cycle percent",
+        )
+        used = w.gauge("tpu_hbm_used_bytes", "HBM/VRAM bytes in use")
+        total = w.gauge("tpu_hbm_total_bytes", "HBM/VRAM capacity bytes")
+        used_pct = w.gauge("tpu_hbm_used_pct", "HBM/VRAM used percent")
         temp = w.gauge("tpu_temp_celsius", "Chip temperature")
-        tx = w.counter("tpu_ici_tx_bytes_total", "Cumulative ICI bytes transmitted")
-        rx = w.counter("tpu_ici_rx_bytes_total", "Cumulative ICI bytes received")
-        link = w.gauge("tpu_ici_link_up", "ICI link state (1=up)")
+        tx = w.counter(
+            "tpu_ici_tx_bytes_total",
+            "Cumulative ICI (GPU: NVLink) bytes transmitted",
+        )
+        rx = w.counter(
+            "tpu_ici_rx_bytes_total",
+            "Cumulative ICI (GPU: NVLink) bytes received",
+        )
+        link = w.gauge("tpu_ici_link_up", "ICI/NVLink link state (1=up)")
         ici_health = w.gauge(
             "tpu_ici_link_health_score",
-            "Worst ICI link health per chip (0 healthy .. 10 unusable)",
+            "Worst ICI/NVLink link health per chip (0 healthy .. 10 unusable)",
         )
         throttle = w.gauge(
             "tpu_throttle_score", "TPU throttle score (0 .. 10 = 100% throttled)"
@@ -97,6 +113,7 @@ def _render_accel(sampler: Sampler) -> str:
                 "host": c.host,
                 "slice": c.slice_id,
                 "kind": c.kind,
+                "accel": c.accel_kind,
             }
             if c.mxu_duty_pct is not None:
                 duty.add(labels, c.mxu_duty_pct)
@@ -165,7 +182,17 @@ def _render_accel(sampler: Sampler) -> str:
         reporting = w.gauge("tpu_slice_reporting_chips", "Chips currently reporting")
         expected = w.gauge("tpu_slice_expected_chips", "Chips expected in slice")
         for s in slices:
-            labels = {"slice": s.slice_id}
+            # The accel label must be PRESENT and STABLE even for an
+            # expected-but-absent slice (no chips to take a family
+            # from): flipping the label across an outage would fork
+            # the Prometheus series identity exactly when an absence
+            # alert needs reporting_chips to read 0 on the same
+            # series. The sampler remembers each slice's last-known
+            # family; never-seen slices read as the "tpu" default.
+            labels = {
+                "slice": s.slice_id,
+                "accel": s.accel_kind or sampler.slice_accel_kind(s.slice_id),
+            }
             reporting.add(labels, s.reporting_chips)
             if s.expected_chips is not None:
                 expected.add(labels, s.expected_chips)

@@ -93,6 +93,11 @@ SLICE_FIELDS: tuple[str, ...] = (
     "temp_max",
     "health",    # "ok" | "dark" | "unreachable"
     "ts",        # the sample's own timestamp (not receipt time)
+    # Appended (ISSUE 15): accelerator family of the slice's chips
+    # ("tpu" | "gpu"). Pre-upgrade aggregators omit it; readers default
+    # absent to "tpu" (FederationHub.slices) — append-only, old peers
+    # decode unchanged.
+    "accel_kind",
 )
 
 # slice-row key -> history series suffix: the rollup series an
@@ -154,6 +159,7 @@ def slice_rollup_rows(
                 "temp_max": v.max("temp_c"),
                 "health": health,
                 "ts": ts,
+                "accel_kind": v.accel_kind or "tpu",
             }
         )
     return rows
@@ -746,6 +752,11 @@ class FederationHub:
                     row["health"] = (
                         "unreachable" if ns.tier == "aggregator" else "dark"
                     )
+                # Pre-accel_kind peers (old SLICE_FIELDS layout) ship
+                # rows without the appended column: they federate
+                # unchanged and read as the pre-upgrade default.
+                if not row.get("accel_kind"):
+                    row["accel_kind"] = "tpu"
                 out.append(row)
         return out
 
@@ -767,6 +778,15 @@ class FederationHub:
             if r.get("duty_mean") is not None
         ]
         wsum = sum(n for _, n in duty)
+        # Per-accelerator-family partition of the fleet (ISSUE 15): one
+        # root view spanning TPU pods and GPU nodes must say how much
+        # of each it spans (the dashboard's per-kind fleet chips).
+        by_accel: dict[str, dict] = {}
+        for r in slices:
+            k = r.get("accel_kind") or "tpu"
+            ent = by_accel.setdefault(k, {"slices": 0, "chips": 0})
+            ent["slices"] += 1
+            ent["chips"] += r.get("chips") or 0
         return {
             "slices": len(slices),
             "chips": chips,
@@ -777,6 +797,7 @@ class FederationHub:
             "duty_mean": (
                 round(sum(d * n for d, n in duty) / wsum, 3) if wsum else None
             ),
+            "by_accel": by_accel,
         }
 
     def to_json(self) -> dict:

@@ -11,11 +11,15 @@ layers on top:
 
 - **Topology labels from series names.** The ring's flat series names
   already encode the topology: ``chip.<id>.<metric>`` becomes family
-  ``chip.<metric>`` with labels ``chip``/``host`` (and ``pod`` when the
-  server's attribution hook is wired), ``slice.<node>.<id>.<stat>``
-  becomes ``slice.<stat>`` with labels ``node``/``slice``, and fleet
-  series (``cpu``, ``mxu``, ...) are label-less families. ``by (label)``
-  grouping and ``{label="..."}`` matchers work over exactly these.
+  ``chip.<metric>`` with labels ``chip``/``host`` (plus ``pod`` and the
+  accelerator family ``accel`` — "tpu" | "gpu" — when the sampler's
+  augmenter hook is wired), ``slice.<node>.<id>.<stat>`` becomes
+  ``slice.<stat>`` with labels ``node``/``slice`` (and ``accel`` at a
+  federation hub), and fleet series (``cpu``, ``mxu``, ...) are
+  label-less families. ``by (label)`` grouping and ``{label="..."}``
+  matchers work over exactly these; ``topk``/``bottomk`` additionally
+  accept ``by`` for per-group ranking (``topk(5, rate(chip.hbm)) by
+  (accel)``).
 - **Incremental recording rules** (``recording_rules`` config):
   a registered ``family[window]`` selector maintains running aggregates
   — count/sum/min/max, rate endpoints, reset-aware increase — in
@@ -985,11 +989,12 @@ class QueryEngine:
     _COMPILE_CAP = 256
 
     # Labels an augmenter may ADD to derived labels (the sampler's pod
-    # attribution). Matchers referencing any of these must resolve
-    # per evaluation (the attribution changes tick to tick); matchers
-    # over naming-derived labels only are resolvable once per series
-    # set and ride the selector cache below.
-    AUGMENT_LABELS = frozenset({"pod"})
+    # attribution, and the accelerator family — chip id → accel_kind,
+    # slice → accel_kind, ISSUE 15). Matchers referencing any of these
+    # must resolve per evaluation (the attribution changes tick to
+    # tick); matchers over naming-derived labels only are resolvable
+    # once per series set and ride the selector cache below.
+    AUGMENT_LABELS = frozenset({"pod", "accel"})
 
     def __init__(
         self,
@@ -1382,14 +1387,28 @@ class QueryEngine:
         if not isinstance(vec, list):
             raise QueryError(f"{node.op} wants a vector, got a scalar")
         if node.op in ("topk", "bottomk"):
-            if node.by:
-                raise QueryError(f"{node.op} does not take by()")
             rows = sorted(
                 vec,
                 key=lambda p: (p[1], _labels_key(p[0])),
                 reverse=(node.op == "topk"),
             )
-            return rows[: max(0, k)]
+            if not node.by:
+                return rows[: max(0, k)]
+            # Per-group top-k (Prometheus semantics, ISSUE 15:
+            # ``topk(5, rate(chip.hbm)) by (accel)``): k rows per
+            # by-group, each row keeping its FULL label set so the
+            # answer says which chip won, not just which family.
+            taken: dict[tuple, int] = {}
+            out = []
+            for labels, v in rows:
+                gk = _labels_key({
+                    l: labels[l] for l in node.by if labels.get(l) is not None
+                })
+                n = taken.get(gk, 0)
+                if n < max(0, k):
+                    taken[gk] = n + 1
+                    out.append((labels, v))
+            return out
         groups: dict[tuple, tuple[dict, list[float]]] = {}
         for labels, v in vec:
             out_labels = {
@@ -1673,14 +1692,46 @@ class QueryEngine:
                 vec,
                 key=lambda p: (p[1], _labels_key(p[0])),
                 reverse=(node.op == "topk"),
-            )[: max(0, k)]
+            )
+            if not node.by:
+                return {
+                    "op": node.op,
+                    "arg": k,
+                    "by": [],
+                    "groups": [
+                        {
+                            "labels": {},
+                            "state": {
+                                "rows": [
+                                    [lb, v] for lb, v in rows[: max(0, k)]
+                                ]
+                            },
+                        }
+                    ],
+                }
+            # Grouped top-k partial: k candidate rows PER by-group —
+            # still never raw points (at most k × groups rows upstream),
+            # and any tier merging fewer groups than exist below it
+            # stays correct because each group's k-set is locally
+            # complete.
+            for labels, v in rows:
+                out_labels = {
+                    l: labels[l] for l in node.by if labels.get(l) is not None
+                }
+                gk = _labels_key(out_labels)
+                ent = groups.get(gk)
+                if ent is None:
+                    ent = groups[gk] = {
+                        "labels": out_labels,
+                        "state": {"rows": []},
+                    }
+                if len(ent["state"]["rows"]) < max(0, k):
+                    ent["state"]["rows"].append([labels, v])
             return {
                 "op": node.op,
                 "arg": k,
                 "by": list(node.by),
-                "groups": [
-                    {"labels": {}, "state": {"rows": [[lb, v] for lb, v in rows]}}
-                ],
+                "groups": [groups[gk] for gk in sorted(groups)],
             }
         for labels, v in vec:
             out_labels = {
@@ -1726,27 +1777,45 @@ class QueryEngine:
         base = parts[0]
         op = base["op"]
         if op in ("topk", "bottomk"):
-            rows = []
+            # Group-aware merge: partials from every tier carry one
+            # entry per by-group (the ungrouped case is the single
+            # group with empty labels, so pre-by peers merge
+            # unchanged); rows re-rank within their group and each
+            # group keeps its own k.
+            k = int(base["arg"])
+            by_groups: dict[tuple, dict] = {}
             for p in parts:
                 for g in p["groups"]:
-                    rows.extend(
+                    gk = _labels_key(g["labels"])
+                    ent = by_groups.get(gk)
+                    if ent is None:
+                        ent = by_groups[gk] = {
+                            "labels": dict(g["labels"]),
+                            "rows": [],
+                        }
+                    ent["rows"].extend(
                         (dict(lb), v) for lb, v in g["state"]["rows"]
                     )
-            k = int(base["arg"])
-            rows.sort(
-                key=lambda r: (r[1], _labels_key(r[0])),
-                reverse=(op == "topk"),
-            )
+            out_groups = []
+            for gk in sorted(by_groups):
+                ent = by_groups[gk]
+                ent["rows"].sort(
+                    key=lambda r: (r[1], _labels_key(r[0])),
+                    reverse=(op == "topk"),
+                )
+                out_groups.append(
+                    {
+                        "labels": ent["labels"],
+                        "state": {
+                            "rows": [[lb, v] for lb, v in ent["rows"][:k]]
+                        },
+                    }
+                )
             return {
                 "op": op,
                 "arg": k,
                 "by": base.get("by") or [],
-                "groups": [
-                    {
-                        "labels": {},
-                        "state": {"rows": [[lb, v] for lb, v in rows[:k]]},
-                    }
-                ],
+                "groups": out_groups,
             }
         merged: dict[tuple, dict] = {}
         for p in parts:

@@ -179,6 +179,16 @@ class Sampler:
         self._ooo_logged = False
         self.ici_rates: dict[str, dict] = {}  # chip_id -> {tx_bps, rx_bps}
         self._prev_ici: dict[str, tuple[float, int, int]] = {}  # chip -> (ts, tx, rx)
+        # Last-known accelerator family per slice id and per chip id
+        # (ISSUE 15): an expected-but-absent slice (or a chip whose
+        # collector failed a scrape) has no current sample to take a
+        # family from, but the `accel` label must not flip to "tpu"
+        # across an outage — that would fork the exporter's Prometheus
+        # series identity, and silently empty `{accel="gpu"}` query/
+        # alert matchers over still-in-lookback chip series, exactly
+        # when the operator is debugging the GPU outage.
+        self._slice_accel_kinds: dict[str, str] = {}
+        self._chip_accel_kinds: dict[str, str] = {}
         # Host NIC rates — the DCN-traffic proxy (SURVEY §5.8: ICI
         # within a slice, DCN across hosts).
         self.net_rates: dict = {}  # {rx_bps, tx_bps} once two samples exist
@@ -329,22 +339,49 @@ class Sampler:
 
     def _query_augmenter(self):
         """Per-evaluation label hook for the query engine: chip-family
-        labels gain ``pod`` from the current pod→chip attribution —
-        computed at most once per evaluation, and only when a matched
-        series actually carries a chip label (the attribution walk is
-        O(chips); per-tick evaluations over serving/slo series must
-        not pay it — bench.py's ``slo`` phase pins that)."""
+        labels gain ``pod`` from the current pod→chip attribution and
+        ``accel`` from the chip's accelerator family (ISSUE 15: the
+        label ``by (accel)`` group-bys and ``{accel="gpu"}`` matchers
+        resolve against); slice-family labels gain ``accel`` from the
+        federation hub's slice table. Each map is computed at most once
+        per evaluation, and only when a matched series actually carries
+        the triggering label (the walks are O(chips)/O(slices);
+        per-tick evaluations over serving/slo series must not pay them
+        — bench.py's ``slo`` phase pins that)."""
         owners_box: list[dict] = []
+        kinds_box: list[dict] = []
+        slice_kinds_box: list[dict] = []
 
         def augment(family: str, labels: dict) -> None:
             cid = labels.get("chip")
             if cid is not None:
                 if not owners_box:
-                    owners_box.append(
-                        attribute_pods(self.chips(), self.pods()))
+                    chips = self.chips()
+                    owners_box.append(attribute_pods(chips, self.pods()))
+                    # Fold this tick's chips into the last-known-family
+                    # memory and label from THAT: a chip whose
+                    # collector failed this scrape keeps its family
+                    # while its series are within query lookback
+                    # (never-seen chips read as the "tpu" default).
+                    for c in chips:
+                        self._chip_accel_kinds[c.chip_id] = c.accel_kind
+                    kinds_box.append(self._chip_accel_kinds)
                 pod = owners_box[0].get(cid)
                 if pod is not None:
                     labels["pod"] = pod
+                labels["accel"] = kinds_box[0].get(cid, "tpu")
+                return
+            sid = labels.get("slice")
+            if sid is not None and self.federation is not None:
+                if not slice_kinds_box:
+                    slice_kinds_box.append({
+                        (r.get("node"), str(r.get("slice_id"))):
+                            r.get("accel_kind") or "tpu"
+                        for r in self.federation.slices()
+                    })
+                labels["accel"] = slice_kinds_box[0].get(
+                    (labels.get("node"), sid), "tpu"
+                )
 
         return augment
 
@@ -376,7 +413,18 @@ class Sampler:
         return list(s.data) if s and s.data else []
 
     def slices(self):
-        return slice_views(self.chips(), self.cfg.expected_slice_chips)
+        views = slice_views(self.chips(), self.cfg.expected_slice_chips)
+        for v in views:
+            if v.accel_kind is not None:
+                self._slice_accel_kinds[v.slice_id] = v.accel_kind
+        return views
+
+    def slice_accel_kind(self, slice_id: str) -> str:
+        """Stable accelerator family for a slice: its chips' family
+        while reporting, the last-known family across an outage, and
+        the pre-accel_kind default ("tpu") for a slice that never
+        reported in this process's lifetime."""
+        return self._slice_accel_kinds.get(slice_id, "tpu")
 
     def pods(self) -> list[dict]:
         s = self.latest.get("k8s")

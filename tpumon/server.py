@@ -301,12 +301,15 @@ class MonitorServer:
 
     def _api_gpu_compat(self) -> list[dict]:
         """Reference-shaped view (monitor_server.js:90): lets clients
-        written against the reference's /api/gpu/metrics keep working."""
+        written against the reference's /api/gpu/metrics keep working.
+        GPU-family chips (ISSUE 15) render with the reference's own
+        vocabulary — their rows read exactly like nvidia-smi output."""
         out = []
         for c in self.sampler.chips():
             out.append(
                 {
-                    "name": f"TPU {c.kind} {c.chip_id}",
+                    "name": f"{'GPU' if c.accel_kind == 'gpu' else 'TPU'} "
+                    f"{c.kind} {c.chip_id}",
                     "utilization": round(c.mxu_duty_pct, 1)
                     if c.mxu_duty_pct is not None
                     else None,

@@ -24,6 +24,24 @@ HBM_BYTES_BY_KIND: dict[str, int] = {
     "v6e": 32 * 1024**3,
 }
 
+# Accelerator-family display vocabulary (ISSUE 15): the JSON keys stay
+# the TPU-native names everywhere (`mxu_duty_pct`, `hbm_*`, `ici_*` —
+# renaming them would break every wire/payload contract), but anything
+# HUMAN-facing (dashboard rows, alert text, the CLI table) renders the
+# family's own terms. The normalization back to the reference's GPU
+# vocabulary (monitor_server.js:83-95) is documented in
+# docs/federation.md "Mixed fleets".
+ACCEL_TERMS: dict[str, dict[str, str]] = {
+    "tpu": {"duty": "MXU", "mem": "HBM", "link": "ICI"},
+    "gpu": {"duty": "SM", "mem": "VRAM", "link": "NVLink"},
+}
+
+
+def accel_terms(accel_kind: str | None) -> dict[str, str]:
+    """Display terms for an accelerator family; unknown kinds read as
+    TPU (the pre-`accel_kind` default everywhere else)."""
+    return ACCEL_TERMS.get(accel_kind or "tpu", ACCEL_TERMS["tpu"])
+
 
 def normalize_chip_kind(device_kind: str) -> str:
     """Map a raw device-kind string (e.g. 'TPU v5 lite') to a short kind."""
@@ -68,10 +86,20 @@ class ChipSample:
     ici_link_health: int | None = None
     throttle_score: int | None = None
     # Provenance of the duty/HBM counters, e.g. "sdk", "grpc", "pjrt",
-    # "workload" (self-reported), "fake", or a "+"-joined mix — surfaced
-    # in /api/accel/metrics and the dashboard health strip so a reader
-    # can always tell a hardware counter from a workload's declaration.
+    # "workload" (self-reported), "nvidia-smi", "dcgm", "fake", or a
+    # "+"-joined mix — surfaced in /api/accel/metrics and the dashboard
+    # health strip so a reader can always tell a hardware counter from a
+    # workload's declaration.
     counter_source: str | None = None
+    # Accelerator family ("tpu" | "gpu"). GPU chips carry the SAME
+    # metric fields under the TPU-native names (SM-util% in
+    # mxu_duty_pct, VRAM in hbm_*, NVLink counters in ici_*; see
+    # docs/federation.md "Mixed fleets") — this field is what lets
+    # rollups, queries (`by (accel)`), the exporter's `accel` label and
+    # the UI tell the families apart. Appended LAST so the wire layout
+    # stays append-only (pre-upgrade peers decode unchanged; their rows
+    # default here, to "tpu").
+    accel_kind: str = "tpu"
 
     @property
     def hbm_pct(self) -> float | None:
@@ -98,6 +126,7 @@ class ChipSample:
             "ici_link_health": self.ici_link_health,
             "throttle_score": self.throttle_score,
             "counter_source": self.counter_source,
+            "accel_kind": self.accel_kind,
         }
         return d
 
@@ -120,6 +149,14 @@ class SliceView:
         if self.expected_chips is None:
             return 0
         return max(0, self.expected_chips - len(self.chips))
+
+    @property
+    def accel_kind(self) -> str | None:
+        """The slice's accelerator family — None when no chips report
+        (an expected-but-absent slice has no family to claim). Slices
+        never mix families (they are per-leaf groupings), so the first
+        chip speaks for all."""
+        return self.chips[0].accel_kind if self.chips else None
 
     def _vals(self, attr: str) -> list[float]:
         return [v for c in self.chips if (v := getattr(c, attr)) is not None]
@@ -151,6 +188,7 @@ class SliceView:
             "missing_chips": self.missing_chips,
             "mean_mxu_duty_pct": self.mean("mxu_duty_pct"),
             "mean_hbm_pct": self.mean("hbm_pct"),
+            "accel_kind": self.accel_kind,
         }
 
 
@@ -180,6 +218,7 @@ WIRE_FIELDS: tuple[str, ...] = (
     "ici_link_health",
     "throttle_score",
     "counter_source",
+    "accel_kind",
 )
 
 

@@ -77,6 +77,16 @@ function applyDelta(target, node) {
   return target;
 }
 
+/* Accelerator-family display terms (ISSUE 15): JSON keys stay the
+   TPU-native names (mxu_duty_pct, hbm_*, ici_*) for every payload
+   contract; anything the USER reads renders the chip's own family
+   vocabulary. Mirror of tpumon.topology.accel_terms. */
+function accelTerms(accelKind) {
+  return accelKind === "gpu"
+    ? { duty: "SM", mem: "VRAM", link: "NVLink" }
+    : { duty: "MXU", mem: "HBM", link: "ICI" };
+}
+
 /* ------------------------------ dashboard ------------------------------ */
 
 function makeDashboard(doc, net, env, mkSurface) {
@@ -155,8 +165,11 @@ function makeDashboard(doc, net, env, mkSurface) {
     const grid = $("chips");
     const chips = accel?.chips || [];
     const meanDuty = meanOf(chips.map(c => c.mxu_duty_pct));
+    // Mixed fleets list every kind present ("v5p+a100"), so the card
+    // says what the mean is a mean OF.
+    const kinds = uniqSorted(chips.map(c => c.kind)).join("+");
     setCard("mxu", meanDuty,
-            chips.length ? `${chips.length} chip(s) · ${chips[0].kind}` : "no chips");
+            chips.length ? `${chips.length} chip(s) · ${kinds}` : "no chips");
     const slices = accel?.slices || [];
     $("topo-tag").textContent = chips.length
       ? `${chips.length} chips · ${slices.length} slice(s)` : "no chips";
@@ -169,6 +182,7 @@ function makeDashboard(doc, net, env, mkSurface) {
       return;
     }
     for (const c of chips) {
+      const t = accelTerms(c.accel_kind);
       const el = doc.mk("div");
       el.className = "chip";
       el.style.cursor = "pointer";
@@ -179,7 +193,7 @@ function makeDashboard(doc, net, env, mkSurface) {
       cid.textContent = c.chip; cid.title = c.chip; el.appendChild(cid);
       const duty = doc.mk("div"); duty.className = "duty";
       duty.innerHTML = (c.mxu_duty_pct == null ? "–" : c.mxu_duty_pct.toFixed(1)) +
-        `<small> % MXU</small>`;
+        `<small> % ${t.duty}</small>`;
       el.appendChild(duty);
       const bar = doc.mk("div"); bar.className = "bar";
       const fill = doc.mk("i");
@@ -188,13 +202,13 @@ function makeDashboard(doc, net, env, mkSurface) {
       if (hbmPct > 95) fill.className = "bad";
       else if (hbmPct > 85) fill.className = "warn";
       bar.appendChild(fill); el.appendChild(bar);
-      el.appendChild(mkRow("HBM", hbmPct == null ? "–" :
+      el.appendChild(mkRow(t.mem, hbmPct == null ? "–" :
         `${fmtGiB(c.hbm_used)} (${hbmPct.toFixed(0)}%)`));
       el.appendChild(mkRow("temp", c.temp_c == null ? "–" : c.temp_c.toFixed(0) + "°C"));
-      el.appendChild(mkRow("ICI tx", fmtBps(c.tx_bps)));
+      el.appendChild(mkRow(`${t.link} tx`, fmtBps(c.tx_bps)));
       // libtpu SDK scores (0-10), rendered only when degraded/throttled.
       if (c.ici_link_health != null && c.ici_link_health > 0)
-        el.appendChild(mkRow("ICI health", c.ici_link_health + "/10"));
+        el.appendChild(mkRow(`${t.link} health`, c.ici_link_health + "/10"));
       if (c.throttle_score != null && c.throttle_score > 0)
         el.appendChild(mkRow("throttle", "~" + (c.throttle_score * 10) + "%"));
       if (c.pod) {
@@ -234,12 +248,13 @@ function makeDashboard(doc, net, env, mkSurface) {
     const hit = hitAt(mx, my);
     if (!hit) return null;
     const c = hit.chip;
+    const t = accelTerms(c.accel_kind);
     return {
       title: c.chip,
       lines: [
-        `MXU: ${c.mxu_duty_pct == null ? "–" : c.mxu_duty_pct.toFixed(1) + "%"}`,
-        `HBM: ${c.hbm_pct == null ? "–" : c.hbm_pct.toFixed(0) + "%"}`,
-        `ICI tx: ${fmtBps(c.tx_bps)}`, `ICI rx: ${fmtBps(c.rx_bps)}`,
+        `${t.duty}: ${c.mxu_duty_pct == null ? "–" : c.mxu_duty_pct.toFixed(1) + "%"}`,
+        `${t.mem}: ${c.hbm_pct == null ? "–" : c.hbm_pct.toFixed(0) + "%"}`,
+        `${t.link} tx: ${fmtBps(c.tx_bps)}`, `${t.link} rx: ${fmtBps(c.rx_bps)}`,
         `host: ${c.host}`, `pod: ${c.pod ?? "–"}`,
       ],
     };
@@ -486,15 +501,24 @@ function makeDashboard(doc, net, env, mkSurface) {
      chip's curves yet, fetch just them via the series= glob (cheap and
      epoch-cached server-side — the 256-chip path). */
   let chipSeriesFetched = null;  // chip a filtered fetch already ran for
+  let chipChartKind = null;      // family the modal chart's labels speak
   function openChipModal(chipId) {
     currentChipId = chipId;
     $("chip-modal-title").textContent = chipId;
     $("chip-modal").classList.add("open");
-    if (!chipChart)
+    // GPU-aware units: the modal's series labels speak the clicked
+    // chip's family (SM/VRAM vs MXU/HBM) — rebuilt only when the
+    // family actually flips (mixed fleets).
+    const cinfo = (streamData?.accel?.chips || []).find(c => c.chip === chipId);
+    const kind = cinfo?.accel_kind || "tpu";
+    const t = accelTerms(kind);
+    if (!chipChart || chipChartKind !== kind) {
+      chipChartKind = kind;
       chipChart = makeLineChart(mkSurface($("c-chip")),
-        [{label:"MXU duty %", color:"#36d399", fill:true},
-         {label:"HBM %", color:"#22d3ee"},
+        [{label:`${t.duty} duty %`, color:"#36d399", fill:true},
+         {label:`${t.mem} %`, color:"#22d3ee"},
          {label:"link score ×10", color:"#f59e0b"}], {yMax:100, unit:"%"});
+    }
     const mxu = lastHistory?.per_chip?.[chipId + ".mxu"];
     const hbm = lastHistory?.per_chip?.[chipId + ".hbm"];
     const link = lastHistory?.per_chip?.[chipId + ".link"];
@@ -738,6 +762,14 @@ function makeDashboard(doc, net, env, mkSurface) {
       };
       put("fed-slices", fleet ? fleet.slices : null, v => v.toFixed(0));
       put("fed-chips", fleet ? fleet.chips : null, v => v.toFixed(0));
+      // Per-accelerator-family partition (ISSUE 15): a mixed TPU/GPU
+      // fleet says how many chips each family contributes — blank on
+      // single-family fleets (nothing to partition).
+      const byAccel = fleet ? fleet.by_accel : null;
+      const fams = byAccel ? Object.keys(byAccel).sort() : [];
+      $("fed-accel").textContent = fams.length > 1
+        ? fams.map(k => `${k} ${byAccel[k].chips}`).join(" · ")
+        : "";
       put("fed-dark", fleet ? fleet.dark_slices : null, v => v.toFixed(0));
       $("fed-dark").style.color =
         fleet && fleet.dark_slices > 0 ? "var(--red)" : "";
