@@ -384,3 +384,183 @@ def test_delta_stream_from_pre_accel_kind_sender_replays():
         got = chips_from_columns(res["fields"], res["cols"])
         assert got == chips  # accel_kind defaulted to "tpu" everywhere
         assert all(c.accel_kind == "tpu" for c in got)
+
+
+# ------------- leadership generation trailer (ISSUE 16, root HA) --------
+
+
+def _fake_wire(ts: float):
+    from tpumon.collectors.accel_fake import FakeTpuCollector
+    from tpumon.topology import chips_to_wire
+
+    return chips_to_wire(
+        FakeTpuCollector(topology="v5e-4", clock=lambda: ts).chips()
+    )
+
+
+def _load_pre_generation_fixture():
+    import base64
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "fixtures", "wire_pre_generation.json"
+    )
+    with open(path) as f:
+        fix = json.load(f)
+    return fix, {
+        k: base64.b64decode(fix[f"{k}_b64"])
+        for k in ("keyframe", "delta", "query_req", "query_res")
+    }
+
+
+def test_generation_trailer_roundtrip_all_frame_types():
+    """All four frame types carry the trailing generation varint and
+    decode it back; the decoder remembers the sender's generation."""
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    enc.generation = 7
+    dec = pw.DeltaStreamDecoder()
+    for ts in (1000.0, 1001.0):
+        w = _fake_wire(ts)
+        frame, was_key = enc.encode(w["v"], w["fields"], w["rows"], ts=ts)
+        res = dec.apply(frame)
+        assert res["generation"] == 7 and dec.generation == 7
+    # Generation can only move the way fencing needs it to: up.
+    enc.generation = 300  # 2-byte varint: exercises multi-byte trailers
+    w = _fake_wire(1002.0)
+    res = dec.apply(enc.encode(w["v"], w["fields"], w["rows"], ts=1002.0)[0])
+    assert res["generation"] == 300 and dec.generation == 300
+
+    req = pw.encode_query_request(9, "fleet(duty)", 1.0, 2.0, generation=300)
+    assert pw.decode_query_request(req) == (9, "fleet(duty)", 1.0, 2.0, 300)
+    res = pw.encode_query_result(9, {"kind": "scalar"}, generation=300)
+    qid, partial, error, payload, gen = pw.decode_query_result(res)
+    assert (qid, partial, error, gen) == (9, False, None, 300)
+    assert payload == {"kind": "scalar"}
+
+
+def test_pre_generation_fixture_decodes_and_reencodes_bit_exact():
+    """Back-compat pinned both directions by checked-in frames (never
+    re-generated): a pre-upgrade peer's TPWK/TPWD/TPWQ/TPWR decode
+    unchanged with generation 0, and today's encoder at generation 0
+    reproduces every one of them byte for byte — the trailer really is
+    append-only and conditional."""
+    fix, frames = _load_pre_generation_fixture()
+
+    dec = pw.DeltaStreamDecoder()
+    res = dec.apply(frames["keyframe"])
+    assert res["key"] and res["generation"] == 0 and dec.generation == 0
+    res = dec.apply(frames["delta"])
+    assert not res["key"] and res["generation"] == 0
+
+    q = fix["query_req"]
+    assert pw.decode_query_request(frames["query_req"]) == (
+        q["qid"], q["expr"], q["at"], q["timeout_s"], 0
+    )
+    r = fix["query_res"]
+    qid, partial, error, payload, gen = pw.decode_query_result(
+        frames["query_res"]
+    )
+    assert (qid, partial, error, gen) == (r["qid"], r["partial"], None, 0)
+    assert payload == r["payload"]
+
+    # Today's encoder, generation 0 (the default): bit-exact re-encode.
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    assert enc.generation == 0
+    for ts, name in ((1000.0, "keyframe"), (1001.0, "delta")):
+        w = _fake_wire(ts)
+        frame, _ = enc.encode(w["v"], w["fields"], w["rows"], ts=ts)
+        assert frame == frames[name], name
+    assert pw.encode_query_request(
+        q["qid"], q["expr"], q["at"], q["timeout_s"]
+    ) == frames["query_req"]
+    assert pw.encode_query_result(
+        r["qid"], r["payload"], partial=r["partial"]
+    ) == frames["query_res"]
+
+
+def test_pre_generation_fixture_truncation_at_every_prefix():
+    """The no-trailer fixture frames stay fully guarded: EVERY
+    truncation prefix of all four pre-upgrade frames raises ValueError
+    (and the stream decoder stays atomic, same as the PR 6 harness)."""
+    _, frames = _load_pre_generation_fixture()
+    for blob in (frames["keyframe"], frames["delta"]):
+        for cut in range(len(blob)):
+            dec = pw.DeltaStreamDecoder()
+            dec.apply(frames["keyframe"])
+            before = [list(c) for c in dec.cols]
+            with pytest.raises(ValueError):
+                dec.apply(blob[:cut])
+            assert dec.cols == before
+    for cut in range(len(frames["query_req"])):
+        with pytest.raises(ValueError):
+            pw.decode_query_request(frames["query_req"][:cut])
+    for cut in range(len(frames["query_res"])):
+        with pytest.raises(ValueError):
+            pw.decode_query_result(frames["query_res"][:cut])
+
+
+def test_generation_stamped_truncation_skips_trailer_boundary():
+    """A gen-stamped frame truncated at EXACTLY the trailer boundary is
+    a VALID pre-upgrade frame (that is what append-only means) — it
+    decodes as generation 0. Every other prefix still raises."""
+    _, frames = _load_pre_generation_fixture()
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    enc.generation = 3  # 1-byte varint trailer
+    kg, _ = enc.encode(*_unpack(_fake_wire(1000.0)), ts=1000.0)
+    dg, was_key = enc.encode(*_unpack(_fake_wire(1001.0)), ts=1001.0)
+    assert not was_key
+    # Strictly appended: strip the trailer and the fixture bytes emerge.
+    assert kg[:-1] == frames["keyframe"] and dg[:-1] == frames["delta"]
+    for blob in (kg, dg):
+        boundary = len(blob) - 1
+        for cut in range(len(blob)):
+            dec = pw.DeltaStreamDecoder()
+            dec.apply(kg)
+            if cut == boundary:
+                assert dec.apply(blob[:cut])["generation"] == 0
+                continue
+            with pytest.raises(ValueError):
+                dec.apply(blob[:cut])
+
+    req = pw.encode_query_request(7, "x", 1.0, 2.0, generation=3)
+    assert req[:-1] == pw.encode_query_request(7, "x", 1.0, 2.0)
+    assert pw.decode_query_request(req[:-1])[-1] == 0
+    res = pw.encode_query_result(7, {"a": 1}, generation=3)
+    assert res[:-1] == pw.encode_query_result(7, {"a": 1})
+    assert pw.decode_query_result(res[:-1])[-1] == 0
+
+
+def _unpack(w):
+    return w["v"], w["fields"], w["rows"]
+
+
+def test_replay_onto_promoted_standby_is_bit_exact():
+    """Failover at the wire level: an active root has consumed a long
+    keyframe+delta history; the uplink rotates to a freshly promoted
+    standby and resyncs with one keyframe (encoder reset). The standby's
+    materialized table must equal the active root's — bit-exact through
+    a re-encode — with the new leader's generation riding the resync."""
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    enc.generation = 1
+    active = pw.DeltaStreamDecoder()
+    for t in range(8):
+        w = _fake_wire(1000.0 + t)
+        active.apply(enc.encode(*_unpack(w), ts=1000.0 + t)[0])
+    # Root dies; standby promotes (generation 2); transport reconnects.
+    enc.reset()
+    enc.generation = 2
+    standby = pw.DeltaStreamDecoder()
+    w = _fake_wire(1007.0)  # same tick the active root last saw
+    frame, was_key = enc.encode(*_unpack(w), ts=1007.0)
+    assert was_key
+    res = standby.apply(frame)
+    assert res["generation"] == 2 and standby.generation == 2
+    assert standby.cols == active.cols
+    assert standby.fields == active.fields
+    # Bit-exact: both states re-encode to identical keyframes.
+    def reencode(dec):
+        e = pw.DeltaStreamEncoder(keyframe_every=1)
+        rows = [list(r) for r in zip(*dec.cols)]
+        return e.encode(1, dec.fields, rows, ts=5.0)[0]
+    assert reencode(standby) == reencode(active)

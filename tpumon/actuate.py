@@ -301,11 +301,13 @@ class _Policy:
         self.reverted = 0
         self.suppressed = 0
         self.rate_limited = 0
-        # One journal event per suppression/rate-limit EPISODE (the
-        # armed policy retries every tick; flooding the bounded journal
-        # with per-tick repeats would evict real incidents).
+        self.fenced = 0
+        # One journal event per suppression/rate-limit/fencing EPISODE
+        # (the armed policy retries every tick; flooding the bounded
+        # journal with per-tick repeats would evict real incidents).
         self.suppress_logged = False
         self.limit_logged = False
+        self.fence_logged = False
         self.last_value: float | None = None
         self.last = ""          # "<transition> · <detail>" for the card
         self.last_ts: float | None = None
@@ -326,11 +328,27 @@ class ActuationEngine:
                  actuator=None, dark_slices=None, placement_domains=None,
                  dry_run: bool = False,
                  max_actions: int = 10, window_s: float = 60.0,
-                 shed_max_fraction: float = 0.5):
+                 shed_max_fraction: float = 0.5,
+                 leader_check=None):
         self.query = query
         self.history = history
         self.journal = journal
         self.actuator = actuator
+        # Root-HA fencing (tpumon.leader): callable -> bool asked at
+        # every FIRE decision. None means "no HA deployment here" —
+        # standalone monitors always actuate. A False answer fences the
+        # fire (journaled once per episode); the policy stays armed and
+        # fires for real if leadership arrives while the condition still
+        # holds. Reverts are deliberately NOT fenced: un-shedding is the
+        # safe direction, and a demoted root must be able to release
+        # remedies it applied while it led — the hazard the fence exists
+        # for is two roots BOTH shedding, never both un-shedding.
+        self.leader_check = leader_check
+        # Last leadership answer published: a flip with no policy
+        # transition must still count as a payload change, or the
+        # cached /api/actuate render keeps saying "leader": true on a
+        # root that just fenced itself (observe()).
+        self._last_leader: bool | None = None
         self.dark_slices = dark_slices  # callable -> iterable of slice ids
         # callable -> iterable of ALL fleet placement domains (dark or
         # not) — kept synced into the engine so requests are attributed
@@ -642,6 +660,13 @@ class ActuationEngine:
     def observe(self, ts: float | None = None) -> bool:
         ts = time.time() if ts is None else ts
         changed = False
+        # Leadership rides the published payload (to_json "leader"):
+        # losing or gaining the lease re-renders /api/actuate even when
+        # no policy moved this tick.
+        lead = self._is_leader()
+        if lead != self._last_leader:
+            self._last_leader = lead
+            changed = True
         # Dark-slice count series FIRST, so this very tick's drain
         # conditions read current fleet state. A None provider result
         # means "no fleet here" (standalone monitor, no federation
@@ -690,12 +715,19 @@ class ActuationEngine:
                     "reverted": pol.reverted,
                     "suppressed": pol.suppressed,
                     "rate_limited": pol.rate_limited,
+                    "fenced": pol.fenced,
                 }
         first = self._payload is None
         self.evaluated_at = ts
         if changed or first:
             self._payload = {"policies": [p.row for p in self.policies]}
         return changed or first
+
+    def _is_leader(self) -> bool:
+        """May this engine perform (or even dry-journal) a FIRE right
+        now? True with no leader_check wired — fencing is an HA-root
+        concern only."""
+        return self.leader_check is None or bool(self.leader_check())
 
     def _cond(self, node, text: str, ctx, memo: dict) -> bool:
         try:
@@ -723,6 +755,7 @@ class ActuationEngine:
                 pol.state = "armed"
                 pol.hold = 1
                 pol.suppress_logged = pol.limit_logged = False
+                pol.fence_logged = False
                 self._journal(pol, "armed", "info",
                               f"condition holds: {spec.when}", ts, dry,
                               ctx=ctx)
@@ -738,7 +771,24 @@ class ActuationEngine:
             in_cooldown = (
                 pol.last_fired_ts is not None
                 and ts - pol.last_fired_ts < spec.cooldown_s)
-            if in_cooldown:
+            if not self._is_leader():
+                # Fencing precedes every other fire gate INCLUDING the
+                # dry-run path: a standby root runs the same policy set
+                # (so promotion inherits armed state instantly) but must
+                # not even dry-fire — the journal would read as a second
+                # root acting. Episode-logged like suppression; the
+                # policy stays armed and fires on the first tick after
+                # promotion if the condition still holds.
+                if not pol.fence_logged:
+                    pol.fence_logged = True
+                    pol.fenced += 1
+                    self._journal(
+                        pol, "fenced", "serious",
+                        "not fleet leader (leadership lease lost, "
+                        "expired, or never held): refusing to actuate",
+                        ts, dry, ctx=ctx)
+                    changed = True
+            elif in_cooldown:
                 if not pol.suppress_logged:
                     pol.suppress_logged = True
                     pol.suppressed += 1
@@ -816,6 +866,7 @@ class ActuationEngine:
             "max_actions": self.max_actions,
             "window_s": self.window_s,
             "actions_in_window": self.actions_in_window,
+            "leader": self._is_leader(),
             "evaluated_at": self.evaluated_at,
         }
 

@@ -49,12 +49,18 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         if "serving" in enabled and cfg.serving_targets
         else None
     )
+    link_faults: dict = {}
     if cfg.chaos:
-        from tpumon.collectors.chaos import wrap_collectors
+        from tpumon.collectors.chaos import split_link_faults, wrap_collectors
 
+        # Link faults (`partition:uplink:…`, `partition:leader:…`)
+        # target the federation uplink / leadership heartbeat, not a
+        # collector — split them off and attach them after the links
+        # are built below.
+        coll_faults, link_faults = split_link_faults(cfg.chaos)
         wrapped = wrap_collectors(
             {"host": host, "accel": accel, "k8s": k8s, "serving": serving},
-            cfg.chaos,
+            coll_faults,
             seed=cfg.chaos_seed,
         )
         host, accel = wrapped["host"], wrapped["accel"]
@@ -124,6 +130,44 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
                 keyframe_every=cfg.federation_keyframe_every,
                 auth_token=cfg.auth_token,
             )
+            if sampler.federation is not None:
+                # An aggregator is not a leader but relays the fleet
+                # leader's fencing token: its own TPWQ fan-out stamps
+                # the highest generation its uplink has seen.
+                up = sampler.uplink
+                sampler.federation.gen_source = lambda: up.gen_seen
+        if role == "root" and (
+            cfg.federation_peer or cfg.federation_initial_leader
+        ):
+            # Root HA (tpumon.leader, docs/federation.md "Root HA"):
+            # the lease self-fences actuation, the heartbeat poll
+            # promotes the standby, and the hub stamps the generation
+            # on every fleet query.
+            from tpumon.leader import LeaderLease
+
+            sampler.leader = LeaderLease(
+                node=node,
+                journal=sampler.journal,
+                peer_url=cfg.federation_peer,
+                lease_s=cfg.federation_lease_s,
+                initial_leader=cfg.federation_initial_leader,
+                auth_token=cfg.auth_token,
+                clock=sampler.clock,
+            )
+            sampler.leader.on_events = sampler.mark_events_dirty
+            sampler.federation.lease = sampler.leader
+    # Chaos link faults attach to the links they target; a fault aimed
+    # at a link this config never builds must fail loudly, like an
+    # unknown collector source does.
+    for src, faults in link_faults.items():
+        target = sampler.uplink if src == "uplink" else sampler.leader
+        if target is None:
+            raise ValueError(
+                f"chaos spec targets {src!r} but no federation "
+                f"{'uplink' if src == 'uplink' else 'leadership lease'} "
+                f"is configured"
+            )
+        target.faults = list(faults)
     history = HistoryService(
         ring,
         prometheus_url=cfg.prometheus_url,
@@ -225,6 +269,10 @@ async def run(cfg: Config, loadgen_engine=None) -> None:
         # Push task starts with the tick loops: one delta frame per
         # tick flows upstream from here on (keyframe first).
         await sampler.uplink.start()
+    if sampler.leader is not None:
+        # Lease renewal + peer heartbeat poll; on a fresh HA pair the
+        # initial_leader root promotes on its first probe.
+        await sampler.leader.start()
     if store is not None:
         await store.start(sampler)
     if snapshotter is not None:
@@ -422,6 +470,16 @@ def main(argv: list[str] | None = None) -> int:
         elif arg == "--federation-role":
             # leaf | aggregator | root; --federate-up alone implies leaf.
             overrides["federation_role"] = take(arg)
+        elif arg == "--federation-peer":
+            # This root's peer root (root HA; set on both roots).
+            overrides["federation_peer"] = take(arg)
+        elif arg == "--federation-lease":
+            # Leadership lease length in seconds (root HA).
+            overrides["federation_lease_s"] = take(arg)
+        elif arg == "--federation-initial-leader":
+            # Bootstrap: this root claims leadership on its first peer
+            # probe (set on exactly one root of an HA pair).
+            overrides["federation_initial_leader"] = "1"
         elif arg == "--sse-keyframe-every":
             # Delta-SSE keyframe cadence (1 = full frame per tick).
             overrides["sse_keyframe_every"] = take_int(arg)
@@ -498,8 +556,10 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-prefill-budget N] "
                 "[--loadgen-admit-lookahead N] "
                 "[--peers host:port,...] [--peer-fanout N] "
-                "[--federate-up http://agg:8888] "
+                "[--federate-up http://root-a:8888,http://root-b:8888] "
                 "[--federation-role leaf|aggregator|root] "
+                "[--federation-peer http://root-b:8888] "
+                "[--federation-lease SECONDS] [--federation-initial-leader] "
                 "[--sse-keyframe-every N] "
                 "[--state FILE] [--history-snapshot FILE] "
                 "[--history-snapshot-format binary|json] "

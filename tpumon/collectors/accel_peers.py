@@ -43,10 +43,12 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
+import random
 import urllib.parse
 from dataclasses import dataclass, field
 
 from tpumon.collectors import Collector, Sample
+from tpumon.resilience import decorrelated_jitter
 from tpumon.protowire import (
     WIRE_FRAME_CTYPE,
     WIRE_FRAME_MAGIC,
@@ -58,6 +60,16 @@ from tpumon.topology import (
     chips_from_columns,
     wire_columns,
 )
+
+
+# Down-peer retry pacing (decorrelated jitter, tpumon.resilience): a
+# failed peer is NOT re-fetched every tick — each failure schedules the
+# next attempt uniform-at-random up to 3x the previous delay, capped
+# fleet-safe so a recovered peer is rediscovered within ~PEER_RETRY_CAP_S
+# worst case. Without this, 64 monitors polling a restarted peer hammer
+# it in lockstep on its first healthy tick (the reconnect stampede).
+PEER_RETRY_BASE_S = 0.5
+PEER_RETRY_CAP_S = 8.0
 
 
 def normalize_base_url(url: str) -> str:
@@ -132,6 +144,10 @@ class PeerFederatedCollector:
                 # which peers' wire-fallback has already been recorded
                 "ok": {},
                 "wire_logged": set(),
+                # down-peer retry gates: url -> (loop.time to retry at,
+                # previous backoff delay) — decorrelated jitter
+                "retry": {},
+                "rng": random.Random(),
             }
         return st
 
@@ -297,14 +313,33 @@ class PeerFederatedCollector:
         slice_s = budget / waves
         loop = asyncio.get_running_loop()
         t_deadline = loop.time() + budget
+        st_retry = self._state()["retry"]
+        rng = self._state()["rng"]
 
         async def bounded(url: str) -> tuple[str, list[ChipSample] | None]:
+            gate = st_retry.get(url)
+            if gate is not None and loop.time() < gate[0]:
+                # Down peer inside its jittered retry window: skip the
+                # fetch entirely (its last error stands) — the herd
+                # control that keeps a fleet from re-polling a dead
+                # peer in lockstep every tick.
+                return url, None
             async with sem:
                 remaining = t_deadline - loop.time()
                 if remaining <= 0.01:
                     self.last_peer_status[url] = "fan-out budget exhausted"
                     return url, None
-                return await self._peer_chips(url, min(slice_s, remaining))
+                res = await self._peer_chips(url, min(slice_s, remaining))
+                if res[1] is None:
+                    prev = gate[1] if gate is not None else 0.0
+                    delay = decorrelated_jitter(
+                        prev, base_s=PEER_RETRY_BASE_S,
+                        cap_s=PEER_RETRY_CAP_S, rng=rng,
+                    )
+                    st_retry[url] = (loop.time() + delay, delay)
+                else:
+                    st_retry.pop(url, None)
+                return res
 
         tasks = [asyncio.ensure_future(bounded(u)) for u in self.peers]
         local_sample = None

@@ -15,11 +15,19 @@ injects configurable faults:
   flap     a two-state Markov toggle between healthy and erroring
            (param = per-collect switch probability) — exercises the
            breaker's open → half-open → closed lifecycle
+  partition  blackholes a federation LINK (param = per-frame drop
+           probability; 1.0 = total blackhole): frames are consumed
+           and silently dropped while the socket stays open, so the
+           remote side sees *silence* — dark marking and lease expiry
+           — rather than a clean disconnect. Targets the link sources
+           ``uplink`` (federation push stream) and ``leader`` (root HA
+           heartbeat, tpumon.leader), not a collector.
 
 Spec grammar (config key ``chaos`` / CLI ``--chaos``), comma-separated
 ``mode:source:param`` clauses::
 
     --chaos hang:accel:0.1,err:k8s:0.3,slow:host:200,flap:serving:0.5
+    --chaos partition:uplink:1.0,partition:leader:1.0
 
 Probabilistic faults (hang/err/corrupt) roll an injected seeded RNG per
 collect, so soak tests are reproducible. Faults are mutable at runtime
@@ -35,7 +43,12 @@ from dataclasses import dataclass, field
 
 from tpumon.collectors import Collector, Sample
 
-FAULT_MODES = ("hang", "err", "slow", "corrupt", "flap")
+FAULT_MODES = ("hang", "err", "slow", "corrupt", "flap", "partition")
+
+# Link (non-collector) chaos targets: `partition` applies to these, and
+# only `partition` does — app.build routes their faults to the
+# FederationUplink / LeaderLease instead of a ChaosCollector wrap.
+LINK_SOURCES = ("uplink", "leader")
 
 # How long a "hang" sleeps: effectively forever relative to any sane
 # deadline, but finite so an un-deadlined test can't wedge the suite.
@@ -83,6 +96,36 @@ def parse_chaos_spec(spec: str) -> dict[str, list[Fault]]:
             raise ValueError(f"bad chaos param {param!r} in {clause!r}")
         out.setdefault(source, []).append(Fault(mode=mode, param=value))
     return out
+
+
+def split_link_faults(spec: str) -> tuple[dict[str, list[Fault]], dict[str, list[Fault]]]:
+    """Partition a parsed --chaos spec into (collector faults, link
+    faults). Link sources (``uplink``, ``leader``) accept only the
+    ``partition`` mode, and ``partition`` only applies to link sources
+    — either mismatch raises, so a typo'd clause fails at startup
+    instead of silently injecting nothing."""
+    by_source = parse_chaos_spec(spec)
+    coll: dict[str, list[Fault]] = {}
+    link: dict[str, list[Fault]] = {}
+    for source, faults in by_source.items():
+        if source in LINK_SOURCES:
+            bad = [f.mode for f in faults if f.mode != "partition"]
+            if bad:
+                raise ValueError(
+                    f"chaos {bad[0]!r} cannot target link source "
+                    f"{source!r} (links take only 'partition')"
+                )
+            link[source] = faults
+        else:
+            bad = [f.mode for f in faults if f.mode == "partition"]
+            if bad:
+                raise ValueError(
+                    f"chaos 'partition' targets a federation link "
+                    f"({', '.join(LINK_SOURCES)}), not collector "
+                    f"{source!r}"
+                )
+            coll[source] = faults
+    return coll, link
 
 
 def _corrupt(data, rng: random.Random):
@@ -193,11 +236,17 @@ class ChaosCollector:
 
 
 def wrap_collectors(
-    collectors: dict[str, Collector | None], spec: str, seed: int | None = None
+    collectors: dict[str, Collector | None],
+    spec: str | dict[str, list[Fault]],
+    seed: int | None = None,
 ) -> dict[str, Collector | None]:
     """Wrap each named collector that the spec targets; unknown source
-    names raise (a typo'd --chaos must not silently test nothing)."""
-    faults_by_source = parse_chaos_spec(spec)
+    names raise (a typo'd --chaos must not silently test nothing).
+    ``spec`` is the raw grammar string or an already-split fault dict
+    (app.build splits link faults off first — split_link_faults)."""
+    faults_by_source = (
+        dict(spec) if isinstance(spec, dict) else parse_chaos_spec(spec)
+    )
     unknown = set(faults_by_source) - set(collectors)
     if unknown:
         raise ValueError(

@@ -256,6 +256,9 @@ class Config:
     federation_role: str = ""
     # Upstream aggregator base URL this instance pushes delta frames to
     # (long-lived chunked POST — push-based, the upstream never polls).
+    # Dual-homed HA: a comma-separated second address is the standby
+    # upstream — the uplink rotates to it on any stream failure and the
+    # reconnect keyframe rebuilds the new upstream's fan-in state.
     federate_up: str | None = None
     # Node identity in upstream views/events; default = hostname.
     federation_node: str | None = None
@@ -268,6 +271,21 @@ class Config:
     # marked dark: its slices flip to health="dark" in the fleet view
     # and a serious ``federation`` event fires.
     federation_dark_after_s: float = 5.0
+    # --- root HA (tpumon.leader, docs/federation.md "Root HA") ---
+    # Base URL of this root's peer root. Set on BOTH roots (each points
+    # at the other); enables the leadership lease + heartbeat poll +
+    # journal reconciliation. Leaves/aggregators reach both roots via a
+    # comma-separated dual-homed federate_up instead.
+    federation_peer: str = ""
+    # Leadership lease length: a root whose event loop stops renewing
+    # for this long self-fences (refuses to actuate); the standby
+    # promotes after 2x this of peer silence.
+    federation_lease_s: float = 2.0
+    # Bootstrap asymmetry: exactly one root sets this, and it claims
+    # generation 1 on its first peer probe instead of waiting out a
+    # silence window. A restarting root always defers to an observed
+    # leader regardless.
+    federation_initial_leader: bool = False
     # Native TSDB append/downsample kernel (tpumon/native/tsdbkern.cpp):
     # off forces the bit-exact pure-Python ingest path even when the
     # shared library is built.
@@ -415,6 +433,10 @@ _SCALAR_FIELDS: dict[str, type] = {
     "federation_node": str,
     "federation_keyframe_every": int,
     "federation_dark_after_s": float,
+    "federation_peer": str,
+    "federation_lease_s": float,
+    "federation_initial_leader":
+        lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "ingest_kernel": lambda v: str(v).lower() in ("1", "true", "yes", "on"),
     "query_fleet_timeout_s": float,
     "sse_keyframe_every": int,

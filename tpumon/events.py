@@ -61,6 +61,8 @@ KINDS = (
     "federation", # aggregator tree: tier up/down, keyframe resync,
                   # rollup lag (tpumon.federation)
     "history",    # history/state/journal snapshot save+restore moments
+    "leader",     # root HA leadership: promoted / demoted / fenced,
+                  # peer journal reconciled (tpumon.leader)
     "peer",       # federation peer up / down / wire-fallback
     "profile",    # jax.profiler device capture (tpumon.profiler)
     "query",      # query engine: rejected recording rule, distributed

@@ -399,9 +399,26 @@ def _render_federation(sampler: Sampler) -> str:
     tpulint registry pass pins that."""
     hub = getattr(sampler, "federation", None)
     uplink = getattr(sampler, "uplink", None)
-    if hub is None and uplink is None:
+    leader = getattr(sampler, "leader", None)
+    if hub is None and uplink is None and leader is None:
         return ""
     w = MetricsWriter()
+    if leader is not None:
+        g = w.gauge(
+            "tpumon_federation_leader",
+            "This root holds an unexpired leadership lease (1=leader)",
+        )
+        g.add({}, 1.0 if leader.is_leader() else 0.0)
+        g = w.gauge(
+            "tpumon_federation_generation",
+            "Highest leadership fencing token this root has observed",
+        )
+        g.add({}, leader.generation)
+        c = w.counter(
+            "tpumon_federation_failovers_total",
+            "Promotions that replaced a previous leader (bootstrap excluded)",
+        )
+        c.add({}, leader.failovers)
     if hub is not None:
         hub.check_staleness()  # dark flips land before the render
         up = w.gauge(

@@ -46,6 +46,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import random
 import time
 import urllib.parse
 
@@ -65,6 +66,7 @@ from tpumon.protowire import (
     encode_varint,
 )
 from tpumon.query import QueryError
+from tpumon.resilience import decorrelated_jitter
 from tpumon.topology import (
     WIRE_VERSION,
     ChipSample,
@@ -200,7 +202,7 @@ class NodeState:
     __slots__ = (
         "node", "tier", "status", "connected", "decoder", "chips",
         "slice_rows", "last_ts", "last_wall", "frames", "keyframes",
-        "resyncs", "bytes", "lagging", "conn", "error",
+        "resyncs", "bytes", "lagging", "conn", "error", "generation",
         "writer", "wlock", "query_results",
     )
 
@@ -221,6 +223,9 @@ class NodeState:
         self.lagging = False
         self.conn: object | None = None  # current connection token
         self.error: str | None = None
+        # Highest leadership generation stamped on this node's frames
+        # (0 = unfenced / pre-upgrade peer; tpumon.leader).
+        self.generation = 0
         # Live ingest-stream writer + its write lock — the hub's
         # query push-down channel (TPWQ frames flow DOWN the same
         # socket the delta frames flow up; cleared on disconnect).
@@ -245,6 +250,7 @@ class NodeState:
                 if self.last_wall is not None
                 else None
             ),
+            "generation": self.generation,
             **({"error": self.error} if self.error else {}),
         }
 
@@ -278,6 +284,13 @@ class FederationHub:
         self.history = None
         self.journal = None
         self.clock = None
+        # Root HA (tpumon.leader): the root's LeaderLease — observes
+        # generations on ingested frames (fencing heal path) and stamps
+        # pushed TPWQ sub-queries. Aggregators have no lease; they
+        # relay the newest generation their own uplink has seen via
+        # ``gen_source`` (wired by tpumon.app.build).
+        self.lease = None
+        self.gen_source = None
         # Aggregator-with-local-chips case: the merged collector
         # stashes the LOCAL chips here so upstream rollups cover them
         # without double-counting the hub's own downstream chips.
@@ -309,6 +322,20 @@ class FederationHub:
         stale bytes (tpulint sections.publish-without-bump)."""
         if self.clock is not None:
             self.clock.bump("federation")
+
+    def generation(self) -> int:
+        """The leadership generation this tier stamps on pushed TPWQ
+        sub-queries: its own lease at a root, the newest token its
+        uplink has seen at an aggregator, 0 (unfenced) otherwise."""
+        if self.lease is not None:
+            return self.lease.generation
+        if self.gen_source is not None:
+            return self.gen_source()
+        return 0
+
+    def _observe_generation(self, gen: int, source: str) -> None:
+        if gen > 0 and self.lease is not None:
+            self.lease.observe(gen, source)
 
     # ------------------------------ ingest ------------------------------
 
@@ -446,8 +473,9 @@ class FederationHub:
             # waiting future; never touches the delta decoder or the
             # node's data-liveness clock (a node answering queries but
             # sending no data frames still goes dark honestly).
-            qid, partial, error, payload = decode_query_result(frame)
+            qid, partial, error, payload, rgen = decode_query_result(frame)
             ns.query_results += 1
+            self._observe_generation(rgen, ns.node)
             fut = self._pending.get(qid)
             if fut is not None and not fut.done():
                 fut.set_result((partial, error, payload))
@@ -457,6 +485,12 @@ class FederationHub:
         ns.frames += 1
         if res["key"]:
             ns.keyframes += 1
+        gen = res.get("generation") or 0
+        if gen:
+            ns.generation = gen
+            # Heal path: a downstream that already follows a newer
+            # leader fences a stale root through its own frames.
+            self._observe_generation(gen, ns.node)
         ns.last_ts = res["ts"]
         ns.last_wall = time.monotonic()
         ns.error = None
@@ -568,7 +602,9 @@ class FederationHub:
         failure (the caller marks the node missing)."""
         self._qid += 1
         qid = self._qid
-        frame = encode_query_request(qid, expr, at, timeout_s)
+        frame = encode_query_request(
+            qid, expr, at, timeout_s, generation=self.generation()
+        )
         rec = encode_varint(len(frame)) + frame
         fut = asyncio.get_running_loop().create_future()
         self._pending[qid] = fut
@@ -874,8 +910,15 @@ class FederationUplink:
     """Downstream side of the tree: one long-lived chunked POST to the
     upstream's /api/federation/ingest, one delta frame per sampler tick
     (leaves push chip rows, aggregators push slice rows). Reconnects
-    with exponential backoff, and — because the encoder resets on every
-    reconnect — always resyncs with a keyframe."""
+    with decorrelated-jitter backoff (a root failover must not trigger
+    a synchronized reconnect herd), and — because the encoder resets on
+    every reconnect — always resyncs with a keyframe.
+
+    Root HA (ISSUE 16): ``url`` may carry a comma-separated primary +
+    standby upstream. The uplink streams to one upstream at a time and
+    rotates to the next on connection loss — failover IS a reconnect,
+    so the standby root rebuilds this node's fan-in state entirely from
+    the opening keyframe, exactly like any resync."""
 
     def __init__(
         self,
@@ -887,16 +930,24 @@ class FederationUplink:
         keyframe_every: int = 30,
         backoff_max_s: float = 5.0,
         auth_token: str | None = None,
+        rng: random.Random | None = None,
     ):
         self.sampler = sampler
-        base = url if url.startswith(("http://", "https://")) else f"http://{url}"
-        self.url = base.rstrip("/")
+        self.urls: list[str] = []
+        for u in (p.strip() for p in str(url).split(",") if p.strip()):
+            base = u if u.startswith(("http://", "https://")) else f"http://{u}"
+            self.urls.append(base.rstrip("/"))
+        if not self.urls:
+            raise ValueError("federate_up: no upstream address")
+        self._active = 0
+        self._last_idx: int | None = None  # upstream of last live stream
         self.node = node
         self.tier = tier
         self.hub = hub
         self.enc = DeltaStreamEncoder(keyframe_every=keyframe_every)
         self.backoff_max_s = backoff_max_s
         self._backoff = 0.25
+        self._rng = rng or random.Random()
         # Bearer token for the upstream's POST auth gate — trees are
         # normally deployed with one fleet-wide auth_token, so the
         # node's own token is what app.build passes here.
@@ -904,6 +955,21 @@ class FederationUplink:
         self.connected = False
         self.connects = 0
         self.resyncs = 0
+        self.failovers = 0  # streams established to a DIFFERENT upstream
+        # Highest leadership generation seen on TPWQ frames from any
+        # upstream (tpumon.leader). Stamped back onto pushed frames so
+        # a stale root ingesting this stream observes the newer token,
+        # and used to refuse older-generation fleet queries outright.
+        self.gen_seen = 0
+        self.queries_fenced = 0
+        # Chaos partition faults (mode "partition", source "uplink"):
+        # frames are encoded then silently dropped — the socket stays
+        # open, so the upstream sees silence (dark after dark_after_s),
+        # not a disconnect; on heal the seq gap forces a keyframe
+        # resync. Lease expiry distinct from clean disconnect.
+        self.faults: list = []
+        self.frames_dropped = 0
+        self._partition_logged = False
         # Distributed-query service stats: TPWQ sub-queries answered on
         # this stream and the TPWR bytes shipped — the "never raw
         # points" bound the fed-query soak pins.
@@ -913,6 +979,11 @@ class FederationUplink:
         self._task: asyncio.Task | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._was_down = False
+
+    @property
+    def url(self) -> str:
+        """The upstream this uplink is (re)connecting to right now."""
+        return self.urls[self._active]
 
     async def start(self) -> None:
         if self._task is None:
@@ -968,8 +1039,17 @@ class FederationUplink:
                         f"uplink to {self.url} lost: {err} (reconnecting; "
                         f"resync will open with a keyframe)",
                     )
+            # Dual-homed failover: every failed attempt rotates to the
+            # next upstream, so a dead primary is abandoned within one
+            # backoff and a dead standby never blocks returning to the
+            # primary.
+            if len(self.urls) > 1:
+                self._active = (self._active + 1) % len(self.urls)
             await asyncio.sleep(self._backoff)
-            self._backoff = min(self._backoff * 2, self.backoff_max_s)
+            self._backoff = decorrelated_jitter(
+                self._backoff, base_s=0.25, cap_s=self.backoff_max_s,
+                rng=self._rng,
+            )
 
     async def _stream_once(self, journal) -> None:
         parts = urllib.parse.urlsplit(self.url)
@@ -1005,6 +1085,15 @@ class FederationUplink:
             self._backoff = 0.25
             self.connects += 1
             self.connected = True
+            if self._last_idx is not None and self._last_idx != self._active:
+                self.failovers += 1
+                journal.record(
+                    "federation", "serious", self.node,
+                    f"uplink failed over to {self.url} "
+                    f"(upstream {self._active + 1}/{len(self.urls)}; "
+                    f"keyframe resync rebuilds fan-in state there)",
+                )
+            self._last_idx = self._active
             if self.connects == 1:
                 journal.record(
                     "federation", "info", self.node,
@@ -1039,11 +1128,19 @@ class FederationUplink:
                 while True:
                     ts = time.time()
                     v, fields, rows = self._payload(ts)
+                    self.enc.generation = self.gen_seen
                     frame, _was_key = self.enc.encode(v, fields, rows, ts)
                     rec = encode_varint(len(frame)) + frame
-                    async with wlock:
-                        writer.write(b"%x\r\n" % len(rec) + rec + b"\r\n")
-                        await writer.drain()
+                    if self._partitioned(journal):
+                        # Blackholed link: the frame is consumed (seq
+                        # advances) but never written — on heal the
+                        # upstream refuses the gap and this uplink
+                        # resyncs with a keyframe.
+                        self.frames_dropped += 1
+                    else:
+                        async with wlock:
+                            writer.write(b"%x\r\n" % len(rec) + rec + b"\r\n")
+                            await writer.drain()
                     if qtask.done():
                         exc = qtask.exception()
                         raise exc if exc is not None else ConnectionError(
@@ -1059,6 +1156,28 @@ class FederationUplink:
             self.connected = False
             with contextlib.suppress(Exception):
                 writer.close()
+
+    def _partitioned(self, journal) -> bool:
+        """True while a chaos ``partition`` fault blackholes this link.
+        Journals the transition only (an hour-long partition is one
+        event, not one per tick) — same hygiene as ChaosCollector."""
+        hit = False
+        for f in self.faults:
+            if f.mode == "partition" and self._rng.random() < f.param:
+                hit = True
+                break
+        if hit and not self._partition_logged:
+            self._partition_logged = True
+            journal.record(
+                "chaos", "minor", self.node,
+                f"uplink partition: dropping frames to {self.url} "
+                f"(socket stays open — upstream sees silence, not a "
+                f"disconnect)",
+                mode="partition",
+            )
+        elif not hit:
+            self._partition_logged = False
+        return hit
 
     async def _serve_queries(
         self,
@@ -1086,11 +1205,34 @@ class FederationUplink:
                 for rec in records:
                     if rec[:4] != QUERY_REQ_MAGIC:
                         raise ConnectionError("upstream ended stream")
-                    qid, expr, at, timeout_s = decode_query_request(rec)
-                    reply = await self._answer_query(qid, expr, at, timeout_s)
+                    qid, expr, at, timeout_s, qgen = decode_query_request(rec)
+                    if qgen > self.gen_seen:
+                        self.gen_seen = qgen
+                    if 0 < qgen < self.gen_seen:
+                        # Fencing: a root stamping an older generation
+                        # has been superseded — refuse the query rather
+                        # than hand a deposed root the fleet state an
+                        # actuation decision would need. Unstamped
+                        # (generation-0) queries are pre-upgrade roots
+                        # and pass unchanged.
+                        self.queries_fenced += 1
+                        reply = encode_query_result(
+                            qid, None,
+                            error=(
+                                f"stale generation {qgen} < "
+                                f"{self.gen_seen} (fenced)"
+                            ),
+                            generation=self.gen_seen,
+                        )
+                    else:
+                        reply = await self._answer_query(
+                            qid, expr, at, timeout_s
+                        )
                     out = encode_varint(len(reply)) + reply
                     self.queries_answered += 1
                     self.query_bytes += len(out)
+                    if self._partitioned(self.sampler.journal):
+                        continue  # blackholed link swallows the answer
                     async with wlock:
                         writer.write(b"%x\r\n" % len(out) + out + b"\r\n")
                         await writer.drain()
@@ -1117,22 +1259,32 @@ class FederationUplink:
                     qid,
                     {"partial": partial, "missing": missing},
                     partial=bool(missing),
+                    generation=self.gen_seen,
                 )
             partial = engine.partial_eval(expr, at=at)
-            return encode_query_result(qid, {"partial": partial, "missing": []})
+            return encode_query_result(
+                qid, {"partial": partial, "missing": []},
+                generation=self.gen_seen,
+            )
         except Exception as e:
             return encode_query_result(
-                qid, None, error=f"{type(e).__name__}: {e}"
+                qid, None, error=f"{type(e).__name__}: {e}",
+                generation=self.gen_seen,
             )
 
     def to_json(self) -> dict:
         st = self.enc.stats
         return {
             "url": self.url,
+            "urls": list(self.urls),
             "tier": self.tier,
             "connected": self.connected,
             "connects": self.connects,
             "resyncs": self.resyncs,
+            "failovers": self.failovers,
+            "gen_seen": self.gen_seen,
+            "queries_fenced": self.queries_fenced,
+            "frames_dropped": self.frames_dropped,
             "frames": st["frames"],
             "keyframes": st["keyframes"],
             "bytes": st["bytes"],

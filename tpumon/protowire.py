@@ -521,6 +521,15 @@ def decode_wire_frame(blob: bytes) -> tuple[int, list[str], list[list]]:
 # raises ValueError — the transport treats that as a gap and resyncs
 # by reconnecting, which always starts with a keyframe (the same
 # resync contract as the SSE delta stream, tpumon.deltas).
+#
+# Leadership generation (ISSUE 16, HA roots): every frame MAY carry a
+# trailing varint generation token — the fencing epoch negotiated by
+# tpumon.leader. The trailer is APPEND-ONLY and OPTIONAL: it is only
+# emitted when the sender's generation is > 0, so a non-HA deployment's
+# frames stay byte-identical to the pre-generation layout (pinned by
+# tests/fixtures/wire_pre_generation.json), and a frame without the
+# trailer decodes as generation 0 — pre-upgrade peers federate
+# unchanged in both directions. The same trailer rides TPWQ/TPWR below.
 
 DELTA_KEY_MAGIC = b"TPWK"
 DELTA_DIFF_MAGIC = b"TPWD"
@@ -548,6 +557,11 @@ class DeltaStreamEncoder:
     def __init__(self, keyframe_every: int = 30):
         self.keyframe_every = max(1, int(keyframe_every))
         self.seq = 0
+        # Leadership generation stamped on every frame while > 0
+        # (tpumon.leader fencing epoch). 0 = unfenced: the trailer is
+        # omitted entirely and the frame is byte-identical to the
+        # pre-generation layout.
+        self.generation = 0
         self._since_key = 0
         self._v: int | None = None
         self._fields: list[str] | None = None
@@ -597,6 +611,8 @@ class DeltaStreamEncoder:
             out = self._header(DELTA_KEY_MAGIC, ts)
             out += encode_varint(len(inner))
             out += inner
+            if self.generation > 0:
+                out += encode_varint(self.generation)
             self._since_key = 1
             self.stats["keyframes"] += 1
             self.stats["keyframe_bytes"] = len(out)
@@ -662,6 +678,8 @@ class DeltaStreamEncoder:
                         continue
                 out.append(ctypes[ci])
                 _encode_col(out, sub, ctypes[ci])
+            if self.generation > 0:
+                out += encode_varint(self.generation)
             self._since_key += 1
             self.stats["delta_frames"] += 1
             self.stats["delta_bytes"] += len(out)
@@ -694,6 +712,9 @@ class DeltaStreamDecoder:
         self.seq = 0
         self.frames = 0
         self.keyframes = 0
+        # Sender's leadership generation from the last applied frame
+        # (0 when the frame carried no trailer — pre-upgrade peers).
+        self.generation = 0
         self._synced = False
 
     def apply(self, blob: bytes) -> dict:
@@ -723,16 +744,31 @@ class DeltaStreamDecoder:
         return {
             "v": self.v, "fields": self.fields, "cols": self.cols,
             "ts": ts, "seq": seq, "key": key,
+            "generation": self.generation,
         }
+
+    @staticmethod
+    def _tail_generation(blob: bytes, pos: int, what: str) -> int:
+        """Parse the optional trailing varint generation starting at
+        ``pos``. Absent trailer (pos == end) decodes as generation 0 —
+        pre-upgrade peers. Anything after the trailer raises."""
+        if pos == len(blob):
+            return 0
+        gen, pos = decode_varint(blob, pos)
+        if pos != len(blob):
+            raise ValueError(f"trailing bytes after {what}")
+        return gen
 
     def _apply_key(self, blob: bytes) -> dict:
         ts, seq, pos = self._head(blob)
         ln, pos = decode_varint(blob, pos)
         if pos + ln > len(blob):
             raise ValueError("truncated keyframe payload")
+        # Parse the generation trailer BEFORE decoding the embedded
+        # frame: a truncated trailer must not leave replaced state.
+        gen = self._tail_generation(blob, pos + ln, "keyframe")
         self.v, self.fields, self.cols = decode_wire_frame(blob[pos : pos + ln])
-        if pos + ln != len(blob):
-            raise ValueError("trailing bytes after keyframe")
+        self.generation = gen
         self.keyframes += 1
         return self._done(ts, seq, True)
 
@@ -789,9 +825,9 @@ class DeltaStreamDecoder:
                     blob, pos, nrows if is_full else len(idx), ctype
                 )
             pending.append((ci, is_full, vals))
-        if pos != len(blob):
-            raise ValueError("trailing bytes after delta frame")
+        gen = self._tail_generation(blob, pos, "delta frame")
         # Phase 2: apply.
+        self.generation = gen
         for ci, is_full, vals in pending:
             if is_full:
                 self.cols[ci] = vals
@@ -814,9 +850,16 @@ class DeltaStreamDecoder:
 # framing of the ingest stream. Layout:
 #
 #   request:  TPWQ <u8 ver> varint qid <f64 at> <f64 timeout_s>
-#             varint len + utf-8 expression
+#             varint len + utf-8 expression [varint generation]
 #   result:   TPWR <u8 ver> varint qid <u8 flags: 1=partial 2=error>
-#             varint len + utf-8 JSON payload
+#             varint len + utf-8 JSON payload [varint generation]
+#
+# The trailing generation follows the delta-stream contract above:
+# emitted only when > 0, absent decodes as 0 — pre-upgrade peers see
+# byte-identical unfenced frames. A downstream answering a TPWQ whose
+# generation is older than the newest it has seen refuses with an
+# error TPWR ("stale generation"): a deposed root cannot gather the
+# fleet state an actuation decision would need (tpumon.leader).
 #
 # The result payload is the mergeable partial-aggregate state
 # (tpumon.query.partial_eval: group sums/counts/min/max, topk row sets,
@@ -832,8 +875,17 @@ _QRES_PARTIAL = 1
 _QRES_ERROR = 2
 
 
+def _query_tail_generation(blob: bytes, pos: int, what: str) -> int:
+    if pos == len(blob):
+        return 0
+    gen, pos = decode_varint(blob, pos)
+    if pos != len(blob):
+        raise ValueError(f"trailing bytes after {what}")
+    return gen
+
+
 def encode_query_request(
-    qid: int, expr: str, at: float, timeout_s: float
+    qid: int, expr: str, at: float, timeout_s: float, generation: int = 0
 ) -> bytes:
     out = bytearray(QUERY_REQ_MAGIC)
     out.append(QUERY_FRAME_VERSION)
@@ -842,11 +894,14 @@ def encode_query_request(
     out += struct.pack("<d", timeout_s)
     raw = expr.encode("utf-8")
     out += encode_varint(len(raw)) + raw
+    if generation > 0:
+        out += encode_varint(generation)
     return bytes(out)
 
 
-def decode_query_request(blob: bytes) -> tuple[int, str, float, float]:
-    """(qid, expr, at, timeout_s); ValueError on anything malformed."""
+def decode_query_request(blob: bytes) -> tuple[int, str, float, float, int]:
+    """(qid, expr, at, timeout_s, generation); ValueError on anything
+    malformed. generation is 0 when the frame carries no trailer."""
     if blob[: len(QUERY_REQ_MAGIC)] != QUERY_REQ_MAGIC:
         raise ValueError("bad query request magic")
     if len(blob) < 5:
@@ -859,9 +914,11 @@ def decode_query_request(blob: bytes) -> tuple[int, str, float, float]:
     at, timeout_s = struct.unpack_from("<dd", blob, pos)
     pos += 16
     ln, pos = decode_varint(blob, pos)
-    if pos + ln != len(blob):
+    if pos + ln > len(blob):
         raise ValueError("truncated query request expression")
-    return qid, blob[pos : pos + ln].decode("utf-8"), at, timeout_s
+    expr = blob[pos : pos + ln].decode("utf-8")
+    gen = _query_tail_generation(blob, pos + ln, "query request")
+    return qid, expr, at, timeout_s, gen
 
 
 def encode_query_result(
@@ -869,6 +926,7 @@ def encode_query_result(
     payload: dict | None,
     partial: bool = False,
     error: str | None = None,
+    generation: int = 0,
 ) -> bytes:
     import json as _json
 
@@ -882,11 +940,14 @@ def encode_query_result(
     out += encode_varint(qid)
     out.append(flags)
     out += encode_varint(len(body)) + body
+    if generation > 0:
+        out += encode_varint(generation)
     return bytes(out)
 
 
-def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict]:
-    """(qid, partial, error, payload); ValueError on anything malformed."""
+def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict, int]:
+    """(qid, partial, error, payload, generation); ValueError on
+    anything malformed. generation is 0 without a trailer."""
     import json as _json
 
     if blob[: len(QUERY_RES_MAGIC)] != QUERY_RES_MAGIC:
@@ -901,7 +962,7 @@ def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict]:
     flags = blob[pos]
     pos += 1
     ln, pos = decode_varint(blob, pos)
-    if pos + ln != len(blob):
+    if pos + ln > len(blob):
         raise ValueError("truncated query result payload")
     try:
         payload = _json.loads(blob[pos : pos + ln])
@@ -910,7 +971,8 @@ def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict]:
     if not isinstance(payload, dict):
         raise ValueError("query result payload must be an object")
     error = payload.get("error") if flags & _QRES_ERROR else None
-    return qid, bool(flags & _QRES_PARTIAL), error, payload
+    gen = _query_tail_generation(blob, pos + ln, "query result")
+    return qid, bool(flags & _QRES_PARTIAL), error, payload, gen
 
 
 def decode_message(buf: bytes, max_depth: int = 16) -> Message:

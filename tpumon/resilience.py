@@ -37,6 +37,30 @@ from dataclasses import dataclass, field
 DEADLINE_ERROR = "deadline exceeded"
 
 
+def decorrelated_jitter(
+    prev_s: float,
+    base_s: float = 0.25,
+    cap_s: float = 5.0,
+    rng: random.Random | None = None,
+) -> float:
+    """Next retry delay, AWS-style decorrelated jitter:
+    ``min(cap, uniform(base, prev * 3))``.
+
+    Pure exponential backoff keeps a fleet in lockstep: when a shared
+    upstream (a federation root, a polled peer) dies, every client's
+    retry clock started at the same instant, so the root's replacement
+    takes the whole herd's reconnects simultaneously — at 64 leaves
+    that synchronized stampede IS the second outage. Decorrelating off
+    the *previous* delay spreads retries across the full [base, cap]
+    window within a couple of rounds while keeping the mean growth
+    exponential, and the cap bounds worst-case reconnect latency
+    fleet-wide (tests/test_federation_ha.py pins the spread)."""
+    r = rng if rng is not None else random
+    lo = max(0.001, base_s)
+    hi = max(lo, prev_s * 3.0)
+    return min(max(0.001, cap_s), r.uniform(lo, hi))
+
+
 class DeadlineExceeded(Exception):
     """A collect() exceeded its wall-clock deadline."""
 

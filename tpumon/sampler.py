@@ -219,6 +219,13 @@ class Sampler:
         # on a standalone monitor.
         self.federation = None
         self.uplink = None
+        # Root-HA leadership lease (tpumon.leader): tpumon.app.build
+        # attaches a LeaderLease when this root has a standby peer
+        # configured. None everywhere else — the actuation engine's
+        # leader_check below treats None as "always leader", so
+        # standalone and single-root deployments actuate exactly as
+        # before.
+        self.leader = None
         # In-tree query engine (tpumon.query, docs/query.md): one per
         # process, over this sampler's ring — /api/query[_range], the
         # expression alert rules' vocabulary, the `tpumon query` CLI
@@ -285,6 +292,12 @@ class Sampler:
                 max_actions=cfg.actuate_max_actions,
                 window_s=cfg.actuate_window_s,
                 shed_max_fraction=cfg.shed_max_fraction,
+                # Closure, not a bound value: app.build attaches the
+                # lease AFTER the sampler is constructed, and leadership
+                # must be asked at fire time, not engine-build time.
+                leader_check=lambda: (self.leader.is_leader()
+                                      if self.leader is not None
+                                      else True),
             )
             # Trend conditions (avg_over_time(queue_depth[w])) ride the
             # recording-rule store like the SLO windows — bench.py's
@@ -516,9 +529,19 @@ class Sampler:
                             if self.uplink is not None
                             else {}
                         ),
+                        # Root-HA heartbeat channel: the standby peer's
+                        # LeaderLease polls exactly this block (node,
+                        # leader, generation) to decide promotion —
+                        # tpumon.leader._poll_cycle.
+                        **(
+                            {"leader": self.leader.to_json()}
+                            if self.leader is not None
+                            else {}
+                        ),
                     }
                 }
-                if self.federation is not None or self.uplink is not None
+                if (self.federation is not None or self.uplink is not None
+                    or self.leader is not None)
                 else {}
             ),
             **(
@@ -1165,6 +1188,8 @@ class Sampler:
         # loops will never fire again.
         if self.uplink is not None:
             await self.uplink.stop()
+        if self.leader is not None:
+            await self.leader.stop()
         # Tick loops stop first — a tick firing during notifier.close()
         # would schedule a dispatch task nobody awaits.
         for t in self._tasks:

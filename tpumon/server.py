@@ -131,6 +131,14 @@ WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 # dashboard's Actuation card as a delta frame on the very next tick.
 RT_SECTIONS = ("host", "accel", "k8s", "alerts", "events", "actuate")
 
+# Per-SSE-client send-queue depth, in frames. The broadcaster renders
+# each tick's frame bytes once and put_nowait()s them into every
+# connected client's bounded queue; a consumer that falls this many
+# frames behind is dropped-and-resynced (queue cleared, next frame
+# forced to a keyframe) instead of its TCP backpressure stalling the
+# fan-out for everyone else.
+SSE_QUEUE_FRAMES = 8
+
 
 def parse_query(query: str) -> dict[str, str]:
     return dict(kv.split("=", 1) for kv in query.split("&") if "=" in kv)
@@ -266,6 +274,14 @@ class MonitorServer:
             "prev_ver": -1, "prev_payload": None,
             "key_bytes": None, "patch_bytes": None,
         }
+        # SSE fan-out state: one broadcaster task feeds every client's
+        # bounded queue (see SSE_QUEUE_FRAMES); connection handlers only
+        # dequeue and write. Lazily started with the first client,
+        # exits when the last one leaves.
+        self._sse_clients: dict[int, dict] = {}
+        self._sse_next_id = 0
+        self._sse_broadcaster: asyncio.Task | None = None
+        self.sse_overruns = 0  # slow-consumer drop-and-resync episodes
 
     # ------------------------------ handlers ------------------------------
 
@@ -380,6 +396,9 @@ class MonitorServer:
             out["uplink"] = uplink.to_json()
         if hub is not None:
             out.update(hub.to_json())
+        leader = getattr(self.sampler, "leader", None)
+        if leader is not None:
+            out["leader"] = leader.to_json()
         return out
 
     def _api_slo(self) -> dict:
@@ -638,8 +657,41 @@ class MonitorServer:
                 ).encode()
         return st["key_bytes"], ver, True
 
+    async def _sse_broadcast(self) -> None:
+        """The fan-out loop: once per sampler tick, render each frame's
+        bytes ONCE (shared via the ``_sse`` memo) and enqueue them to
+        every connected client. put_nowait never blocks, so one client
+        with a full TCP window cannot stall the tick for the rest —
+        its queue is cleared, the overrun counted, and its next frame
+        forced to a keyframe (the same resync contract a reconnect or
+        epoch gap gets)."""
+        interval = max(0.25, self.cfg.sample_interval_s)
+        keyframe_every = max(1, self.cfg.sse_keyframe_every)
+        while self._sse_clients:
+            for c in list(self._sse_clients.values()):
+                frame, ver, was_key = self._sse_frame(
+                    c["ver"],
+                    force_key=c["needs_key"]
+                    or c["since_key"] >= keyframe_every,
+                )
+                try:
+                    c["queue"].put_nowait(frame)
+                except asyncio.QueueFull:
+                    while not c["queue"].empty():
+                        c["queue"].get_nowait()
+                    c["needs_key"] = True
+                    self.sse_overruns += 1
+                    continue  # client_ver unchanged: it never got this
+                c["ver"] = ver
+                c["needs_key"] = False
+                c["since_key"] = 1 if was_key else c["since_key"] + 1
+            # Wake on the next sampler tick; the timeout keeps streams
+            # heartbeating when the sampler loops aren't running
+            # (primed-only test servers, wedged fast loop).
+            await self.sampler.wait_tick(timeout_s=max(2 * interval, 2.0))
+
     async def _stream(self, writer: asyncio.StreamWriter) -> None:
-        """SSE loop: delta frames keyed by snapshot epoch.
+        """SSE connection handler: delta frames keyed by snapshot epoch.
 
         Protocol (applied by web/dashboard.js):
           {"epoch": E, "key": {...}}              keyframe (full payload)
@@ -649,6 +701,10 @@ class MonitorServer:
         gap and resyncs (reconnect → immediate keyframe); keyframes also
         recur every ``sse_keyframe_every`` frames so a silently desynced
         consumer is bounded.
+
+        Frames are produced by the shared ``_sse_broadcast`` task; this
+        handler writes the first keyframe synchronously (a new client
+        must not wait out a tick to paint) then drains its queue.
         """
         head = (
             "HTTP/1.1 200 OK\r\n"
@@ -659,21 +715,31 @@ class MonitorServer:
         )
         writer.write(head.encode("latin-1"))
         await writer.drain()
-        interval = max(0.25, self.cfg.sample_interval_s)
-        keyframe_every = max(1, self.cfg.sse_keyframe_every)
-        client_ver = -1
-        since_key = keyframe_every  # first frame is always a keyframe
-        while True:
-            frame, client_ver, was_key = self._sse_frame(
-                client_ver, force_key=since_key >= keyframe_every
-            )
-            since_key = 1 if was_key else since_key + 1
+        cid = self._sse_next_id
+        self._sse_next_id += 1
+        client = {
+            "queue": asyncio.Queue(maxsize=SSE_QUEUE_FRAMES),
+            "ver": -1,
+            "since_key": 1,
+            "needs_key": False,
+        }
+        # Register BEFORE the first write: the broadcaster only runs at
+        # this handler's next await, by which point the immediate
+        # keyframe below has already settled this client's epoch.
+        self._sse_clients[cid] = client
+        if self._sse_broadcaster is None or self._sse_broadcaster.done():
+            self._sse_broadcaster = asyncio.create_task(
+                self._sse_broadcast())
+        try:
+            frame, client["ver"], _ = self._sse_frame(-1, force_key=True)
             writer.write(b"data: " + frame + b"\n\n")
-            await writer.drain()  # raises once the client is gone
-            # Wake on the next sampler tick; the timeout keeps the
-            # stream heartbeating when the sampler loops aren't running
-            # (primed-only test servers, wedged fast loop).
-            await self.sampler.wait_tick(timeout_s=max(2 * interval, 2.0))
+            await writer.drain()
+            while True:
+                frame = await client["queue"].get()
+                writer.write(b"data: " + frame + b"\n\n")
+                await writer.drain()  # raises once the client is gone
+        finally:
+            self._sse_clients.pop(cid, None)
 
     def _api_health(self) -> dict:
         q_all = quantiles(self.request_latencies_ms)
@@ -696,6 +762,10 @@ class MonitorServer:
                 "latency_p50_ms": round(q_all[0], 3) if q_all else None,
                 "latency_p95_ms": round(q_all[1], 3) if q_all else None,
                 "per_path": per_path,
+                # SSE slow-consumer drop-and-resync episodes (bounded
+                # per-client queues; see _sse_broadcast).
+                "sse_overruns": self.sse_overruns,
+                "sse_clients": len(self._sse_clients),
             },
             # Fast-path health: how much render work the epoch caches
             # absorbed (tpumon.snapshot; pinned by tests/test_fastpath).
@@ -1252,6 +1322,16 @@ class MonitorServer:
         return self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        # The SSE broadcaster dies first (it sleeps up to a heartbeat
+        # interval between fan-outs; letting it linger past stop would
+        # leave a pending task when the loop closes).
+        if self._sse_broadcaster is not None:
+            self._sse_broadcaster.cancel()
+            try:
+                await self._sse_broadcaster
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._sse_broadcaster = None
         # Client writers close BEFORE wait_closed(): on Python >= 3.12.1
         # wait_closed() waits for connection handlers too, and the
         # long-lived streams (SSE, federation ingest) would hold it

@@ -755,8 +755,15 @@ function makeDashboard(doc, net, env, mkSurface) {
         return;
       }
       card.style.display = "";
+      // Root HA leadership (tpumon.leader): which root leads, at what
+      // fencing generation — a standby root labels itself plainly.
+      const lead = res.leader || null;
       $("fed-tag").textContent = res.role +
-        (res.node ? " · " + res.node : "");
+        (res.node ? " · " + res.node : "") +
+        (lead
+          ? (lead.leader ? " · LEADER" : " · standby") +
+            " gen " + lead.generation
+          : "");
       const put = (id, v, fmt) => {
         $(id).textContent = v == null ? "–" : fmt(v);
       };
