@@ -1514,6 +1514,138 @@ async def _bench_federation_ha(leaf_topology: str = "v5p-256") -> dict:
     }
 
 
+async def _bench_trace_fed(
+    n_leaves: int = 8, leaf_topology: str = "v5p-256", n_aggs: int = 2,
+    iters: int = 15, warmup: int = 3,
+) -> dict:
+    """Fleet-tracing cost (ISSUE 19, docs/observability.md "Distributed
+    tracing"): the 8-leaf federation tree of _bench_federation_tree,
+    ticked A/B/A — tracing on, off, on again — so drift can't fake an
+    overhead. Numbers of record:
+
+      fed_freshness_p50_ms        leaf sample -> visible at the root,
+                                  clock-offset corrected (the per-leaf
+                                  fed.<node>.freshness_ms series the
+                                  root records at ingest), tracing on
+      trace_fed_overhead_tick_pct leaf tick p50 with span + TPWS + trace
+                                  trailer shipping vs tracing off
+                                  (acceptance: <= 1%)
+
+    The off leg also proves the degradation contract structurally:
+    every uplink must ship ZERO trace bytes (no TPWS records) and hold
+    a None encoder trace context (no trailing context field) — tracing
+    off adds nothing to the wire.
+    """
+    from tpumon.app import build
+    from tpumon.config import load_config
+
+    async def run(trace_ring: int) -> dict:
+        def mk(**env):
+            base = {
+                "TPUMON_PORT": "0", "TPUMON_HOST": "127.0.0.1",
+                "TPUMON_K8S_MODE": "none", "TPUMON_COLLECTORS": "accel",
+                "TPUMON_HISTORY_PER_CHIP": "0",
+                "TPUMON_FEDERATION_DARK_AFTER_S": "30",
+                "TPUMON_TRACE_RING": str(trace_ring),
+            }
+            base.update(env)
+            return build(load_config(env=base))
+
+        nodes = []
+        tick_ms: list[float] = []
+        fresh_ms: list[float] = []
+        try:
+            root_s, root_srv = mk(
+                TPUMON_ACCEL_BACKEND="none",
+                TPUMON_FEDERATION_ROLE="root",
+                TPUMON_FEDERATION_NODE="root",
+            )
+            await root_s.tick_fast()
+            await root_srv.start()
+            nodes.append((root_s, root_srv))
+            aggs = []
+            for a in range(n_aggs):
+                agg_s, agg_srv = mk(
+                    TPUMON_ACCEL_BACKEND="none",
+                    TPUMON_FEDERATION_ROLE="aggregator",
+                    TPUMON_FEDERATION_NODE=f"agg{a}",
+                    TPUMON_FEDERATE_UP=f"http://127.0.0.1:{root_srv.port}",
+                )
+                await agg_s.tick_fast()
+                await agg_srv.start()
+                await agg_s.uplink.start()
+                aggs.append(agg_s)
+                nodes.append((agg_s, agg_srv))
+            leaves = []
+            for i in range(n_leaves):
+                agg_port = nodes[1 + i * n_aggs // n_leaves][1].port
+                leaf_s, leaf_srv = mk(
+                    TPUMON_ACCEL_BACKEND=f"fake:{leaf_topology}@leaf{i}",
+                    TPUMON_FEDERATION_NODE=f"leaf{i}",
+                    TPUMON_FEDERATE_UP=f"http://127.0.0.1:{agg_port}",
+                )
+                await leaf_s.tick_fast()
+                await leaf_s.uplink.start()
+                leaves.append(leaf_s)
+                nodes.append((leaf_s, leaf_srv))
+
+            async def settle():
+                for _ in range(4):
+                    await asyncio.sleep(0.005)
+
+            for i in range(warmup + iters):
+                t0 = time.perf_counter()
+                await asyncio.gather(*(lf.tick_fast() for lf in leaves))
+                dt = (time.perf_counter() - t0) * 1e3 / n_leaves
+                await settle()
+                await asyncio.gather(*(ag.tick_fast() for ag in aggs))
+                await settle()
+                await root_s.tick_fast()
+                await settle()
+                if i >= warmup:
+                    tick_ms.append(dt)
+                    for node, row in root_s.federation.freshness_now.items():
+                        if node.startswith("leaf"):
+                            fresh_ms.append(row["ms"])
+            uplinks = [s.uplink for s, _ in nodes if s.uplink is not None]
+            return {
+                "tick_p50_ms": _p50(tick_ms),
+                "fresh_ms": fresh_ms,
+                "trace_bytes": sum(u.trace_bytes for u in uplinks),
+                "spans_shipped": sum(u.spans_shipped for u in uplinks),
+                "enc_traces": sum(
+                    1 for u in uplinks if u.enc.trace is not None),
+            }
+        finally:
+            for sampler, server in nodes:
+                with contextlib.suppress(Exception):
+                    await sampler.stop()
+                with contextlib.suppress(Exception):
+                    await server.stop()
+
+    on_a = await run(4096)
+    off = await run(0)
+    on_b = await run(4096)
+    if off["trace_bytes"] != 0 or off["enc_traces"] != 0:
+        raise RuntimeError(
+            f"tracing off leaked onto the wire: {off['trace_bytes']} TPWS "
+            f"bytes, {off['enc_traces']} armed encoder contexts")
+    if not (on_a["spans_shipped"] and on_b["spans_shipped"]):
+        raise RuntimeError("tracing on shipped no spans — nothing measured")
+    tick_on = min(on_a["tick_p50_ms"], on_b["tick_p50_ms"])
+    overhead = 100.0 * (tick_on - off["tick_p50_ms"]) / off["tick_p50_ms"]
+    fresh = on_a["fresh_ms"] + on_b["fresh_ms"]
+    return {
+        "fed_freshness_p50_ms": round(_p50(fresh), 3),
+        "trace_fed_overhead_tick_pct": round(overhead, 2),
+        "trace_fed_tick_on_p50_ms": round(tick_on, 3),
+        "trace_fed_tick_off_p50_ms": round(off["tick_p50_ms"], 3),
+        "trace_fed_spans_shipped": on_a["spans_shipped"],
+        "trace_fed_trace_bytes": on_a["trace_bytes"],
+        "trace_fed_off_trace_bytes": off["trace_bytes"],
+    }
+
+
 async def _bench_hetero(
     n_tpu: int = 8, n_gpu: int = 4, iters: int = 25, warmup: int = 5,
 ) -> dict:
@@ -2265,6 +2397,13 @@ PHASES: dict[str, tuple[float, tuple[str, ...]]] = {
                             "federation_ha_promote_ms",
                             "federation_ha_generation",
                             "federation_ha_lease_s")),
+    "trace_fed": (300, ("fed_freshness_p50_ms",
+                        "trace_fed_overhead_tick_pct",
+                        "trace_fed_tick_on_p50_ms",
+                        "trace_fed_tick_off_p50_ms",
+                        "trace_fed_spans_shipped",
+                        "trace_fed_trace_bytes",
+                        "trace_fed_off_trace_bytes")),
     "hetero": (300, ("hetero_root_scrape_p50_ms",
                      "hetero_root_scrape_tpu_only_p50_ms",
                      "hetero_vs_tpu_only",
@@ -2385,11 +2524,16 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # reconnect-only operand failover_ms subsumes, moved there to keep
     # the summary under its byte budget)
     "federation_failover_ms",
+    # trace_fed (fleet tracing + freshness, docs/observability.md
+    # "Distributed tracing"; the on/off tick operands, shipped-span and
+    # TPWS byte counts live in full results)
+    "fed_freshness_p50_ms",
+    "trace_fed_overhead_tick_pct",
     # hetero (mixed TPU/GPU tree, docs/federation.md "Mixed fleets";
-    # the TPU-only baseline operand, the ≤1.1x ratio and the chip
-    # count live in full results)
+    # the TPU-only baseline operand, the ≤1.1x ratio, the chip count
+    # and the by-accel query p50 live in full results — the query p50
+    # moved there to keep the summary under its byte budget)
     "hetero_root_scrape_p50_ms",
-    "hetero_by_accel_query_p50_ms",
     # query engine (in-tree PromQL subset, docs/query.md; the raw
     # history-walk comparison, the range-grid p50, per-config rule
     # tick operands and the per-leaf TPWR byte cost live in full
@@ -2419,8 +2563,10 @@ KEYS_OF_RECORD: tuple[str, ...] = (
     # TTFT pair moved to full results to make room for the concurrency
     # keys under the summary byte budget — prefix hit/cold remain as
     # diagnostics in BENCH_FULL.json)
+    # (serving_spec_accept_pct moved to full results alongside the
+    # other spec diagnostics — byte budget)
     "serving_tokens_per_sec", "serving_block8_tokens_per_sec",
-    "serving_spec_tokens_per_sec", "serving_spec_accept_pct",
+    "serving_spec_tokens_per_sec",
     "serving_paged_block8_tokens_per_sec",
     "serving_paged_kernel_vs_gather",
     # serving_concurrency (chunked-prefill scheduler vs the sequential
@@ -2492,6 +2638,8 @@ def _run_phase(name: str, backend: str) -> dict:
         return asyncio.run(_bench_federation_tree())
     if name == "federation_ha":
         return asyncio.run(_bench_federation_ha())
+    if name == "trace_fed":
+        return asyncio.run(_bench_trace_fed())
     if name == "hetero":
         return asyncio.run(_bench_hetero())
     if name == "query":

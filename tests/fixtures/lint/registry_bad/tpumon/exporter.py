@@ -6,6 +6,11 @@ def render(w):
     g.add({}, 1.0)
     a = w.gauge("tpumon_actuate_ghost_gauge", "documented nowhere")
     a.add({}, 1.0)
+    # ISSUE 19: tpumon_federation_freshness_* families are pinned to
+    # docs/observability.md on top of the federation pin — this ghost
+    # is in neither doc, so it fires for both prefixes.
+    f = w.gauge("tpumon_federation_freshness_ghost_ms", "documented nowhere")
+    f.add({"node": "leaf0"}, 1.0)
     # ISSUE 15: tpu_* chip/slice families are pinned to
     # docs/federation.md's mixed-fleet table — an accel-labeled family
     # nobody documented must fire registry.metric-undocumented.

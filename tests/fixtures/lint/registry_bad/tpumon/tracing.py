@@ -1,0 +1,5 @@
+"""Known-bad fixture: a federation stage the doc never mentions."""
+
+# `fed.push` has a doc row; `fed.ghost_stage` is documented nowhere and
+# must fire registry.trace-stage-undocumented.
+FED_STAGES = ("fed.push", "fed.ghost_stage")

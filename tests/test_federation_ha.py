@@ -207,6 +207,20 @@ def test_federation_ha_kill_the_root_soak():
         # will be rebuilt entirely from the failover keyframe.
         assert not await asyncio.to_thread(slices, b_port)
 
+        # End-to-end freshness (ISSUE 19): the active root ages leaf0's
+        # samples through agg0's relay, offset-corrected per link.
+        def freshness(port):
+            try:
+                return get_json(port, "/api/federation").get(
+                    "freshness") or {}
+            except OSError:
+                return {}
+        await wait_until(
+            lambda: "leaf0" in freshness(a_port),
+            "leaf freshness accounted on the active root")
+        fr_a = (await asyncio.to_thread(freshness, a_port))["leaf0"]
+        assert 0 <= fr_a["ms"] < 30_000.0, fr_a
+
         # --- mid-burn: page fires on BOTH; only the leader sheds -----
         def fast_firing(port):
             return lambda: (
@@ -294,6 +308,17 @@ def test_federation_ha_kill_the_root_soak():
         assert "tpumon_federation_leader 1" in text
         assert "tpumon_federation_generation 2" in text
         assert "tpumon_federation_failovers_total 1" in text
+        # Freshness survives the failover: the promoted root re-derives
+        # leaf0's age from ITS OWN per-link clock offsets (keyframe
+        # resync rebuilt the fan-in) — no negative ages, no multi-hour
+        # spikes from trusting the dead root's clock arithmetic.
+        await wait_until(
+            lambda: "leaf0" in freshness(b_port),
+            "leaf freshness re-accounted on the promoted root")
+        fr_b = (await asyncio.to_thread(freshness, b_port))["leaf0"]
+        assert 0 <= fr_b["ms"] < 30_000.0, fr_b
+        text = await asyncio.to_thread(metrics_text)
+        assert 'tpumon_federation_freshness_ms{node="leaf0"' in text
 
         # --- the old root restarts: standby, whatever its flag -------
         root_a2, srv_a2 = _mk(**_root_env(

@@ -156,6 +156,8 @@ def test_registry_checker_fires_on_fixture():
         ("registry.metric-undocumented", "tpumon/exporter.py"),
         ("registry.query-func-undocumented", "tpumon/query.py"),
         ("registry.query-func-phantom", "docs/query.md"),
+        ("registry.trace-stage-undocumented", "tpumon/tracing.py"),
+        ("registry.trace-stage-phantom", "docs/observability.md"),
     }
     msgs = " ".join(f.message for f in _fixture("registry_bad", only=("registry",)))
     assert "mystery_fn" in msgs and "made_up" in msgs
@@ -168,6 +170,12 @@ def test_registry_checker_fires_on_fixture():
     # ISSUE 15: the accelerator chip/slice families (tpu_*, accel
     # label) are pinned to docs/federation.md's mixed-fleet table.
     assert "tpu_ghost_accel_gauge" in msgs
+    # ISSUE 19: the freshness family is additionally pinned to
+    # docs/observability.md, and FED_STAGES drift fires both ways —
+    # the documented+declared stage stays clean.
+    assert "tpumon_federation_freshness_ghost_ms" in msgs
+    assert "fed.ghost_stage" in msgs and "fed.invented" in msgs
+    assert "'fed.push'" not in msgs
 
 
 # ---------------------------- suppressions ----------------------------

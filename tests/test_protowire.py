@@ -432,10 +432,12 @@ def test_generation_trailer_roundtrip_all_frame_types():
     assert res["generation"] == 300 and dec.generation == 300
 
     req = pw.encode_query_request(9, "fleet(duty)", 1.0, 2.0, generation=300)
-    assert pw.decode_query_request(req) == (9, "fleet(duty)", 1.0, 2.0, 300)
+    assert pw.decode_query_request(req) == (
+        9, "fleet(duty)", 1.0, 2.0, 300, None
+    )
     res = pw.encode_query_result(9, {"kind": "scalar"}, generation=300)
-    qid, partial, error, payload, gen = pw.decode_query_result(res)
-    assert (qid, partial, error, gen) == (9, False, None, 300)
+    qid, partial, error, payload, gen, trace = pw.decode_query_result(res)
+    assert (qid, partial, error, gen, trace) == (9, False, None, 300, None)
     assert payload == {"kind": "scalar"}
 
 
@@ -455,13 +457,15 @@ def test_pre_generation_fixture_decodes_and_reencodes_bit_exact():
 
     q = fix["query_req"]
     assert pw.decode_query_request(frames["query_req"]) == (
-        q["qid"], q["expr"], q["at"], q["timeout_s"], 0
+        q["qid"], q["expr"], q["at"], q["timeout_s"], 0, None
     )
     r = fix["query_res"]
-    qid, partial, error, payload, gen = pw.decode_query_result(
+    qid, partial, error, payload, gen, trace = pw.decode_query_result(
         frames["query_res"]
     )
-    assert (qid, partial, error, gen) == (r["qid"], r["partial"], None, 0)
+    assert (qid, partial, error, gen, trace) == (
+        r["qid"], r["partial"], None, 0, None
+    )
     assert payload == r["payload"]
 
     # Today's encoder, generation 0 (the default): bit-exact re-encode.
@@ -525,10 +529,10 @@ def test_generation_stamped_truncation_skips_trailer_boundary():
 
     req = pw.encode_query_request(7, "x", 1.0, 2.0, generation=3)
     assert req[:-1] == pw.encode_query_request(7, "x", 1.0, 2.0)
-    assert pw.decode_query_request(req[:-1])[-1] == 0
+    assert pw.decode_query_request(req[:-1])[4] == 0
     res = pw.encode_query_result(7, {"a": 1}, generation=3)
     assert res[:-1] == pw.encode_query_result(7, {"a": 1})
-    assert pw.decode_query_result(res[:-1])[-1] == 0
+    assert pw.decode_query_result(res[:-1])[4] == 0
 
 
 def _unpack(w):
@@ -564,3 +568,217 @@ def test_replay_onto_promoted_standby_is_bit_exact():
         rows = [list(r) for r in zip(*dec.cols)]
         return e.encode(1, dec.fields, rows, ts=5.0)[0]
     assert reencode(standby) == reencode(active)
+
+
+# ------------- trace context trailer (ISSUE 19, fleet tracing) ----------
+
+
+def _load_gen_pre_trace_fixture():
+    import base64
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(__file__), "fixtures", "wire_gen_pre_trace.json"
+    )
+    with open(path) as f:
+        fix = json.load(f)
+    return fix, {
+        k: base64.b64decode(fix[f"{k}_b64"])
+        for k in ("keyframe", "delta", "query_req", "query_res")
+    }
+
+
+def test_trace_trailer_roundtrip_all_frame_types():
+    """All four frame types carry the optional trace context after the
+    generation and decode it back — including at generation 0, where
+    the generation varint is emitted explicitly so the trace fields
+    stay positionally unambiguous."""
+    ctx = (0xABCDEF0123, 42, "leaf0")
+    for gen in (0, 7, 300):
+        enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+        enc.generation = gen
+        enc.trace = ctx
+        dec = pw.DeltaStreamDecoder()
+        for ts in (1000.0, 1001.0):  # keyframe, then delta
+            res = dec.apply(enc.encode(*_unpack(_fake_wire(ts)), ts=ts)[0])
+            assert res["generation"] == gen and res["trace"] == ctx
+            assert dec.trace == ctx
+        req = pw.encode_query_request(
+            9, "fleet(duty)", 1.0, 2.0, generation=gen, trace=ctx
+        )
+        assert pw.decode_query_request(req) == (
+            9, "fleet(duty)", 1.0, 2.0, gen, ctx
+        )
+        res = pw.encode_query_result(
+            9, {"kind": "scalar"}, generation=gen, trace=ctx
+        )
+        out = pw.decode_query_result(res)
+        assert (out[0], out[4], out[5]) == (9, gen, ctx)
+    # Clearing the context restores the pre-trace layout mid-stream.
+    enc.trace = None
+    res = dec.apply(enc.encode(*_unpack(_fake_wire(1002.0)), ts=1002.0)[0])
+    assert res["trace"] is None and dec.trace is None
+
+
+def test_gen_pre_trace_fixture_decodes_and_reencodes_bit_exact():
+    """ISSUE-16-era back-compat pinned both directions by checked-in
+    frames (never re-generated): a generation-stamped pre-trace peer's
+    TPWK/TPWD/TPWQ/TPWR decode unchanged (generation kept, trace None),
+    and today's encoder with tracing off reproduces every one byte for
+    byte — the trace trailer really is append-only and conditional, so
+    tracing off adds ZERO wire bytes."""
+    fix, frames = _load_gen_pre_trace_fixture()
+    gen = fix["generation"]
+
+    dec = pw.DeltaStreamDecoder()
+    res = dec.apply(frames["keyframe"])
+    assert res["key"] and res["generation"] == gen and res["trace"] is None
+    res = dec.apply(frames["delta"])
+    assert not res["key"] and res["generation"] == gen
+    assert res["trace"] is None and dec.trace is None
+
+    q = fix["query_req"]
+    assert pw.decode_query_request(frames["query_req"]) == (
+        q["qid"], q["expr"], q["at"], q["timeout_s"], gen, None
+    )
+    r = fix["query_res"]
+    qid, partial, error, payload, rgen, trace = pw.decode_query_result(
+        frames["query_res"]
+    )
+    assert (qid, partial, error, rgen, trace) == (
+        r["qid"], r["partial"], None, gen, None
+    )
+    assert payload == r["payload"]
+
+    # Today's encoder, trace None (the default, and always when tracing
+    # is off): bit-exact re-encode of the pre-trace frames.
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    enc.generation = gen
+    assert enc.trace is None
+    for ts, name in ((1000.0, "keyframe"), (1001.0, "delta")):
+        frame, _ = enc.encode(*_unpack(_fake_wire(ts)), ts=ts)
+        assert frame == frames[name], name
+    assert pw.encode_query_request(
+        q["qid"], q["expr"], q["at"], q["timeout_s"], generation=gen
+    ) == frames["query_req"]
+    assert pw.encode_query_result(
+        r["qid"], r["payload"], partial=r["partial"], generation=gen
+    ) == frames["query_res"]
+
+
+def test_gen_pre_trace_fixture_truncation_at_every_prefix():
+    """Truncation guard over the gen-stamped fixture frames: every cut
+    raises EXCEPT the single append-only boundary at the start of the
+    generation varint (a valid pre-generation frame), and the stream
+    decoder stays atomic across refused frames."""
+    fix, frames = _load_gen_pre_trace_fixture()
+    ngen = len(pw.encode_varint(fix["generation"]))
+    assert ngen == 2  # multi-byte: cuts INSIDE the varint must raise
+    for blob in (frames["keyframe"], frames["delta"]):
+        boundary = len(blob) - ngen
+        for cut in range(len(blob)):
+            dec = pw.DeltaStreamDecoder()
+            dec.apply(frames["keyframe"])
+            before = [list(c) for c in dec.cols]
+            if cut == boundary:
+                assert dec.apply(blob[:cut])["generation"] == 0
+                continue
+            with pytest.raises(ValueError):
+                dec.apply(blob[:cut])
+            assert dec.cols == before
+    for name, decode in (
+        ("query_req", pw.decode_query_request),
+        ("query_res", pw.decode_query_result),
+    ):
+        blob = frames[name]
+        boundary = len(blob) - ngen
+        for cut in range(len(blob)):
+            if cut == boundary:
+                assert decode(blob[:cut])[4] == 0
+                continue
+            with pytest.raises(ValueError):
+                decode(blob[:cut])
+
+
+def test_trace_stamped_truncation_skips_both_trailer_boundaries():
+    """A trace-stamped frame has exactly TWO valid truncation points —
+    end of payload (pre-generation layout) and end of the generation
+    varint (pre-trace layout); every cut inside the trace context
+    itself raises, and the stream decoder stays atomic."""
+    ctx = (0x1234, 5, "leaf0")
+    gen = 3
+    trailer = pw.encode_trailers(gen, ctx)
+    enc = pw.DeltaStreamEncoder(keyframe_every=1000)
+    enc.generation = gen
+    enc.trace = ctx
+    kg, _ = enc.encode(*_unpack(_fake_wire(1000.0)), ts=1000.0)
+    dg, was_key = enc.encode(*_unpack(_fake_wire(1001.0)), ts=1001.0)
+    assert not was_key
+    for blob in (kg, dg):
+        base = len(blob) - len(trailer)
+        gen_end = base + len(pw.encode_varint(gen))
+        for cut in range(len(blob)):
+            dec = pw.DeltaStreamDecoder()
+            dec.apply(kg)
+            before = [list(c) for c in dec.cols]
+            if cut in (base, gen_end):
+                res = dec.apply(blob[:cut])
+                assert res["generation"] == (0 if cut == base else gen)
+                assert res["trace"] is None
+                continue
+            with pytest.raises(ValueError):
+                dec.apply(blob[:cut])
+            assert dec.cols == before
+
+    req = pw.encode_query_request(7, "x", 1.0, 2.0, generation=gen, trace=ctx)
+    base = len(req) - len(trailer)
+    gen_end = base + len(pw.encode_varint(gen))
+    assert req[:base] == pw.encode_query_request(7, "x", 1.0, 2.0)
+    for cut in range(len(req)):
+        if cut in (base, gen_end):
+            out = pw.decode_query_request(req[:cut])
+            assert out[4] == (0 if cut == base else gen) and out[5] is None
+            continue
+        with pytest.raises(ValueError):
+            pw.decode_query_request(req[:cut])
+
+
+def test_trace_origin_bounded_both_directions():
+    ok = (1, 2, "x" * pw.TRACE_ORIGIN_MAX)
+    assert pw.decode_query_request(
+        pw.encode_query_request(1, "e", 0.0, 1.0, trace=ok)
+    )[5] == ok
+    with pytest.raises(ValueError):
+        pw.encode_query_request(
+            1, "e", 0.0, 1.0, trace=(1, 2, "x" * (pw.TRACE_ORIGIN_MAX + 1))
+        )
+    # Hand-crafted hostile trailer: implausible origin length refused.
+    base = pw.encode_query_request(1, "e", 0.0, 1.0)
+    evil = base + pw.encode_varint(0) + pw.encode_varint(1) + \
+        pw.encode_varint(2) + pw.encode_varint(pw.TRACE_ORIGIN_MAX + 1)
+    with pytest.raises(ValueError):
+        pw.decode_query_request(evil)
+
+
+def test_trace_span_relay_frame_roundtrip_and_truncation():
+    """TPWS span-relay records roundtrip and refuse truncation/garbage
+    everywhere — same record discipline as the query frames they ride
+    the ingest stream with."""
+    payload = {
+        "node": "agg0",
+        "spans": [
+            {"sid": 3, "parent": None, "name": "fed.push", "dur_ms": 1.5},
+        ],
+        "offsets": {"leaf0": 12.25},
+    }
+    blob = pw.encode_trace_spans(payload)
+    assert blob[:4] == pw.TRACE_SPANS_MAGIC
+    assert pw.decode_trace_spans(blob) == payload
+    for cut in range(len(blob)):
+        with pytest.raises(ValueError):
+            pw.decode_trace_spans(blob[:cut])
+    with pytest.raises(ValueError):
+        pw.decode_trace_spans(blob + b"x")
+    with pytest.raises(ValueError):
+        pw.encode_trace_spans({"spans": ["y" * pw.TRACE_SPANS_MAX]})

@@ -79,6 +79,42 @@ class TestSpanTracer:
         assert tr.to_json()["spans"] == []
         assert tr.export_chrome()["traceEvents"][0]["ph"] == "M"
 
+    def test_fleet_export_stamps_node_names_per_pid(self):
+        """ISSUE 19 satellite: ``export_chrome(fleet=True)`` gives each
+        node its own pid, names every process ``tpumon:<node>`` in the
+        metadata (Perfetto's process list IS the fleet roster), and
+        shifts remote timestamps by the per-origin clock offset."""
+        tr = SpanTracer(16)
+        tr.node = "root"
+        tid = tr.new_trace()
+        with tr.span("fed.render", trace=tid):
+            pass
+        tr.add_remote([
+            {"name": "fed.push", "node": "leaf0", "trace": format(tid, "x"),
+             "sid": 7, "parent": None, "track": "uplink",
+             "ts": 1000.5, "dur_ms": 2.0, "rp": ["root", 1]},
+            {"name": "fed.ingest", "node": "agg0", "trace": format(tid, "x"),
+             "sid": 3, "parent": None, "track": "http",
+             "ts": 1000.2, "dur_ms": 1.0},
+        ])
+        out = tr.export_chrome(fleet=True, offsets={"leaf0": 0.5})
+        meta = {
+            e["args"]["name"]: e["pid"]
+            for e in out["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
+        assert meta == {"tpumon:root": 1, "tpumon:leaf0": 2,
+                        "tpumon:agg0": 3}
+        xs = {e["name"]: e for e in out["traceEvents"] if e["ph"] == "X"}
+        assert xs["fed.push"]["pid"] == meta["tpumon:leaf0"]
+        assert xs["fed.ingest"]["pid"] == meta["tpumon:agg0"]
+        assert xs["fed.render"]["pid"] == 1
+        # leaf0's clock runs 0.5 s ahead: its span lands at ts-0.5 on
+        # the root's timeline; agg0 (no offset known) ships unshifted.
+        assert xs["fed.push"]["ts"] == round(1000.0 * 1e6, 1)
+        assert xs["fed.ingest"]["ts"] == round(1000.2 * 1e6, 1)
+        assert xs["fed.push"]["args"]["remote_parent"] == ["root", 1]
+
     def test_concurrent_tasks_do_not_adopt_each_others_spans(self):
         tr = SpanTracer(64)
 

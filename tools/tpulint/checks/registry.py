@@ -32,7 +32,14 @@ declarative pass over every name registry the tree carries:
   (``RANGE_FUNCTIONS`` + ``AGG_OPS``) must have a row in
   docs/query.md's "## Functions" table, and that table may not invent
   functions (``registry.query-func-*``) — the expression language's
-  vocabulary is user-facing and must not drift from its docs.
+  vocabulary is user-facing and must not drift from its docs;
+- trace stages: every federation span name in tpumon/tracing.py's
+  ``FED_STAGES`` tuple must appear backticked in
+  docs/observability.md, and the doc may not invent ``fed.*`` stages
+  (``registry.trace-stage-*``) — operators grep Perfetto exports and
+  ``/api/trace`` payloads by these names, so the doc table IS the
+  contract. Dotted names need their own regex: ``TABLE_ROW_RE``
+  only matches ``[a-z_]+`` and would silently skip ``fed.push``.
 
 The scan helpers are module-level so tests/test_routes_doc.py and
 tests/test_events_doc.py run their original assertions through the
@@ -53,12 +60,14 @@ SERVER = "tpumon/server.py"
 BENCH = "bench.py"
 EXPORTER = "tpumon/exporter.py"
 QUERY = "tpumon/query.py"
+TRACING = "tpumon/tracing.py"
 README = "README.md"
 EVENTS_DOC = "docs/events.md"
 FEDERATION_DOC = "docs/federation.md"
 QUERY_DOC = "docs/query.md"
 SLO_DOC = "docs/slo.md"
 ACTUATION_DOC = "docs/actuation.md"
+OBSERVABILITY_DOC = "docs/observability.md"
 
 # journal.record("<kind>" — restricted to journal receivers so
 # RingHistory.record("cpu", ...) never matches (same contract as the
@@ -69,6 +78,10 @@ TABLE_ROW_RE = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.M)
 # Route-shaped string literals in server.py (the original
 # tests/test_routes_doc.py scan).
 ROUTE_RE = re.compile(r'"(/(?:api/[a-z0-9_/]+|metrics))"')
+# Backticked dotted federation stage names (`fed.push`) anywhere in
+# docs/observability.md — TABLE_ROW_RE's [a-z_]+ can't see the dot, and
+# prose mentions count as documentation the same way table rows do.
+FED_STAGE_RE = re.compile(r"`(fed\.[a-z_]+)`")
 
 
 def _assign_targets(node: ast.AST) -> list[tuple[ast.AST, ast.AST]]:
@@ -326,6 +339,35 @@ def documented_query_functions(project: Project) -> set[str]:
     return set(TABLE_ROW_RE.findall(m.group(1)))
 
 
+def trace_stage_names(project: Project) -> dict[str, int]:
+    """Federation span names declared in tpumon/tracing.py's
+    ``FED_STAGES`` literal tuple, with lines."""
+    sf = project.file(TRACING)
+    if sf is None or sf.tree is None:
+        return {}
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        for t, value in _assign_targets(node):
+            if (
+                isinstance(t, ast.Name)
+                and t.id == "FED_STAGES"
+                and isinstance(value, (ast.Tuple, ast.List))
+            ):
+                for elt in value.elts:
+                    s = const_str(elt)
+                    if s is not None:
+                        out[s] = elt.lineno
+    return out
+
+
+def documented_trace_stages(project: Project) -> set[str]:
+    """Backticked ``fed.*`` stage names in docs/observability.md."""
+    sf = project.file(OBSERVABILITY_DOC)
+    if sf is None:
+        return set()
+    return set(FED_STAGE_RE.findall(sf.text))
+
+
 def exporter_metric_families(project: Project) -> dict[str, int]:
     """Literal metric-family names registered in tpumon/exporter.py."""
     sf = project.file(EXPORTER)
@@ -540,6 +582,38 @@ def check(project: Project) -> list[Finding]:
                 )
             )
 
+    # --- federation trace stages (ISSUE 19 satellite) ---
+    stages = trace_stage_names(project)
+    if stages and project.file(OBSERVABILITY_DOC) is not None:
+        documented = documented_trace_stages(project)
+        # Same no-guard rule as query funcs: a deleted tracing section
+        # fires one finding per stage instead of disarming the lint.
+        for name, line in sorted(stages.items()):
+            if name not in documented:
+                findings.append(
+                    Finding(
+                        check="registry.trace-stage-undocumented",
+                        path=TRACING,
+                        line=line,
+                        message=(
+                            f"federation trace stage {name!r} is not "
+                            f"documented in docs/observability.md"
+                        ),
+                    )
+                )
+        for name in sorted(documented - set(stages)):
+            findings.append(
+                Finding(
+                    check="registry.trace-stage-phantom",
+                    path=OBSERVABILITY_DOC,
+                    line=1,
+                    message=(
+                        f"docs/observability.md documents stage {name!r}, "
+                        f"which tracing.FED_STAGES does not declare"
+                    ),
+                )
+            )
+
     # --- federation / SLO / actuation exporter gauges (ISSUE 8 / 13 /
     # 14 satellites) --- Prefix -> the doc that must carry the family's
     # row (README.md is accepted for any): operator-facing exporter
@@ -547,9 +621,15 @@ def check(project: Project) -> list[Finding]:
     fed_doc = project.file(FEDERATION_DOC)
     slo_doc = project.file(SLO_DOC)
     act_doc = project.file(ACTUATION_DOC)
+    obs_doc = project.file(OBSERVABILITY_DOC)
     pinned_prefixes = (
         ("tpumon_federation_", FEDERATION_DOC,
          (fed_doc.text if fed_doc else "") + readme_text),
+        # Freshness accounting (ISSUE 19) is documented where the
+        # tracing semantics live — the family must ALSO have a row in
+        # docs/observability.md, on top of the federation pin above.
+        ("tpumon_federation_freshness_", OBSERVABILITY_DOC,
+         (obs_doc.text if obs_doc else "") + readme_text),
         ("tpumon_slo_", SLO_DOC,
          (slo_doc.text if slo_doc else "") + readme_text),
         ("tpumon_actuate_", ACTUATION_DOC,

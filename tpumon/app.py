@@ -113,6 +113,11 @@ def build(cfg: Config) -> tuple[Sampler, MonitorServer]:
         )
 
         node = cfg.federation_node or socket.gethostname()
+        # Fleet tracing: spans shipped upstream (and wire/header trace
+        # contexts) carry this node's federation name, not the "local"
+        # placeholder — a multi-node Perfetto export needs one process
+        # track per NAMED node.
+        sampler.tracer.node = node
         if role in ("aggregator", "root"):
             hub = FederationHub(
                 node=node, role=role, dark_after_s=cfg.federation_dark_after_s
@@ -573,8 +578,9 @@ def main(argv: list[str] | None = None) -> int:
                 "[--events-ring N] [--events-log FILE] "
                 "[--chaos mode:source:param,...]\n"
                 "       python -m tpumon trace [--url HOST:8888] "
-                "[--export trace.json] [--spans N]   (self-trace of a "
-                "running server)\n"
+                "[--export trace.json] [--spans N] [--fleet]   "
+                "(self-trace of a running server; --fleet adds the "
+                "federation freshness/span view)\n"
                 "       python -m tpumon events [--url HOST:8888] [-n N] "
                 "[--kind K] [--severity S] [--follow] [--json]   (event "
                 "journal tail)\n"

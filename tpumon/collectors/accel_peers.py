@@ -60,6 +60,7 @@ from tpumon.topology import (
     chips_from_columns,
     wire_columns,
 )
+from tpumon.tracing import current_ctx_header
 
 
 # Down-peer retry pacing (decorrelated jitter, tpumon.resilience): a
@@ -230,6 +231,12 @@ class PeerFederatedCollector:
             headers["If-None-Match"] = etag
         if use_wire and self.wire_binary:
             headers["Accept"] = WIRE_FRAME_CTYPE
+        # Fleet tracing: when this fetch runs inside a fleet-traced
+        # span, the peer joins the same trace (its http span remote-
+        # parents onto ours). Absent otherwise — no bytes added.
+        trace_hdr = current_ctx_header()
+        if trace_hdr:
+            headers["X-Tpumon-Trace"] = trace_hdr
         status, body, rheaders = self._request(url, path, headers, timeout_s)
         if status == 304:
             return st["chips"].get(url, [])

@@ -444,6 +444,19 @@ def _render_federation(sampler: Sampler) -> str:
                 age.add(labels, round(time.monotonic() - ns.last_wall, 3))
             frames.add(labels, ns.frames)
             fbytes.add(labels, ns.bytes)
+        if hub.freshness_now:
+            # End-to-end freshness (ISSUE 19): age of each ORIGIN
+            # node's newest sample when it landed here, clock-offset
+            # corrected — keyed per origin, not per direct downstream,
+            # so a root exports one series per leaf it can see.
+            fr = w.gauge(
+                "tpumon_federation_freshness_ms",
+                "Milliseconds from an origin node's newest sample to it "
+                "landing at this node (clock-offset corrected)",
+            )
+            for node, row in sorted(hub.freshness_now.items()):
+                fr.add({"node": node, "tier": row.get("tier") or ""},
+                       row.get("ms"))
         fleet = hub.fleet()
         g = w.gauge(
             "tpumon_federation_fleet_slices", "Slices in the fleet view"

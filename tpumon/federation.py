@@ -56,17 +56,21 @@ from tpumon.protowire import (
     DELTA_STREAM_CTYPE,
     QUERY_REQ_MAGIC,
     QUERY_RES_MAGIC,
+    TRACE_SPANS_MAGIC,
     DeltaStreamDecoder,
     DeltaStreamEncoder,
     decode_query_request,
     decode_query_result,
+    decode_trace_spans,
     decode_varint,
     encode_query_request,
     encode_query_result,
+    encode_trace_spans,
     encode_varint,
 )
 from tpumon.query import QueryError
 from tpumon.resilience import decorrelated_jitter
+from tpumon.tracing import format_trace_header
 from tpumon.topology import (
     WIRE_VERSION,
     ChipSample,
@@ -116,6 +120,19 @@ ROLLUP_SERIES: tuple[tuple[str, str], ...] = (
 )
 
 _MAX_RECORD = 16 * 1024 * 1024  # one frame can never plausibly exceed this
+
+# Fleet-tracing bounds (ISSUE 19). OFFSET_WINDOW: per-link send/recv
+# timestamp deltas kept for the clock-offset estimate — the minimum of
+# the window is the least-delayed frame, so offset ≈ -min(delta) with
+# network/tick jitter filtered out. RELAY_CAP bounds spans an
+# aggregator buffers for upstream relay; FRESH_NODES_MAX bounds the
+# per-origin freshness/offset tables (origin names arrive over the
+# wire from subtrees — the tables must stay bounded even against a
+# malicious or miswired downstream, same rule as Hub.MAX_NODES).
+OFFSET_WINDOW = 64
+RELAY_CAP = 512
+RELAY_PER_TICK = 256
+FRESH_NODES_MAX = 1024
 
 # Float metric fields the uplink quantizes to f32 before encoding
 # (tsdb.quantize_val — the same round-trip the TSDB applies at append
@@ -203,7 +220,7 @@ class NodeState:
         "node", "tier", "status", "connected", "decoder", "chips",
         "slice_rows", "last_ts", "last_wall", "frames", "keyframes",
         "resyncs", "bytes", "lagging", "conn", "error", "generation",
-        "writer", "wlock", "query_results",
+        "writer", "wlock", "query_results", "off_win", "offset_s",
     )
 
     def __init__(self, node: str, tier: str):
@@ -232,6 +249,14 @@ class NodeState:
         self.writer: asyncio.StreamWriter | None = None
         self.wlock: asyncio.Lock | None = None
         self.query_results = 0  # TPWR partial-result frames received
+        # Clock-offset estimation (ISSUE 19): recv_wall - frame_ts for
+        # the last OFFSET_WINDOW data frames. Every delta is
+        # (local_clock - sender_clock) + transit delay with delay >= 0,
+        # so offset_s = sender - local ≈ -min(window) — the least-
+        # delayed frame carries the purest skew reading. No wall-clock
+        # trust: the estimate survives a sender whose NTP is hours off.
+        self.off_win: list[float] = []
+        self.offset_s: float | None = None
 
     def to_json(self) -> dict:
         return {
@@ -251,6 +276,11 @@ class NodeState:
                 else None
             ),
             "generation": self.generation,
+            "offset_ms": (
+                round(self.offset_s * 1e3, 3)
+                if self.offset_s is not None
+                else None
+            ),
             **({"error": self.error} if self.error else {}),
         }
 
@@ -307,12 +337,31 @@ class FederationHub:
         # peer/federation kinds).
         self._partial_missing: frozenset = frozenset()
         self._timeout_logged: set[str] = set()
+        # Fleet tracing (ISSUE 19, docs/observability.md "Distributed
+        # tracing"): per-origin clock offsets in SECONDS
+        # (origin_clock - local_clock; direct children measured from
+        # frame send/recv pairs, grandchildren composed from TPWS
+        # offsets_s relays), spans buffered for upstream relay at a
+        # non-root tier, the latest per-origin end-to-end freshness
+        # snapshot, and the last ingested frame's trace context (the
+        # root tick's fed.render span links to it, then clears it).
+        self.clock_offsets: dict[str, float] = {}
+        self.span_relay: list[dict] = []
+        self.spans_relayed = 0
+        self.freshness_now: dict[str, dict] = {}
+        self.last_ingest_ctx: tuple[int, int] | None = None
 
     def bind(self, sampler) -> None:
         self.sampler = sampler
         self.history = sampler.history
         self.journal = sampler.journal
         self.clock = sampler.clock
+
+    def _tracer(self):
+        """The bound sampler's SpanTracer, or None pre-bind — every
+        tracing touch point goes through here so a hub exercised
+        standalone (tests) never trips on a missing sampler."""
+        return getattr(self.sampler, "tracer", None)
 
     def _bump(self) -> None:
         """Advance the "federation" dirty section — every mutation of
@@ -346,6 +395,7 @@ class FederationHub:
         node: str | None,
         tier: str | None,
         chunked: bool,
+        trace: tuple[int, int, str] | None = None,
     ) -> None:
         """Serve one long-lived downstream push stream. Frames are
         decoded and landed as they arrive; the HTTP response is only
@@ -394,6 +444,18 @@ class FederationHub:
         # (NodeState.to_json "connected"): a connect that lands before
         # the first frame must re-render /api/federation too.
         self._bump()
+        tr = self._tracer()
+        if trace is not None and tr is not None and tr.enabled:
+            # fed.accept: one marker span per accepted stream, remote-
+            # parented on the uplink's X-Tpumon-Trace context — NOT an
+            # open-ended span over the long-lived POST (which would
+            # never close and never land; per-frame work is fed.ingest).
+            tid, psid, origin = trace
+            tr.record(
+                "fed.accept", cat="http", track="http",
+                trace=tid, remote_parent=(origin, psid),
+                node=ns.node, tier=tier, route=INGEST_PATH,
+            )
         status, err = 200, None
         buf = bytearray()
         try:
@@ -473,14 +535,24 @@ class FederationHub:
             # waiting future; never touches the delta decoder or the
             # node's data-liveness clock (a node answering queries but
             # sending no data frames still goes dark honestly).
-            qid, partial, error, payload, rgen = decode_query_result(frame)
+            qid, partial, error, payload, rgen, _rtrace = decode_query_result(
+                frame
+            )
             ns.query_results += 1
             self._observe_generation(rgen, ns.node)
             fut = self._pending.get(qid)
             if fut is not None and not fut.done():
                 fut.set_result((partial, error, payload))
             return
+        if frame[:4] == TRACE_SPANS_MAGIC:
+            # Completed spans (and composed clock offsets) relayed from
+            # a downstream tier. Advisory, like TPWR: never touches the
+            # delta decoder or the liveness clock.
+            self._ingest_spans(ns, decode_trace_spans(frame))
+            return
+        t_start = time.perf_counter()
         res = ns.decoder.apply(frame)  # ValueError → caller answers 400
+        t_decode = time.perf_counter()
         self.frames += 1
         ns.frames += 1
         if res["key"]:
@@ -515,10 +587,15 @@ class FederationHub:
             chips = chips_from_columns(res["fields"], res["cols"])
             ns.chips = chips
             ns.slice_rows = slice_rollup_rows(chips, ns.node, res["ts"])
+        t_rollup = time.perf_counter()
         self._record_rollups(ns.slice_rows, res["ts"])
+        recv_wall = time.time()
+        self._observe_offset(ns, res["ts"], recv_wall)
+        self._record_freshness(ns, res["ts"], recv_wall)
+        self._trace_ingest(ns, res.get("trace"), t_start, t_decode, t_rollup)
         # Rollup lag: frames landing long after their sample time mean
         # the tree is buffering somewhere — one event per transition.
-        lag = time.time() - res["ts"]
+        lag = recv_wall - res["ts"]
         if lag > self.dark_after_s:
             if not ns.lagging:
                 ns.lagging = True
@@ -562,6 +639,156 @@ class FederationHub:
         if batch:
             self.history.record_batch(batch, ts=ts)
 
+    # ------------------------- fleet tracing ----------------------------
+    #
+    # ISSUE 19 (docs/observability.md "Distributed tracing"): the hub
+    # side of cross-node span assembly. Data frames double as clock
+    # probes (send/recv timestamp pairs per link), TPWS records relay
+    # completed downstream spans plus the sender's own composed offset
+    # table, and every landed frame records the per-origin end-to-end
+    # freshness series — the latter ALWAYS, tracing on or off (direct
+    # children need no TPWS; grandchild offsets compose only while the
+    # subtree relays them, i.e. while tracing is on down there).
+
+    def _observe_offset(
+        self, ns: NodeState, frame_ts: float, recv_wall: float
+    ) -> None:
+        win = ns.off_win
+        win.append(recv_wall - frame_ts)
+        if len(win) > OFFSET_WINDOW:
+            del win[: len(win) - OFFSET_WINDOW]
+        ns.offset_s = -min(win)
+        if ns.node in self.clock_offsets or len(self.clock_offsets) < FRESH_NODES_MAX:
+            self.clock_offsets[ns.node] = ns.offset_s
+
+    def _record_freshness(
+        self, ns: NodeState, frame_ts: float, recv_wall: float
+    ) -> None:
+        """Land ``fed.<origin>.freshness_ms`` for every origin node the
+        frame carried fresh rows for: the age of the origin's newest
+        sample once it became visible HERE, with the origin's clock
+        skew corrected via the estimated offset. Leaf frames speak for
+        their sender; aggregator frames carry per-row origin nodes and
+        origin-stamped timestamps, so one root frame refreshes a whole
+        subtree's series. Dark rows (last-known, re-shipped) are
+        skipped — an outage is an honest gap, same rule as rollups."""
+        if ns.tier == "leaf" or not ns.slice_rows:
+            origin_ts = {ns.node: frame_ts}
+        else:
+            origin_ts = {}
+            for r in ns.slice_rows:
+                if (r.get("health") or "ok") != "ok":
+                    continue
+                node, ts = r.get("node"), r.get("ts")
+                if node and isinstance(ts, (int, float)):
+                    prev = origin_ts.get(node)
+                    origin_ts[node] = ts if prev is None else max(prev, ts)
+        batch = []
+        for node, ts in origin_ts.items():
+            off = self.clock_offsets.get(node)
+            if off is None:
+                # No composed estimate for this origin yet: correct by
+                # the direct link's skew alone (exact when origin IS
+                # the direct child; a bounded approximation deeper).
+                off = ns.offset_s or 0.0
+            ms = max(0.0, (recv_wall - (ts - off)) * 1e3)
+            if node in self.freshness_now or len(self.freshness_now) < FRESH_NODES_MAX:
+                self.freshness_now[node] = {
+                    "ms": round(ms, 3),
+                    "offset_ms": round(off * 1e3, 3),
+                    "via": ns.node,
+                    "tier": ns.tier,
+                }
+                batch.append((f"fed.{node}.freshness_ms", ms))
+        if batch and self.history is not None:
+            self.history.record_batch(batch, ts=recv_wall)
+
+    def _ingest_spans(self, ns: NodeState, payload: dict) -> None:
+        spans = [s for s in payload.get("spans") or [] if isinstance(s, dict)]
+        tr = self._tracer()
+        if tr is not None and tr.enabled:
+            tr.add_remote(spans)
+        # Compose the sender's offset table onto THIS clock: it
+        # measured off(X rel sender); this link measured
+        # off(sender rel me); the sum is off(X rel me).
+        base = self.clock_offsets.get(ns.node, ns.offset_s or 0.0)
+        for origin, off in (payload.get("offsets_s") or {}).items():
+            if not isinstance(origin, str) or not isinstance(off, (int, float)):
+                continue
+            if origin in self.clock_offsets or len(self.clock_offsets) < FRESH_NODES_MAX:
+                self.clock_offsets[origin] = off + base
+        if self.role != "root" and spans:
+            # Relay upstream (bounded): the root is the assembly point;
+            # an intermediate tier forwards what its subtree shipped.
+            self.span_relay.extend(spans)
+            if len(self.span_relay) > RELAY_CAP:
+                del self.span_relay[: len(self.span_relay) - RELAY_CAP]
+
+    def _trace_ingest(
+        self,
+        ns: NodeState,
+        rctx: tuple[int, int, str] | None,
+        t_start: float,
+        t_decode: float,
+        t_rollup: float,
+    ) -> None:
+        """Retrofit spans onto a landed frame whose trailer carried a
+        trace context — the sender's fed.push becomes this fed.ingest's
+        remote parent. Recorded AFTER the fact because the context is
+        only known once the frame decoded. A closed per-frame span
+        (cat="http", route-tagged) is what puts the federation ingest
+        route in the /api/trace per-route p95 table — the long-lived
+        POST itself never completes, so an open-ended request span
+        would never land (the bug this closes)."""
+        if rctx is None:
+            return
+        tr = self._tracer()
+        if tr is None or not tr.enabled:
+            return
+        tid, psid, origin = rctx
+        now = time.perf_counter()
+        sid = tr.record(
+            "fed.ingest", cat="http", track="http",
+            t0=t_start, dur_ms=(now - t_start) * 1e3,
+            trace=tid, remote_parent=(origin, psid),
+            route=INGEST_PATH, node=ns.node,
+        )
+        tr.record(
+            "fed.decode", t0=t_start, dur_ms=(t_decode - t_start) * 1e3,
+            trace=tid, parent=sid,
+        )
+        tr.record(
+            "fed.rollup", t0=t_decode, dur_ms=(t_rollup - t_decode) * 1e3,
+            trace=tid, parent=sid,
+        )
+        tr.record(
+            "fed.land", t0=t_rollup, dur_ms=(now - t_rollup) * 1e3,
+            trace=tid, parent=sid,
+        )
+        self.last_ingest_ctx = (tid, sid)
+
+    def fleet_trace_json(self) -> dict:
+        """The ``/api/trace?fleet=1`` federation block: per-origin
+        freshness + offsets, and the assembled cross-node span buffer
+        shifted onto this node's clock."""
+        tr = self._tracer()
+        return {
+            "node": self.node,
+            "role": self.role,
+            "freshness": {
+                n: dict(row) for n, row in sorted(self.freshness_now.items())
+            },
+            "offsets_s": {
+                n: round(v, 6) for n, v in sorted(self.clock_offsets.items())
+            },
+            "relay_pending": len(self.span_relay),
+            "spans": (
+                tr.fleet_spans(self.clock_offsets)
+                if tr is not None and tr.enabled
+                else []
+            ),
+        }
+
     # ----------------------- distributed queries ------------------------
     #
     # The Monarch-style push-down (docs/query.md): a fleet query is a
@@ -602,8 +829,15 @@ class FederationHub:
         failure (the caller marks the node missing)."""
         self._qid += 1
         qid = self._qid
+        # Trace propagation: if the caller runs inside a fleet-traced
+        # span (the /api/query handler's http span after ensure_trace),
+        # its context rides the TPWQ trailer — contextvars survive the
+        # asyncio.gather fan-out, so every sub-query carries the same
+        # trace id. Untraced callers stamp nothing (zero wire bytes).
+        tr = self._tracer()
+        ctx = tr.current_ctx() if tr is not None and tr.enabled else None
         frame = encode_query_request(
-            qid, expr, at, timeout_s, generation=self.generation()
+            qid, expr, at, timeout_s, generation=self.generation(), trace=ctx
         )
         rec = encode_varint(len(frame)) + frame
         fut = asyncio.get_running_loop().create_future()
@@ -844,6 +1078,9 @@ class FederationHub:
             "slices": self.slices(),
             "fleet": self.fleet(),
             "frames": self.frames,
+            "freshness": {
+                n: dict(row) for n, row in sorted(self.freshness_now.items())
+            },
         }
 
     def health_json(self) -> dict:
@@ -975,6 +1212,11 @@ class FederationUplink:
         # points" bound the fed-query soak pins.
         self.queries_answered = 0
         self.query_bytes = 0
+        # Fleet-tracing stats: spans shipped upstream in TPWS records
+        # and the wire bytes they cost (0 while tracing is off — the
+        # bench's zero-added-bytes assert reads these).
+        self.spans_shipped = 0
+        self.trace_bytes = 0
         self.last_error: str | None = None
         self._task: asyncio.Task | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -1066,6 +1308,18 @@ class FederationUplink:
                 if self.auth_token
                 else ""
             )
+            # Stream-scope trace context (ISSUE 19): a fresh trace id
+            # with parent span 0 — "this stream's root at the origin".
+            # The upstream's fed.accept span joins it; per-frame traces
+            # ride the frame trailers instead. Absent while tracing is
+            # off: the request bytes stay pre-upgrade identical.
+            tr0 = getattr(self.sampler, "tracer", None)
+            thdr = (
+                "X-Tpumon-Trace: "
+                f"{format_trace_header((tr0.new_trace(), 0, tr0.node))}\r\n"
+                if tr0 is not None and tr0.enabled
+                else ""
+            )
             writer.write(
                 (
                     f"POST {INGEST_PATH} HTTP/1.1\r\n"
@@ -1073,6 +1327,7 @@ class FederationUplink:
                     f"Content-Type: {DELTA_STREAM_CTYPE}\r\n"
                     "Transfer-Encoding: chunked\r\n"
                     f"{auth}"
+                    f"{thdr}"
                     f"X-Tpumon-Node: {self.node}\r\n"
                     f"X-Tpumon-Tier: {self.tier}\r\n\r\n"
                 ).encode("latin-1")
@@ -1124,13 +1379,36 @@ class FederationUplink:
             qtask = asyncio.create_task(
                 self._serve_queries(reader, writer, wlock)
             )
+            tr = getattr(self.sampler, "tracer", None)
             try:
                 while True:
                     ts = time.time()
-                    v, fields, rows = self._payload(ts)
-                    self.enc.generation = self.gen_seen
-                    frame, _was_key = self.enc.encode(v, fields, rows, ts)
+                    if tr is not None and tr.enabled:
+                        # One fleet trace per pushed frame: fed.push
+                        # roots it, fed.collect/fed.encode nest inside,
+                        # and the frame trailer carries the context so
+                        # the upstream's fed.ingest joins the tree.
+                        with tr.span(
+                            "fed.push", track="uplink", trace=tr.new_trace()
+                        ) as sp:
+                            sp.tag(upstream=self.url)
+                            with tr.span("fed.collect", track="uplink"):
+                                v, fields, rows = self._payload(ts)
+                            self.enc.generation = self.gen_seen
+                            self.enc.trace = (sp.trace, sp.sid, tr.node)
+                            with tr.span("fed.encode", track="uplink"):
+                                frame, _was_key = self.enc.encode(
+                                    v, fields, rows, ts
+                                )
+                    else:
+                        v, fields, rows = self._payload(ts)
+                        self.enc.generation = self.gen_seen
+                        self.enc.trace = None  # off ⇒ zero added wire bytes
+                        frame, _was_key = self.enc.encode(v, fields, rows, ts)
                     rec = encode_varint(len(frame)) + frame
+                    # Piggyback this tick's completed spans (the
+                    # fed.push that just closed is in the outbox now).
+                    rec += self._trace_record(tr)
                     if self._partitioned(journal):
                         # Blackholed link: the frame is consumed (seq
                         # advances) but never written — on heal the
@@ -1156,6 +1434,38 @@ class FederationUplink:
             self.connected = False
             with contextlib.suppress(Exception):
                 writer.close()
+
+    def _trace_record(self, tr) -> bytes:
+        """The piggybacked TPWS record for one tick: this node's own
+        completed trace-correlated spans, anything its subtree relayed
+        through the hub, and — at an aggregator — the hub's composed
+        clock-offset table (how the root learns grandchild offsets).
+        b"" when tracing is off or nothing is queued, so the stream
+        stays bit-identical to a pre-trace peer's (PR 3 contract)."""
+        if tr is None or not tr.enabled:
+            return b""
+        spans = tr.drain_outbox()
+        offsets: dict[str, float] = {}
+        if self.hub is not None:
+            relay = self.hub.span_relay[:RELAY_PER_TICK]
+            del self.hub.span_relay[:RELAY_PER_TICK]
+            self.hub.spans_relayed += len(relay)
+            spans += relay
+            offsets = {
+                n: round(v, 6) for n, v in self.hub.clock_offsets.items()
+            }
+        if not spans:
+            return b""
+        try:
+            frame = encode_trace_spans(
+                {"node": self.node, "spans": spans, "offsets_s": offsets}
+            )
+        except ValueError:
+            return b""  # oversize relay burst: drop it (advisory data)
+        out = encode_varint(len(frame)) + frame
+        self.spans_shipped += len(spans)
+        self.trace_bytes += len(out)
+        return out
 
     def _partitioned(self, journal) -> bool:
         """True while a chaos ``partition`` fault blackholes this link.
@@ -1205,29 +1515,48 @@ class FederationUplink:
                 for rec in records:
                     if rec[:4] != QUERY_REQ_MAGIC:
                         raise ConnectionError("upstream ended stream")
-                    qid, expr, at, timeout_s, qgen = decode_query_request(rec)
+                    (
+                        qid, expr, at, timeout_s, qgen, qtrace,
+                    ) = decode_query_request(rec)
                     if qgen > self.gen_seen:
                         self.gen_seen = qgen
-                    if 0 < qgen < self.gen_seen:
-                        # Fencing: a root stamping an older generation
-                        # has been superseded — refuse the query rather
-                        # than hand a deposed root the fleet state an
-                        # actuation decision would need. Unstamped
-                        # (generation-0) queries are pre-upgrade roots
-                        # and pass unchanged.
-                        self.queries_fenced += 1
-                        reply = encode_query_result(
-                            qid, None,
-                            error=(
-                                f"stale generation {qgen} < "
-                                f"{self.gen_seen} (fenced)"
-                            ),
-                            generation=self.gen_seen,
-                        )
-                    else:
-                        reply = await self._answer_query(
-                            qid, expr, at, timeout_s
-                        )
+                    # A traced sub-query answers inside a fed.query
+                    # span remote-parented on the asker's context; the
+                    # TPWR trailer echoes THIS span's context back and
+                    # the completed span ships upstream via TPWS.
+                    tr = getattr(self.sampler, "tracer", None)
+                    span_cm = (
+                        tr.span("fed.query", track="uplink", remote=qtrace)
+                        if qtrace is not None and tr is not None and tr.enabled
+                        else contextlib.nullcontext()
+                    )
+                    with span_cm as sp:
+                        rctx = None
+                        if sp is not None:
+                            sp.tag(expr=expr[:80])
+                            rctx = (sp.trace, sp.sid, tr.node)
+                        if 0 < qgen < self.gen_seen:
+                            # Fencing: a root stamping an older
+                            # generation has been superseded — refuse
+                            # the query rather than hand a deposed root
+                            # the fleet state an actuation decision
+                            # would need. Unstamped (generation-0)
+                            # queries are pre-upgrade roots and pass
+                            # unchanged.
+                            self.queries_fenced += 1
+                            reply = encode_query_result(
+                                qid, None,
+                                error=(
+                                    f"stale generation {qgen} < "
+                                    f"{self.gen_seen} (fenced)"
+                                ),
+                                generation=self.gen_seen,
+                                trace=rctx,
+                            )
+                        else:
+                            reply = await self._answer_query(
+                                qid, expr, at, timeout_s, trace=rctx
+                            )
                     out = encode_varint(len(reply)) + reply
                     self.queries_answered += 1
                     self.query_bytes += len(out)
@@ -1241,12 +1570,19 @@ class FederationUplink:
                 writer.close()
 
     async def _answer_query(
-        self, qid: int, expr: str, at: float, timeout_s: float
+        self,
+        qid: int,
+        expr: str,
+        at: float,
+        timeout_s: float,
+        trace: tuple[int, int, str] | None = None,
     ) -> bytes:
         """One TPWQ → TPWR: partial-evaluate over local data (and, at an
         aggregator, this node's own subtree). Evaluation failures ship
         as explicit error results — the upstream degrades to partial
-        instead of tearing the stream down."""
+        instead of tearing the stream down. ``trace`` is the answering
+        fed.query span's context, echoed on every TPWR shape (success,
+        partial, error) so the asker can link the reply."""
         try:
             engine = getattr(self.sampler, "query", None)
             if engine is None:
@@ -1260,16 +1596,19 @@ class FederationUplink:
                     {"partial": partial, "missing": missing},
                     partial=bool(missing),
                     generation=self.gen_seen,
+                    trace=trace,
                 )
             partial = engine.partial_eval(expr, at=at)
             return encode_query_result(
                 qid, {"partial": partial, "missing": []},
                 generation=self.gen_seen,
+                trace=trace,
             )
         except Exception as e:
             return encode_query_result(
                 qid, None, error=f"{type(e).__name__}: {e}",
                 generation=self.gen_seen,
+                trace=trace,
             )
 
     def to_json(self) -> dict:
@@ -1293,5 +1632,7 @@ class FederationUplink:
             "keyframe_bytes": st["keyframe_bytes"],
             "queries_answered": self.queries_answered,
             "query_bytes": self.query_bytes,
+            "spans_shipped": self.spans_shipped,
+            "trace_bytes": self.trace_bytes,
             **({"last_error": self.last_error} if self.last_error else {}),
         }

@@ -530,11 +530,79 @@ def decode_wire_frame(blob: bytes) -> tuple[int, list[str], list[list]]:
 # tests/fixtures/wire_pre_generation.json), and a frame without the
 # trailer decodes as generation 0 — pre-upgrade peers federate
 # unchanged in both directions. The same trailer rides TPWQ/TPWR below.
+#
+# Trace context (ISSUE 19, fleet tracing): a SECOND optional trailer
+# MAY follow the generation — varint trace id, varint parent span id,
+# varint len + utf-8 origin node name — linking the frame to the
+# sender's open span (tpumon.tracing). Ordering makes both layers
+# independently append-only: absent entirely → (generation 0, no
+# trace); generation alone → the PR 16 layout, bit-exact (pinned by
+# tests/fixtures/wire_gen_pre_trace.json); both → the generation varint
+# is emitted even when 0 so the trace fields are unambiguous. Tracing
+# is off by default, so a tracing-off sender adds ZERO wire bytes (the
+# PR 3 contract), and a traced deployment only stamps frames after the
+# receiving tier is upgraded — a pre-trace decoder refuses the extra
+# bytes exactly like any other trailing garbage.
 
 DELTA_KEY_MAGIC = b"TPWK"
 DELTA_DIFF_MAGIC = b"TPWD"
 DELTA_FRAME_VERSION = 1
 DELTA_STREAM_CTYPE = "application/x-tpumon-deltastream"
+
+# Longest origin node name the trace trailer accepts — matches the
+# federation tier's node-name sanity bound, and keeps a hostile trailer
+# from smuggling a megabyte into every frame.
+TRACE_ORIGIN_MAX = 128
+
+
+def encode_trailers(
+    generation: int, trace: tuple[int, int, str] | None
+) -> bytes:
+    """The optional frame trailers: [varint generation][trace ctx].
+
+    No generation, no trace → b"" (pre-generation layout, bit-exact).
+    Generation only → single varint (PR 16 layout, bit-exact).
+    With a trace ctx the generation varint is ALWAYS emitted (0 is
+    fine) so the decoder can tell the two trailers apart positionally.
+    """
+    if trace is None:
+        return encode_varint(generation) if generation > 0 else b""
+    tid, psid, origin = trace
+    raw = origin.encode("utf-8")
+    if len(raw) > TRACE_ORIGIN_MAX:
+        raise ValueError("trace origin name too long")
+    out = bytearray(encode_varint(generation))
+    out += encode_varint(tid)
+    out += encode_varint(psid)
+    out += encode_varint(len(raw)) + raw
+    return bytes(out)
+
+
+def decode_trailers(
+    blob: bytes, pos: int, what: str
+) -> tuple[int, tuple[int, int, str] | None]:
+    """Parse the optional trailers starting at ``pos``; returns
+    (generation, trace ctx | None). The only VALID early ends are the
+    append-only boundaries: end-of-payload (pre-generation peer) and
+    end-of-generation-varint (pre-trace peer) — anything else, and any
+    bytes past a complete trace ctx, raises ValueError."""
+    if pos == len(blob):
+        return 0, None
+    gen, pos = decode_varint(blob, pos)
+    if pos == len(blob):
+        return gen, None
+    tid, pos = decode_varint(blob, pos)
+    psid, pos = decode_varint(blob, pos)
+    ln, pos = decode_varint(blob, pos)
+    if ln > TRACE_ORIGIN_MAX:
+        raise ValueError(f"implausible trace origin after {what}")
+    if pos + ln > len(blob):
+        raise ValueError(f"truncated trace context after {what}")
+    origin = blob[pos : pos + ln].decode("utf-8")
+    pos += ln
+    if pos != len(blob):
+        raise ValueError(f"trailing bytes after {what}")
+    return gen, (tid, psid, origin)
 
 
 def _read_f64(blob: bytes, pos: int) -> tuple[float, int]:
@@ -562,6 +630,11 @@ class DeltaStreamEncoder:
         # omitted entirely and the frame is byte-identical to the
         # pre-generation layout.
         self.generation = 0
+        # Trace context (trace id, parent span id, origin node) stamped
+        # while not None — set per tick by the federation uplink when
+        # tracing is enabled. None (the default, and always when tracing
+        # is off) adds zero wire bytes.
+        self.trace: tuple[int, int, str] | None = None
         self._since_key = 0
         self._v: int | None = None
         self._fields: list[str] | None = None
@@ -611,8 +684,7 @@ class DeltaStreamEncoder:
             out = self._header(DELTA_KEY_MAGIC, ts)
             out += encode_varint(len(inner))
             out += inner
-            if self.generation > 0:
-                out += encode_varint(self.generation)
+            out += encode_trailers(self.generation, self.trace)
             self._since_key = 1
             self.stats["keyframes"] += 1
             self.stats["keyframe_bytes"] = len(out)
@@ -678,8 +750,7 @@ class DeltaStreamEncoder:
                         continue
                 out.append(ctypes[ci])
                 _encode_col(out, sub, ctypes[ci])
-            if self.generation > 0:
-                out += encode_varint(self.generation)
+            out += encode_trailers(self.generation, self.trace)
             self._since_key += 1
             self.stats["delta_frames"] += 1
             self.stats["delta_bytes"] += len(out)
@@ -715,6 +786,9 @@ class DeltaStreamDecoder:
         # Sender's leadership generation from the last applied frame
         # (0 when the frame carried no trailer — pre-upgrade peers).
         self.generation = 0
+        # Sender's trace context from the last applied frame (None when
+        # absent — untraced or pre-trace peers).
+        self.trace: tuple[int, int, str] | None = None
         self._synced = False
 
     def apply(self, blob: bytes) -> dict:
@@ -744,31 +818,20 @@ class DeltaStreamDecoder:
         return {
             "v": self.v, "fields": self.fields, "cols": self.cols,
             "ts": ts, "seq": seq, "key": key,
-            "generation": self.generation,
+            "generation": self.generation, "trace": self.trace,
         }
-
-    @staticmethod
-    def _tail_generation(blob: bytes, pos: int, what: str) -> int:
-        """Parse the optional trailing varint generation starting at
-        ``pos``. Absent trailer (pos == end) decodes as generation 0 —
-        pre-upgrade peers. Anything after the trailer raises."""
-        if pos == len(blob):
-            return 0
-        gen, pos = decode_varint(blob, pos)
-        if pos != len(blob):
-            raise ValueError(f"trailing bytes after {what}")
-        return gen
 
     def _apply_key(self, blob: bytes) -> dict:
         ts, seq, pos = self._head(blob)
         ln, pos = decode_varint(blob, pos)
         if pos + ln > len(blob):
             raise ValueError("truncated keyframe payload")
-        # Parse the generation trailer BEFORE decoding the embedded
-        # frame: a truncated trailer must not leave replaced state.
-        gen = self._tail_generation(blob, pos + ln, "keyframe")
+        # Parse the trailers BEFORE decoding the embedded frame: a
+        # truncated trailer must not leave replaced state.
+        gen, trace = decode_trailers(blob, pos + ln, "keyframe")
         self.v, self.fields, self.cols = decode_wire_frame(blob[pos : pos + ln])
         self.generation = gen
+        self.trace = trace
         self.keyframes += 1
         return self._done(ts, seq, True)
 
@@ -825,9 +888,10 @@ class DeltaStreamDecoder:
                     blob, pos, nrows if is_full else len(idx), ctype
                 )
             pending.append((ci, is_full, vals))
-        gen = self._tail_generation(blob, pos, "delta frame")
+        gen, trace = decode_trailers(blob, pos, "delta frame")
         # Phase 2: apply.
         self.generation = gen
+        self.trace = trace
         for ci, is_full, vals in pending:
             if is_full:
                 self.cols[ci] = vals
@@ -875,17 +939,13 @@ _QRES_PARTIAL = 1
 _QRES_ERROR = 2
 
 
-def _query_tail_generation(blob: bytes, pos: int, what: str) -> int:
-    if pos == len(blob):
-        return 0
-    gen, pos = decode_varint(blob, pos)
-    if pos != len(blob):
-        raise ValueError(f"trailing bytes after {what}")
-    return gen
-
-
 def encode_query_request(
-    qid: int, expr: str, at: float, timeout_s: float, generation: int = 0
+    qid: int,
+    expr: str,
+    at: float,
+    timeout_s: float,
+    generation: int = 0,
+    trace: tuple[int, int, str] | None = None,
 ) -> bytes:
     out = bytearray(QUERY_REQ_MAGIC)
     out.append(QUERY_FRAME_VERSION)
@@ -894,14 +954,16 @@ def encode_query_request(
     out += struct.pack("<d", timeout_s)
     raw = expr.encode("utf-8")
     out += encode_varint(len(raw)) + raw
-    if generation > 0:
-        out += encode_varint(generation)
+    out += encode_trailers(generation, trace)
     return bytes(out)
 
 
-def decode_query_request(blob: bytes) -> tuple[int, str, float, float, int]:
-    """(qid, expr, at, timeout_s, generation); ValueError on anything
-    malformed. generation is 0 when the frame carries no trailer."""
+def decode_query_request(
+    blob: bytes,
+) -> tuple[int, str, float, float, int, tuple[int, int, str] | None]:
+    """(qid, expr, at, timeout_s, generation, trace); ValueError on
+    anything malformed. generation is 0 and trace None when the frame
+    carries no trailers."""
     if blob[: len(QUERY_REQ_MAGIC)] != QUERY_REQ_MAGIC:
         raise ValueError("bad query request magic")
     if len(blob) < 5:
@@ -917,8 +979,8 @@ def decode_query_request(blob: bytes) -> tuple[int, str, float, float, int]:
     if pos + ln > len(blob):
         raise ValueError("truncated query request expression")
     expr = blob[pos : pos + ln].decode("utf-8")
-    gen = _query_tail_generation(blob, pos + ln, "query request")
-    return qid, expr, at, timeout_s, gen
+    gen, trace = decode_trailers(blob, pos + ln, "query request")
+    return qid, expr, at, timeout_s, gen, trace
 
 
 def encode_query_result(
@@ -927,6 +989,7 @@ def encode_query_result(
     partial: bool = False,
     error: str | None = None,
     generation: int = 0,
+    trace: tuple[int, int, str] | None = None,
 ) -> bytes:
     import json as _json
 
@@ -940,14 +1003,16 @@ def encode_query_result(
     out += encode_varint(qid)
     out.append(flags)
     out += encode_varint(len(body)) + body
-    if generation > 0:
-        out += encode_varint(generation)
+    out += encode_trailers(generation, trace)
     return bytes(out)
 
 
-def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict, int]:
-    """(qid, partial, error, payload, generation); ValueError on
-    anything malformed. generation is 0 without a trailer."""
+def decode_query_result(
+    blob: bytes,
+) -> tuple[int, bool, str | None, dict, int, tuple[int, int, str] | None]:
+    """(qid, partial, error, payload, generation, trace); ValueError on
+    anything malformed. generation is 0 and trace None without
+    trailers."""
     import json as _json
 
     if blob[: len(QUERY_RES_MAGIC)] != QUERY_RES_MAGIC:
@@ -971,8 +1036,67 @@ def decode_query_result(blob: bytes) -> tuple[int, bool, str | None, dict, int]:
     if not isinstance(payload, dict):
         raise ValueError("query result payload must be an object")
     error = payload.get("error") if flags & _QRES_ERROR else None
-    gen = _query_tail_generation(blob, pos + ln, "query result")
-    return qid, bool(flags & _QRES_PARTIAL), error, payload, gen
+    gen, trace = decode_trailers(blob, pos + ln, "query result")
+    return qid, bool(flags & _QRES_PARTIAL), error, payload, gen, trace
+
+
+# ---------------------- trace span relay frames ------------------------
+#
+# Fleet tracing upload (ISSUE 19, tpumon.tracing / docs/observability.md
+# "Distributed tracing"): when tracing is enabled, each federation tier
+# interleaves a TPWS record into its ingest upload after the data frame
+# — its own completed remote-correlated spans for the tick (bounded by
+# the tracer outbox, never raw rings) plus its current clock-offset
+# table, which the root composes hop by hop to place every node's spans
+# on its own clock. TPWS only exists on upgraded, tracing-on links:
+# tracing off ⇒ the record is never written (zero wire bytes), and a
+# pre-trace hub that somehow receives one refuses the unknown magic and
+# drops the stream like any other corrupt record. Layout:
+#
+#   spans:  TPWS <u8 ver> varint len + utf-8 JSON
+#           {"node": sender, "spans": [...], "offsets": {node: ms}}
+#
+# JSON is fine here: span relay is low-rate (bounded per tick) and off
+# the hot decode path, unlike the columnar data frames above.
+
+TRACE_SPANS_MAGIC = b"TPWS"
+TRACE_SPANS_VERSION = 1
+TRACE_SPANS_MAX = 256 * 1024  # refuse implausible relay payloads
+
+
+def encode_trace_spans(payload: dict) -> bytes:
+    import json as _json
+
+    body = _json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if len(body) > TRACE_SPANS_MAX:
+        raise ValueError("trace span relay payload too large")
+    out = bytearray(TRACE_SPANS_MAGIC)
+    out.append(TRACE_SPANS_VERSION)
+    out += encode_varint(len(body)) + body
+    return bytes(out)
+
+
+def decode_trace_spans(blob: bytes) -> dict:
+    import json as _json
+
+    if blob[: len(TRACE_SPANS_MAGIC)] != TRACE_SPANS_MAGIC:
+        raise ValueError("bad trace span frame magic")
+    if len(blob) < 5:
+        raise ValueError("truncated trace span header")
+    if blob[4] != TRACE_SPANS_VERSION:
+        raise ValueError(f"unsupported trace span frame version {blob[4]}")
+    ln, pos = decode_varint(blob, 5)
+    if ln > TRACE_SPANS_MAX:
+        raise ValueError("implausible trace span payload")
+    if pos + ln != len(blob):
+        raise ValueError("truncated trace span payload")
+    try:
+        payload = _json.loads(blob[pos : pos + ln])
+    except ValueError as e:
+        raise ValueError(f"corrupt trace span payload: {e}")
+    if not isinstance(payload, dict):
+        raise ValueError("trace span payload must be an object")
+    return payload
 
 
 def decode_message(buf: bytes, max_depth: int = 16) -> Message:

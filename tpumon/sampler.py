@@ -1062,7 +1062,25 @@ class Sampler:
         tr = self.tracer
         with tr.span("tick_fast", cat="tick"):
             await self._run(self.host)
+            t_accel = time.perf_counter()
             await self._run(self.accel)
+            hub = self.federation
+            if hub is not None and hub.last_ingest_ctx is not None:
+                # fed.render (ISSUE 19): the hub-bearing tick that
+                # folded freshly-ingested downstream state into the
+                # published view, retrofitted onto the newest ingested
+                # frame's trace — the terminal span of that frame's
+                # leaf-to-here journey. Consumed once: quiet ticks must
+                # not chain renders onto a long-gone frame.
+                tid, psid = hub.last_ingest_ctx
+                hub.last_ingest_ctx = None
+                tr.record(
+                    "fed.render",
+                    t0=t_accel,
+                    dur_ms=(time.perf_counter() - t_accel) * 1e3,
+                    trace=tid,
+                    parent=psid,
+                )
             self._update_ici_rates(self.chips(), ts)
             with tr.span("history"):
                 self._record_history(ts)

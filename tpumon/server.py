@@ -114,7 +114,7 @@ from tpumon.query import QueryError
 from tpumon.sampler import Sampler
 from tpumon.snapshot import ExporterCache, RenderCache
 from tpumon.topology import attribute_pods, chips_to_wire
-from tpumon.tracing import quantiles
+from tpumon.tracing import parse_trace_header, quantiles
 
 WEB_DIR = os.path.join(os.path.dirname(__file__), "web")
 
@@ -526,6 +526,11 @@ class MonitorServer:
                     "fleet=1 needs federation_role aggregator|root "
                     "(this node has no downstream tree)",
                 )
+            # Opt this request's open http span into fleet tracing: the
+            # span gains a trace id (keeping one that arrived via
+            # X-Tpumon-Trace) and every TPWQ pushed below carries it —
+            # the whole fan-out becomes one cross-node trace.
+            self.sampler.tracer.ensure_trace()
             try:
                 payload = await hub.fleet_query(
                     src, at=at, timeout_s=self.cfg.query_fleet_timeout_s
@@ -909,13 +914,21 @@ class MonitorServer:
         auth: str | None = None,
         if_none_match: str | None = None,
         accept: str | None = None,
+        trace: str | None = None,
     ) -> tuple[int, str, bytes, dict]:
         """Route a request; returns (status, content_type, body,
         extra response headers). Every request is bracketed by an
         "http" span tagged with route/status/bytes and whether the
-        epoch render cache absorbed it."""
+        epoch render cache absorbed it. ``trace`` is a raw
+        ``X-Tpumon-Trace`` header value: when present (and parseable)
+        the span joins that fleet trace with a cross-node parent link,
+        so an HTTP hop between tpumon nodes is one tree with the
+        caller's spans."""
         tr = self.sampler.tracer
-        with tr.span("http", cat="http", track="http") as sp:
+        with tr.span(
+            "http", cat="http", track="http",
+            remote=parse_trace_header(trace),
+        ) as sp:
             try:
                 status, ctype, rbody, headers = await self._route(
                     method, path, query, body, auth, if_none_match, accept
@@ -1005,6 +1018,26 @@ class MonitorServer:
                 ctype="text/plain; version=0.0.4; charset=utf-8",
             )
 
+        if (
+            path == "/api/trace"
+            and parse_query(query).get("fleet") in ("1", "true")
+        ):
+            # Fleet assembly (ISSUE 19): the base self-trace payload
+            # plus the hub's federation block — per-origin freshness,
+            # clock offsets, and the cross-node span buffer shifted
+            # onto this node's clock. Uncached like the export: a
+            # debugging view whose value is being exactly current.
+            hub = getattr(self.sampler, "federation", None)
+            if hub is None:
+                raise HttpError(
+                    400,
+                    "fleet=1 needs federation_role aggregator|root "
+                    "(this node assembles no downstream spans)",
+                )
+            payload = self._api_trace()
+            payload["fleet"] = hub.fleet_trace_json()
+            return 200, "application/json", json.dumps(payload).encode(), {}
+
         cached = self._cached_routes.get(path)
         if cached is not None:
             sections, builder = cached
@@ -1065,8 +1098,22 @@ class MonitorServer:
         elif path == "/api/trace/export":
             # Perfetto/chrome://tracing-loadable dump of the span ring.
             # Not cached: the export is a debugging artifact fetched
-            # rarely, and its value is being exactly current.
-            payload = self.sampler.tracer.export_chrome()
+            # rarely, and its value is being exactly current. ?fleet=1
+            # adds the buffered remote spans, one Perfetto process
+            # track per node, clock-shifted via the hub's offsets.
+            if parse_query(query).get("fleet") in ("1", "true"):
+                hub = getattr(self.sampler, "federation", None)
+                if hub is None:
+                    raise HttpError(
+                        400,
+                        "fleet=1 needs federation_role aggregator|root "
+                        "(this node assembles no downstream spans)",
+                    )
+                payload = self.sampler.tracer.export_chrome(
+                    fleet=True, offsets=hub.clock_offsets
+                )
+            else:
+                payload = self.sampler.tracer.export_chrome()
         elif path == "/api/profile":
             self._check_auth(auth)  # capture burns device time; gate it
             payload = await self._api_profile(query)
@@ -1116,7 +1163,7 @@ class MonitorServer:
         # (POST bodies for the silence routes).
         content_length = 0
         origin = host_hdr = auth_hdr = inm_hdr = accept_hdr = None
-        conn_hdr = te_hdr = node_hdr = tier_hdr = None
+        conn_hdr = te_hdr = node_hdr = tier_hdr = trace_hdr = None
         while True:
             line = await asyncio.wait_for(reader.readline(), timeout=10)
             if line in (b"\r\n", b"\n", b""):
@@ -1145,6 +1192,8 @@ class MonitorServer:
                 node_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
             elif lower.startswith(b"x-tpumon-tier:"):
                 tier_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
+            elif lower.startswith(b"x-tpumon-trace:"):
+                trace_hdr = line.split(b":", 1)[1].strip().decode("latin-1")
         # Query stripped from routing (monitor_server.js:250) but kept
         # for the routes that take parameters (/api/profile).
         path, _, query = target.partition("?")
@@ -1196,6 +1245,7 @@ class MonitorServer:
             await hub.handle_ingest(
                 reader, writer, node=node_hdr, tier=tier_hdr,
                 chunked="chunked" in (te_hdr or "").lower(),
+                trace=parse_trace_header(trace_hdr),
             )
             return False
         if method not in ("GET", "HEAD", "POST"):
@@ -1234,7 +1284,7 @@ class MonitorServer:
         try:
             status, ctype, body, headers = await self.handle_ex(
                 method, path, query, req_body, auth=auth_hdr,
-                if_none_match=inm_hdr, accept=accept_hdr,
+                if_none_match=inm_hdr, accept=accept_hdr, trace=trace_hdr,
             )
         except HttpError as e:
             status, ctype = e.status, "application/json"

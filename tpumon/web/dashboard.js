@@ -310,6 +310,43 @@ function makeDashboard(doc, net, env, mkSurface) {
     }
   }
 
+  /* Fleet freshness waterfall (ISSUE 19, tpumon/federation.py): one
+     bar per origin node on the trace card — how long that node's
+     newest sample took to become visible HERE, clock-offset
+     corrected. Bars share one scale (the slowest node spans the
+     track); fed from the /api/federation payload's freshness block,
+     so it costs no extra fetch loop. */
+  function renderFleetWaterfall(fresh) {
+    const box = $("fleet-waterfall");
+    if (!box) return;
+    const names = fresh ? Object.keys(fresh).sort() : [];
+    if (!names.length) { box.style.display = "none"; return; }
+    box.style.display = "";
+    box.replaceChildren();
+    const head = doc.mk("div");
+    head.textContent = "fleet freshness · leaf sample → visible here";
+    box.appendChild(head);
+    let max = 0;
+    for (const n of names) max = Math.max(max, fresh[n].ms || 0);
+    for (const n of names) {
+      const ms = fresh[n].ms || 0;
+      const row = doc.mk("div"); row.className = "fw-row";
+      const lab = doc.mk("span"); lab.className = "fw-node";
+      lab.textContent = n;
+      lab.title = `via ${fresh[n].via || "?"} · offset ` +
+        `${(fresh[n].offset_ms ?? 0).toFixed(1)} ms`;
+      const track = doc.mk("span"); track.className = "fw-track";
+      const bar = doc.mk("i"); bar.className = "fw-bar";
+      bar.style.width =
+        (max > 0 ? Math.max(2, 100 * ms / max) : 0) + "%";
+      track.appendChild(bar);
+      const val = doc.mk("span"); val.className = "fw-ms";
+      val.textContent = ms.toFixed(0) + " ms";
+      row.append(lab, track, val);
+      box.appendChild(row);
+    }
+  }
+
   /* Polling fallback for the strip: when the SSE stream is down the
      rest of the page refreshes via fetch loops — the trace card must
      not freeze on the last streamed tick. /api/trace rides the epoch
@@ -750,6 +787,7 @@ function makeDashboard(doc, net, env, mkSurface) {
       // A fleet block means this node aggregates a downstream tree:
       // the hottest-chips query upgrades to distributed (fleet=1).
       topchipsFleet = !!fleet;
+      renderFleetWaterfall(res ? res.freshness : null);
       if (!res || (!fleet && !uplink)) {
         card.style.display = "none";
         return;
