@@ -1,0 +1,19 @@
+"""Known-bad serving exposition for the registry pass: one replica
+gauge family documented in docs/perf.md (stays clean), one ghost
+family no doc mentions (fires registry.metric-undocumented anchored
+here, not in exporter.py)."""
+
+
+def metrics_text(rows):
+    lines = []
+    for replica, slots in rows:
+        lines.append(
+            'tpumon_serving_replica_slots_available{replica="%s"} %d'
+            % (replica, slots))
+        lines.append(
+            'tpumon_serving_replica_ghost_gauge{replica="%s"} 1'
+            % replica)
+    # The family literals below are what the scanner keys on.
+    _ = "tpumon_serving_replica_slots_available"
+    _ = "tpumon_serving_replica_ghost_gauge"
+    return "\n".join(lines)

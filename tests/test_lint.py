@@ -154,6 +154,7 @@ def test_registry_checker_fires_on_fixture():
         ("registry.route-undocumented", "tpumon/server.py"),
         ("registry.bench-key-unproduced", "bench.py"),
         ("registry.metric-undocumented", "tpumon/exporter.py"),
+        ("registry.metric-undocumented", "tpumon/loadgen/serving.py"),
         ("registry.query-func-undocumented", "tpumon/query.py"),
         ("registry.query-func-phantom", "docs/query.md"),
         ("registry.trace-stage-undocumented", "tpumon/tracing.py"),
@@ -176,6 +177,11 @@ def test_registry_checker_fires_on_fixture():
     assert "tpumon_federation_freshness_ghost_ms" in msgs
     assert "fed.ghost_stage" in msgs and "fed.invented" in msgs
     assert "'fed.push'" not in msgs
+    # ISSUE 20: the per-replica serving gauge family is pinned to
+    # docs/perf.md — the ghost fires anchored in serving.py, while the
+    # documented family stays clean.
+    assert "tpumon_serving_replica_ghost_gauge" in msgs
+    assert "'tpumon_serving_replica_slots_available'" not in msgs
 
 
 # ---------------------------- suppressions ----------------------------

@@ -379,3 +379,142 @@ class TestConfigValidation:
             assert eng.cfg.admit_lookahead == 2
         finally:
             stop.set()
+
+
+# ---------------------------------------------------------------------------
+# dp×tp mesh golden matrix + ring-attention admission (mesh serving)
+# ---------------------------------------------------------------------------
+
+# tp=2 shards heads/vocab/ffn over the "model" axis, so every sharded
+# dim must divide 2 — same scale as MODEL with vocab 96, not prime 97.
+MESH_MODEL = dataclasses.replace(MODEL, vocab=96)
+
+
+def run_mesh_trace(dp: int, tp: int, **cfg_over) -> list[list[int]]:
+    from tpumon.loadgen.serving import make_serving_engine
+
+    eng = make_serving_engine(ServeConfig(
+        model=MESH_MODEL, slots=cfg_over.pop("slots", 2), prefill_len=8,
+        mesh_dp=dp, mesh_tp=tp, **cfg_over), seed=5)
+    reqs = [eng.submit(p, max_new=mx, temperature=t, top_k=k)
+            for p, mx, t, k in TRACE]
+    eng.drain()
+    assert all(r.done.is_set() for r in reqs)
+    return [r.output for r in reqs]
+
+
+class TestMeshGoldenMatrix:
+    """The golden contract across shard layouts: every request's
+    sampled stream is a pure function of (seed, prompt, params) — the
+    router owns the rid namespace and all replicas share seed/params,
+    so dp=1/tp=1, dp=2/tp=2 and dp=4/tp=1 emit BIT-IDENTICAL streams
+    (greedy AND seeded: TRACE carries both), across dense/paged
+    layouts and block/spec decode modes. CPU fake mesh (conftest
+    forces 8 host devices), f32."""
+
+    @pytest.fixture(scope="class")
+    def reference(self):
+        return run_mesh_trace(1, 1)
+
+    @pytest.mark.parametrize("dp,tp,over", [
+        (2, 2, dict()),
+        (4, 1, dict()),
+        (2, 2, dict(kv_layout="paged")),
+        (4, 1, dict(kv_layout="paged")),
+        (2, 2, dict(kv_layout="paged", decode_block=4)),
+        (4, 1, dict(decode_block=4)),
+        (2, 2, dict(kv_layout="paged", spec_len=2)),
+        (4, 1, dict(spec_len=2)),
+    ], ids=lambda v: ("-".join(f"{k}={x}" for k, x in v.items()) or "dense"
+                      if isinstance(v, dict) else str(v)))
+    def test_stream_matches_single_device(self, reference, dp, tp, over):
+        assert run_mesh_trace(dp, tp, **over) == reference
+
+
+class TestRingAdmission:
+    """Ring-attention engine mode (ServeConfig.ring_stripes): the
+    admission boundary moves from max_seq to stripes×max_seq, and the
+    admitted stream is bit-identical to an unsharded engine big enough
+    to hold the context flat."""
+
+    BASE = ServeConfig(model=MODEL, slots=2, prefill_len=8,
+                       kv_layout="paged")
+    LONG = [(7 * i + 3) % 97 for i in range(MODEL.max_seq + 20)]
+
+    def wide_ref(self, temperature=0.0, top_k=0):
+        wide = ServingEngine(dataclasses.replace(
+            self.BASE, model=dataclasses.replace(
+                MODEL, max_seq=2 * MODEL.max_seq)), seed=5)
+        r = wide.submit(self.LONG, max_new=4, temperature=temperature,
+                        top_k=top_k)
+        wide.drain()
+        return r.output
+
+    def test_flat_refuses_ring_admits_same_stream(self):
+        flat = ServingEngine(self.BASE, seed=5)
+        r = flat.submit(self.LONG, max_new=4)
+        assert r.status == "rejected" and r.output == []
+        ring = ServingEngine(dataclasses.replace(
+            self.BASE, ring_stripes=2), seed=5)
+        r2 = ring.submit(self.LONG, max_new=4)
+        ring.drain()
+        assert r2.status == "completed"
+        assert r2.output == self.wide_ref()
+
+    def test_ring_seeded_stream_matches_unsharded(self):
+        ring = ServingEngine(dataclasses.replace(
+            self.BASE, ring_stripes=2), seed=5)
+        r = ring.submit(self.LONG, max_new=4, temperature=1.0, top_k=8)
+        ring.drain()
+        assert r.status == "completed"
+        assert r.output == self.wide_ref(temperature=1.0, top_k=8)
+
+    def test_blockwise_ring_attend_matches_gather(self):
+        """paged_attn="ring" streams pages through the online-softmax
+        accumulator instead of one fused gather; greedy decode picks
+        the same tokens (the accumulation reassociates the reduction,
+        so this pins argmax agreement, not bitwise logits)."""
+        ring = ServingEngine(dataclasses.replace(
+            self.BASE, ring_stripes=2, paged_attn="ring"), seed=5)
+        r = ring.submit(self.LONG, max_new=4)
+        ring.drain()
+        assert r.status == "completed"
+        assert r.output == self.wide_ref()
+
+
+class TestMeshConfigValidation:
+    @pytest.mark.parametrize("over,msg", [
+        (dict(mesh_dp=0), "mesh_dp"),
+        (dict(mesh_dp=2), "MeshServingEngine"),
+        (dict(ring_stripes=1), "ring_stripes"),
+        (dict(ring_stripes=2), "paged"),  # dense has no pages
+        (dict(ring_stripes=2, kv_layout="paged", spec_len=2),
+         "speculative"),
+        (dict(ring_stripes=2, kv_layout="paged", paged_attn="kernel"),
+         "kernel"),
+        (dict(kv_layout="paged", paged_attn="ring", kv_dtype="int8"),
+         "ring"),
+    ])
+    def test_plain_engine_rejects(self, over, msg):
+        with pytest.raises(ValueError, match=msg):
+            ServingEngine(ServeConfig(model=MODEL, **over))
+
+    def test_mesh_shape_must_divide_device_count(self):
+        from tpumon.loadgen.serving import MeshServingEngine
+
+        # 8 fake devices (conftest): 3x1 neither fills nor tiles.
+        with pytest.raises(ValueError, match="divide"):
+            MeshServingEngine(ServeConfig(
+                model=MESH_MODEL, slots=2, prefill_len=8,
+                mesh_dp=3, mesh_tp=1))
+
+    def test_factory_picks_engine_shape(self):
+        from tpumon.loadgen.serving import (
+            MeshServingEngine, make_serving_engine)
+
+        cfg = ServeConfig(model=MESH_MODEL, slots=2, prefill_len=8)
+        assert isinstance(make_serving_engine(cfg), ServingEngine)
+        eng = make_serving_engine(
+            dataclasses.replace(cfg, mesh_dp=2, mesh_tp=1))
+        assert isinstance(eng, MeshServingEngine)
+        assert eng.replica_ids == ("r0", "r1")

@@ -184,12 +184,19 @@ def test_engine_long_prompt_decodes_correctly():
     assert r.output == seq[len(prompt):]
 
 
-def test_prompt_capped_at_max_seq():
+def test_prompt_over_max_seq_refused():
+    # Truncating would silently serve a DIFFERENT prompt; the refusal
+    # is exactly the admission boundary ring mode moves (ServeConfig
+    # .ring_stripes — the ring admission test in test_scheduler.py).
     eng = ServingEngine(cfg=CFG)
     r = eng.submit(list(range(100)), max_new=2)  # 100 > max_seq=32
-    eng.drain()
     assert r.done.is_set()
-    assert len(r.output) >= 1  # capped, served, no crash
+    assert r.status == "rejected"
+    assert r.output == []
+    # In-cap prompts are untouched by the refusal boundary.
+    ok = eng.submit(list(range(CFG.model.max_seq - 1)), max_new=0)
+    eng.drain()
+    assert ok.status == "completed"
 
 
 def test_engine_lifecycle_fuzz():

@@ -264,3 +264,84 @@ def test_moe_paged_spec_prompt_over_tp_mesh():
     eng, got = _moe_serve(mesh=mesh, **spec)
     assert got == ref  # the mesh axis in isolation
     assert eng.spec_rounds_total > 0
+
+
+class TestMeshEngineDrain:
+    """Actuation over the mesh engine (EngineActuator verbs hit dp
+    replica ids): a drained replica admits nothing, and its requeued
+    in-flight work replays bit-identical streams on other replicas."""
+
+    def _mesh_cfg(self, dp=2, tp=1):
+        import dataclasses
+
+        return dataclasses.replace(CFG, slots=2, mesh_dp=dp, mesh_tp=tp)
+
+    def test_drain_moves_work_and_streams_stay_bit_identical(self):
+        from tpumon.loadgen.serving import MeshServingEngine, ServingEngine
+
+        prompts = [[9, 4, 77, 3], [1, 2, 3], [5, 5, 5, 5, 5], [8, 1, 8]]
+
+        def submit_all(eng):
+            return [eng.submit(p, max_new=6,
+                               temperature=(1.0 if i == 1 else 0.0),
+                               top_k=(8 if i == 1 else 0))
+                    for i, p in enumerate(prompts)]
+
+        import dataclasses
+
+        single = ServingEngine(dataclasses.replace(CFG, slots=2), seed=7)
+        ref = submit_all(single)
+        single.drain()
+
+        eng = MeshServingEngine(self._mesh_cfg(), seed=7)
+        reqs = submit_all(eng)
+        for _ in range(2):  # some requests mid-flight on both replicas
+            eng.step()
+        eng.drain_slice("r0")
+        assert eng.drained_slices() == ("r0",)
+        # The drained replica holds nothing: queue empty, slots empty.
+        r0 = eng.replicas[0]
+        assert len(r0._queue) == 0
+        assert all(s is None for s in r0._slots)
+        # New work routes around the drained replica.
+        probe = eng.submit([4, 2], max_new=2)
+        assert len(r0._queue) == 0 and probe.status != "rejected"
+        eng.drain()
+        assert all(r.done.is_set() for r in reqs + [probe])
+        assert [r.output for r in reqs] == [r.output for r in ref]
+
+    def test_all_drained_rejects_then_undrain_recovers(self):
+        from tpumon.loadgen.serving import MeshServingEngine
+
+        eng = MeshServingEngine(self._mesh_cfg(), seed=7)
+        eng.drain_slice("r0")
+        eng.drain_slice("r1")
+        r = eng.submit([1, 2, 3], max_new=2)
+        assert r.status == "rejected" and r.done.is_set()
+        eng.undrain_slice("r1")
+        r2 = eng.submit([1, 2, 3], max_new=2)
+        eng.drain()
+        assert r2.status == "completed"
+        assert len(eng.replicas[0]._queue) == 0  # r1 served it
+
+    def test_engine_actuator_verbs_hit_replicas(self):
+        from tpumon.actuate import EngineActuator
+        from tpumon.loadgen.serving import MeshServingEngine
+
+        eng = MeshServingEngine(self._mesh_cfg(), seed=7)
+        act = EngineActuator(eng)
+        act.drain("r1")
+        assert eng.drained_slices() == ("r1",)
+        act.undrain("r1")
+        assert eng.drained_slices() == ()
+        assert act.shed("batch", 0.5) == 0.5
+        assert all(e.shed_fractions() == {"batch": 0.5}
+                   for e in eng.replicas)
+        act.unshed("batch")
+        got = act.nudge(prefill_budget=3)
+        assert got["prefill_budget"] == 3
+        assert all(e.cfg.prefill_chunk_budget == 3 for e in eng.replicas)
+        # set_slices prunes stale drain marks, replica-namespace style.
+        act.drain("r0")
+        act.set_slices(["r1"])
+        assert eng.drained_slices() == ()

@@ -28,6 +28,13 @@ declarative pass over every name registry the tree carries:
   tpumon/exporter.py must appear in README.md or docs/federation.md
   (``registry.metric-undocumented``) — the fleet gauges are an
   operator-facing contract, not an implementation detail;
+- serving replica gauges (ISSUE 20): every
+  ``tpumon_serving_replica_*`` family literal rendered by
+  tpumon/loadgen/serving.py must have a mention in docs/perf.md's
+  "Mesh serving" section or README.md (``registry.metric-undocumented``)
+  — the per-replica family feeds the ``serving.<replica>.*`` TSDB
+  series the SLO/actuation layers key on, so drift here silently
+  un-pins per-domain objectives;
 - query functions: every name in tpumon/query.py's function registry
   (``RANGE_FUNCTIONS`` + ``AGG_OPS``) must have a row in
   docs/query.md's "## Functions" table, and that table may not invent
@@ -61,10 +68,12 @@ BENCH = "bench.py"
 EXPORTER = "tpumon/exporter.py"
 QUERY = "tpumon/query.py"
 TRACING = "tpumon/tracing.py"
+SERVING = "tpumon/loadgen/serving.py"
 README = "README.md"
 EVENTS_DOC = "docs/events.md"
 FEDERATION_DOC = "docs/federation.md"
 QUERY_DOC = "docs/query.md"
+PERF_DOC = "docs/perf.md"
 SLO_DOC = "docs/slo.md"
 ACTUATION_DOC = "docs/actuation.md"
 OBSERVABILITY_DOC = "docs/observability.md"
@@ -82,6 +91,11 @@ ROUTE_RE = re.compile(r'"(/(?:api/[a-z0-9_/]+|metrics))"')
 # docs/observability.md — TABLE_ROW_RE's [a-z_]+ can't see the dot, and
 # prose mentions count as documentation the same way table rows do.
 FED_STAGE_RE = re.compile(r"`(fed\.[a-z_]+)`")
+# Per-replica serving gauge families rendered by the mesh engine —
+# plain string literals in serving.py (they are not exporter.py
+# gauge()/counter() registrations, so exporter_metric_families can't
+# see them).
+REPLICA_GAUGE_RE = re.compile(r'"(tpumon_serving_replica_[a-z0-9_]+)"')
 
 
 def _assign_targets(node: ast.AST) -> list[tuple[ast.AST, ast.AST]]:
@@ -366,6 +380,19 @@ def documented_trace_stages(project: Project) -> set[str]:
     if sf is None:
         return set()
     return set(FED_STAGE_RE.findall(sf.text))
+
+
+def serving_replica_families(project: Project) -> dict[str, int]:
+    """``tpumon_serving_replica_*`` family literals rendered by the
+    serving engine's exposition, with first-occurrence lines."""
+    sf = project.file(SERVING)
+    if sf is None:
+        return {}
+    out: dict[str, int] = {}
+    for m in REPLICA_GAUGE_RE.finditer(sf.text):
+        line = sf.text.count("\n", 0, m.start()) + 1
+        out.setdefault(m.group(1), line)
+    return out
 
 
 def exporter_metric_families(project: Project) -> dict[str, int]:
@@ -656,4 +683,24 @@ def check(project: Project) -> list[Finding]:
                         ),
                     )
                 )
+
+    # --- serving replica gauge family (ISSUE 20 satellite) --- rendered
+    # by the mesh engine's exposition, not exporter.py, so it gets its
+    # own scan; pinned to docs/perf.md's "Mesh serving" section (README
+    # accepted, same rule as every other family).
+    perf_doc = project.file(PERF_DOC)
+    perf_text = (perf_doc.text if perf_doc else "") + readme_text
+    for name, line in sorted(serving_replica_families(project).items()):
+        if name not in perf_text:
+            findings.append(
+                Finding(
+                    check="registry.metric-undocumented",
+                    path=SERVING,
+                    line=line,
+                    message=(
+                        f"serving replica family {name!r} is not "
+                        f"documented in {PERF_DOC} or README.md"
+                    ),
+                )
+            )
     return findings

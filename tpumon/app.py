@@ -357,6 +357,9 @@ def main(argv: list[str] | None = None) -> int:
     loadgen_scheduler = "interleaved"
     loadgen_prefill_budget = 1
     loadgen_admit_lookahead = 0
+    loadgen_mesh_dp = 1
+    loadgen_mesh_tp = 1
+    loadgen_ring_attn = 0
     it = iter(argv)
 
     def take(flag: str) -> str:
@@ -462,6 +465,25 @@ def main(argv: list[str] | None = None) -> int:
             # queue head (0 = strict FIFO; aging-bounded).
             loadgen_admit_lookahead = take_int(arg)
             serve_loadgen = True
+        elif arg == "--loadgen-mesh":
+            # "DP,TP": serve over a dp×tp device mesh — DP replicas
+            # behind the prefix-affinity router, each tensor-parallel
+            # over TP chips (docs/perf.md "Mesh serving").
+            raw = take(arg)
+            try:
+                loadgen_mesh_dp, loadgen_mesh_tp = (
+                    int(x) for x in raw.split(","))
+            except ValueError:
+                print(f"--loadgen-mesh wants DP,TP (two integers), "
+                      f"got {raw!r}", file=sys.stderr)
+                return 2
+            serve_loadgen = True
+        elif arg == "--loadgen-ring-attn":
+            # Ring-attention engine mode: admit prompts up to
+            # N × max_seq by paging KV block-wise around the tp ring
+            # (needs --loadgen-kv-layout paged; 0 = off).
+            loadgen_ring_attn = take_int(arg)
+            serve_loadgen = True
         elif arg == "--peers":
             # Comma-separated peer tpumon instances to federate
             # (docs/perf.md; also TPUMON_PEERS / config "peers").
@@ -560,6 +582,7 @@ def main(argv: list[str] | None = None) -> int:
                 "[--loadgen-scheduler interleaved|sequential] "
                 "[--loadgen-prefill-budget N] "
                 "[--loadgen-admit-lookahead N] "
+                "[--loadgen-mesh DP,TP] [--loadgen-ring-attn N] "
                 "[--peers host:port,...] [--peer-fanout N] "
                 "[--federate-up http://root-a:8888,http://root-b:8888] "
                 "[--federation-role leaf|aggregator|root] "
@@ -623,6 +646,8 @@ def main(argv: list[str] | None = None) -> int:
                 scheduler=loadgen_scheduler,
                 prefill_budget=loadgen_prefill_budget,
                 admit_lookahead=loadgen_admit_lookahead,
+                mesh_dp=loadgen_mesh_dp, mesh_tp=loadgen_mesh_tp,
+                ring_stripes=loadgen_ring_attn,
             )
         except ValueError as e:  # uncomposable/unknown engine options
             print(f"--serve-loadgen: {e}", file=sys.stderr)

@@ -258,6 +258,26 @@ def distill_serving_metrics(
                     row["error_rate"] = 0.0
         out["tenants"] = tenants
 
+    # Per-replica mesh-serving gauges (tpumon.loadgen.serving
+    # MeshServingEngine, docs/perf.md "Mesh serving"): one row per dp
+    # replica — free slots, router-assigned queue depth and the
+    # recent-window latency p95s — distilled verbatim so the sampler
+    # can land serving.<replica>.* TSDB series for per-replica SLOs
+    # and the actuation drain verbs.
+    replicas: dict[str, dict] = {}
+    for metric, field_name in (
+        ("tpumon_serving_replica_slots_available", "slots_available"),
+        ("tpumon_serving_replica_queue_size", "queue_depth"),
+        ("tpumon_serving_replica_ttft_p95_ms", "ttft_p95_ms"),
+        ("tpumon_serving_replica_tpot_p95_ms", "tpot_p95_ms"),
+    ):
+        for s in by_name.get(metric, ()):
+            replica = s.labels.get("replica")
+            if replica:
+                replicas.setdefault(replica, {})[field_name] = s.value
+    if replicas:
+        out["replicas"] = replicas
+
     # Training targets (tpumon_train_* families).
     for field_name, metric in TRAIN_GAUGES.items():
         got = _sum_samples(by_name, (metric,))

@@ -557,6 +557,43 @@ def _check_moe_tp(cfg: ModelConfig, mesh: Mesh) -> None:
             f"'model' axis ({tp}) — experts shard over it")
 
 
+def replica_meshes(dp: int, tp: int, dense: bool = False,
+                   devices=None) -> list:
+    """Carve the device set into ``dp`` disjoint tensor-parallel
+    submeshes for mesh serving (one per data-parallel replica). Each
+    entry is the replica's Mesh over its own ``tp`` contiguous devices
+    — contiguous so a replica's tp ring stays on neighboring chips
+    (ICI locality on real slices) — or None when tp == 1 (a plain
+    single-device engine needs no mesh at all). ``dense`` picks the
+    dense serving path's ("data", "model") axis names (a degenerate
+    data axis of 1: data parallelism lives at the replica level here,
+    never inside one engine); paged serving is tensor-parallel only
+    and uses a bare ("model",) axis. Raises ValueError when dp*tp
+    does not tile the device count — the caller's config error, named
+    here once so both CLIs report the same text."""
+    import numpy as np
+
+    devices = list(jax.devices() if devices is None else devices)
+    ndev = len(devices)
+    if dp < 1 or tp < 1:
+        raise ValueError(f"mesh shape dp×tp must be >= 1x1, got {dp}x{tp}")
+    if dp * tp > ndev or ndev % (dp * tp):
+        raise ValueError(
+            f"mesh shape dp×tp = {dp}x{tp} needs {dp * tp} devices but "
+            f"{ndev} are visible — dp*tp must divide the device count")
+    out = []
+    for d in range(dp):
+        devs = devices[d * tp:(d + 1) * tp]
+        if tp == 1:
+            out.append(None)
+        elif dense:
+            out.append(Mesh(np.array(devs).reshape(1, tp),
+                            ("data", "model")))
+        else:
+            out.append(Mesh(np.array(devs), ("model",)))
+    return out
+
+
 def make_sharded_train_step(cfg: ModelConfig, mesh: Mesh, params: dict):
     """jit the train step over a dp×tp mesh; returns (step_fn, placed_params).
 

@@ -263,6 +263,21 @@ def paged_decode_step(cfg, params: dict, pool: dict,
             out = paged_attention(q[:, 0], pool["k"][li], pool["v"][li],
                                   tables, lengths, interpret=interpret)
             return out[:, None]  # [B, 1, nh, hd]
+    elif getattr(cfg, "paged_attn", "gather") == "ring":
+        if "ks" in pool:
+            # Same failure mode as the kernel branch: the blockwise
+            # reader pages pool["k"]/pool["v"] raw, so an int8 pool
+            # would attend over undequantized garbage silently.
+            raise ValueError(
+                "paged_attn='ring' cannot read a quantized (int8) pool; "
+                "use the gather path or a compute-dtype pool"
+            )
+        from tpumon.loadgen.ring_attention import paged_ring_decode_attend
+
+        def attend(li, q, k, v):
+            scatter(li, k, v)
+            return paged_ring_decode_attend(
+                pool["k"][li], pool["v"][li], q, tables, positions)
 
     x = decoder_forward(cfg, params, last_tokens[:, None], pos, mask,
                         kv_update, attend=attend)
@@ -340,7 +355,8 @@ def paged_decode_rounds(cfg, params: dict, pool: dict,
                         last_tokens: jax.Array, positions: jax.Array,
                         tables: jax.Array, base_key: jax.Array,
                         rids: jax.Array, ctr0: jax.Array,
-                        temps: jax.Array, topks: jax.Array, steps: int):
+                        temps: jax.Array, topks: jax.Array, steps: int,
+                        seq_cap: int = 0):
     """``steps`` (paged_decode_step -> sample) pairs in ONE dispatch —
     the paged twin of serving.decode_rounds (rids/ctr0 carry each
     request's (id, next token index) for the schedule-independent
@@ -348,14 +364,19 @@ def paged_decode_rounds(cfg, params: dict, pool: dict,
     the whole request at admission, and trailing table entries point at
     the permanent trash page, so a block that overshoots a request's
     reserved rows writes harmlessly (the same guard that protects freed
-    slots). Returns (pool, last_tokens, positions, tokens [B, steps])."""
+    slots). ``seq_cap`` overrides the position clamp ceiling for ring
+    layouts whose tables span more than ``cfg.model.max_seq`` rows (0 =
+    the model's own max_seq). Returns (pool, last_tokens, positions,
+    tokens [B, steps])."""
     from tpumon.loadgen.serving import sample_tokens
+
+    cap = seq_cap or cfg.model.max_seq
 
     def body(carry, _):
         pool, last, pos, ctr = carry
         pool, logits = paged_decode_step(cfg, params, pool, last, pos, tables)
         nxt = sample_tokens(logits, base_key, rids, ctr, temps, topks)
-        pos = jnp.minimum(pos + 1, cfg.model.max_seq - 1)
+        pos = jnp.minimum(pos + 1, cap - 1)
         return (pool, nxt, pos, ctr + 1), nxt
 
     (pool, last, pos, _), toks = lax.scan(

@@ -33,14 +33,18 @@ def _block_attend(q, k, v, q_off, k_off, scale, causal, m, l, o):
     """One online-softmax accumulation step.
 
     q: [B, Tq, H, D], k/v: [B, Tk, H, D]; m/l: [B, H, Tq]; o like q.
-    q_off/k_off are the blocks' global sequence offsets (traced scalars).
+    q_off/k_off are the blocks' global sequence offsets (traced
+    scalars), except q_off may also be a [B] vector — per-slot decode
+    frontiers, the paged ring path — which masks per batch row.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        qpos = q_off + jnp.arange(tq)[:, None]
+        qpos = jnp.asarray(q_off)[..., None, None] + jnp.arange(tq)[:, None]
         kpos = k_off + jnp.arange(tk)[None, :]
-        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+        keep = qpos >= kpos  # [Tq, Tk] or [B, Tq, Tk]
+        keep = keep[None, None] if keep.ndim == 2 else keep[:, None]
+        s = jnp.where(keep, s, _NEG_INF)
     m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
     m_new = jnp.maximum(m, m_blk)
     # exp(-inf - -inf) guards: a fully-masked row keeps m_new == -inf;
@@ -69,6 +73,57 @@ def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(
         q.dtype
     )
+
+
+def paged_ring_decode_attend(pk: jax.Array, pv: jax.Array, q: jax.Array,
+                             tables: jax.Array, positions: jax.Array
+                             ) -> jax.Array:
+    """Single-query decode attention over a paged KV pool, pages
+    visited one block at a time with the online-softmax accumulator —
+    the engine's ring read path (``ServeConfig.paged_attn="ring"``).
+
+    On a real tp ring each logical page stripe lives on a different
+    chip and the blocks rotate over ICI (``ring_attend_inner``); here
+    the rotation is a ``lax.scan`` over the slot's page table — same
+    block order, same accumulation math, so the monitor-visible
+    traffic shape (one page-sized K/V read per visit instead of one
+    s_max-row gather) matches the ring schedule. Unlike the fused
+    gather-softmax this is NOT bitwise-equal to naive attention (the
+    online softmax reassociates the reduction); tests pin it to the
+    gather path by tolerance, never in the exact golden matrix.
+
+    pk/pv: [nkv, num_pages, ps, hd] (one layer of the pool);
+    q: [B, 1, nh, hd]; tables: [B, max_pages] page tables;
+    positions: [B] decode frontiers. Rows past a slot's frontier —
+    including every unreserved logical page, whose table entry still
+    points at the trash page — are masked per batch row via the [B]
+    ``q_off`` form of ``_block_attend``. Returns [B, 1, nh, hd] in
+    q's dtype.
+    """
+    nkv, _, ps, hd = pk.shape
+    b, _, nh, _ = q.shape
+    kv_rep = nh // nkv
+    scale = 1.0 / hd**0.5
+    m0 = jnp.full((b, nh, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nh, 1), jnp.float32)
+    o0 = jnp.zeros((b, 1, nh, hd), jnp.float32)
+
+    def visit(carry, page_ids):  # page_ids: [B], one logical page
+        m, l, o, k_off = carry
+        kb = pk[:, page_ids].transpose(1, 2, 0, 3)  # [B, ps, nkv, hd]
+        vb = pv[:, page_ids].transpose(1, 2, 0, 3)
+        if kv_rep > 1:
+            kb = jnp.repeat(kb, kv_rep, axis=2)
+            vb = jnp.repeat(vb, kv_rep, axis=2)
+        m, l, o = _block_attend(q, kb, vb, positions, k_off, scale,
+                                True, m, l, o)
+        return (m, l, o, k_off + ps), None
+
+    (_, l, o, _), _ = jax.lax.scan(
+        visit, (m0, l0, o0, jnp.int32(0)), tables.T)
+    # Every slot attends at least its own frontier row, so l >= the
+    # frontier's softmax weight > 0 — no masked-row zero guard needed.
+    return (o / l.swapaxes(1, 2)[..., None]).astype(q.dtype)
 
 
 def ring_attend_inner(

@@ -186,6 +186,34 @@ class ServeConfig:
     # head is force-next (lookahead suspends) so nothing starves.
     admit_lookahead: int = 0
     admit_max_skips: int = 8
+    # dp×tp mesh serving (MeshServingEngine): mesh_dp data-parallel
+    # replicas, each a full continuous-batching engine over its own
+    # mesh_tp-chip tensor-parallel submesh. Requests are admitted to a
+    # replica by the topology- and prefix-affinity-aware router
+    # (MeshServingEngine.submit); the PR 10 interleaved scheduler runs
+    # per replica unchanged, and every request's sampled stream stays a
+    # pure function of (seed, prompt, params) — bit-identical across
+    # shard layouts (tests/test_scheduler.py golden matrix). 1×1 = the
+    # plain single engine. dp*tp must divide the device count
+    # (validated where the config meets devices — MeshServingEngine).
+    mesh_dp: int = 1
+    mesh_tp: int = 1
+    # Ring-attention engine mode (0 = off, >= 2 = stripe count):
+    # long-context requests whose KV exceeds one chip's HBM stripe
+    # admit into a ring layout — the page table widens to
+    # ring_stripes × the flat capacity, stripe s owning page block s,
+    # and decode pages KV block-wise around the tp ring during
+    # attention (on the fake mesh the page gather IS the collect the
+    # ring's ppermute performs). Admission cap rises from max_seq-1 to
+    # ring_stripes*max_seq - 1 tokens; the paged kernels are
+    # table-width-driven, so the ring engine's math is IDENTICAL to a
+    # flat paged engine whose max_seq is the full ring capacity —
+    # which is what pins bit-identical streams vs unsharded
+    # (tests/test_scheduler.py ring admission test). Requires
+    # kv_layout="paged"; speculative decoding (dense draft cache is
+    # one stripe wide) and paged_attn="kernel" (geometry pinned to one
+    # stripe) do not compose.
+    ring_stripes: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -771,7 +799,7 @@ class ServingEngine:
                 f"{self.cfg.admit_max_skips}")
         if self.cfg.kv_dtype not in ("compute", "int8"):
             raise ValueError(f"unknown kv_dtype {self.cfg.kv_dtype!r}")
-        if self.cfg.paged_attn not in ("gather", "kernel"):
+        if self.cfg.paged_attn not in ("gather", "kernel", "ring"):
             raise ValueError(f"unknown paged_attn {self.cfg.paged_attn!r}")
         if self.cfg.paged_attn == "kernel" and (
                 self.cfg.kv_layout != "paged"
@@ -780,6 +808,44 @@ class ServingEngine:
                 "paged_attn='kernel' requires kv_layout='paged' with "
                 "kv_dtype='compute' (the Pallas kernel reads bf16/f32 "
                 "pages, not the int8 pool)")
+        if self.cfg.paged_attn == "ring" and (
+                self.cfg.kv_layout != "paged"
+                or self.cfg.kv_dtype == "int8"):
+            raise ValueError(
+                "paged_attn='ring' requires kv_layout='paged' with "
+                "kv_dtype='compute' (the blockwise ring accumulator "
+                "streams compute-dtype pages, not the int8 pool)")
+        if self.cfg.mesh_dp < 1 or self.cfg.mesh_tp < 1:
+            raise ValueError(
+                f"mesh_dp/mesh_tp must be >= 1, got "
+                f"{self.cfg.mesh_dp}x{self.cfg.mesh_tp}")
+        if self.cfg.mesh_dp * self.cfg.mesh_tp > 1:
+            raise ValueError(
+                "ServeConfig.mesh_dp/mesh_tp describe a dp×tp mesh "
+                "engine — construct a MeshServingEngine (or pass "
+                "--loadgen-mesh dp,tp), not a plain ServingEngine")
+        if self.cfg.ring_stripes:
+            if self.cfg.ring_stripes < 2:
+                raise ValueError(
+                    f"ring_stripes must be 0 (off) or >= 2, got "
+                    f"{self.cfg.ring_stripes} (one stripe IS the flat "
+                    "layout)")
+            if self.cfg.kv_layout != "paged":
+                raise ValueError(
+                    "ring_stripes requires kv_layout='paged' (ring mode "
+                    "pages KV block-wise around the tp ring — a dense "
+                    "cache has no pages to stripe)")
+            if self.cfg.spec_len:
+                raise ValueError(
+                    "ring_stripes does not compose with speculative "
+                    "decoding (the draft cache is one stripe wide; a "
+                    "ring-admitted context would overrun it)")
+            if self.cfg.paged_attn == "kernel":
+                raise ValueError(
+                    "ring_stripes does not compose with "
+                    "paged_attn='kernel' (the Pallas kernel's geometry "
+                    "is pinned to one chip's stripe); use the gather "
+                    "or ring read path")
         if self.cfg.kv_dtype == "int8" and (
                 mesh is not None
                 or ((self.cfg.spec_len or self.cfg.prefix_cache_entries)
@@ -791,6 +857,14 @@ class ServingEngine:
                 "decoding) — not with a mesh, or with the DENSE "
                 "layout's speculative/prefix cache surgery")
         m = self.cfg.model
+        # Ring-attention engine mode: the admission/position ceiling.
+        # Flat engines cap sequences at max_seq; ring engines stripe
+        # ring_stripes × max_seq KV rows around the tp ring, so every
+        # completion check, position clamp and the submit() refusal
+        # work against _seq_cap instead. The paged kernels derive all
+        # geometry from the page-table width, so widening the tables
+        # (below) is the ONLY device-side change ring mode needs.
+        self._seq_cap = max(1, self.cfg.ring_stripes or 1) * m.max_seq
         self.params = params if params is not None else init_params(
             m, jax.random.PRNGKey(seed))
         self.ckpt_step: int | None = None
@@ -955,7 +1029,10 @@ class ServingEngine:
             )
 
             p = self.cfg.prefill_len
-            self._max_pages = -(-m.max_seq // p)  # per-slot table width
+            # Per-slot table width: ring mode widens each slot's table
+            # to the full ring capacity (stripe s owns page block s of
+            # the row).
+            self._max_pages = -(-self._seq_cap // p)
             pool_pages = self.cfg.pool_pages or (
                 self.cfg.slots * self._max_pages + 1)
             if pool_pages < 2:
@@ -1004,7 +1081,8 @@ class ServingEngine:
                 from tpumon.loadgen.paged_kv import paged_decode_rounds
 
                 self._decode_rounds = jax.jit(
-                    partial(paged_decode_rounds, self.cfg),
+                    partial(paged_decode_rounds, self.cfg,
+                            seq_cap=self._seq_cap),
                     static_argnames=("steps",), donate_argnums=(1,))
             if mesh is not None:
                 self._shard_paged_jits(mesh)
@@ -1159,7 +1237,8 @@ class ServingEngine:
             out_shardings=(pool_sh, rep), donate_argnums=(1,))
         if self.cfg.decode_block > 1:
             _rounds = jax.jit(
-                partial(paged_decode_rounds, self.cfg),
+                partial(paged_decode_rounds, self.cfg,
+                        seq_cap=self._seq_cap),
                 in_shardings=(shardings, pool_sh,
                               rep, rep, rep, rep, rep, rep, rep, rep),
                 out_shardings=(pool_sh, rep, rep, rep),
@@ -1234,27 +1313,36 @@ class ServingEngine:
     def submit(self, prompt: list[int], max_new: int = 16,
                temperature: float = 0.0, top_k: int = 0,
                stream: bool = False,
-               stop_tokens: tuple = (), tenant: str = "") -> Request:
+               stop_tokens: tuple = (), tenant: str = "",
+               rid: int | None = None) -> Request:
         """Enqueue a request. When the queue is full the request is
         rejected immediately (done is set, output stays empty) — the
         backpressure a real serving frontend applies instead of letting
         latency grow without bound. temperature 0 = greedy; top_k 0 =
         full vocab. Prompts may exceed prefill_len — they run as chunked
-        prefill — but are capped at max_seq-1 (room for decode rows).
-        stream=True attaches a queue (req.stream) that receives each
-        token as it is emitted, None at end of stream."""
+        prefill — but a prompt over the engine's sequence capacity
+        (max_seq-1 rows flat; ring_stripes*max_seq - 1 in ring mode) is
+        REFUSED with status="rejected": truncating would silently serve
+        a different prompt, and the refusal is exactly the admission
+        boundary ring mode exists to move. stream=True attaches a queue
+        (req.stream) that receives each token as it is emitted, None at
+        end of stream. ``rid`` overrides the engine-local id — the mesh
+        router owns the rid namespace so streams stay pure functions of
+        (seed, prompt, params) regardless of which replica serves them."""
         m = self.cfg.model
         max_new = max(0, int(max_new))  # negatives would corrupt paged
         # reservation math and mean nothing in any mode
-        prompt = [t % m.vocab for t in prompt][: m.max_seq - 1]
-        req = Request(rid=next(self._rid), prompt=prompt or [0],
+        prompt = [t % m.vocab for t in prompt]
+        over_cap = len(prompt) > self._seq_cap - 1
+        req = Request(rid=rid if rid is not None else next(self._rid),
+                      prompt=prompt or [0],
                       max_new=max_new, enqueued=time.monotonic(),
                       temperature=float(temperature), top_k=int(top_k),
                       stream=queue.Queue() if stream else None,
                       stop_tokens=tuple(int(t) for t in stop_tokens),
                       tenant=str(tenant))
-        infeasible = self.paged and self._pages_needed(
-            req) > self.allocator.num_pages - 1
+        infeasible = over_cap or (self.paged and self._pages_needed(
+            req) > self.allocator.num_pages - 1)
         with self._lock:
             # Cancelled entries must not consume queue capacity.
             self._purge_cancelled_locked()
@@ -1392,6 +1480,50 @@ class ServingEngine:
         with self._lock:
             self.requeued_total += 1
             self._queue.appendleft(req)
+
+    # -- mesh-replica surface (MeshServingEngine) ---------------------------
+
+    def load(self) -> int:
+        """Queued + in-flight request count — the mesh router's
+        tie-break signal when no replica holds a cached prefix."""
+        with self._lock:
+            qd = len(self._queue)
+        return qd + sum(1 for s in self._slots if s is not None)
+
+    def prefix_hit_len(self, prompt: list[int]) -> int:
+        """Longest cached chunk-aligned prefix (tokens) this engine
+        already holds for ``prompt`` — side-effect-free (the router's
+        affinity probe must not touch hit/miss counters or LRU order).
+        0 with no prefix cache. Both cache kinds expose ``peek``; the
+        paged one returns (len, pages), the dense one the bare length."""
+        if self.prefix_cache is None:
+            return 0
+        got = self.prefix_cache.peek(prompt)
+        return int(got[0] if isinstance(got, tuple) else got)
+
+    def adopt(self, req: Request) -> None:
+        """Take ownership of an existing Request at the queue head —
+        the mesh drain path moves in-flight work between replicas
+        WITHOUT minting a new rid, so the re-run on the new replica
+        replays a bit-identical stream (sampling is keyed per
+        (rid, token index)). Counters were already charged by the
+        original submit/requeue, so adoption charges nothing."""
+        with self._lock:
+            self._queue.appendleft(req)
+
+    def evict_all(self) -> "list[Request]":
+        """Drain this engine for the mesh router: abort-and-requeue
+        every in-flight slot (the _requeue_slot replay contract — rid
+        and delivered-stream watermark preserved) and hand back the
+        whole queue, leaving the engine empty. The router re-routes
+        the returned requests to un-drained replicas."""
+        for slot in range(self.cfg.slots):
+            if self._slots[slot] is not None:
+                self._requeue_slot(slot)
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+        return out
 
     # -- engine loop --------------------------------------------------------
 
@@ -1566,10 +1698,11 @@ class ServingEngine:
         # flight: batched decode dispatches still compute this slot (and
         # write garbage K/V at its position), and a stale position could
         # land that garbage on a row an earlier chunk already filled.
-        # Row max_seq-1 is never a prompt row (prompts cap at max_seq-1
-        # tokens) and is legitimately rewritten in the same dispatch
-        # that first attends it, so garbage there is dead.
-        park = self.cfg.model.max_seq - 1
+        # The last capacity row (_seq_cap-1; max_seq-1 flat) is never a
+        # prompt row (prompts cap one short of capacity) and is
+        # legitimately rewritten in the same dispatch that first attends
+        # it, so garbage there is dead.
+        park = self._seq_cap - 1
         self.positions = self.positions.at[slot].set(park)
         self._host_positions[slot] = park
 
@@ -1819,7 +1952,7 @@ class ServingEngine:
                 and any(self._slots[s].temperature <= 0 for s in active)
                 and all(
                     self._host_positions[s]
-                    <= self.cfg.model.max_seq - 2 - self.spec_len
+                    <= self._seq_cap - 2 - self.spec_len
                     for s in active
                 )
             ):
@@ -1839,7 +1972,7 @@ class ServingEngine:
             self._decode_rounds is not None
             and n > 1
             and all(
-                self._host_positions[s] <= self.cfg.model.max_seq - 1 - n
+                self._host_positions[s] <= self._seq_cap - 1 - n
                 for s in active
             )
         ):
@@ -1859,7 +1992,7 @@ class ServingEngine:
         self.tok_ctrs = self.tok_ctrs + 1
         self.last_tokens = nxt
         self.positions = jnp.minimum(
-            self.positions + 1, self.cfg.model.max_seq - 1)
+            self.positions + 1, self._seq_cap - 1)
         # ONE host-device sync per step; positions tracked host-side.
         nxt_host = jax.device_get(nxt).tolist()
         self._host_last = list(nxt_host)
@@ -1871,11 +2004,11 @@ class ServingEngine:
             req.emit([nxt_host[slot]])
             self._host_positions[slot] = min(
                 self._host_positions[slot] + 1,
-                self.cfg.model.max_seq - 1)
+                self._seq_cap - 1)
             if (len(req.output) >= req.max_new + 1
                     or req.hit_stop()
                     or self._host_positions[slot]
-                    >= self.cfg.model.max_seq - 1):
+                    >= self._seq_cap - 1):
                 self._complete(slot)
 
     def _block_step(self, active: list[int], n: int) -> None:
@@ -1917,11 +2050,11 @@ class ServingEngine:
                 emitted += 1
                 self._host_positions[slot] = min(
                     self._host_positions[slot] + 1,
-                    self.cfg.model.max_seq - 1)
+                    self._seq_cap - 1)
                 if (len(req.output) >= req.max_new + 1
                         or req.hit_stop()
                         or self._host_positions[slot]
-                        >= self.cfg.model.max_seq - 1):
+                        >= self._seq_cap - 1):
                     self._complete(slot)
                     break
         self._host_last = [row[-1] for row in toks_host]
@@ -2083,7 +2216,7 @@ class ServingEngine:
             if (len(req.output) >= req.max_new + 1
                     or req.hit_stop()
                     or self._host_positions[slot]
-                    >= self.cfg.model.max_seq - 1):
+                    >= self._seq_cap - 1):
                 self._complete(slot)
         self.positions = jnp.asarray(self._host_positions, jnp.int32)
         self.last_tokens = jnp.asarray(self._host_last, jnp.int32)
@@ -2107,134 +2240,51 @@ class ServingEngine:
 
     # -- metrics ------------------------------------------------------------
 
-    def metrics_text(self) -> str:
+    def _stats_snapshot(self) -> dict:
+        """Raw metrics state as one mergeable dict — counters under the
+        lock, latency windows as plain lists, per-tenant series with
+        their observation times intact. ``metrics_text`` renders one
+        snapshot; MeshServingEngine sums its replicas' snapshots
+        (_merge_serving_snapshots) and renders ONCE, so the federation
+        of dp replicas exposes a single coherent /metrics page plus the
+        per-replica gauge family."""
         with self._lock:
-            tokens = self.tokens_total
-            requests = self.requests_total
-            completed = self.completed_total
-            steps = self.decode_steps_total
-            queue = len(self._queue)
-            rejected = self.rejected_total
-            cancelled = self.cancelled_total
-            shed = self.shed_total
-            requeued = self.requeued_total
-            counts = list(self._ttft_counts)
-            inf = self._ttft_inf
-            ttft_sum = self._ttft_sum
-            free = sum(1 for s in self._slots if s is None)
-            in_prefill = sum(
-                1 for w in self._prefill_work if w is not None)
-            ttft_recent = list(self._ttft_recent)
-            tpot_recent = list(self._tpot_recent)
-            spec_rounds = self.spec_rounds_total
-            spec_proposed = self.spec_proposed_total
-            spec_accepted = self.spec_accepted_total
-            now_mono = time.monotonic()
-            tw = self.tenant_window_s
-            tenant_rows = [
-                (
-                    name,
-                    st.submitted, st.completed, st.rejected,
-                    st.cancelled, st.shed, st.tokens,
-                    st.recent(st.ttft, tw, now_mono),
-                    st.recent(st.tpot, tw, now_mono),
-                )
-                for name, st in sorted(self.tenants.items())
-            ]
-        w = MetricsWriter()
-        w.counter("jetstream_generate_tokens",
-                  "tokens generated (prefill first-token + decode)"
-                  ).add(value=tokens)
-        w.counter("jetstream_request_count", "requests submitted"
-                  ).add(value=requests)
-        w.counter("tpumon_serving_requests_completed", "requests finished"
-                  ).add(value=completed)
-        w.counter("tpumon_serving_requests_rejected",
-                  "requests dropped by queue backpressure"
-                  ).add(value=rejected)
-        w.counter("tpumon_serving_requests_cancelled",
-                  "requests cancelled before their first token "
-                  "(while queued or mid-prefill)"
-                  ).add(value=cancelled)
-        w.counter("tpumon_serving_requests_shed",
-                  "requests shed at admission by the actuation layer "
-                  "(tpumon.actuate; a remedial drop, never an error)"
-                  ).add(value=shed)
-        w.counter("tpumon_serving_requests_requeued",
-                  "in-flight requests aborted and re-admitted by a "
-                  "slice drain (tpumon.actuate)"
-                  ).add(value=requeued)
-        w.counter("tpumon_serving_decode_steps", "fused decode steps"
-                  ).add(value=steps)
-        w.gauge("jetstream_queue_size", "requests waiting for a slot"
-                ).add(value=queue)
-        w.gauge("jetstream_slots_available", "free decode slots"
-                ).add(value=free)
-        w.gauge("tpumon_serving_slots_prefill",
-                "slots mid-chunked-prefill (admitted, not yet decoding)"
-                ).add(value=in_prefill)
-        # Per-request latency quantiles over a recent window
-        # (tracing.quantiles — one sort per render): TTFT from enqueue
-        # to first token, TPOT decode seconds per token after it.
-        from tpumon.tracing import quantiles
-
-        for fam, series, unit in (
-            ("tpumon_serving_ttft", ttft_recent, 1e3),
-            ("tpumon_serving_tpot", tpot_recent, 1e3),
-        ):
-            q = quantiles(series)
-            if q is not None:
-                w.gauge(fam + "_p50_ms",
-                        "recent-window per-request p50"
-                        ).add(value=round(q[0] * unit, 3))
-                w.gauge(fam + "_p95_ms",
-                        "recent-window per-request p95"
-                        ).add(value=round(q[1] * unit, 3))
-        if tenant_rows:
-            # Per-tenant serving signals (tpumon.loadgen.traffic): the
-            # SLO engine's inputs. Counters are lifetime (the collector
-            # derives windowed goodput/error rates from scrape deltas);
-            # latency quantiles cover the tenant_window_s recency
-            # window, so a recovered tenant's p95 actually recovers.
-            reqs = w.counter("tpumon_serving_tenant_requests",
-                             "requests submitted per tenant")
-            comp = w.counter("tpumon_serving_tenant_completed",
-                             "requests finished per tenant")
-            rej = w.counter("tpumon_serving_tenant_rejected",
-                            "requests dropped by backpressure per tenant")
-            canc = w.counter("tpumon_serving_tenant_cancelled",
-                             "requests cancelled per tenant")
-            shd = w.counter("tpumon_serving_tenant_shed",
-                            "requests shed at admission per tenant "
-                            "(excluded from error-rate math — a shed "
-                            "is the remedy, not the fault)")
-            toks = w.counter("tpumon_serving_tenant_tokens",
-                             "tokens emitted per tenant")
-            tg: dict[str, object] = {}
-            for fam in ("tpumon_serving_tenant_ttft_p50_ms",
-                        "tpumon_serving_tenant_ttft_p95_ms",
-                        "tpumon_serving_tenant_tpot_p50_ms",
-                        "tpumon_serving_tenant_tpot_p95_ms"):
-                tg[fam] = w.gauge(
-                    fam, "recent-window per-tenant latency quantile")
-            for (name, sub, done, rj, cn, sh, tk, ttfts, tpots) in tenant_rows:
-                labels = {"tenant": name}
-                reqs.add(labels, sub)
-                comp.add(labels, done)
-                rej.add(labels, rj)
-                canc.add(labels, cn)
-                shd.add(labels, sh)
-                toks.add(labels, tk)
-                for fam_base, series in (
-                    ("tpumon_serving_tenant_ttft", ttfts),
-                    ("tpumon_serving_tenant_tpot", tpots),
-                ):
-                    q = quantiles(series)
-                    if q is not None:
-                        tg[fam_base + "_p50_ms"].add(
-                            labels, round(q[0] * 1e3, 3))
-                        tg[fam_base + "_p95_ms"].add(
-                            labels, round(q[1] * 1e3, 3))
+            snap = {
+                "tokens": self.tokens_total,
+                "requests": self.requests_total,
+                "completed": self.completed_total,
+                "steps": self.decode_steps_total,
+                "queue": len(self._queue),
+                "rejected": self.rejected_total,
+                "cancelled": self.cancelled_total,
+                "shed": self.shed_total,
+                "requeued": self.requeued_total,
+                "ttft_counts": list(self._ttft_counts),
+                "ttft_inf": self._ttft_inf,
+                "ttft_sum": self._ttft_sum,
+                "free": sum(1 for s in self._slots if s is None),
+                "in_prefill": sum(
+                    1 for w in self._prefill_work if w is not None),
+                "ttft_recent": list(self._ttft_recent),
+                "tpot_recent": list(self._tpot_recent),
+                "spec_rounds": self.spec_rounds_total,
+                "spec_proposed": self.spec_proposed_total,
+                "spec_accepted": self.spec_accepted_total,
+                "tenant_window_s": self.tenant_window_s,
+                "tenants": {
+                    name: {
+                        "submitted": st.submitted,
+                        "completed": st.completed,
+                        "rejected": st.rejected,
+                        "cancelled": st.cancelled,
+                        "shed": st.shed,
+                        "tokens": st.tokens,
+                        "ttft": list(st.ttft),
+                        "tpot": list(st.tpot),
+                    }
+                    for name, st in self.tenants.items()
+                },
+            }
         from tpumon.loadgen.quant import QTensor, param_bytes
 
         weight_bytes = param_bytes(self.params)
@@ -2251,50 +2301,547 @@ class ServingEngine:
                 x.nbytes
                 for x in jax.tree.leaves(self.draft_params, is_leaf=_is_q)
                 if id(x) not in target_ids)
-        w.gauge("tpumon_serving_weight_bytes",
-                "resident model weight bytes (int8 when quantized)"
-                ).add(value=weight_bytes)
-        w.counter("tpumon_serving_spec_rounds",
-                  "speculative decode rounds (0 when disabled)"
-                  ).add(value=spec_rounds)
-        w.counter("tpumon_serving_spec_proposed",
-                  "draft tokens proposed").add(value=spec_proposed)
-        w.counter("tpumon_serving_spec_accepted",
-                  "draft tokens the target verify accepted"
-                  ).add(value=spec_accepted)
+        snap["weight_bytes"] = weight_bytes
         if self.paged:
-            w.gauge("tpumon_serving_kv_pages_total",
-                    "shared KV pool pages (excl. the trash page)"
-                    ).add(value=self.allocator.num_pages - 1)
-            w.gauge("tpumon_serving_kv_pages_free",
-                    "KV pool pages not reserved by admitted requests"
-                    ).add(value=self.allocator.free_pages)
+            snap["kv_pages_total"] = self.allocator.num_pages - 1
+            snap["kv_pages_free"] = self.allocator.free_pages
+        else:
+            snap["kv_pages_total"] = snap["kv_pages_free"] = None
         if self.prefix_cache is not None:
             pc = self.prefix_cache
-            w.counter("tpumon_serving_prefix_hits",
-                      "admissions served a cached prompt prefix"
-                      ).add(value=pc.hits)
-            w.counter("tpumon_serving_prefix_misses",
-                      "admissions with no cached prefix").add(value=pc.misses)
-            w.counter("tpumon_serving_prefix_saved_tokens",
-                      "prompt tokens whose prefill was skipped"
-                      ).add(value=pc.saved_tokens)
-            w.gauge("tpumon_serving_prefix_bytes",
-                    "HBM pinned by cached prefix K/V"
-                    ).add(value=pc.resident_bytes())
-        lines = [w.render().rstrip("\n")]
-        lines.append("# TYPE jetstream_time_to_first_token histogram")
-        cum = 0
-        for bound, c in zip(TTFT_BUCKETS_S, counts):
-            cum += c
-            lines.append(
-                f'jetstream_time_to_first_token_bucket{{le="{bound}"}} {cum}')
-        total = cum + inf
+            snap["prefix"] = {
+                "hits": pc.hits, "misses": pc.misses,
+                "saved_tokens": pc.saved_tokens,
+                "bytes": pc.resident_bytes(),
+            }
+        else:
+            snap["prefix"] = None
+        return snap
+
+    def metrics_text(self) -> str:
+        return _render_serving_metrics(self._stats_snapshot())
+
+
+def _merge_serving_snapshots(snaps: "list[dict]") -> dict:
+    """Sum dp-replica snapshots into one fleet snapshot: counters and
+    gauge counts add, latency windows concatenate (quantiles are
+    order-independent), per-tenant series merge with observation times
+    intact so the recency window still applies."""
+    out = dict(snaps[0])
+    out["tenants"] = {
+        name: dict(row, ttft=list(row["ttft"]), tpot=list(row["tpot"]))
+        for name, row in snaps[0]["tenants"].items()
+    }
+    for s in snaps[1:]:
+        for k in ("tokens", "requests", "completed", "steps", "queue",
+                  "rejected", "cancelled", "shed", "requeued", "ttft_inf",
+                  "ttft_sum", "free", "in_prefill", "spec_rounds",
+                  "spec_proposed", "spec_accepted", "weight_bytes"):
+            out[k] += s[k]
+        out["ttft_counts"] = [
+            a + b for a, b in zip(out["ttft_counts"], s["ttft_counts"])]
+        for k in ("kv_pages_total", "kv_pages_free"):
+            if s[k] is not None:
+                out[k] = (out[k] or 0) + s[k]
+        out["ttft_recent"] = out["ttft_recent"] + s["ttft_recent"]
+        out["tpot_recent"] = out["tpot_recent"] + s["tpot_recent"]
+        if s["prefix"] is not None:
+            if out["prefix"] is None:
+                out["prefix"] = dict(s["prefix"])
+            else:
+                out["prefix"] = {
+                    k: out["prefix"][k] + v for k, v in s["prefix"].items()}
+        for name, row in s["tenants"].items():
+            mine = out["tenants"].get(name)
+            if mine is None:
+                out["tenants"][name] = dict(
+                    row, ttft=list(row["ttft"]), tpot=list(row["tpot"]))
+                continue
+            for k in ("submitted", "completed", "rejected", "cancelled",
+                      "shed", "tokens"):
+                mine[k] += row[k]
+            mine["ttft"] = list(mine["ttft"]) + list(row["ttft"])
+            mine["tpot"] = list(mine["tpot"]) + list(row["tpot"])
+    return out
+
+
+def _render_serving_metrics(snap: dict,
+                            replica_rows: "list[tuple] | None" = None
+                            ) -> str:
+    """Render one (possibly merged) stats snapshot as the /metrics
+    exposition. ``replica_rows`` — (replica, slots_free, queue,
+    ttft_p95_ms, tpot_p95_ms) per dp replica — adds the
+    ``tpumon_serving_replica_*`` gauge family the mesh engine exposes
+    (docs/perf.md "Mesh serving"); None omits the family entirely."""
+    tokens = snap["tokens"]
+    requests = snap["requests"]
+    completed = snap["completed"]
+    steps = snap["steps"]
+    queue = snap["queue"]
+    rejected = snap["rejected"]
+    cancelled = snap["cancelled"]
+    shed = snap["shed"]
+    requeued = snap["requeued"]
+    counts = snap["ttft_counts"]
+    inf = snap["ttft_inf"]
+    ttft_sum = snap["ttft_sum"]
+    free = snap["free"]
+    in_prefill = snap["in_prefill"]
+    ttft_recent = snap["ttft_recent"]
+    tpot_recent = snap["tpot_recent"]
+    spec_rounds = snap["spec_rounds"]
+    spec_proposed = snap["spec_proposed"]
+    spec_accepted = snap["spec_accepted"]
+    now_mono = time.monotonic()
+    tw = snap["tenant_window_s"]
+    tenant_rows = [
+        (
+            name,
+            row["submitted"], row["completed"], row["rejected"],
+            row["cancelled"], row["shed"], row["tokens"],
+            [v for t, v in row["ttft"] if now_mono - t <= tw],
+            [v for t, v in row["tpot"] if now_mono - t <= tw],
+        )
+        for name, row in sorted(snap["tenants"].items())
+    ]
+    w = MetricsWriter()
+    w.counter("jetstream_generate_tokens",
+              "tokens generated (prefill first-token + decode)"
+              ).add(value=tokens)
+    w.counter("jetstream_request_count", "requests submitted"
+              ).add(value=requests)
+    w.counter("tpumon_serving_requests_completed", "requests finished"
+              ).add(value=completed)
+    w.counter("tpumon_serving_requests_rejected",
+              "requests dropped by queue backpressure"
+              ).add(value=rejected)
+    w.counter("tpumon_serving_requests_cancelled",
+              "requests cancelled before their first token "
+              "(while queued or mid-prefill)"
+              ).add(value=cancelled)
+    w.counter("tpumon_serving_requests_shed",
+              "requests shed at admission by the actuation layer "
+              "(tpumon.actuate; a remedial drop, never an error)"
+              ).add(value=shed)
+    w.counter("tpumon_serving_requests_requeued",
+              "in-flight requests aborted and re-admitted by a "
+              "slice drain (tpumon.actuate)"
+              ).add(value=requeued)
+    w.counter("tpumon_serving_decode_steps", "fused decode steps"
+              ).add(value=steps)
+    w.gauge("jetstream_queue_size", "requests waiting for a slot"
+            ).add(value=queue)
+    w.gauge("jetstream_slots_available", "free decode slots"
+            ).add(value=free)
+    w.gauge("tpumon_serving_slots_prefill",
+            "slots mid-chunked-prefill (admitted, not yet decoding)"
+            ).add(value=in_prefill)
+    # Per-request latency quantiles over a recent window
+    # (tracing.quantiles — one sort per render): TTFT from enqueue
+    # to first token, TPOT decode seconds per token after it.
+    from tpumon.tracing import quantiles
+
+    for fam, series, unit in (
+        ("tpumon_serving_ttft", ttft_recent, 1e3),
+        ("tpumon_serving_tpot", tpot_recent, 1e3),
+    ):
+        q = quantiles(series)
+        if q is not None:
+            w.gauge(fam + "_p50_ms",
+                    "recent-window per-request p50"
+                    ).add(value=round(q[0] * unit, 3))
+            w.gauge(fam + "_p95_ms",
+                    "recent-window per-request p95"
+                    ).add(value=round(q[1] * unit, 3))
+    if tenant_rows:
+        # Per-tenant serving signals (tpumon.loadgen.traffic): the
+        # SLO engine's inputs. Counters are lifetime (the collector
+        # derives windowed goodput/error rates from scrape deltas);
+        # latency quantiles cover the tenant_window_s recency
+        # window, so a recovered tenant's p95 actually recovers.
+        reqs = w.counter("tpumon_serving_tenant_requests",
+                         "requests submitted per tenant")
+        comp = w.counter("tpumon_serving_tenant_completed",
+                         "requests finished per tenant")
+        rej = w.counter("tpumon_serving_tenant_rejected",
+                        "requests dropped by backpressure per tenant")
+        canc = w.counter("tpumon_serving_tenant_cancelled",
+                         "requests cancelled per tenant")
+        shd = w.counter("tpumon_serving_tenant_shed",
+                        "requests shed at admission per tenant "
+                        "(excluded from error-rate math — a shed "
+                        "is the remedy, not the fault)")
+        toks = w.counter("tpumon_serving_tenant_tokens",
+                         "tokens emitted per tenant")
+        tg: dict[str, object] = {}
+        for fam in ("tpumon_serving_tenant_ttft_p50_ms",
+                    "tpumon_serving_tenant_ttft_p95_ms",
+                    "tpumon_serving_tenant_tpot_p50_ms",
+                    "tpumon_serving_tenant_tpot_p95_ms"):
+            tg[fam] = w.gauge(
+                fam, "recent-window per-tenant latency quantile")
+        for (name, sub, done, rj, cn, sh, tk, ttfts, tpots) in tenant_rows:
+            labels = {"tenant": name}
+            reqs.add(labels, sub)
+            comp.add(labels, done)
+            rej.add(labels, rj)
+            canc.add(labels, cn)
+            shd.add(labels, sh)
+            toks.add(labels, tk)
+            for fam_base, series in (
+                ("tpumon_serving_tenant_ttft", ttfts),
+                ("tpumon_serving_tenant_tpot", tpots),
+            ):
+                q = quantiles(series)
+                if q is not None:
+                    tg[fam_base + "_p50_ms"].add(
+                        labels, round(q[0] * 1e3, 3))
+                    tg[fam_base + "_p95_ms"].add(
+                        labels, round(q[1] * 1e3, 3))
+    w.gauge("tpumon_serving_weight_bytes",
+            "resident model weight bytes (int8 when quantized)"
+            ).add(value=snap["weight_bytes"])
+    w.counter("tpumon_serving_spec_rounds",
+              "speculative decode rounds (0 when disabled)"
+              ).add(value=spec_rounds)
+    w.counter("tpumon_serving_spec_proposed",
+              "draft tokens proposed").add(value=spec_proposed)
+    w.counter("tpumon_serving_spec_accepted",
+              "draft tokens the target verify accepted"
+              ).add(value=spec_accepted)
+    if snap["kv_pages_total"] is not None:
+        w.gauge("tpumon_serving_kv_pages_total",
+                "shared KV pool pages (excl. the trash page)"
+                ).add(value=snap["kv_pages_total"])
+        w.gauge("tpumon_serving_kv_pages_free",
+                "KV pool pages not reserved by admitted requests"
+                ).add(value=snap["kv_pages_free"])
+    if snap["prefix"] is not None:
+        pc = snap["prefix"]
+        w.counter("tpumon_serving_prefix_hits",
+                  "admissions served a cached prompt prefix"
+                  ).add(value=pc["hits"])
+        w.counter("tpumon_serving_prefix_misses",
+                  "admissions with no cached prefix").add(value=pc["misses"])
+        w.counter("tpumon_serving_prefix_saved_tokens",
+                  "prompt tokens whose prefill was skipped"
+                  ).add(value=pc["saved_tokens"])
+        w.gauge("tpumon_serving_prefix_bytes",
+                "HBM pinned by cached prefix K/V"
+                ).add(value=pc["bytes"])
+    if replica_rows is not None:
+        # Mesh-engine per-replica gauge family (docs/perf.md "Mesh
+        # serving"): the collector distills these into per-replica
+        # TSDB series so the SLO engine can target one dp replica.
+        rg = {}
+        for fam, help_ in (
+            ("tpumon_serving_replica_slots_available",
+             "free decode slots per dp replica"),
+            ("tpumon_serving_replica_queue_size",
+             "requests waiting per dp replica (router-assigned)"),
+            ("tpumon_serving_replica_ttft_p95_ms",
+             "recent-window TTFT p95 per dp replica"),
+            ("tpumon_serving_replica_tpot_p95_ms",
+             "recent-window TPOT p95 per dp replica"),
+        ):
+            rg[fam] = w.gauge(fam, help_)
+        for (replica, slots_free, rq, ttft_p95, tpot_p95) in replica_rows:
+            labels = {"replica": replica}
+            rg["tpumon_serving_replica_slots_available"].add(
+                labels, slots_free)
+            rg["tpumon_serving_replica_queue_size"].add(labels, rq)
+            if ttft_p95 is not None:
+                rg["tpumon_serving_replica_ttft_p95_ms"].add(
+                    labels, round(ttft_p95, 3))
+            if tpot_p95 is not None:
+                rg["tpumon_serving_replica_tpot_p95_ms"].add(
+                    labels, round(tpot_p95, 3))
+    lines = [w.render().rstrip("\n")]
+    lines.append("# TYPE jetstream_time_to_first_token histogram")
+    cum = 0
+    for bound, c in zip(TTFT_BUCKETS_S, counts):
+        cum += c
         lines.append(
-            f'jetstream_time_to_first_token_bucket{{le="+Inf"}} {total}')
-        lines.append(f"jetstream_time_to_first_token_sum {ttft_sum:.6f}")
-        lines.append(f"jetstream_time_to_first_token_count {total}")
-        return "\n".join(lines) + "\n"
+            f'jetstream_time_to_first_token_bucket{{le="{bound}"}} {cum}')
+    total = cum + inf
+    lines.append(
+        f'jetstream_time_to_first_token_bucket{{le="+Inf"}} {total}')
+    lines.append(f"jetstream_time_to_first_token_sum {ttft_sum:.6f}")
+    lines.append(f"jetstream_time_to_first_token_count {total}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# dp×tp mesh serving: replicated engines behind an affinity router
+# ---------------------------------------------------------------------------
+
+
+class MeshServingEngine:
+    """Production-shape sharded serving: ``mesh_dp`` data-parallel
+    replicas — each a plain ServingEngine running the PR 10 interleaved
+    scheduler UNCHANGED over its own ``mesh_tp``-chip tensor-parallel
+    submesh (model.replica_meshes) — behind a topology- and
+    prefix-affinity-aware router.
+
+    Routing policy (docs/perf.md "Mesh serving"): a request goes to the
+    replica with the LONGEST cached prefix for its prompt (the replica
+    already holding those KV pages skips that prefill), ties broken by
+    least load (queued + in-flight), then lowest replica index — the
+    index order is ICI-locality order, replica_meshes carves contiguous
+    device ranges. The router owns the rid namespace (children take
+    ``submit(rid=...)``), so every request's sampled stream stays a
+    pure function of (seed, prompt, params): sampling is keyed per
+    (rid, token index) and all replicas share seed and params —
+    dp=1/tp=1, dp=2/tp=2 and dp=4/tp=1 produce bit-identical streams
+    (the golden matrix in tests/test_scheduler.py pins this).
+
+    Placement-domain surface (tpumon.actuate): the dp replica ids
+    ("r0".."r<dp-1>") ARE the placement domains — ``drain_slice("r1")``
+    stops admission to that replica and moves its queued + in-flight
+    work to live replicas via the PR 14 requeue path (rid and
+    delivered-stream watermark preserved, so re-runs replay
+    bit-identically). With every replica drained the router REJECTS new
+    work — backpressure a client can see and retry beats silently
+    un-draining a replica an operator just drained."""
+
+    def __init__(self, cfg: ServeConfig | None = None,
+                 params: dict | None = None, seed: int = 0,
+                 max_queue: int = 64, ckpt_dir: str | None = None,
+                 quantize: str | None = None,
+                 draft_params: dict | None = None,
+                 devices=None):
+        from tpumon.loadgen.model import replica_meshes
+
+        self.cfg = cfg or default_engine_config()
+        dp, tp = self.cfg.mesh_dp, self.cfg.mesh_tp
+        # replica_meshes validates the shape against the device count
+        # (the satellite-6 ValueError both CLIs surface verbatim).
+        meshes = replica_meshes(dp, tp, dense=self.cfg.kv_layout != "paged",
+                                devices=devices)
+        child_cfg = dc_replace(self.cfg, mesh_dp=1, mesh_tp=1)
+        self.replica_ids: tuple[str, ...] = tuple(
+            f"r{d}" for d in range(dp))
+        # Children share (seed, params): identical weights on every
+        # replica is the bit-identical-stream precondition. With
+        # params=None each child re-inits from the SAME PRNG seed, so
+        # the replicas still agree leaf-for-leaf.
+        self.replicas: list[ServingEngine] = [
+            ServingEngine(cfg=child_cfg, params=params, seed=seed,
+                          max_queue=max_queue, ckpt_dir=ckpt_dir,
+                          quantize=quantize, draft_params=draft_params,
+                          mesh=meshes[d])
+            for d in range(dp)
+        ]
+        self._rid = itertools.count()
+        self._lock = threading.Lock()
+        self._drained: set[str] = set()
+        self.slices: tuple[str, ...] = self.replica_ids
+        self.router_rejected = 0
+
+    # -- admission / routing ------------------------------------------------
+
+    def _live(self) -> "list[int]":
+        with self._lock:
+            drained = set(self._drained)
+        return [i for i, rid_ in enumerate(self.replica_ids)
+                if rid_ not in drained]
+
+    def _route(self, prompt: list[int], live: "list[int]") -> ServingEngine:
+        best_i = live[0]
+        best = (-self.replicas[best_i].prefix_hit_len(prompt),
+                self.replicas[best_i].load())
+        for i in live[1:]:
+            eng = self.replicas[i]
+            key = (-eng.prefix_hit_len(prompt), eng.load())
+            if key < best:
+                best, best_i = key, i
+        return self.replicas[best_i]
+
+    def submit(self, prompt: list[int], max_new: int = 16,
+               temperature: float = 0.0, top_k: int = 0,
+               stream: bool = False, stop_tokens=(),
+               tenant: str = "", rid: int | None = None) -> Request:
+        """Route one request to a dp replica (affinity → load → index)
+        and submit it there with a router-minted rid. Same contract as
+        ServingEngine.submit; with every replica drained the request is
+        rejected here (visible backpressure, never a silent admit to a
+        drained replica)."""
+        live = self._live()
+        if not live:
+            req = Request(
+                rid=rid if rid is not None else next(self._rid),
+                prompt=[t % self.cfg.model.vocab for t in prompt] or [0],
+                max_new=max(0, int(max_new)), enqueued=time.monotonic(),
+                temperature=float(temperature), top_k=int(top_k),
+                stream=queue.Queue() if stream else None,
+                stop_tokens=tuple(int(t) for t in stop_tokens),
+                tenant=str(tenant))
+            with self._lock:
+                self.router_rejected += 1
+            req.status = "rejected"
+            req.finish_stream()
+            req.done.set()
+            return req
+        eng = self._route(list(prompt), live)
+        return eng.submit(prompt, max_new=max_new, temperature=temperature,
+                          top_k=top_k, stream=stream,
+                          stop_tokens=stop_tokens, tenant=tenant,
+                          rid=rid if rid is not None else next(self._rid))
+
+    # -- engine loop --------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler step on every replica (drained replicas
+        included: their remaining in-flight work — the evict below is
+        best-effort when no live replica exists — must still finish).
+        True if any replica made progress."""
+        progressed = False
+        for eng in self.replicas:
+            progressed = eng.step() or progressed
+        return progressed
+
+    def drain(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if not self.step():
+                return
+
+    # -- actuation surface (tpumon.actuate) ---------------------------------
+
+    def set_shed(self, tenant: str, fraction: float) -> float:
+        got = 0.0
+        for eng in self.replicas:
+            got = eng.set_shed(tenant, fraction)
+        return got
+
+    def shed_fractions(self) -> dict[str, float]:
+        return self.replicas[0].shed_fractions()
+
+    def nudge_capacity(self, prefill_budget: int | None = None,
+                       admit_lookahead: int | None = None) -> dict:
+        out: dict = {}
+        for eng in self.replicas:
+            out = eng.nudge_capacity(prefill_budget=prefill_budget,
+                                     admit_lookahead=admit_lookahead)
+        return out
+
+    def set_slices(self, names) -> None:
+        """The placement-domain namespace here is the replica ids —
+        fixed at construction. A sync (tpumon.actuate._sync_domains,
+        fed replica ids by the sampler when a mesh engine is bound)
+        only prunes drain marks for names that no longer exist, exactly
+        like ServingEngine.set_slices."""
+        with self._lock:
+            self.slices = tuple(str(n) for n in names)
+            self._drained &= set(self.slices)
+
+    def drain_slice(self, name: str) -> None:
+        """Drain one dp replica: the router stops admitting to it and
+        its queued + in-flight requests move to live replicas via the
+        PR 14 requeue path (abort, re-admit with rid and stream
+        watermark preserved — the re-run replays bit-identically).
+        With no live replica left the work stays put (and finishes
+        where it is): liveness beats placement purity."""
+        name = str(name)
+        with self._lock:
+            self._drained.add(name)
+        if name not in self.replica_ids:
+            return
+        live = self._live()
+        if not live:
+            return
+        evicted = self.replicas[self.replica_ids.index(name)].evict_all()
+        # adopt() pushes at the queue HEAD; reversed iteration keeps
+        # the evicted order (requeued in-flight first, then the queue)
+        # intact on each receiving replica.
+        for req in reversed(evicted):
+            target = min(live, key=lambda i: self.replicas[i].load())
+            self.replicas[target].adopt(req)
+
+    def undrain_slice(self, name: str) -> None:
+        with self._lock:
+            self._drained.discard(str(name))
+
+    def drained_slices(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._drained))
+
+    # -- shared-surface passthroughs ----------------------------------------
+
+    @property
+    def paged(self) -> bool:
+        return self.replicas[0].paged
+
+    @property
+    def params(self) -> dict:
+        return self.replicas[0].params
+
+    @property
+    def prefix_cache(self):
+        return self.replicas[0].prefix_cache
+
+    @property
+    def reporter(self):
+        return self.replicas[0].reporter
+
+    @reporter.setter
+    def reporter(self, value) -> None:
+        for eng in self.replicas:
+            eng.reporter = value
+
+    @property
+    def tokens_total(self) -> int:
+        return sum(e.tokens_total for e in self.replicas)
+
+    @property
+    def requests_total(self) -> int:
+        return sum(e.requests_total for e in self.replicas)
+
+    @property
+    def completed_total(self) -> int:
+        return sum(e.completed_total for e in self.replicas)
+
+    @property
+    def rejected_total(self) -> int:
+        return self.router_rejected + sum(
+            e.rejected_total for e in self.replicas)
+
+    @property
+    def requeued_total(self) -> int:
+        return sum(e.requeued_total for e in self.replicas)
+
+    # -- metrics ------------------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """One merged /metrics page for the whole mesh — fleet counters
+        are sums, latency quantiles pool every replica's recent window
+        — plus the tpumon_serving_replica_* per-replica gauge family
+        the collector distills into serving.<replica>.* TSDB series."""
+        from tpumon.tracing import quantiles
+
+        snaps = [eng._stats_snapshot() for eng in self.replicas]
+        rows = []
+        for rid_, snap in zip(self.replica_ids, snaps):
+            tq = quantiles(snap["ttft_recent"])
+            pq = quantiles(snap["tpot_recent"])
+            rows.append((rid_, snap["free"], snap["queue"],
+                         None if tq is None else tq[1] * 1e3,
+                         None if pq is None else pq[1] * 1e3))
+        merged = _merge_serving_snapshots(snaps)
+        with self._lock:
+            merged["rejected"] += self.router_rejected
+        return _render_serving_metrics(merged, replica_rows=rows)
+
+
+def make_serving_engine(cfg: ServeConfig | None = None, **kw):
+    """Build the engine the config asks for: a MeshServingEngine when
+    mesh_dp×mesh_tp describes a real mesh, a plain ServingEngine
+    otherwise. One seam so both CLIs (and tests) pick the engine shape
+    from ServeConfig alone."""
+    cfg = cfg or default_engine_config()
+    if cfg.mesh_dp * cfg.mesh_tp > 1:
+        return MeshServingEngine(cfg=cfg, **kw)
+    kw.pop("devices", None)
+    return ServingEngine(cfg=cfg, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -2533,7 +3080,9 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                      spec_source: str = "draft",
                      scheduler: str = "interleaved",
                      prefill_budget: int = 1,
-                     admit_lookahead: int = 0):
+                     admit_lookahead: int = 0,
+                     mesh_dp: int = 1, mesh_tp: int = 1,
+                     ring_stripes: int = 0):
     """Run the serving loadgen inside this process: engine loop in a
     daemon thread + /metrics endpoint. Returns (engine, url, stop_event).
     Used by ``python -m tpumon --serve-loadgen`` so one command runs the
@@ -2546,7 +3095,9 @@ def start_background(rps: float = 0.5, max_new: int = 16,
                         or spec_source != "draft"
                         or scheduler != "interleaved"
                         or prefill_budget != 1
-                        or admit_lookahead != 0):
+                        or admit_lookahead != 0
+                        or mesh_dp != 1 or mesh_tp != 1
+                        or ring_stripes != 0):
         import dataclasses
 
         # Keep the checkpoint-architecture adoption the engine would do
@@ -2567,8 +3118,10 @@ def start_background(rps: float = 0.5, max_new: int = 16,
             decode_block=decode_block, kv_dtype=kv_dtype,
             paged_attn=paged_attn, spec_source=spec_source,
             scheduler=scheduler, prefill_chunk_budget=prefill_budget,
-            admit_lookahead=admit_lookahead)
-    engine = ServingEngine(cfg=cfg, ckpt_dir=ckpt_dir, quantize=quantize)
+            admit_lookahead=admit_lookahead,
+            mesh_dp=mesh_dp, mesh_tp=mesh_tp, ring_stripes=ring_stripes)
+    engine = make_serving_engine(cfg=cfg, ckpt_dir=ckpt_dir,
+                                 quantize=quantize)
     server, bound = start_metrics_server(engine, port=port)
     stop = threading.Event()
 
@@ -2633,11 +3186,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="paged pool size in pages (0 = dense "
                          "equivalent; smaller = real memory savings "
                          "with admission backpressure)")
-    ap.add_argument("--paged-attn", choices=["gather", "kernel"],
+    ap.add_argument("--paged-attn", choices=["gather", "kernel", "ring"],
                     default="gather",
-                    help="paged decode read path: XLA fused gather or "
+                    help="paged decode read path: XLA fused gather, "
                          "the Pallas paged-attention kernel (regime "
-                         "map in ops/paged_attention)")
+                         "map in ops/paged_attention), or blockwise "
+                         "ring attention paging KV page-by-page "
+                         "(long-context ring layouts)")
+    ap.add_argument("--mesh", default=None, metavar="DP,TP",
+                    help="serve over a dp×tp device mesh: DP "
+                         "data-parallel replicas behind the affinity "
+                         "router, each tensor-parallel over TP chips "
+                         "(docs/perf.md 'Mesh serving')")
+    ap.add_argument("--ring-attn", type=int, default=0, metavar="N",
+                    help="ring-attention engine mode: admit prompts up "
+                         "to N x max_seq by paging KV block-wise "
+                         "around the tp ring (requires --kv-layout "
+                         "paged; 0 = off)")
     ap.add_argument("--scheduler", choices=["interleaved", "sequential"],
                     default="interleaved",
                     help="admission scheduler: interleaved chunked "
@@ -2685,6 +3250,21 @@ def main(argv: list[str] | None = None) -> int:
             args.kv_layout != "paged" or args.kv_dtype == "int8"):
         ap.error("--paged-attn kernel requires --kv-layout paged with "
                  "--kv-dtype compute (the kernel reads bf16/f32 pages)")
+    mesh_dp = mesh_tp = 1
+    if args.mesh is not None:
+        try:
+            mesh_dp, mesh_tp = (int(x) for x in args.mesh.split(","))
+        except ValueError:
+            ap.error(f"--mesh wants DP,TP (two integers), got "
+                     f"{args.mesh!r}")
+        if mesh_dp < 1 or mesh_tp < 1:
+            ap.error(f"--mesh shape must be >= 1,1, got {args.mesh}")
+    if args.ring_attn and args.ring_attn < 2:
+        ap.error("--ring-attn N needs N >= 2 stripes (1 stripe IS the "
+                 "flat layout; pass 0 to disable)")
+    if args.ring_attn and args.kv_layout != "paged":
+        ap.error("--ring-attn requires --kv-layout paged (the ring "
+                 "pages KV block-wise; a dense cache has no pages)")
 
     import dataclasses
 
@@ -2693,17 +3273,25 @@ def main(argv: list[str] | None = None) -> int:
                         n_experts=args.experts)
     draft = (dataclasses.replace(model, n_layers=args.spec_draft_layers)
              if args.spec_draft_layers else None)
-    engine = ServingEngine(cfg=ServeConfig(
-        model=model, slots=args.slots, prefill_len=32, quantize=args.quant,
-        spec_len=args.spec_len, draft_model=draft,
-        spec_source=args.spec_source,
-        prefix_cache_entries=args.prefix_cache,
-        kv_layout=args.kv_layout, pool_pages=args.pool_pages,
-        decode_block=args.decode_block, kv_dtype=args.kv_dtype,
-        paged_attn=args.paged_attn, scheduler=args.scheduler,
-        prefill_chunk_budget=args.prefill_budget,
-        admit_lookahead=args.admit_lookahead,
-    ))
+    try:
+        engine = make_serving_engine(cfg=ServeConfig(
+            model=model, slots=args.slots, prefill_len=32,
+            quantize=args.quant,
+            spec_len=args.spec_len, draft_model=draft,
+            spec_source=args.spec_source,
+            prefix_cache_entries=args.prefix_cache,
+            kv_layout=args.kv_layout, pool_pages=args.pool_pages,
+            decode_block=args.decode_block, kv_dtype=args.kv_dtype,
+            paged_attn=args.paged_attn, scheduler=args.scheduler,
+            prefill_chunk_budget=args.prefill_budget,
+            admit_lookahead=args.admit_lookahead,
+            mesh_dp=mesh_dp, mesh_tp=mesh_tp,
+            ring_stripes=args.ring_attn,
+        ))
+    except ValueError as e:
+        # Mesh shapes that don't divide the device count, ring modes
+        # that don't compose — config errors, reported as such.
+        ap.error(str(e))
     server, port = start_metrics_server(engine, args.port)
     print(f"serving loadgen: /metrics on :{port} "
           f"(point TPUMON_SERVING_TARGETS=http://127.0.0.1:{port}/metrics)")

@@ -336,11 +336,21 @@ class Sampler:
     def _placement_domains(self) -> list[str] | None:
         """ALL fleet placement domains — dark or not — the actuation
         engine syncs into the serving engine (set_slices) so requests
-        carry a slice attribution before any drain fires. Federated:
-        the hub's slice namespace (the same names `_dark_slices`
-        reports, so drain targets always match). Standalone: the local
-        accel topology's slice ids. None/[] = nothing known yet (the
-        engine keeps its last synced namespace)."""
+        carry a slice attribution before any drain fires. A bound MESH
+        serving engine overrides everything: its dp replica ids ARE the
+        placement domains (``drain_slice("r1")`` must hit a replica the
+        router actually routes around, not a topology slice name the
+        mesh knows nothing about). Otherwise federated: the hub's slice
+        namespace (the same names `_dark_slices` reports, so drain
+        targets always match). Standalone: the local accel topology's
+        slice ids. None/[] = nothing known yet (the engine keeps its
+        last synced namespace)."""
+        act = self.actuate
+        eng = (getattr(act.actuator, "engine", None)
+               if act is not None and act.actuator is not None else None)
+        replica_ids = getattr(eng, "replica_ids", None)
+        if replica_ids:
+            return list(replica_ids)
         hub = self.federation
         if hub is not None:
             return sorted({
@@ -836,6 +846,25 @@ class Sampler:
         for (tenant, key), vals in tenant_vals.items():
             agg = sum if key == "goodput_rps" else max
             add((handle(f"serving.{tenant}.{key}"), agg(vals)))
+        # Per-replica serving series (mesh serving, docs/perf.md "Mesh
+        # serving"): serving.<replica>.* rides the same serving.<label>
+        # naming contract as the tenant series (replica ids r0..rN are
+        # dot-free by construction), so the SLO engine can hold one dp
+        # replica to its own objective. Latency/queue worst-of-targets,
+        # free slots summed.
+        replica_vals: dict[tuple[str, str], list[float]] = {}
+        for s in serving:
+            for rep, row in (s.get("replicas") or {}).items():
+                if "." in rep or not rep:
+                    continue
+                for key in ("ttft_p95_ms", "tpot_p95_ms",
+                            "queue_depth", "slots_available"):
+                    v = row.get(key)
+                    if v is not None:
+                        replica_vals.setdefault((rep, key), []).append(v)
+        for (rep, key), vals in replica_vals.items():
+            agg = sum if key == "slots_available" else max
+            add((handle(f"serving.{rep}.{key}"), agg(vals)))
         if batch:
             self.history.record_batch(batch, ts=ts)
         self._journal_out_of_order()
